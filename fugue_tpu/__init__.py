@@ -1,0 +1,8 @@
+"""fugue-tpu: a TPU-native framework for distributed computation.
+
+A unified interface where plain Python/pandas/arrow functions and SQL run
+unchanged across execution engines — with JAX/XLA over TPU device meshes as
+the first-class distributed engine. See SURVEY.md for the blueprint.
+"""
+
+__version__ = "0.1.0"

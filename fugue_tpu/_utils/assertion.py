@@ -1,0 +1,33 @@
+"""Tiny assertion helpers used across the framework.
+
+In-tree replacement for the reference's dependency on
+``triad.utils.assertion`` (see SURVEY.md §0 — triad must be rebuilt in-tree).
+"""
+
+from typing import Any, Callable, Union
+
+
+def assert_or_throw(
+    cond: bool, exc: Union[None, str, Exception, Callable[[], Any]] = None
+) -> None:
+    """Raise when ``cond`` is falsy.
+
+    ``exc`` may be a message (→ ``AssertionError``), an exception instance,
+    or a zero-arg callable producing either (lazily evaluated so building the
+    message is free on the happy path).
+    """
+    if cond:
+        return
+    if callable(exc):
+        exc = exc()
+    if exc is None:
+        raise AssertionError()
+    if isinstance(exc, Exception):
+        raise exc
+    raise AssertionError(str(exc))
+
+
+def assert_arg_not_none(obj: Any, arg_name: str = "") -> None:
+    if obj is None:
+        msg = f"{arg_name} can't be None" if arg_name else "argument can't be None"
+        raise ValueError(msg)

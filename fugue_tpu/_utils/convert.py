@@ -1,0 +1,121 @@
+"""Object/name conversion utilities.
+
+In-tree replacement for the triad convert helpers the reference relies on to
+resolve string references (class/function names) against the *caller's*
+scope — the mechanism behind ``transform(df, "my_func")`` style usage.
+"""
+
+import importlib
+import inspect
+from typing import Any, Callable, Dict, Optional, Tuple, Type, get_type_hints
+
+
+def get_caller_global_local_vars(
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+    start: int = -1,
+    end: int = -1,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Capture globals/locals of the first caller frame outside this package."""
+    if global_vars is not None or local_vars is not None:
+        return global_vars or {}, local_vars or {}
+    g: Dict[str, Any] = {}
+    l: Dict[str, Any] = {}
+    frame = inspect.currentframe()
+    try:
+        f = frame.f_back if frame is not None else None
+        while f is not None:
+            mod = f.f_globals.get("__name__", "")
+            if not mod.startswith("fugue_tpu"):
+                g = dict(f.f_globals)
+                l = dict(f.f_locals)
+                break
+            f = f.f_back
+    finally:
+        del frame
+    return g, l
+
+
+def _resolve_name(
+    name: str,
+    global_vars: Optional[Dict[str, Any]],
+    local_vars: Optional[Dict[str, Any]],
+) -> Any:
+    if local_vars is not None and name in local_vars:
+        return local_vars[name]
+    if global_vars is not None and name in global_vars:
+        return global_vars[name]
+    if "." in name:
+        mod_name, _, attr = name.rpartition(".")
+        try:
+            mod = importlib.import_module(mod_name)
+            return getattr(mod, attr)
+        except (ImportError, AttributeError):
+            pass
+    try:
+        import builtins
+
+        return getattr(builtins, name)
+    except AttributeError:
+        raise ValueError(f"can't resolve {name!r}")
+
+
+def to_type(
+    obj: Any,
+    base: Type = object,
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+) -> Type:
+    if isinstance(obj, str):
+        obj = _resolve_name(obj, global_vars, local_vars)
+    if inspect.isclass(obj):
+        if not issubclass(obj, base):
+            raise TypeError(f"{obj} is not a subclass of {base}")
+        return obj
+    if isinstance(obj, base):
+        return type(obj)
+    raise TypeError(f"can't convert {obj!r} to a type of {base}")
+
+
+def to_instance(
+    obj: Any,
+    base: Type = object,
+    args: Optional[list] = None,
+    kwargs: Optional[dict] = None,
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+) -> Any:
+    if isinstance(obj, base) and not inspect.isclass(obj):
+        return obj
+    tp = to_type(obj, base, global_vars, local_vars)
+    return tp(*(args or []), **(kwargs or {}))
+
+
+def to_function(
+    obj: Any,
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+) -> Callable:
+    if isinstance(obj, str):
+        obj = _resolve_name(obj, global_vars, local_vars)
+    if inspect.isclass(obj):
+        raise TypeError(f"{obj} is a class, not a function")
+    if callable(obj):
+        return obj
+    raise TypeError(f"{obj!r} is not callable")
+
+
+def get_full_type_path(obj: Any) -> str:
+    if inspect.isclass(obj) or inspect.isfunction(obj):
+        return f"{obj.__module__}.{obj.__qualname__}"
+    return f"{type(obj).__module__}.{type(obj).__qualname__}"
+
+
+def annotation_of(func: Callable, param: Optional[str]) -> Any:
+    """Resolved annotation of a param (or the return when param is None)."""
+    try:
+        hints = get_type_hints(func)
+    except Exception:
+        hints = getattr(func, "__annotations__", {}) or {}
+    key = "return" if param is None else param
+    return hints.get(key, inspect.Parameter.empty)

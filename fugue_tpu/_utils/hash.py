@@ -1,0 +1,57 @@
+"""Deterministic uuid hashing for workflow determinism.
+
+The reference derives stable task ids from specs via triad's ``to_uuid``
+(reference usage: ``fugue/workflow/_tasks.py:85-98``). Determinism across
+processes and runs is what makes deterministic checkpoints possible, so this
+implementation only uses stable representations (no ``id()``, no ``hash()``).
+"""
+
+import uuid
+from hashlib import md5
+from typing import Any
+
+
+def _feed(h: Any, obj: Any) -> None:
+    if obj is None:
+        h.update(b"\x00N")
+    elif isinstance(obj, bool):
+        h.update(b"\x00B" + (b"1" if obj else b"0"))
+    elif isinstance(obj, int):
+        h.update(b"\x00I" + str(obj).encode())
+    elif isinstance(obj, float):
+        h.update(b"\x00F" + repr(obj).encode())
+    elif isinstance(obj, str):
+        h.update(b"\x00S" + obj.encode())
+    elif isinstance(obj, bytes):
+        h.update(b"\x00Y" + obj)
+    elif hasattr(obj, "__uuid__"):
+        h.update(b"\x00U" + obj.__uuid__().encode())
+    elif isinstance(obj, dict):
+        h.update(b"\x00D")
+        for k, v in obj.items():
+            _feed(h, k)
+            _feed(h, v)
+        h.update(b"\x00d")
+    elif isinstance(obj, (list, tuple)) or hasattr(obj, "__iter__"):
+        h.update(b"\x00L")
+        for x in obj:
+            _feed(h, x)
+        h.update(b"\x00l")
+    elif callable(obj):
+        # stable across runs for module-level functions; lambdas fall back
+        # to their qualname which is stable within one workflow definition
+        h.update(
+            b"\x00C"
+            + getattr(obj, "__module__", "").encode()
+            + b"."
+            + getattr(obj, "__qualname__", repr(type(obj))).encode()
+        )
+    else:
+        h.update(b"\x00O" + repr(obj).encode())
+
+
+def to_uuid(*args: Any) -> str:
+    h = md5()
+    for a in args:
+        _feed(h, a)
+    return str(uuid.UUID(bytes=h.digest()))

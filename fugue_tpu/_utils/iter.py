@@ -1,0 +1,96 @@
+"""Iteration helpers: empty-aware one-pass iterables.
+
+In-tree replacement for triad's ``EmptyAwareIterable`` used by the reference
+for streaming transformer inputs (``fugue/dataframe/function_wrapper.py:354``)
+— lets per-partition code ask "is this partition empty?" and peek the first
+row without consuming it.
+"""
+
+from typing import Any, Generic, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+class EmptyAwareIterable(Generic[T], Iterable[T]):
+    def __init__(self, it: Iterable[T]):
+        self._iter = iter(it)
+        self._has_peeked = False
+        self._peeked: Any = None
+        self._fill()
+
+    def _fill(self) -> None:
+        if not self._has_peeked:
+            try:
+                self._peeked = next(self._iter)
+                self._has_peeked = True
+            except StopIteration:
+                self._has_peeked = False
+                self._peeked = None
+                self._exhausted = True
+                return
+        self._exhausted = False
+
+    @property
+    def empty(self) -> bool:
+        return not self._has_peeked
+
+    def peek(self) -> T:
+        if self.empty:
+            raise StopIteration("iterable is empty")
+        return self._peeked
+
+    def __iter__(self) -> Iterator[T]:
+        while self._has_peeked:
+            item = self._peeked
+            self._has_peeked = False
+            try:
+                self._peeked = next(self._iter)
+                self._has_peeked = True
+            except StopIteration:
+                pass
+            yield item
+
+
+def make_empty_aware(it: Iterable[T]) -> EmptyAwareIterable[T]:
+    return it if isinstance(it, EmptyAwareIterable) else EmptyAwareIterable(it)
+
+
+def slice_iterable(it: Iterable[T], slicer: Any) -> Iterator["EmptyAwareIterable[T]"]:
+    """Yield sub-iterables; a new slice starts whenever ``slicer(n, cur, last)``
+    returns True. Used for logical-partition slicing inside a physical one."""
+    src = iter(it)
+
+    class _State:
+        done = False
+        nxt: Any = None
+        has_next = False
+
+    st = _State()
+    try:
+        st.nxt = next(src)
+        st.has_next = True
+    except StopIteration:
+        return
+
+    def chunk() -> Iterator[T]:
+        n = 0
+        last = None
+        while st.has_next:
+            cur = st.nxt
+            if n > 0 and slicer(n, cur, last):
+                return
+            st.has_next = False
+            try:
+                st.nxt = next(src)
+                st.has_next = True
+            except StopIteration:
+                pass
+            n += 1
+            last = cur
+            yield cur
+
+    while st.has_next:
+        c = EmptyAwareIterable(chunk())
+        yield c
+        for _ in c:  # drain any unconsumed remainder of the slice
+            pass

@@ -1,0 +1,163 @@
+"""``ParamDict`` and ``IndexedOrderedDict`` — in-tree replacements for the
+triad collections the reference builds on (SURVEY.md §0: triad must be
+rebuilt in-tree; reference usage e.g. ``fugue/execution/execution_engine.py``
+conf handling).
+
+``ParamDict`` is a plain ``dict`` with typed accessors; ``IndexedOrderedDict``
+preserves insertion order (native in py3.7+ dicts) and adds positional access
+plus a ``readonly`` switch, which the reference relies on for Schema and
+presort maps.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Type, TypeVar, Union
+
+T = TypeVar("T")
+
+_BOOL_TRUE = {"true", "yes", "1", "on"}
+_BOOL_FALSE = {"false", "no", "0", "off"}
+
+
+def _convert(value: Any, expected: Type[T]) -> T:
+    if expected is object or isinstance(value, expected):
+        return value  # type: ignore
+    if expected is bool:
+        if isinstance(value, (int, float)):
+            return bool(value)  # type: ignore
+        s = str(value).strip().lower()
+        if s in _BOOL_TRUE:
+            return True  # type: ignore
+        if s in _BOOL_FALSE:
+            return False  # type: ignore
+        raise TypeError(f"can't convert {value!r} to bool")
+    if expected in (int, float, str):
+        return expected(value)  # type: ignore
+    raise TypeError(f"can't convert {value!r} to {expected}")
+
+
+class ParamDict(Dict[str, Any]):
+    """A string-keyed dict with typed, throwing accessors."""
+
+    OVERWRITE = 0
+    THROW = 1
+    IGNORE = 2
+
+    def __init__(self, data: Any = None, deep: bool = True):
+        super().__init__()
+        self.update(data, deep=deep)
+
+    def update(  # type: ignore[override]
+        self, other: Any = None, on_dup: int = 0, deep: bool = True
+    ) -> "ParamDict":
+        if other is None:
+            return self
+        if isinstance(other, dict):
+            items: Iterable[Tuple[Any, Any]] = other.items()
+        elif hasattr(other, "items"):
+            items = other.items()
+        else:
+            items = other
+        for k, v in items:
+            if k in self:
+                if on_dup == ParamDict.THROW:
+                    raise KeyError(f"duplicated key {k}")
+                if on_dup == ParamDict.IGNORE:
+                    continue
+            if deep and isinstance(v, dict):
+                v = dict(v)
+            self[str(k)] = v
+        return self
+
+    def get(self, key: Union[int, str], default: Any) -> Any:  # type: ignore
+        """Typed get: the result is converted to ``type(default)``."""
+        if isinstance(key, int):
+            key = list(self.keys())[key]
+        if key in self:
+            if default is None:
+                return self[key]
+            return _convert(self[key], type(default))
+        return default
+
+    def get_or_none(self, key: Union[int, str], expected: Type[T]) -> Optional[T]:
+        if isinstance(key, int):
+            key = list(self.keys())[key]
+        if key not in self:
+            return None
+        return _convert(self[key], expected)
+
+    def get_or_throw(self, key: Union[int, str], expected: Type[T]) -> T:
+        if isinstance(key, int):
+            key = list(self.keys())[key]
+        if key not in self:
+            raise KeyError(f"{key} not found")
+        return _convert(self[key], expected)
+
+
+class IndexedOrderedDict(Dict[Any, Any]):
+    """Ordered dict with positional access and a readonly latch."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self._readonly = False
+        super().__init__(*args, **kwargs)
+
+    @property
+    def readonly(self) -> bool:
+        return getattr(self, "_readonly", False)
+
+    def set_readonly(self) -> "IndexedOrderedDict":
+        self._readonly = True
+        return self
+
+    def _pre_update(self) -> None:
+        if self.readonly:
+            raise InvalidOperationError("dict is readonly")
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._pre_update()
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key: Any) -> None:
+        self._pre_update()
+        super().__delitem__(key)
+
+    def pop(self, *args: Any, **kwargs: Any) -> Any:
+        self._pre_update()
+        return super().pop(*args, **kwargs)
+
+    def clear(self) -> None:
+        self._pre_update()
+        super().clear()
+
+    def index_of_key(self, key: Any) -> int:
+        for i, k in enumerate(self.keys()):
+            if k == key:
+                return i
+        raise KeyError(key)
+
+    def get_key_by_index(self, index: int) -> Any:
+        return list(self.keys())[index]
+
+    def get_value_by_index(self, index: int) -> Any:
+        return list(self.values())[index]
+
+    def get_item_by_index(self, index: int) -> Tuple[Any, Any]:
+        return list(self.items())[index]
+
+    def equals(self, other: Any, with_order: bool = True) -> bool:
+        if not isinstance(other, dict):
+            return False
+        if with_order:
+            return list(self.items()) == list(other.items())
+        return dict(self) == dict(other)
+
+
+class InvalidOperationError(Exception):
+    """Mutation attempted on a readonly collection."""
+
+
+def to_list_of_str(obj: Any) -> List[str]:
+    """Normalize str | Iterable[str] | None into a list of strings."""
+    if obj is None:
+        return []
+    if isinstance(obj, str):
+        return [obj]
+    return [str(x) for x in obj]

@@ -1,0 +1,100 @@
+"""Conditional-dispatch plugin system.
+
+In-tree replacement for triad's ``conditional_dispatcher`` which the
+reference binds to the ``fugue.plugins`` entry point
+(``/root/reference/fugue/_utils/registry.py:9-10``). A *plugin* is a
+function with registered *candidates*: ``(matcher, priority, impl)``
+triples. Calling the plugin evaluates matchers in priority order (highest
+first, later registration wins ties) and runs the first match; if none
+match, the decorated default body runs.
+
+Two flavors mirror the reference's usage:
+
+- ``fugue_plugin`` — dispatch to the single best candidate.
+- ``run_at_def`` — a function executed at definition time (used by backend
+  registries to self-register on import).
+"""
+
+import inspect
+from typing import Any, Callable, List, NamedTuple, Optional
+
+from ..exceptions import FuguePluginsRegistrationError
+
+
+class _Candidate(NamedTuple):
+    priority: float
+    serial: int
+    matcher: Callable[..., bool]
+    func: Callable
+
+
+class ConditionalDispatcher:
+    def __init__(self, default_func: Callable, name: Optional[str] = None):
+        self._default = default_func
+        self._name = name or default_func.__name__
+        self._candidates: List[_Candidate] = []
+        self._serial = 0
+        self.__doc__ = default_func.__doc__
+        self.__name__ = self._name
+        self.__wrapped__ = default_func
+
+    def candidate(
+        self, matcher: Callable[..., bool], priority: float = 1.0
+    ) -> Callable[[Callable], Callable]:
+        """Register an implementation guarded by ``matcher``."""
+
+        def deco(func: Callable) -> Callable:
+            self._serial += 1
+            self._candidates.append(_Candidate(priority, self._serial, matcher, func))
+            # stable: higher priority first, then most recent registration
+            self._candidates.sort(key=lambda c: (-c.priority, -c.serial))
+            return func
+
+        return deco
+
+    def register(self, func: Callable, matcher: Callable[..., bool], priority: float = 1.0) -> None:
+        self.candidate(matcher, priority)(func)
+
+    def _matches(self, *args: Any, **kwargs: Any):
+        for c in self._candidates:
+            try:
+                ok = c.matcher(*args, **kwargs)
+            except Exception:
+                ok = False
+            if ok:
+                yield c.func
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        for f in self._matches(*args, **kwargs):
+            return f(*args, **kwargs)
+        return self._default(*args, **kwargs)
+
+    def run_all(self, *args: Any, **kwargs: Any) -> List[Any]:
+        """Run every matching candidate plus the default; collect results."""
+        res = [f(*args, **kwargs) for f in self._matches(*args, **kwargs)]
+        res.append(self._default(*args, **kwargs))
+        return res
+
+    def has_match(self, *args: Any, **kwargs: Any) -> bool:
+        for _ in self._matches(*args, **kwargs):
+            return True
+        return False
+
+
+def fugue_plugin(func: Callable) -> ConditionalDispatcher:
+    """Declare an extensible hook (the decorated body is the fallback)."""
+    if not inspect.isfunction(func):
+        raise FuguePluginsRegistrationError(f"{func} is not a function")
+    return ConditionalDispatcher(func)
+
+
+def run_at_def(run_func: Optional[Callable] = None, **kwargs: Any) -> Callable:
+    """Execute the decorated function immediately at definition time."""
+
+    def deco(func: Callable) -> Callable:
+        func(**kwargs)
+        return func
+
+    if run_func is None:
+        return deco
+    return deco(run_func)

@@ -1,0 +1,4 @@
+from .bag import Bag, LocalBag, LocalBoundedBag
+from .array_bag import ArrayBag
+
+__all__ = ["Bag", "LocalBag", "LocalBoundedBag", "ArrayBag"]

@@ -1,0 +1,48 @@
+"""Bag — unordered collection of arbitrary objects.
+
+Parity with the reference (`fugue/bag/bag.py:7`): the schemaless sibling of
+DataFrame; engines may optionally support ``map_bag``.
+"""
+
+from abc import abstractmethod
+from typing import Any, Iterable, List
+
+from ..dataset.dataset import Dataset
+from ..exceptions import FugueDatasetEmptyError
+
+
+class Bag(Dataset):
+    @abstractmethod
+    def as_local(self) -> "LocalBag":
+        raise NotImplementedError
+
+    @abstractmethod
+    def peek(self) -> Any:
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array(self) -> List[Any]:
+        raise NotImplementedError
+
+    @abstractmethod
+    def head(self, n: int) -> "LocalBoundedBag":
+        raise NotImplementedError
+
+
+class LocalBag(Bag):
+    @property
+    def is_local(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+
+class LocalBoundedBag(LocalBag):
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+    def as_local(self) -> LocalBag:
+        return self

@@ -1,0 +1,19 @@
+from .partition import (
+    PartitionCursor,
+    PartitionSpec,
+    DatasetPartitionCursor,
+    parse_presort_exp,
+)
+from .sql import StructuredRawSQL, TempTableName
+from .yielded import PhysicalYielded, Yielded
+
+__all__ = [
+    "PartitionCursor",
+    "PartitionSpec",
+    "DatasetPartitionCursor",
+    "parse_presort_exp",
+    "StructuredRawSQL",
+    "TempTableName",
+    "PhysicalYielded",
+    "Yielded",
+]

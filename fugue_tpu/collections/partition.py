@@ -1,0 +1,339 @@
+"""Partitioning vocabulary: ``PartitionSpec`` and per-partition cursors.
+
+Capability parity with the reference (`fugue/collections/partition.py:79`):
+``algo`` ∈ {default, hash, rand, even, coarse}, ``num`` supports expressions
+with ``ROWCOUNT``/``CONCURRENCY`` keywords, ``by`` keys, ``presort``
+("a asc, b desc"), the ``"per_row"`` shorthand, and a deterministic uuid.
+
+On the TPU engine each ``algo`` lowers to a sharding strategy over the
+device mesh (SURVEY.md §2.14): hash → bucket exchange via collectives,
+even → balanced redistribution, rand → permuted exchange.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+from .._utils.assertion import assert_or_throw
+from .._utils.hash import to_uuid
+from .._utils.params import IndexedOrderedDict, ParamDict, to_list_of_str
+from ..constants import KEYWORD_CONCURRENCY, KEYWORD_ROWCOUNT
+from ..exceptions import FugueTPUError
+
+
+class PartitionSpecError(FugueTPUError):
+    """Invalid partition specification."""
+
+
+def parse_presort_exp(presort: Any) -> IndexedOrderedDict:
+    """Parse ``"a asc, b desc"`` into an ordered ``{name: ascending}`` map.
+
+    Accepts a ready-made dict (validated+copied) or a string expression.
+    Column names may be backtick-quoted.
+    """
+    if presort is None:
+        return IndexedOrderedDict()
+    if isinstance(presort, dict):
+        res = IndexedOrderedDict()
+        for k, v in presort.items():
+            assert_or_throw(
+                isinstance(v, bool),
+                lambda: PartitionSpecError(f"presort direction for {k} must be bool"),
+            )
+            res[str(k)] = v
+        return res
+    res = IndexedOrderedDict()
+    s = str(presort).strip()
+    if s == "":
+        return res
+    for part in s.split(","):
+        part = part.strip()
+        if part == "":
+            raise PartitionSpecError(f"invalid presort expression {presort!r}")
+        if part.startswith("`"):
+            end = part.index("`", 1)
+            name = part[1:end]
+            rest = part[end + 1 :].strip()
+        else:
+            tokens = part.split()
+            name = tokens[0]
+            rest = " ".join(tokens[1:])
+        direction = rest.strip().lower()
+        if direction in ("", "asc"):
+            asc = True
+        elif direction == "desc":
+            asc = False
+        else:
+            raise PartitionSpecError(f"invalid presort direction {rest!r} in {presort!r}")
+        assert_or_throw(
+            name not in res,
+            lambda: PartitionSpecError(f"duplicated presort key {name!r}"),
+        )
+        res[name] = asc
+    return res
+
+
+def _safe_eval_num(expr: str, variables: Dict[str, int]) -> int:
+    """Evaluate a numeric partition expression like ``ROWCOUNT/4 + 1``."""
+    import ast
+    import operator as op
+
+    ops = {
+        ast.Add: op.add,
+        ast.Sub: op.sub,
+        ast.Mult: op.mul,
+        ast.Div: op.truediv,
+        ast.FloorDiv: op.floordiv,
+        ast.Mod: op.mod,
+        ast.Pow: op.pow,
+        ast.USub: op.neg,
+    }
+
+    def ev(node: ast.AST) -> float:
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in variables:
+                return variables[node.id]
+            raise PartitionSpecError(f"unknown keyword {node.id} in {expr!r}")
+        if isinstance(node, ast.BinOp) and type(node.op) in ops:
+            return ops[type(node.op)](ev(node.left), ev(node.right))
+        if isinstance(node, ast.UnaryOp) and type(node.op) in ops:
+            return ops[type(node.op)](ev(node.operand))
+        raise PartitionSpecError(f"invalid partition number expression {expr!r}")
+
+    return int(ev(ast.parse(expr, mode="eval")))
+
+
+class PartitionSpec:
+    """Description of how to partition a dataset.
+
+    Examples::
+
+        PartitionSpec()                       # default (engine decides)
+        PartitionSpec(num=4)
+        PartitionSpec(algo="hash", by=["a"], presort="b desc")
+        PartitionSpec("per_row")              # every row its own partition
+        PartitionSpec(spec1, num=8)           # override on top of another spec
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        p = ParamDict()
+        for a in args:
+            if a is None:
+                continue
+            elif isinstance(a, PartitionSpec):
+                self._update_dict(p, a.jsondict)
+            elif isinstance(a, Dict):
+                self._update_dict(p, a)
+            elif isinstance(a, str):
+                if a == "per_row":
+                    self._update_dict(p, dict(algo="even", num=KEYWORD_ROWCOUNT))
+                elif a.lower() in ("hash", "rand", "even", "coarse", "default"):
+                    self._update_dict(p, dict(algo=a.lower()))
+                else:
+                    self._update_dict(p, json.loads(a))
+            elif isinstance(a, int):
+                self._update_dict(p, dict(num=a))
+            else:
+                raise PartitionSpecError(f"can't initialize PartitionSpec with {a!r}")
+        self._update_dict(p, kwargs)
+        self._num_partitions = str(p.get("num", p.get("num_partitions", "0")))
+        self._algo = str(p.get("algo", "")).lower()
+        assert_or_throw(
+            self._algo in ("", "default", "hash", "rand", "even", "coarse"),
+            lambda: PartitionSpecError(f"invalid algo {self._algo!r}"),
+        )
+        if self._algo == "default":
+            self._algo = ""
+        self._partition_by = to_list_of_str(p.get_or_none("by", object) or p.get_or_none("partition_by", object))
+        assert_or_throw(
+            len(self._partition_by) == len(set(self._partition_by)),
+            lambda: PartitionSpecError(f"duplicated partition keys {self._partition_by}"),
+        )
+        self._presort = parse_presort_exp(p.get_or_none("presort", object))
+        overlap = set(self._partition_by) & set(self._presort.keys())
+        assert_or_throw(
+            len(overlap) == 0,
+            lambda: PartitionSpecError(f"presort keys {overlap} overlap partition keys"),
+        )
+        extra = set(p.keys()) - {"num", "num_partitions", "algo", "by", "partition_by", "presort"}
+        assert_or_throw(
+            len(extra) == 0,
+            lambda: PartitionSpecError(f"invalid PartitionSpec keys {extra}"),
+        )
+
+    @staticmethod
+    def _update_dict(d: ParamDict, u: Dict[str, Any]) -> None:
+        for k, v in u.items():
+            if k == "partition_by":
+                k = "by"
+            if k == "num_partitions":
+                k = "num"
+            d[k] = v
+
+    @property
+    def empty(self) -> bool:
+        return (
+            self._num_partitions in ("0", "")
+            and self._algo == ""
+            and len(self._partition_by) == 0
+            and len(self._presort) == 0
+        )
+
+    @property
+    def num_partitions(self) -> str:
+        return self._num_partitions
+
+    def get_num_partitions(self, **expr_map_funcs: Any) -> int:
+        """Evaluate the partition-number expression.
+
+        ``expr_map_funcs`` maps keywords (``ROWCOUNT``, ``CONCURRENCY``) to
+        zero-arg callables, evaluated lazily only if the keyword appears.
+        """
+        expr = self._num_partitions
+        variables: Dict[str, int] = {}
+        for k, f in expr_map_funcs.items():
+            if k in expr:
+                variables[k] = int(f())
+        if expr.strip() == "":
+            return 0
+        try:
+            return int(expr)
+        except ValueError:
+            return _safe_eval_num(expr, variables)
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def partition_by(self) -> List[str]:
+        return self._partition_by
+
+    @property
+    def presort(self) -> IndexedOrderedDict:
+        return self._presort
+
+    @property
+    def presort_expr(self) -> str:
+        return ",".join(f"{k} {'ASC' if v else 'DESC'}" for k, v in self._presort.items())
+
+    @property
+    def jsondict(self) -> ParamDict:
+        return ParamDict(
+            dict(
+                num_partitions=self._num_partitions,
+                algo=self._algo,
+                partition_by=self._partition_by,
+                presort=self.presort_expr,
+            )
+        )
+
+    def __repr__(self) -> str:
+        return f"PartitionSpec({json.dumps(dict(self.jsondict))})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PartitionSpec) and self.jsondict == other.jsondict
+
+    def __uuid__(self) -> str:
+        return to_uuid(self.jsondict)
+
+    def get_sorts(
+        self, schema: Any, with_partition_keys: bool = True
+    ) -> IndexedOrderedDict:
+        """Full sort map for a physical partition: partition keys + presort."""
+        res = IndexedOrderedDict()
+        if with_partition_keys:
+            for k in self._partition_by:
+                assert_or_throw(
+                    k in schema,
+                    lambda: PartitionSpecError(f"partition key {k} not in {schema}"),
+                )
+                res[k] = True
+        for k, v in self._presort.items():
+            assert_or_throw(
+                k in schema,
+                lambda: PartitionSpecError(f"presort key {k} not in {schema}"),
+            )
+            res[k] = v
+        return res
+
+    def get_key_schema(self, schema: Any) -> Any:
+        """Sub-schema of the partition keys."""
+        return schema.extract(self._partition_by)
+
+    def get_cursor(self, schema: Any, physical_partition_no: int) -> "PartitionCursor":
+        return PartitionCursor(schema, self, physical_partition_no)
+
+
+EMPTY_PARTITION_SPEC = PartitionSpec()
+
+
+class DatasetPartitionCursor:
+    """Minimal cursor: tracks the physical partition number and current item.
+
+    Reference: ``fugue/collections/partition.py:336``.
+    """
+
+    def __init__(self, physical_no: int):
+        self._physical_no = physical_no
+        self._item: Any = None
+        self._partition_no = 0
+        self._slice_no = 0
+
+    def set(self, item: Any, partition_no: int, slice_no: int) -> None:
+        self._item = item() if callable(item) else item
+        self._partition_no = partition_no
+        self._slice_no = slice_no
+
+    @property
+    def item(self) -> Any:
+        return self._item
+
+    @property
+    def partition_no(self) -> int:
+        return self._partition_no
+
+    @property
+    def physical_partition_no(self) -> int:
+        return self._physical_no
+
+    @property
+    def slice_no(self) -> int:
+        return self._slice_no
+
+
+class PartitionCursor(DatasetPartitionCursor):
+    """Cursor over logical partitions inside one physical partition.
+
+    Exposes the key values of the current logical partition, given the frame
+    schema and the spec (reference ``fugue/collections/partition.py:404``).
+    """
+
+    def __init__(self, schema: Any, spec: PartitionSpec, physical_partition_no: int):
+        super().__init__(physical_partition_no)
+        self._schema = schema
+        self._spec = spec
+        self._key_index = [schema.index_of_key(k) for k in spec.partition_by]
+
+    @property
+    def row(self) -> List[Any]:
+        return self.item
+
+    @property
+    def row_schema(self) -> Any:
+        return self._schema
+
+    @property
+    def key_schema(self) -> Any:
+        return self._schema.extract(self._spec.partition_by)
+
+    @property
+    def key_value_array(self) -> List[Any]:
+        return [self.row[i] for i in self._key_index]
+
+    @property
+    def key_value_dict(self) -> Dict[str, Any]:
+        return {self._schema.names[i]: self.row[i] for i in self._key_index}

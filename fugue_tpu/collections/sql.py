@@ -1,0 +1,103 @@
+"""SQL text structured into table-reference and literal segments.
+
+Parity with the reference (`fugue/collections/sql.py:14,48`): SQL statements
+are stored as ``(is_table_ref, text)`` segments so engines can substitute
+their own temp-table naming before execution. Dialect transpilation is a
+plugin (``transpile_sql``) — the default is passthrough since no sqlglot is
+available in this environment; engines that need dialect conversion can
+register a candidate.
+"""
+
+import uuid
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from .._utils.hash import to_uuid
+from .._utils.registry import fugue_plugin
+
+
+class TempTableName:
+    """A unique, safely-named temp table reference embeddable in raw SQL."""
+
+    def __init__(self):
+        self.key = "_" + str(uuid.uuid4())[:5]
+
+    @property
+    def ref(self) -> str:
+        return f"<tmpdf:{self.key}>"
+
+    def __repr__(self) -> str:
+        return self.ref
+
+
+@fugue_plugin
+def transpile_sql(raw: str, from_dialect: Optional[str], to_dialect: Optional[str]) -> str:
+    """Transpile SQL between dialects (default: passthrough)."""
+    return raw
+
+
+class StructuredRawSQL:
+    """An immutable sequence of ``(is_table_ref, text)`` SQL segments."""
+
+    def __init__(self, statements: Iterable[Tuple[bool, str]], dialect: Optional[str] = None):
+        self._statements = list(statements)
+        self._dialect = dialect
+
+    @property
+    def dialect(self) -> Optional[str]:
+        return self._dialect
+
+    def __iter__(self):
+        return iter(self._statements)
+
+    def construct(
+        self,
+        name_map: Any = None,
+        dialect: Optional[str] = None,
+        log: Any = None,
+    ) -> str:
+        """Render the SQL, mapping table refs through ``name_map`` (a dict or
+        a callable), then transpile to ``dialect`` if it differs."""
+
+        def _map(name: str) -> str:
+            if name_map is None:
+                return name
+            if callable(name_map):
+                return name_map(name)
+            return name_map.get(name, name)
+
+        raw = " ".join(_map(t) if is_ref else t for is_ref, t in self._statements)
+        if dialect is not None and self._dialect is not None and dialect != self._dialect:
+            transpiled = transpile_sql(raw, self._dialect, dialect)
+            if log is not None:
+                log.debug(
+                    "transpiled %s from %s to %s: %s",
+                    raw, self._dialect, dialect, transpiled,
+                )
+            raw = transpiled
+        return raw
+
+    @staticmethod
+    def from_expr(
+        sql: str, prefix: str = "<tmpdf:", suffix: str = ">", dialect: Optional[str] = None
+    ) -> "StructuredRawSQL":
+        """Parse raw text containing ``<tmpdf:key>`` markers into segments."""
+        statements: List[Tuple[bool, str]] = []
+        pos = 0
+        while True:
+            start = sql.find(prefix, pos)
+            if start < 0:
+                if pos < len(sql):
+                    statements.append((False, sql[pos:]))
+                break
+            end = sql.find(suffix, start)
+            if end < 0:
+                statements.append((False, sql[pos:]))
+                break
+            if start > pos:
+                statements.append((False, sql[pos:start]))
+            statements.append((True, sql[start + len(prefix) : end]))
+            pos = end + len(suffix)
+        return StructuredRawSQL(statements, dialect=dialect)
+
+    def __uuid__(self) -> str:
+        return to_uuid(self._dialect, self._statements)

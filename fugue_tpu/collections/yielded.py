@@ -1,0 +1,61 @@
+"""Workflow outputs that outlive ``run()``.
+
+Parity with the reference (`fugue/collections/yielded.py:7,37`): a
+``Yielded`` is identified by a deterministic uuid; ``PhysicalYielded``
+additionally carries a storage location (file path or table name).
+"""
+
+from typing import Any
+
+from .._utils.assertion import assert_or_throw
+from .._utils.hash import to_uuid
+from ..exceptions import FugueInvalidOperation
+
+
+class Yielded:
+    """Base class for values yielded out of a workflow run."""
+
+    def __init__(self, yid: str):
+        self._yid = to_uuid(yid)
+
+    def __uuid__(self) -> str:
+        return self._yid
+
+    @property
+    def is_set(self) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __copy__(self) -> "Yielded":
+        return self
+
+    def __deepcopy__(self, memo: Any) -> "Yielded":
+        return self
+
+
+class PhysicalYielded(Yielded):
+    """Yielded result backed by storage: ``storage_type`` ∈ {file, table}."""
+
+    def __init__(self, yid: str, storage_type: str):
+        super().__init__(yid)
+        assert_or_throw(
+            storage_type in ("file", "table"),
+            lambda: FugueInvalidOperation(f"invalid storage type {storage_type}"),
+        )
+        self._name = ""
+        self._storage_type = storage_type
+
+    @property
+    def is_set(self) -> bool:
+        return self._name != ""
+
+    def set_value(self, name: str) -> None:
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        assert_or_throw(self.is_set, lambda: FugueInvalidOperation("value is not set"))
+        return self._name
+
+    @property
+    def storage_type(self) -> str:
+        return self._storage_type

@@ -1,0 +1,59 @@
+"""Configuration keys and global defaults.
+
+Parity with the reference's conf surface (``/root/reference/fugue/constants.py``)
+plus TPU-engine keys. All keys use the ``fugue.`` prefix so user code written
+against the reference conf names keeps working.
+"""
+
+from typing import Any, Dict
+
+from ._utils.params import ParamDict
+
+KEYWORD_ROWCOUNT = "ROWCOUNT"
+KEYWORD_CONCURRENCY = "CONCURRENCY"
+KEYWORD_PARALLELISM = "PARALLELISM"
+
+FUGUE_ENTRYPOINT = "fugue.plugins"
+
+FUGUE_CONF_WORKFLOW_CONCURRENCY = "fugue.workflow.concurrency"
+FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH = "fugue.workflow.checkpoint.path"
+FUGUE_CONF_WORKFLOW_AUTO_PERSIST = "fugue.workflow.auto_persist"
+FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE = "fugue.workflow.auto_persist_value"
+FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE = "fugue.workflow.exception.hide"
+FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT = "fugue.workflow.exception.inject"
+FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE = "fugue.workflow.exception.optimize"
+FUGUE_CONF_SQL_IGNORE_CASE = "fugue.sql.compile.ignore_case"
+FUGUE_CONF_SQL_DIALECT = "fugue.sql.compile.dialect"
+FUGUE_CONF_DEFAULT_PARTITIONS = "fugue.default.partitions"
+FUGUE_CONF_CACHE_PATH = "fugue.workflow.cache.path"
+
+# TPU-engine specific
+FUGUE_TPU_CONF_MESH_SHAPE = "fugue.tpu.mesh_shape"
+FUGUE_TPU_CONF_ROW_AXIS = "fugue.tpu.row_axis"
+FUGUE_TPU_CONF_DEFAULT_BATCH_ROWS = "fugue.tpu.default_batch_rows"
+
+FUGUE_COMPILE_TIME_CONFIGS = {
+    FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
+    FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,
+    FUGUE_CONF_SQL_IGNORE_CASE,
+    FUGUE_CONF_SQL_DIALECT,
+}
+
+_FUGUE_GLOBAL_CONF = ParamDict(
+    {
+        FUGUE_CONF_WORKFLOW_CONCURRENCY: 1,
+        FUGUE_CONF_WORKFLOW_AUTO_PERSIST: False,
+        FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE: "fugue.,fugue_tpu.,concurrent.,"
+        "pandas.,pyarrow.,jax.,numpy.",
+        FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT: 3,
+        FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE: True,
+        FUGUE_CONF_SQL_IGNORE_CASE: False,
+        FUGUE_CONF_SQL_DIALECT: "spark",
+        FUGUE_CONF_DEFAULT_PARTITIONS: -1,
+    }
+)
+
+
+def register_global_conf(conf: Dict[str, Any], on_dup: int = ParamDict.OVERWRITE) -> None:
+    """Merge keys into the process-level global conf (lowest priority layer)."""
+    _FUGUE_GLOBAL_CONF.update(conf, on_dup=on_dup)

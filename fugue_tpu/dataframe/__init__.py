@@ -1,0 +1,55 @@
+from .array_dataframe import ArrayDataFrame
+from .arrow_dataframe import ArrowDataFrame
+from .dataframe import (
+    AnySchema,
+    DataFrame,
+    DataFrameDisplay,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalUnboundedDataFrame,
+    YieldedDataFrame,
+)
+from .dataframe_iterable_dataframe import (
+    IterableArrowDataFrame,
+    IterablePandasDataFrame,
+    LocalDataFrameIterableDataFrame,
+)
+from .dataframes import DataFrames
+from .function_wrapper import (
+    AnnotatedParam,
+    DataFrameFunctionWrapper,
+    DataFrameParam,
+    LocalDataFrameParam,
+    fugue_annotated_param,
+)
+from .iterable_dataframe import IterableDataFrame
+from .pandas_dataframe import PandasDataFrame
+from .utils import _df_eq, deserialize_df, get_join_schemas, parse_join_type, serialize_df
+
+__all__ = [
+    "AnySchema",
+    "ArrayDataFrame",
+    "ArrowDataFrame",
+    "DataFrame",
+    "DataFrameDisplay",
+    "DataFrames",
+    "DataFrameFunctionWrapper",
+    "DataFrameParam",
+    "LocalDataFrameParam",
+    "AnnotatedParam",
+    "fugue_annotated_param",
+    "IterableDataFrame",
+    "IterableArrowDataFrame",
+    "IterablePandasDataFrame",
+    "LocalBoundedDataFrame",
+    "LocalDataFrame",
+    "LocalDataFrameIterableDataFrame",
+    "LocalUnboundedDataFrame",
+    "PandasDataFrame",
+    "YieldedDataFrame",
+    "_df_eq",
+    "serialize_df",
+    "deserialize_df",
+    "get_join_schemas",
+    "parse_join_type",
+]

@@ -1,0 +1,148 @@
+"""Functional DataFrame API — plugin-dispatched over *any* frame type.
+
+Parity with the reference (`fugue/dataframe/api.py`): each verb works on
+fugue frames, pandas frames, arrow tables, and anything a backend registers
+a candidate for (the TPU engine registers its device frames).
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.registry import fugue_plugin
+from ..schema import Schema
+from .arrow_dataframe import ArrowDataFrame
+from .dataframe import DataFrame, LocalBoundedDataFrame
+from .pandas_dataframe import PandasDataFrame
+
+AnyDataFrame = Any
+
+
+@fugue_plugin
+def as_fugue_df(df: AnyDataFrame, **kwargs: Any) -> DataFrame:
+    """Convert any supported object to a fugue DataFrame (plugin hook)."""
+    if isinstance(df, DataFrame):
+        return df
+    if isinstance(df, pd.DataFrame):
+        return PandasDataFrame(df, **kwargs)
+    if isinstance(df, (pa.Table, pa.RecordBatch)):
+        return ArrowDataFrame(df, **kwargs)
+    raise NotImplementedError(f"can't convert {type(df)} to a fugue DataFrame")
+
+
+def is_df(df: Any) -> bool:
+    try:
+        return isinstance(df, DataFrame) or as_fugue_df(df) is not None
+    except NotImplementedError:
+        return False
+
+
+@fugue_plugin
+def get_native_as_df(df: AnyDataFrame) -> AnyDataFrame:
+    """Return the most natural native object of a dataframe."""
+    if isinstance(df, DataFrame):
+        return df.native
+    return df
+
+
+def get_schema(df: AnyDataFrame) -> Schema:
+    return as_fugue_df(df).schema
+
+
+def get_column_names(df: AnyDataFrame) -> List[Any]:
+    return get_schema(df).names
+
+
+def rename(df: AnyDataFrame, columns: Dict[str, Any], as_fugue: bool = False) -> AnyDataFrame:
+    if len(columns) == 0:
+        return as_fugue_df(df) if as_fugue else df
+    return _adjust(df, as_fugue_df(df).rename(columns), as_fugue)
+
+
+def drop_columns(df: AnyDataFrame, columns: List[str], as_fugue: bool = False) -> AnyDataFrame:
+    return _adjust(df, as_fugue_df(df).drop(columns), as_fugue)
+
+
+def select_columns(df: AnyDataFrame, columns: List[Any], as_fugue: bool = False) -> AnyDataFrame:
+    return _adjust(df, as_fugue_df(df)[columns], as_fugue)
+
+
+def alter_columns(df: AnyDataFrame, columns: Any, as_fugue: bool = False) -> AnyDataFrame:
+    return _adjust(df, as_fugue_df(df).alter_columns(columns), as_fugue)
+
+
+def head(
+    df: AnyDataFrame, n: int, columns: Optional[List[str]] = None, as_fugue: bool = False
+) -> AnyDataFrame:
+    return _adjust(df, as_fugue_df(df).head(n, columns=columns), as_fugue)
+
+
+def peek_array(df: AnyDataFrame) -> List[Any]:
+    return as_fugue_df(df).peek_array()
+
+
+def peek_dict(df: AnyDataFrame) -> Dict[str, Any]:
+    return as_fugue_df(df).peek_dict()
+
+
+def as_array(
+    df: AnyDataFrame, columns: Optional[List[str]] = None, type_safe: bool = False
+) -> List[List[Any]]:
+    return as_fugue_df(df).as_array(columns=columns, type_safe=type_safe)
+
+
+def as_array_iterable(
+    df: AnyDataFrame, columns: Optional[List[str]] = None, type_safe: bool = False
+) -> Iterable[List[Any]]:
+    return as_fugue_df(df).as_array_iterable(columns=columns, type_safe=type_safe)
+
+
+def as_dicts(df: AnyDataFrame, columns: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    return as_fugue_df(df).as_dicts(columns=columns)
+
+
+def as_dict_iterable(
+    df: AnyDataFrame, columns: Optional[List[str]] = None
+) -> Iterable[Dict[str, Any]]:
+    return as_fugue_df(df).as_dict_iterable(columns=columns)
+
+
+def as_pandas(df: AnyDataFrame) -> pd.DataFrame:
+    return as_fugue_df(df).as_pandas()
+
+
+def as_arrow(df: AnyDataFrame) -> pa.Table:
+    return as_fugue_df(df).as_arrow()
+
+
+def as_local(df: AnyDataFrame, as_fugue: bool = False) -> AnyDataFrame:
+    res = as_fugue_df(df).as_local()
+    return res if as_fugue else get_native_as_df(res)
+
+
+def as_local_bounded(df: AnyDataFrame, as_fugue: bool = False) -> AnyDataFrame:
+    res = as_fugue_df(df).as_local_bounded()
+    return res if as_fugue else get_native_as_df(res)
+
+
+def normalize_column_names(df: AnyDataFrame) -> Any:
+    """Rename columns not expressible in schema syntax to ``_N`` and return
+    (renamed_df, inverse_rename_map) — reference ``fugue/dataframe/api.py``."""
+    fdf = as_fugue_df(df)
+    rename_map: Dict[str, str] = {}
+    inverse: Dict[str, str] = {}
+    for i, name in enumerate(fdf.schema.names):
+        if not name.isidentifier():
+            new = f"_{i}"
+            rename_map[name] = new
+            inverse[new] = name
+    if len(rename_map) == 0:
+        return df, {}
+    return fdf.rename(rename_map), inverse
+
+
+def _adjust(original: Any, result: DataFrame, as_fugue: bool) -> AnyDataFrame:
+    if as_fugue or isinstance(original, DataFrame):
+        return result
+    return get_native_as_df(result)

@@ -1,0 +1,94 @@
+"""ArrayDataFrame — local frame over list-of-lists (no type enforcement).
+
+Parity with the reference (`fugue/dataframe/array_dataframe.py:14`): the
+cheapest local frame; ``type_safe=True`` conversions go through arrow.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from .._utils.assertion import assert_or_throw
+from ..exceptions import FugueDataFrameInitError
+from ..schema import Schema
+from .dataframe import DataFrame, LocalBoundedDataFrame
+
+
+class ArrayDataFrame(LocalBoundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            assert_or_throw(
+                schema is not None, FugueDataFrameInitError("schema is required")
+            )
+            data: List[List[Any]] = []
+            s = schema if isinstance(schema, Schema) else Schema(schema)
+        elif isinstance(df, DataFrame):
+            s = schema if schema is not None else df.schema
+            s = s if isinstance(s, Schema) else Schema(s)
+            data = df.as_array(columns=s.names if schema is not None else None)
+        elif isinstance(df, Iterable):
+            assert_or_throw(
+                schema is not None, FugueDataFrameInitError("schema is required")
+            )
+            s = schema if isinstance(schema, Schema) else Schema(schema)
+            data = [list(row) for row in df]
+        else:
+            raise FugueDataFrameInitError(f"can't build ArrayDataFrame from {type(df)}")
+        self._data = data
+        super().__init__(s)
+
+    @property
+    def native(self) -> List[List[Any]]:
+        return self._data
+
+    @property
+    def empty(self) -> bool:
+        return len(self._data) == 0
+
+    def count(self) -> int:
+        return len(self._data)
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return list(self._data[0])
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        keep = [n for n in self.schema.names if n not in cols]
+        return self._select_cols(keep)
+
+    def _select_cols(self, cols: List[str]) -> DataFrame:
+        idx = [self.schema.index_of_key(c) for c in cols]
+        return ArrayDataFrame(
+            [[row[i] for i in idx] for row in self._data], self.schema.extract(cols)
+        )
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        return ArrayDataFrame(self._data, self.schema.rename(columns))
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        from .arrow_dataframe import ArrowDataFrame
+
+        new_schema = self.schema.alter(columns)
+        if new_schema == self.schema:
+            return self
+        res = ArrowDataFrame(self._data, self.schema).alter_columns(columns)
+        return ArrayDataFrame(res.as_array(), res.schema)
+
+    def head(self, n: int, columns: Optional[List[str]] = None) -> LocalBoundedDataFrame:
+        res = self if columns is None else self._select_cols(columns)
+        return ArrayDataFrame(res.as_array()[:n], res.schema)  # type: ignore
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        if type_safe:
+            from .arrow_dataframe import ArrowDataFrame
+
+            return ArrowDataFrame(self._data, self.schema).as_array(columns)
+        if columns is None:
+            return self._data
+        idx = [self.schema.index_of_key(c) for c in columns]
+        return [[row[i] for i in idx] for row in self._data]
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        yield from self.as_array(columns, type_safe=type_safe)

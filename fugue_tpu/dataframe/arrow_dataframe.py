@@ -1,0 +1,136 @@
+"""ArrowDataFrame — the columnar workhorse local frame.
+
+Parity with the reference (`fugue/dataframe/arrow_dataframe.py:45`). Arrow is
+the interchange format of the whole framework (and the host-side format the
+TPU engine converts to/from device arrays), so this frame is the canonical
+type-safe local representation.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from ..exceptions import FugueDataFrameInitError, FugueDataFrameOperationError
+from ..schema import Schema, _normalize_type
+from .dataframe import DataFrame, LocalBoundedDataFrame
+
+
+def _normalize_table(tbl: pa.Table) -> pa.Table:
+    target = pa.schema([pa.field(f.name, _normalize_type(f.type)) for f in tbl.schema])
+    if target != tbl.schema:
+        tbl = tbl.cast(target)
+    return tbl
+
+
+def build_arrow_table(df: Any, schema: Optional[Schema]) -> pa.Table:
+    """Build a ``pa.Table`` from tables/pandas/arrays/iterables + schema."""
+    if df is None:
+        assert_or_throw(
+            schema is not None, FugueDataFrameInitError("schema is required")
+        )
+        return schema.create_empty_arrow_table()
+    if isinstance(df, pa.Table):
+        if schema is not None and Schema(df.schema) != schema:
+            return df.cast(schema.pa_schema)
+        return _normalize_table(df)
+    if isinstance(df, pa.RecordBatch):
+        return build_arrow_table(pa.Table.from_batches([df]), schema)
+    if isinstance(df, pd.DataFrame):
+        if schema is None:
+            schema = Schema(df)
+        return pa.Table.from_pandas(
+            df, schema=schema.pa_schema, preserve_index=False, safe=False
+        )
+    if isinstance(df, Iterable):
+        assert_or_throw(
+            schema is not None, FugueDataFrameInitError("schema is required")
+        )
+        names = schema.names
+        rows = [dict(zip(names, row)) for row in df]
+        if len(rows) == 0:
+            return schema.create_empty_arrow_table()
+        return pa.Table.from_pylist(rows, schema=schema.pa_schema)
+    raise FugueDataFrameInitError(f"can't build arrow table from {type(df)}")
+
+
+class ArrowDataFrame(LocalBoundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        s = None if schema is None else (schema if isinstance(schema, Schema) else Schema(schema))
+        if isinstance(df, DataFrame):
+            tbl = df.as_arrow()
+            if s is not None and Schema(tbl.schema) != s:
+                tbl = tbl.cast(s.pa_schema)
+        else:
+            tbl = build_arrow_table(df, s)
+        self._native = tbl
+        super().__init__(Schema(tbl.schema))
+
+    @property
+    def native(self) -> pa.Table:
+        return self._native
+
+    def native_as_df(self) -> pa.Table:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return self._native.num_rows == 0
+
+    def count(self) -> int:
+        return self._native.num_rows
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        row = self._native.slice(0, 1).to_pylist()[0]
+        return [_postprocess(v) for v in row.values()]
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return self._native
+
+    def as_pandas(self) -> pd.DataFrame:
+        return self._native.to_pandas(use_threads=False)
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        keep = [n for n in self.schema.names if n not in cols]
+        return ArrowDataFrame(self._native.select(keep))
+
+    def _select_cols(self, cols: List[str]) -> DataFrame:
+        return ArrowDataFrame(self._native.select(cols))
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        new_schema = self.schema.rename(columns)  # validates
+        return ArrowDataFrame(self._native.rename_columns(new_schema.names))
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self.schema.alter(columns)
+        if new_schema == self.schema:
+            return self
+        try:
+            return ArrowDataFrame(self._native.cast(new_schema.pa_schema))
+        except pa.ArrowInvalid as e:
+            raise FugueDataFrameOperationError(str(e)) from e
+
+    def head(self, n: int, columns: Optional[List[str]] = None) -> LocalBoundedDataFrame:
+        tbl = self._native if columns is None else self._native.select(columns)
+        return ArrowDataFrame(tbl.slice(0, n))
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        tbl = self._native if columns is None else self._native.select(columns)
+        return [[_postprocess(v) for v in row.values()] for row in tbl.to_pylist()]
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        tbl = self._native if columns is None else self._native.select(columns)
+        for batch in tbl.to_batches():
+            for row in batch.to_pylist():
+                yield [_postprocess(v) for v in row.values()]
+
+
+def _postprocess(v: Any) -> Any:
+    # pyarrow returns maps as list-of-tuples; keep as-is (reference behavior)
+    return v

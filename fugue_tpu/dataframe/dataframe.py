@@ -1,0 +1,284 @@
+"""DataFrame ABC: schema-carrying datasets with columnar conversions.
+
+Parity with the reference (`fugue/dataframe/dataframe.py:29-299`):
+lazy schema, conversions (pandas/arrow/arrays/dicts), column ops
+(rename/drop/alter/head), local/bounded variants, and the ``YieldedDataFrame``
+handle used by workflow yields. Redesigned TPU-first: conversions are
+columnar (arrow is the interchange format); per-row paths exist only for the
+user-facing ``as_array*`` APIs.
+"""
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from ..collections.yielded import Yielded
+from ..dataset.dataset import Dataset, DatasetDisplay, get_dataset_display
+from ..exceptions import (
+    FugueDataFrameEmptyError,
+    FugueDataFrameOperationError,
+    FugueInvalidOperation,
+)
+from ..schema import Schema
+
+AnySchema = Union[Schema, str, pa.Schema, List[Any], Dict[str, Any], None]
+
+
+class DataFrame(Dataset):
+    """Abstract schema-carrying dataframe."""
+
+    def __init__(self, schema: Any = None):
+        super().__init__()
+        if callable(schema):
+            self._schema: Union[Schema, Callable[[], Any]] = schema
+            self._schema_discovered = False
+        else:
+            s = schema if isinstance(schema, Schema) else Schema(schema)
+            s.assert_not_empty().set_readonly()
+            self._schema = s
+            self._schema_discovered = True
+
+    @property
+    def schema(self) -> Schema:
+        if not self._schema_discovered:
+            raw = self._schema()  # type: ignore
+            s = raw if isinstance(raw, Schema) else Schema(raw)
+            s.assert_not_empty().set_readonly()
+            self._schema = s
+            self._schema_discovered = True
+        return self._schema  # type: ignore
+
+    @property
+    def schema_discovered(self) -> bool:
+        return self._schema_discovered
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # ---- abstract surface -------------------------------------------------
+    @abstractmethod
+    def peek_array(self) -> List[Any]:
+        """First row as a list; raises when empty."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_local_bounded(self) -> "LocalBoundedDataFrame":
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        raise NotImplementedError
+
+    @abstractmethod
+    def _drop_cols(self, cols: List[str]) -> "DataFrame":
+        raise NotImplementedError
+
+    @abstractmethod
+    def _select_cols(self, cols: List[str]) -> "DataFrame":
+        raise NotImplementedError
+
+    @abstractmethod
+    def rename(self, columns: Dict[str, str]) -> "DataFrame":
+        raise NotImplementedError
+
+    @abstractmethod
+    def alter_columns(self, columns: Any) -> "DataFrame":
+        """Cast a subset of columns to new types (``columns`` is schema-like)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> "LocalBoundedDataFrame":
+        raise NotImplementedError
+
+    # ---- provided ---------------------------------------------------------
+    def as_local(self) -> "LocalDataFrame":
+        return self.as_local_bounded()
+
+    def peek_dict(self) -> Dict[str, Any]:
+        arr = self.peek_array()
+        return dict(zip(self.schema.names, arr))
+
+    def as_dicts(self, columns: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        names = columns or self.schema.names
+        return [dict(zip(names, row)) for row in self.as_array(columns, type_safe=True)]
+
+    def as_dict_iterable(
+        self, columns: Optional[List[str]] = None
+    ) -> Iterable[Dict[str, Any]]:
+        names = columns or self.schema.names
+        for row in self.as_array_iterable(columns, type_safe=True):
+            yield dict(zip(names, row))
+
+    def as_pandas(self) -> pd.DataFrame:
+        return self.as_arrow().to_pandas(use_threads=False)
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return pa.Table.from_pylist(
+            [dict(zip(self.schema.names, row)) for row in self.as_array(type_safe=True)],
+            schema=self.schema.pa_schema,
+        )
+
+    def drop(self, columns: List[str]) -> "DataFrame":
+        assert_or_throw(
+            len(columns) > 0, FugueDataFrameOperationError("columns can't be empty")
+        )
+        missing = [c for c in columns if c not in self.schema]
+        assert_or_throw(
+            len(missing) == 0,
+            lambda: FugueDataFrameOperationError(f"columns {missing} not in {self.schema}"),
+        )
+        assert_or_throw(
+            len(columns) < len(self.schema),
+            FugueDataFrameOperationError("can't drop all columns"),
+        )
+        return self._drop_cols(columns)
+
+    def __getitem__(self, columns: List[Any]) -> "DataFrame":
+        assert_or_throw(
+            isinstance(columns, list) and len(columns) > 0,
+            FugueDataFrameOperationError("columns must be a non-empty list"),
+        )
+        missing = [c for c in columns if c not in self.schema]
+        assert_or_throw(
+            len(missing) == 0,
+            lambda: FugueDataFrameOperationError(f"columns {missing} not in {self.schema}"),
+        )
+        return self._select_cols(columns)
+
+    def get_info_str(self) -> str:
+        return f"{type(self).__name__}({self.schema})"
+
+    def __repr__(self) -> str:
+        return self.get_info_str()
+
+    def _repr_html_(self) -> str:
+        try:
+            return get_dataset_display(self).repr_html()
+        except NotImplementedError:
+            return "<pre>" + self.get_info_str() + "</pre>"
+
+    def assert_not_empty(self) -> None:
+        if self.empty:
+            raise FugueDataFrameEmptyError("dataframe is empty")
+
+
+class LocalDataFrame(DataFrame):
+    """A dataframe fully resident in the driver process."""
+
+    @property
+    def is_local(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+
+class LocalBoundedDataFrame(LocalDataFrame):
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+    def as_local_bounded(self) -> "LocalBoundedDataFrame":
+        return self
+
+
+class LocalUnboundedDataFrame(LocalDataFrame):
+    @property
+    def is_bounded(self) -> bool:
+        return False
+
+    def count(self) -> int:
+        raise FugueInvalidOperation("can't count an unbounded dataframe")
+
+
+class YieldedDataFrame(Yielded):
+    """A dataframe-valued workflow yield (reference
+    ``fugue/dataframe/dataframe.py:384``)."""
+
+    def __init__(self, yid: str):
+        super().__init__(yid)
+        self._df: Optional[DataFrame] = None
+
+    @property
+    def is_set(self) -> bool:
+        return self._df is not None
+
+    def set_value(self, df: DataFrame) -> None:
+        self._df = df
+
+    @property
+    def result(self) -> DataFrame:
+        assert_or_throw(self.is_set, FugueInvalidOperation("value is not set"))
+        return self._df  # type: ignore
+
+
+class DataFrameDisplay(DatasetDisplay):
+    """Plain-text tabular display for any DataFrame."""
+
+    @property
+    def df(self) -> DataFrame:
+        return self._ds  # type: ignore
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        head = self.df.head(n)
+        rows = head.as_array(type_safe=True)
+        print(self.render(rows, with_count=with_count, title=title, n=n))
+
+    def render(
+        self,
+        rows: List[List[Any]],
+        with_count: bool = False,
+        title: Optional[str] = None,
+        n: int = 10,
+    ) -> str:
+        lines: List[str] = []
+        if title is not None:
+            lines.append(title)
+        schema = self.df.schema
+        headers = [f"{f.name}:{_short_type(f)}" for f in schema.fields]
+        widths = [
+            max(len(h), *(len(_cell(r[i])) for r in rows)) if len(rows) > 0 else len(h)
+            for i, h in enumerate(headers)
+        ]
+        lines.append("|".join(h.ljust(w) for h, w in zip(headers, widths)))
+        lines.append("+".join("-" * w for w in widths))
+        for r in rows:
+            lines.append("|".join(_cell(v).ljust(w) for v, w in zip(r, widths)))
+        if with_count:
+            lines.append(f"Total count: {self.df.count()}")
+        return "\n".join(lines)
+
+
+@get_dataset_display.candidate(lambda ds: isinstance(ds, DataFrame), priority=0.1)
+def _default_dataframe_display(ds: Dataset) -> DatasetDisplay:
+    return DataFrameDisplay(ds)
+
+
+def _short_type(f: pa.Field) -> str:
+    from ..schema import type_to_expression
+
+    return type_to_expression(f.type)
+
+
+def _cell(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    s = str(v)
+    return s if len(s) <= 40 else s[:37] + "..."

@@ -1,0 +1,155 @@
+"""LocalDataFrameIterableDataFrame — a stream of local frames.
+
+Parity with the reference (`fugue/dataframe/dataframe_iterable_dataframe.py:21`):
+the chunked output format of map operations, letting a partition be processed
+as a sequence of small columnar frames without full materialization.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from .._utils.iter import EmptyAwareIterable, make_empty_aware
+from ..exceptions import FugueDataFrameInitError
+from ..schema import Schema
+from .array_dataframe import ArrayDataFrame
+from .arrow_dataframe import ArrowDataFrame
+from .dataframe import DataFrame, LocalBoundedDataFrame, LocalDataFrame, LocalUnboundedDataFrame
+from .pandas_dataframe import PandasDataFrame
+
+
+class LocalDataFrameIterableDataFrame(LocalUnboundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            it: Iterable[LocalDataFrame] = []
+        elif isinstance(df, LocalDataFrameIterableDataFrame):
+            it = df.native
+            schema = schema or (df.schema if df.schema_discovered else None)
+        elif isinstance(df, DataFrame):
+            it = [df.as_local()]  # type: ignore
+            schema = schema or df.schema
+        elif isinstance(df, Iterable):
+            it = df
+        else:
+            raise FugueDataFrameInitError(
+                f"can't build LocalDataFrameIterableDataFrame from {type(df)}"
+            )
+        self._native: EmptyAwareIterable[LocalDataFrame] = make_empty_aware(
+            self._wrap(it)
+        )
+        if schema is not None:
+            super().__init__(schema)
+        else:
+            assert_or_throw(
+                not self._native.empty,
+                FugueDataFrameInitError(
+                    "schema is required when the iterable can be empty"
+                ),
+            )
+            super().__init__(lambda: self._native.peek().schema)
+
+    def _wrap(self, it: Iterable[Any]) -> Iterable[LocalDataFrame]:
+        for x in it:
+            if isinstance(x, LocalDataFrame):
+                yield x
+            elif isinstance(x, pd.DataFrame):
+                yield PandasDataFrame(x)
+            elif isinstance(x, pa.Table):
+                yield ArrowDataFrame(x)
+            else:
+                raise FugueDataFrameInitError(f"invalid chunk type {type(x)}")
+
+    @property
+    def native(self) -> EmptyAwareIterable[LocalDataFrame]:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        # like the reference, only the head chunk is inspected (one-pass)
+        return self._native.empty or self._native.peek().empty
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return self._native.peek().peek_array()
+
+    def as_local_bounded(self) -> LocalBoundedDataFrame:
+        tables = [f.as_arrow() for f in self._native if f.count() > 0]
+        if len(tables) == 0:
+            return ArrowDataFrame(None, self.schema)
+        target = self.schema.pa_schema
+        tables = [t if t.schema == target else t.cast(target) for t in tables]
+        return ArrowDataFrame(pa.concat_tables(tables))
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema - cols
+
+        def gen() -> Iterable[LocalDataFrame]:
+            for f in self._native:
+                yield f.drop(cols)  # type: ignore
+
+        return LocalDataFrameIterableDataFrame(gen(), schema)
+
+    def _select_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.extract(cols)
+
+        def gen() -> Iterable[LocalDataFrame]:
+            for f in self._native:
+                yield f[cols]  # type: ignore
+
+        return LocalDataFrameIterableDataFrame(gen(), schema)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        schema = self.schema.rename(columns)
+
+        def gen() -> Iterable[LocalDataFrame]:
+            for f in self._native:
+                yield f.rename(columns)  # type: ignore
+
+        return LocalDataFrameIterableDataFrame(gen(), schema)
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        schema = self.schema.alter(columns)
+        if schema == self.schema:
+            return self
+
+        def gen() -> Iterable[LocalDataFrame]:
+            for f in self._native:
+                yield f.alter_columns(columns)  # type: ignore
+
+        return LocalDataFrameIterableDataFrame(gen(), schema)
+
+    def head(self, n: int, columns: Optional[List[str]] = None) -> LocalBoundedDataFrame:
+        rows: List[List[Any]] = []
+        src = self if columns is None else self._select_cols(columns)
+        for f in src.native:  # type: ignore
+            if len(rows) >= n:
+                break
+            rows.extend(f.head(n - len(rows)).as_array())
+        return ArrayDataFrame(rows, src.schema)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        return list(self.as_array_iterable(columns, type_safe=type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        for f in self._native:
+            yield from f.as_array_iterable(columns, type_safe=type_safe)
+
+    def as_pandas(self) -> pd.DataFrame:
+        return self.as_local_bounded().as_pandas()
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return self.as_local_bounded().as_arrow()
+
+
+class IterablePandasDataFrame(LocalDataFrameIterableDataFrame):
+    """Stream of pandas chunks (reference ``:202``)."""
+
+
+class IterableArrowDataFrame(LocalDataFrameIterableDataFrame):
+    """Stream of arrow chunks (reference ``:207``)."""

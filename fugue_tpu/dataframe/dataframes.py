@@ -1,0 +1,55 @@
+"""DataFrames — ordered, named collection of DataFrames.
+
+Parity with the reference (`fugue/dataframe/dataframes.py:9`): the
+multi-input container passed to processors/outputters/cotransformers.
+"""
+
+from typing import Any, Dict, List
+
+from .._utils.params import IndexedOrderedDict
+from ..exceptions import FugueDataFrameInitError
+from .dataframe import DataFrame
+
+
+class DataFrames(IndexedOrderedDict):
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__()
+        self._has_dict_key = False
+        for a in args:
+            self._append(a)
+        for k, v in kwargs.items():
+            self[k] = v
+        self.set_readonly()
+
+    def _append(self, obj: Any) -> None:
+        if obj is None:
+            return
+        if isinstance(obj, DataFrame):
+            self[f"_{len(self)}"] = obj
+        elif isinstance(obj, DataFrames) or isinstance(obj, Dict):
+            for k, v in obj.items():
+                self[k] = v
+        elif isinstance(obj, (list, tuple)):
+            for x in obj:
+                self._append(x)
+        else:
+            raise FugueDataFrameInitError(f"can't add {type(obj)} to DataFrames")
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        if not isinstance(value, DataFrame):
+            raise FugueDataFrameInitError(f"{key} value must be a DataFrame")
+        if not key.startswith("_"):
+            self._has_dict_key = True
+        super().__setitem__(key, value)
+
+    @property
+    def has_key(self) -> bool:
+        return self._has_dict_key
+
+    def __getitem__(self, key: Any) -> DataFrame:  # type: ignore
+        if isinstance(key, int):
+            return self.get_value_by_index(key)
+        return super().__getitem__(key)
+
+    def convert(self, func: Any) -> "DataFrames":
+        return DataFrames({k: func(v) for k, v in self.items()})

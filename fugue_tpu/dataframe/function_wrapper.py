@@ -1,0 +1,519 @@
+"""The interfaceless core: adapt plain Python functions by annotations.
+
+Parity with the reference (`fugue/dataframe/function_wrapper.py:50`): each
+function parameter/return annotation maps to an ``AnnotatedParam`` with a
+one-char code; the concatenated code string is validated against a regex per
+extension type. Codes (matching the reference's conventions):
+
+    e  ExecutionEngine          c  DataFrames (multi-input)
+    d  DataFrame (any)          l  LocalDataFrame
+    s  no-schema local data (List[List], Iterable[List], List[Dict], ...)
+    p  pd.DataFrame (+ Iterable[pd.DataFrame])
+    q  pa.Table (+ Iterable[pa.Table])
+    f  Callable   F  Optional[Callable]
+    x  simple param             z  **kwargs
+    n  None / no return annotation
+
+New annotated params register via :func:`fugue_annotated_param` — the same
+plugin mechanism backends (including the TPU engine) use to accept
+``jax.Array``/device-frame annotations.
+"""
+
+import inspect
+import re
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Type,
+    Union,
+)
+
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from .._utils.hash import to_uuid
+from .._utils.iter import EmptyAwareIterable, make_empty_aware
+from .._utils.params import IndexedOrderedDict
+from ..exceptions import FugueInterfacelessError
+from ..schema import Schema
+from .array_dataframe import ArrayDataFrame
+from .arrow_dataframe import ArrowDataFrame
+from .dataframe import DataFrame, LocalDataFrame
+from .dataframe_iterable_dataframe import (
+    IterableArrowDataFrame,
+    IterablePandasDataFrame,
+    LocalDataFrameIterableDataFrame,
+)
+from .dataframes import DataFrames
+from .iterable_dataframe import IterableDataFrame
+from .pandas_dataframe import PandasDataFrame
+
+_PARAM_REGISTRY: List[Any] = []  # (matcher, cls) pairs, later registrations win
+
+
+def fugue_annotated_param(
+    annotation: Any = None,
+    code: Optional[str] = None,
+    matcher: Optional[Callable[[Any], bool]] = None,
+):
+    """Register an ``AnnotatedParam`` class for an annotation."""
+
+    def deco(cls: Type["AnnotatedParam"]) -> Type["AnnotatedParam"]:
+        m = matcher
+        if m is None:
+            m = lambda a: a == annotation  # noqa: E731
+        if code is not None:
+            cls.code = code
+        _PARAM_REGISTRY.insert(0, (m, cls))
+        return cls
+
+    return deco
+
+
+def _compare_iter(tp: Any) -> Callable[[Any], bool]:
+    def m(a: Any) -> bool:
+        return a in (
+            Iterable[tp],
+            Iterator[tp],
+        ) or str(a) in (
+            f"typing.Generator[{tp}, NoneType, NoneType]",
+        )
+
+    return m
+
+
+class AnnotatedParam:
+    code = "x"
+
+    def __init__(self, param: Optional[inspect.Parameter]):
+        self.param = param
+
+    @property
+    def format_hint(self) -> Optional[str]:
+        return None
+
+    def __uuid__(self) -> str:
+        return to_uuid(type(self).__name__, self.code)
+
+
+class _OtherParam(AnnotatedParam):
+    code = "x"
+
+
+class _KeywordParam(AnnotatedParam):
+    code = "z"
+
+
+class _NoneParam(AnnotatedParam):
+    code = "n"
+
+
+class _CallableParam(AnnotatedParam):
+    code = "f"
+
+
+class _OptionalCallableParam(AnnotatedParam):
+    code = "F"
+
+
+def _is_callable_anno(a: Any) -> bool:
+    return (
+        a == Callable
+        or a == callable
+        or str(a).startswith("typing.Callable")
+        or str(a).startswith("collections.abc.Callable")
+    )
+
+
+def _is_opt_callable_anno(a: Any) -> bool:
+    s = str(a)
+    return (
+        a == Optional[Callable]
+        or s.startswith("typing.Optional[typing.Callable")
+        or s.startswith("typing.Union[typing.Callable")
+        or (s.startswith("typing.Optional[collections.abc.Callable"))
+    )
+
+
+class DataFrameParam(AnnotatedParam):
+    """Base for params that carry a dataframe."""
+
+    code = "d"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Any:
+        return df
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        assert_or_throw(
+            isinstance(output, DataFrame),
+            lambda: FugueInterfacelessError(f"output {type(output)} is not a DataFrame"),
+        )
+        assert_or_throw(
+            schema is None or output.schema == schema,
+            lambda: FugueInterfacelessError(
+                f"output schema {output.schema} != expected {schema}"
+            ),
+        )
+        return output
+
+    def count(self, df: Any) -> int:
+        raise NotImplementedError
+
+    @property
+    def need_schema(self) -> Optional[bool]:
+        return False
+
+
+class LocalDataFrameParam(DataFrameParam):
+    code = "l"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> LocalDataFrame:
+        return df.as_local()
+
+    def count(self, df: LocalDataFrame) -> int:
+        return df.count() if df.is_bounded else sum(1 for _ in df.as_array_iterable())
+
+
+class _NoSchemaParam(LocalDataFrameParam):
+    """Local data without an attached schema — output schema is mandatory."""
+
+    code = "s"
+
+    @property
+    def need_schema(self) -> Optional[bool]:
+        return True
+
+
+class _ListListParam(_NoSchemaParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> List[List[Any]]:
+        return df.as_array(type_safe=True)
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        return ArrayDataFrame(output, schema)
+
+    def count(self, df: List[List[Any]]) -> int:
+        return len(df)
+
+
+class _IterableListParam(_NoSchemaParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Iterable[List[Any]]:
+        return df.as_array_iterable(type_safe=True)
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        return IterableDataFrame(output, schema)
+
+    def count(self, df: Any) -> int:
+        return sum(1 for _ in df)
+
+
+class _EmptyAwareIterableListParam(_IterableListParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> EmptyAwareIterable[List[Any]]:
+        return make_empty_aware(df.as_array_iterable(type_safe=True))
+
+
+class _ListDictParam(_NoSchemaParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> List[Dict[str, Any]]:
+        return df.as_local().as_dicts()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        assert_or_throw(schema is not None, FugueInterfacelessError("schema is required"))
+        rows = [[r.get(n, None) for n in schema.names] for r in output]
+        return ArrayDataFrame(rows, schema)
+
+    def count(self, df: Any) -> int:
+        return len(df)
+
+
+class _IterableDictParam(_NoSchemaParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Iterable[Dict[str, Any]]:
+        return df.as_dict_iterable()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        assert_or_throw(schema is not None, FugueInterfacelessError("schema is required"))
+        names = schema.names
+
+        def gen() -> Iterable[List[Any]]:
+            for r in output:
+                yield [r.get(n, None) for n in names]
+
+        return IterableDataFrame(gen(), schema)
+
+    def count(self, df: Any) -> int:
+        return sum(1 for _ in df)
+
+
+class _EmptyAwareIterableDictParam(_IterableDictParam):
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> EmptyAwareIterable[Dict[str, Any]]:
+        return make_empty_aware(df.as_dict_iterable())
+
+
+class _PandasParam(LocalDataFrameParam):
+    code = "p"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> pd.DataFrame:
+        return df.as_pandas()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        assert_or_throw(
+            isinstance(output, pd.DataFrame),
+            lambda: FugueInterfacelessError(f"output {type(output)} is not pd.DataFrame"),
+        )
+        return PandasDataFrame(output, schema)
+
+    def count(self, df: pd.DataFrame) -> int:
+        return len(df)
+
+    @property
+    def format_hint(self) -> Optional[str]:
+        return "pandas"
+
+
+class _IterablePandasParam(LocalDataFrameParam):
+    code = "p"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Iterable[pd.DataFrame]:
+        if isinstance(df, LocalDataFrameIterableDataFrame):
+            for sub in df.native:
+                yield sub.as_pandas()
+        else:
+            yield df.as_pandas()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        def gen() -> Iterable[LocalDataFrame]:
+            for pdf in output:
+                yield PandasDataFrame(pdf, schema)
+
+        return IterablePandasDataFrame(gen(), schema)
+
+    def count(self, df: Any) -> int:
+        return sum(len(x) for x in df)
+
+    @property
+    def format_hint(self) -> Optional[str]:
+        return "pandas"
+
+
+class _PyArrowTableParam(LocalDataFrameParam):
+    code = "q"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> pa.Table:
+        return df.as_arrow()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        assert_or_throw(
+            isinstance(output, pa.Table),
+            lambda: FugueInterfacelessError(f"output {type(output)} is not pa.Table"),
+        )
+        res = ArrowDataFrame(output)
+        if schema is not None and res.schema != schema:
+            res = ArrowDataFrame(output, schema)
+        return res
+
+    def count(self, df: pa.Table) -> int:
+        return df.num_rows
+
+    @property
+    def format_hint(self) -> Optional[str]:
+        return "pyarrow"
+
+
+class _IterableArrowParam(LocalDataFrameParam):
+    code = "q"
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Iterable[pa.Table]:
+        if isinstance(df, LocalDataFrameIterableDataFrame):
+            for sub in df.native:
+                yield sub.as_arrow()
+        else:
+            yield df.as_arrow()
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        def gen() -> Iterable[LocalDataFrame]:
+            for tbl in output:
+                adf = ArrowDataFrame(tbl)
+                if schema is not None and adf.schema != schema:
+                    adf = ArrowDataFrame(tbl, schema)
+                yield adf
+
+        return IterableArrowDataFrame(gen(), schema)
+
+    def count(self, df: Any) -> int:
+        return sum(x.num_rows for x in df)
+
+    @property
+    def format_hint(self) -> Optional[str]:
+        return "pyarrow"
+
+
+class _DataFramesParam(AnnotatedParam):
+    code = "c"
+
+
+# registration order matters only within equal matchers; each matcher is exact
+fugue_annotated_param(DataFrame)(DataFrameParam)
+fugue_annotated_param(LocalDataFrame)(LocalDataFrameParam)
+fugue_annotated_param(List[List[Any]])(_ListListParam)
+fugue_annotated_param(matcher=_compare_iter(List[Any]))(_IterableListParam)
+fugue_annotated_param(EmptyAwareIterable[List[Any]])(_EmptyAwareIterableListParam)
+fugue_annotated_param(List[Dict[str, Any]])(_ListDictParam)
+fugue_annotated_param(matcher=_compare_iter(Dict[str, Any]))(_IterableDictParam)
+fugue_annotated_param(EmptyAwareIterable[Dict[str, Any]])(_EmptyAwareIterableDictParam)
+fugue_annotated_param(pd.DataFrame)(_PandasParam)
+fugue_annotated_param(matcher=_compare_iter(pd.DataFrame))(_IterablePandasParam)
+fugue_annotated_param(pa.Table)(_PyArrowTableParam)
+fugue_annotated_param(matcher=_compare_iter(pa.Table))(_IterableArrowParam)
+fugue_annotated_param(DataFrames)(_DataFramesParam)
+fugue_annotated_param(matcher=_is_callable_anno)(_CallableParam)
+fugue_annotated_param(matcher=_is_opt_callable_anno)(_OptionalCallableParam)
+
+
+def parse_annotation(
+    annotation: Any,
+    param: Optional[inspect.Parameter] = None,
+    none_as_other: bool = True,
+) -> AnnotatedParam:
+    if param is not None and param.kind == param.VAR_KEYWORD:
+        return _KeywordParam(param)
+    if param is not None and param.kind == param.VAR_POSITIONAL:
+        raise FugueInterfacelessError("*args is not supported")
+    if annotation is None or annotation == type(None) or annotation is inspect.Parameter.empty:
+        return _OtherParam(param) if none_as_other else _NoneParam(param)
+    for m, cls in _PARAM_REGISTRY:
+        try:
+            if m(annotation):
+                return cls(param)
+        except Exception:
+            continue
+    return _OtherParam(param)
+
+
+class DataFrameFunctionWrapper:
+    """Wrap a plain function; validate and adapt its dataframe params."""
+
+    def __init__(self, func: Callable, params_re: str = ".*", return_re: str = ".*"):
+        from .._utils.convert import annotation_of
+
+        self._func = func
+        sig = inspect.signature(func)
+        self._params: IndexedOrderedDict = IndexedOrderedDict()
+        for name, param in sig.parameters.items():
+            anno = annotation_of(func, name)
+            if anno is inspect.Parameter.empty:
+                anno = param.annotation
+            self._params[name] = parse_annotation(anno, param)
+        rt_anno = annotation_of(func, None)
+        if rt_anno is inspect.Parameter.empty:
+            rt_anno = sig.return_annotation
+        self._rt = parse_annotation(rt_anno, None, none_as_other=False)
+        self._input_code = "".join(p.code for p in self._params.values())
+        assert_or_throw(
+            re.match(params_re, self._input_code) is not None,
+            lambda: FugueInterfacelessError(
+                f"input signature {self._input_code!r} of {func} "
+                f"doesn't match pattern {params_re!r}"
+            ),
+        )
+        assert_or_throw(
+            re.match(return_re, self._rt.code) is not None,
+            lambda: FugueInterfacelessError(
+                f"return annotation code {self._rt.code!r} of {func} "
+                f"doesn't match pattern {return_re!r}"
+            ),
+        )
+
+    @property
+    def input_code(self) -> str:
+        return self._input_code
+
+    @property
+    def output_code(self) -> str:
+        return self._rt.code
+
+    @property
+    def params(self) -> IndexedOrderedDict:
+        return self._params
+
+    @property
+    def rt(self) -> AnnotatedParam:
+        return self._rt
+
+    @property
+    def need_output_schema(self) -> Optional[bool]:
+        return (
+            self._rt.need_schema
+            if isinstance(self._rt, DataFrameParam)
+            else None
+        )
+
+    def get_format_hint(self) -> Optional[str]:
+        for p in self._params.values():
+            if p.format_hint is not None:
+                return p.format_hint
+        if isinstance(self._rt, AnnotatedParam) and self._rt.format_hint is not None:
+            return self._rt.format_hint
+        return None
+
+    def __uuid__(self) -> str:
+        return to_uuid(self._func, self._input_code, self._rt.code)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self._func(*args, **kwargs)
+
+    def run(
+        self,
+        args: List[Any],
+        kwargs: Dict[str, Any],
+        ignore_unknown: bool = False,
+        output_schema: Any = None,
+        output: bool = True,
+        ctx: Any = None,
+    ) -> Any:
+        """Call the wrapped function, converting dataframe args per annotation."""
+        schema = None if output_schema is None else (
+            output_schema if isinstance(output_schema, Schema) else Schema(output_schema)
+        )
+        p: Dict[str, Any] = {}
+        remaining = dict(kwargs)
+        i = 0
+        for name, ap in self._params.items():
+            if isinstance(ap, _KeywordParam):
+                continue
+            if i < len(args):
+                p[name] = self._to_input(ap, args[i], ctx)
+                i += 1
+            elif name in remaining:
+                p[name] = self._to_input(ap, remaining.pop(name), ctx)
+            elif ap.param is not None and ap.param.default is not inspect.Parameter.empty:
+                pass  # use default
+            elif isinstance(ap, _OptionalCallableParam):
+                p[name] = None
+        has_kw = any(isinstance(ap, _KeywordParam) for ap in self._params.values())
+        if len(remaining) > 0:
+            if has_kw:
+                p.update(remaining)
+            elif not ignore_unknown:
+                raise FugueInterfacelessError(
+                    f"{list(remaining.keys())} are not acceptable by {self._func}"
+                )
+        result = self._func(**p)
+        if not output:
+            if isinstance(result, (Iterator, Iterable)) and not isinstance(
+                result, (str, bytes, list, dict, pd.DataFrame, pa.Table)
+            ):
+                for _ in result:  # drain generators so side effects happen
+                    pass
+            return None
+        if isinstance(self._rt, DataFrameParam):
+            return self._rt.to_output_df(result, schema, ctx)
+        return result
+
+    def _to_input(self, ap: AnnotatedParam, value: Any, ctx: Any) -> Any:
+        if isinstance(ap, DataFrameParam) and isinstance(value, DataFrame):
+            return ap.to_input_data(value, ctx)
+        return value

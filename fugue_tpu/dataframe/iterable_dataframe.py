@@ -1,0 +1,134 @@
+"""IterableDataFrame — one-pass unbounded local frame.
+
+Parity with the reference (`fugue/dataframe/iterable_dataframe.py:16`): wraps
+a row iterator; most operations consume the stream lazily; materializing
+converts to :class:`ArrayDataFrame`.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from .._utils.assertion import assert_or_throw
+from .._utils.iter import EmptyAwareIterable, make_empty_aware
+from ..exceptions import FugueDataFrameInitError
+from ..schema import Schema
+from .array_dataframe import ArrayDataFrame
+from .dataframe import DataFrame, LocalBoundedDataFrame, LocalUnboundedDataFrame
+
+
+class IterableDataFrame(LocalUnboundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            assert_or_throw(
+                schema is not None, FugueDataFrameInitError("schema is required")
+            )
+            it: Iterable[Any] = []
+            s = schema if isinstance(schema, Schema) else Schema(schema)
+        elif isinstance(df, IterableDataFrame):
+            it = df.native
+            s = schema if schema is not None else df.schema
+            s = s if isinstance(s, Schema) else Schema(s)
+        elif isinstance(df, DataFrame):
+            s = schema if schema is not None else df.schema
+            s = s if isinstance(s, Schema) else Schema(s)
+            it = df.as_array_iterable(columns=s.names if schema is not None else None)
+        elif isinstance(df, Iterable):
+            assert_or_throw(
+                schema is not None, FugueDataFrameInitError("schema is required")
+            )
+            s = schema if isinstance(schema, Schema) else Schema(schema)
+            it = df
+        else:
+            raise FugueDataFrameInitError(f"can't build IterableDataFrame from {type(df)}")
+        self._native: EmptyAwareIterable[List[Any]] = make_empty_aware(it)
+        super().__init__(s)
+
+    @property
+    def native(self) -> EmptyAwareIterable[List[Any]]:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return self._native.empty
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return list(self._native.peek())
+
+    def as_local_bounded(self) -> LocalBoundedDataFrame:
+        return ArrayDataFrame(self.as_array(), self.schema)
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        keep = [n for n in self.schema.names if n not in cols]
+        return self._select_cols(keep)
+
+    def _select_cols(self, cols: List[str]) -> DataFrame:
+        idx = [self.schema.index_of_key(c) for c in cols]
+
+        def gen() -> Iterable[List[Any]]:
+            for row in self._native:
+                yield [row[i] for i in idx]
+
+        return IterableDataFrame(gen(), self.schema.extract(cols))
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        return IterableDataFrame(self._native, self.schema.rename(columns))
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        from .arrow_dataframe import ArrowDataFrame
+
+        new_schema = self.schema.alter(columns)
+        if new_schema == self.schema:
+            return self
+
+        old_schema = self.schema
+
+        def gen() -> Iterable[List[Any]]:
+            for chunk in _chunked(self._native, 10000):
+                adf = ArrowDataFrame(chunk, old_schema).alter_columns(columns)
+                yield from adf.as_array()
+
+        return IterableDataFrame(gen(), new_schema)
+
+    def head(self, n: int, columns: Optional[List[str]] = None) -> LocalBoundedDataFrame:
+        src = self if columns is None else self._select_cols(columns)
+        rows = []
+        for row in src.as_array_iterable():
+            if len(rows) >= n:
+                break
+            rows.append(row)
+        return ArrayDataFrame(rows, src.schema)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        return list(self.as_array_iterable(columns, type_safe=type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        src: Iterable[List[Any]]
+        if columns is None:
+            src = self._native
+        else:
+            src = self._select_cols(columns).as_array_iterable()  # type: ignore
+            yield from src
+            return
+        if not type_safe:
+            yield from src
+        else:
+            from .arrow_dataframe import ArrowDataFrame
+
+            schema = self.schema
+            for chunk in _chunked(src, 10000):
+                yield from ArrowDataFrame(chunk, schema).as_array()
+
+
+def _chunked(it: Iterable[Any], size: int) -> Iterable[List[Any]]:
+    buf: List[Any] = []
+    for x in it:
+        buf.append(x)
+        if len(buf) >= size:
+            yield buf
+            buf = []
+    if len(buf) > 0:
+        yield buf

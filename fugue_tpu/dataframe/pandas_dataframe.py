@@ -1,0 +1,150 @@
+"""PandasDataFrame — local frame over ``pd.DataFrame``.
+
+Parity with the reference (`fugue/dataframe/pandas_dataframe.py:38`),
+including the zero-copy wrapper mode (``pandas_df_wrapper=True``) used when
+the caller guarantees dtypes already match the schema.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from ..exceptions import FugueDataFrameInitError, FugueDataFrameOperationError
+from ..schema import Schema
+from .dataframe import DataFrame, LocalBoundedDataFrame
+from .arrow_dataframe import ArrowDataFrame
+
+
+def _enforce_type(pdf: pd.DataFrame, schema: Schema) -> pd.DataFrame:
+    """Coerce a pandas frame to a schema via an arrow round trip.
+
+    Fast path: if every column's dtype already equals the schema's expected
+    pandas dtype, return as-is (zero copy).
+    """
+    expected = schema.pandas_dtype
+    if list(pdf.columns) == schema.names and all(
+        pdf[c].dtype == expected[c] for c in schema.names
+    ):
+        return pdf
+    tbl = pa.Table.from_pandas(
+        pdf[schema.names] if list(pdf.columns) != schema.names else pdf,
+        schema=schema.pa_schema,
+        preserve_index=False,
+        safe=False,
+    )
+    return tbl.to_pandas(use_threads=False)
+
+
+class PandasDataFrame(LocalBoundedDataFrame):
+    def __init__(
+        self,
+        df: Any = None,
+        schema: Any = None,
+        pandas_df_wrapper: bool = False,
+    ):
+        s = None if schema is None else (schema if isinstance(schema, Schema) else Schema(schema))
+        if df is None:
+            assert_or_throw(s is not None, FugueDataFrameInitError("schema is required"))
+            pdf = s.create_empty_pandas_df()
+        elif isinstance(df, PandasDataFrame):
+            pdf = df.native
+            s = s or df.schema
+        elif isinstance(df, DataFrame):
+            pdf = df.as_pandas()
+            s = s or df.schema
+        elif isinstance(df, pd.DataFrame):
+            pdf = df.reset_index(drop=True) if not df.index.equals(pd.RangeIndex(len(df))) else df
+            if s is None:
+                s = Schema(pdf)
+        elif isinstance(df, pd.Series):
+            pdf = df.to_frame()
+            s = s or Schema(pdf)
+        elif isinstance(df, Iterable):
+            assert_or_throw(s is not None, FugueDataFrameInitError("schema is required"))
+            data = list(df)
+            if len(data) == 0:
+                pdf = s.create_empty_pandas_df()
+            else:
+                tbl = pa.Table.from_pylist(
+                    [dict(zip(s.names, row)) for row in data], schema=s.pa_schema
+                )
+                pdf = tbl.to_pandas(use_threads=False)
+        else:
+            raise FugueDataFrameInitError(f"can't build PandasDataFrame from {type(df)}")
+        if not pandas_df_wrapper and isinstance(df, pd.DataFrame):
+            pdf = _enforce_type(pdf, s)
+        self._native = pdf
+        super().__init__(s)
+
+    @property
+    def native(self) -> pd.DataFrame:
+        return self._native
+
+    def native_as_df(self) -> pd.DataFrame:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return len(self._native) == 0
+
+    def count(self) -> int:
+        return len(self._native)
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        head = pa.Table.from_pandas(
+            self._native.head(1),
+            schema=self.schema.pa_schema,
+            preserve_index=False,
+            safe=False,
+        )
+        return list(head.to_pylist()[0].values())
+
+    def as_pandas(self) -> pd.DataFrame:
+        return self._native
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return pa.Table.from_pandas(
+            self._native, schema=self.schema.pa_schema, preserve_index=False, safe=False
+        )
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        keep = [n for n in self.schema.names if n not in cols]
+        return PandasDataFrame(
+            self._native[keep], self.schema.extract(keep), pandas_df_wrapper=True
+        )
+
+    def _select_cols(self, cols: List[str]) -> DataFrame:
+        return PandasDataFrame(
+            self._native[cols], self.schema.extract(cols), pandas_df_wrapper=True
+        )
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        new_schema = self.schema.rename(columns)
+        pdf = self._native.rename(columns=columns)
+        return PandasDataFrame(pdf, new_schema, pandas_df_wrapper=True)
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self.schema.alter(columns)
+        if new_schema == self.schema:
+            return self
+        return ArrowDataFrame(self.as_arrow()).alter_columns(columns)
+
+    def head(self, n: int, columns: Optional[List[str]] = None) -> LocalBoundedDataFrame:
+        pdf = self._native if columns is None else self._native[columns]
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        return PandasDataFrame(pdf.head(n), schema, pandas_df_wrapper=True)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[List[Any]]:
+        # always go through arrow: nulls become None, values match schema types
+        return ArrowDataFrame(self.as_arrow()).as_array(columns)
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[List[Any]]:
+        yield from ArrowDataFrame(self.as_arrow()).as_array_iterable(columns)

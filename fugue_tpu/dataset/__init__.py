@@ -1,0 +1,23 @@
+from .dataset import Dataset, DatasetDisplay, get_dataset_display
+from .api import (
+    as_fugue_dataset,
+    count,
+    get_num_partitions,
+    is_bounded,
+    is_empty,
+    is_local,
+    show,
+)
+
+__all__ = [
+    "Dataset",
+    "DatasetDisplay",
+    "get_dataset_display",
+    "as_fugue_dataset",
+    "count",
+    "get_num_partitions",
+    "is_bounded",
+    "is_empty",
+    "is_local",
+    "show",
+]

@@ -1,0 +1,43 @@
+"""Functional Dataset API (plugin-dispatched).
+
+Parity with the reference (`fugue/dataset/api.py`).
+"""
+
+from typing import Any, Optional
+
+from .._utils.registry import fugue_plugin
+from .dataset import Dataset
+
+
+@fugue_plugin
+def as_fugue_dataset(data: Any, **kwargs: Any) -> Dataset:
+    """Convert any supported object to a Dataset (plugin hook)."""
+    if isinstance(data, Dataset):
+        return data
+    from ..dataframe.api import as_fugue_df
+
+    return as_fugue_df(data, **kwargs)
+
+
+def count(data: Any) -> int:
+    return as_fugue_dataset(data).count()
+
+
+def is_empty(data: Any) -> bool:
+    return as_fugue_dataset(data).empty
+
+
+def is_local(data: Any) -> bool:
+    return as_fugue_dataset(data).is_local
+
+
+def is_bounded(data: Any) -> bool:
+    return as_fugue_dataset(data).is_bounded
+
+
+def get_num_partitions(data: Any) -> int:
+    return as_fugue_dataset(data).num_partitions
+
+
+def show(data: Any, n: int = 10, with_count: bool = False, title: Optional[str] = None) -> None:
+    as_fugue_dataset(data).show(n=n, with_count=with_count, title=title)

@@ -1,0 +1,106 @@
+"""Dataset — the root abstraction for any data collection.
+
+Parity with the reference (`fugue/dataset/dataset.py:14-110`): metadata,
+locality/boundedness flags, counting, and a pluggable display. DataFrame and
+Bag both derive from this.
+"""
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+from .._utils.hash import to_uuid
+from .._utils.params import ParamDict
+from .._utils.registry import fugue_plugin
+from ..exceptions import FugueDatasetEmptyError
+
+
+class Dataset(ABC):
+    """An abstract collection of data with metadata."""
+
+    def __init__(self):
+        self._metadata: Optional[ParamDict] = None
+
+    @property
+    def metadata(self) -> ParamDict:
+        if self._metadata is None:
+            self._metadata = ParamDict()
+        return self._metadata
+
+    @property
+    def has_metadata(self) -> bool:
+        return self._metadata is not None and len(self._metadata) > 0
+
+    def reset_metadata(self, metadata: Any) -> None:
+        self._metadata = ParamDict(metadata) if metadata is not None else None
+
+    @property
+    def native(self) -> Any:
+        """The underlying object this dataset wraps (self if none)."""
+        return self
+
+    @property
+    @abstractmethod
+    def is_local(self) -> bool:
+        """Whether the data fully resides in the driver process."""
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def is_bounded(self) -> bool:
+        """Whether the data size is known/finite."""
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def num_partitions(self) -> int:
+        """Number of physical partitions (1 for local data)."""
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def empty(self) -> bool:
+        raise NotImplementedError
+
+    @abstractmethod
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def assert_not_empty(self) -> None:
+        if self.empty:
+            raise FugueDatasetEmptyError("dataset is empty")
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        get_dataset_display(self).show(n=n, with_count=with_count, title=title)
+
+    def __uuid__(self) -> str:
+        return to_uuid(str(type(self)), id(self))
+
+
+class DatasetDisplay(ABC):
+    """Pluggable renderer for :meth:`Dataset.show`.
+
+    Reference: ``fugue/dataset/dataset.py:151`` display plugin chain.
+    """
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    @abstractmethod
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        raise NotImplementedError
+
+    def repr(self) -> str:
+        return str(type(self._ds).__name__)
+
+    def repr_html(self) -> str:
+        return "<pre>" + self.repr() + "</pre>"
+
+
+@fugue_plugin
+def get_dataset_display(ds: Dataset) -> DatasetDisplay:
+    """Resolve the display implementation for a dataset (plugin hook)."""
+    raise NotImplementedError(f"no display registered for {type(ds)}")

@@ -1,0 +1,79 @@
+"""Framework exception hierarchy.
+
+Capability parity with the reference's error surface
+(``/root/reference/fugue/exceptions.py``), re-designed for this framework:
+every error raised by fugue-tpu derives from :class:`FugueTPUError` so user
+code can catch one root type.
+"""
+
+
+class FugueTPUError(Exception):
+    """Root of all framework errors."""
+
+
+class FugueBug(FugueTPUError):
+    """An internal invariant was violated — a framework bug, not a user error."""
+
+
+class FugueDataFrameError(FugueTPUError):
+    """Errors from DataFrame construction or conversion."""
+
+
+class FugueDataFrameInitError(FugueDataFrameError):
+    """DataFrame could not be constructed from the given object/schema."""
+
+
+class FugueDataFrameOperationError(FugueDataFrameError):
+    """An operation on a DataFrame (rename/alter/head/...) is invalid."""
+
+
+class FugueDataFrameEmptyError(FugueDataFrameError):
+    """Operation requires a non-empty DataFrame (e.g. ``peek``)."""
+
+
+class FugueDatasetEmptyError(FugueDataFrameEmptyError):
+    """Operation requires a non-empty Dataset."""
+
+
+class FugueWorkflowError(FugueTPUError):
+    """Errors raised while building or running a workflow DAG."""
+
+
+class FugueWorkflowCompileError(FugueWorkflowError):
+    """Error at DAG-construction (compile) time."""
+
+
+class FugueWorkflowCompileValidationError(FugueWorkflowCompileError):
+    """Compile-time validation rule (e.g. partition-by requirements) failed."""
+
+
+class FugueWorkflowRuntimeError(FugueWorkflowError):
+    """Error while executing the DAG."""
+
+
+class FugueWorkflowRuntimeValidationError(FugueWorkflowRuntimeError):
+    """Runtime validation rule (e.g. input-schema requirements) failed."""
+
+
+class FugueInterfacelessError(FugueTPUError):
+    """A plain function could not be adapted into an extension."""
+
+
+class FugueInvalidOperation(FugueTPUError):
+    """The requested operation is not allowed in the current state."""
+
+
+class FuguePluginsRegistrationError(FugueTPUError):
+    """A plugin could not be registered or resolved."""
+
+
+class FugueSQLError(FugueTPUError):
+    """Errors from parsing or executing SQL."""
+
+
+class FugueSQLSyntaxError(FugueSQLError):
+    """The SQL text could not be parsed."""
+
+
+class FugueSQLRuntimeError(FugueSQLError):
+    """The SQL executed but failed at runtime."""
