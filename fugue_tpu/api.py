@@ -1,0 +1,80 @@
+"""The ``fa``-style functional API facade.
+
+Parity with the reference (`fugue/api.py:1-72`): one flat namespace with
+dataset/dataframe utilities, engine verbs, workflow entrypoints and SQL.
+
+Usage::
+
+    import fugue_tpu.api as fa
+
+    with fa.engine_context("tpu"):
+        res = fa.transform(df, fn, schema="*", partition={"by": ["k"]})
+"""
+
+from .dataset.api import (  # noqa: F401
+    as_fugue_dataset,
+    count,
+    get_num_partitions,
+    is_bounded,
+    is_empty,
+    is_local,
+    show,
+)
+from .dataframe.api import (  # noqa: F401
+    alter_columns,
+    as_array,
+    as_array_iterable,
+    as_arrow,
+    as_dict_iterable,
+    as_dicts,
+    as_fugue_df,
+    as_local,
+    as_local_bounded,
+    as_pandas,
+    drop_columns,
+    get_column_names,
+    get_native_as_df,
+    get_schema,
+    head,
+    is_df,
+    normalize_column_names,
+    peek_array,
+    peek_dict,
+    rename,
+    select_columns,
+)
+from .execution.api import (  # noqa: F401
+    aggregate,
+    anti_join,
+    assign,
+    broadcast,
+    clear_global_engine,
+    cross_join,
+    distinct,
+    dropna,
+    engine_context,
+    fillna,
+    filter,  # noqa: A004
+    full_outer_join,
+    get_context_engine,
+    get_current_conf,
+    get_current_parallelism,
+    inner_join,
+    intersect,
+    join,
+    left_outer_join,
+    load,
+    persist,
+    repartition,
+    right_outer_join,
+    run_engine_function,
+    sample,
+    save,
+    select,
+    semi_join,
+    set_global_engine,
+    subtract,
+    take,
+    union,
+)
+from .workflow.api import out_transform, raw_sql, transform  # noqa: F401

@@ -1,0 +1,15 @@
+from .expressions import ColumnExpr, all_cols, col, function, lit, null
+from .sql import SelectColumns, SQLExpressionGenerator
+from . import functions
+
+__all__ = [
+    "ColumnExpr",
+    "all_cols",
+    "col",
+    "function",
+    "lit",
+    "null",
+    "SelectColumns",
+    "SQLExpressionGenerator",
+    "functions",
+]

@@ -1,0 +1,256 @@
+"""Pandas evaluator for the column-expression IR.
+
+This replaces the reference's SQL-generation path for the native engine
+(reference derives select/filter/assign/aggregate by generating SQL and
+running qpd — ``fugue/execution/execution_engine.py:736-939``). Here the IR
+is evaluated directly on pandas; the TPU engine has a parallel jnp evaluator.
+"""
+
+from typing import Any, List, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from ..exceptions import FugueSQLError
+from ..schema import Schema
+from .expressions import (
+    ColumnExpr,
+    _BinaryOpExpr,
+    _FuncExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _UnaryOpExpr,
+)
+from .sql import SelectColumns
+
+
+def _cast_series(s: pd.Series, tp: pa.DataType) -> pd.Series:
+    arr = pa.Array.from_pandas(s)
+    return arr.cast(tp, safe=False).to_pandas()
+
+
+def evaluate(pdf: pd.DataFrame, expr: ColumnExpr) -> Any:
+    """Evaluate a non-aggregate expression to a Series (or scalar literal)."""
+    res = _eval(pdf, expr)
+    if expr.as_type is not None and isinstance(res, pd.Series):
+        res = _cast_series(res, expr.as_type)
+    elif expr.as_type is not None:
+        res = _cast_series(pd.Series([res]), expr.as_type).iloc[0]
+    return res
+
+
+def _eval(pdf: pd.DataFrame, expr: ColumnExpr) -> Any:
+    if isinstance(expr, _NamedColumnExpr):
+        return pdf[expr.name]
+    if isinstance(expr, _LitColumnExpr):
+        return expr.value
+    if isinstance(expr, _UnaryOpExpr):
+        v = evaluate(pdf, expr.col)
+        if expr.op == "IS_NULL":
+            return v.isna()
+        if expr.op == "NOT_NULL":
+            return v.notna()
+        if expr.op == "~":
+            if isinstance(v, pd.Series) and v.dtype == object:
+                return v.map(lambda x: None if x is None else not x)
+            return ~v
+        if expr.op == "-":
+            return -v
+        raise NotImplementedError(f"unary op {expr.op}")
+    if isinstance(expr, _BinaryOpExpr):
+        l = evaluate(pdf, expr.left)
+        r = evaluate(pdf, expr.right)
+        op = expr.op
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        if op == "==":
+            return l == r
+        if op == "!=":
+            return l != r
+        if op == "&":
+            return _as_bool(l) & _as_bool(r)
+        if op == "|":
+            return _as_bool(l) | _as_bool(r)
+        raise NotImplementedError(f"binary op {op}")
+    if isinstance(expr, _FuncExpr) and not expr.is_agg:
+        if expr.func.upper() == "COALESCE":
+            args = [evaluate(pdf, a) for a in expr.args]
+            res = None
+            for a in args:
+                if res is None:
+                    res = a if isinstance(a, pd.Series) else pd.Series([a] * len(pdf))
+                else:
+                    fill = a if not isinstance(a, pd.Series) else a
+                    res = res.where(res.notna(), fill)
+            return res
+        raise NotImplementedError(f"function {expr.func} not supported on pandas")
+    raise NotImplementedError(f"can't evaluate {type(expr)}")
+
+
+def _as_bool(v: Any) -> Any:
+    if isinstance(v, pd.Series):
+        if v.dtype == bool:
+            return v
+        return v.astype("boolean").fillna(False).astype(bool)
+    return bool(v)
+
+
+def eval_agg(pdf: pd.DataFrame, expr: _FuncExpr) -> Any:
+    """Evaluate an aggregate function over a whole frame → scalar."""
+    func = expr.func.upper()
+    arg = expr.args[0] if len(expr.args) > 0 else None
+    v = evaluate(pdf, arg) if arg is not None else None
+    if not isinstance(v, pd.Series):
+        v = pd.Series([v] * len(pdf))
+    if expr.is_distinct:
+        v = v.drop_duplicates()
+    if func == "COUNT":
+        return int(v.notna().sum()) if expr.is_distinct else int(v.notna().sum())
+    if func == "MIN":
+        return v.min()
+    if func == "MAX":
+        return v.max()
+    if func == "SUM":
+        return v.sum()
+    if func == "AVG":
+        return v.mean()
+    if func == "FIRST":
+        nn = v.dropna()
+        return nn.iloc[0] if len(nn) > 0 else None
+    if func == "LAST":
+        nn = v.dropna()
+        return nn.iloc[-1] if len(nn) > 0 else None
+    raise NotImplementedError(f"aggregation {func} not supported")
+
+
+def eval_filter(pdf: pd.DataFrame, condition: ColumnExpr) -> pd.DataFrame:
+    mask = evaluate(pdf, condition)
+    mask = _as_bool(mask)
+    if not isinstance(mask, pd.Series):
+        return pdf if mask else pdf.head(0)
+    return pdf[mask].reset_index(drop=True)
+
+
+def eval_select(
+    pdf: pd.DataFrame,
+    input_schema: Schema,
+    columns: SelectColumns,
+    where: Optional[ColumnExpr] = None,
+    having: Optional[ColumnExpr] = None,
+) -> pd.DataFrame:
+    """Full SELECT semantics on pandas: where → project/aggregate → having
+    → distinct."""
+    sc = columns.replace_wildcard(input_schema).assert_all_with_names()
+    if where is not None:
+        pdf = eval_filter(pdf, where)
+    if not sc.has_agg:
+        data = {}
+        for c in sc.all_cols:
+            v = evaluate(pdf, c)
+            if not isinstance(v, pd.Series):
+                v = pd.Series([v] * len(pdf), dtype=object if v is None else None)
+            data[c.output_name] = v.reset_index(drop=True)
+        res = pd.DataFrame(data) if len(pdf) > 0 else pd.DataFrame(
+            {k: pd.Series(dtype=v.dtype) for k, v in data.items()}
+        )
+        assert_or_throw(having is None, FugueSQLError("having requires aggregation"))
+        if sc.is_distinct:
+            res = res.drop_duplicates().reset_index(drop=True)
+        return res
+
+    group_keys = list(sc.group_keys)
+    group_key_ids = {id(c) for c in group_keys}
+    if len(group_keys) == 0:
+        row = {}
+        for c in sc.all_cols:
+            if isinstance(c, _LitColumnExpr):
+                row[c.output_name] = evaluate(pdf, c)
+            else:
+                row[c.output_name] = _agg_one(pdf, c)
+        res = pd.DataFrame([row], columns=[c.output_name for c in sc.all_cols])
+    else:
+        key_names = []
+        kdf = pd.DataFrame(index=pdf.index)
+        for k in group_keys:
+            kv = evaluate(pdf, k)
+            if not isinstance(kv, pd.Series):
+                kv = pd.Series([kv] * len(pdf))
+            kdf[k.output_name] = kv
+            key_names.append(k.output_name)
+        work = pd.concat([pdf.reset_index(drop=True), kdf.reset_index(drop=True).add_prefix("__key_")], axis=1)
+        out_rows: List[dict] = []
+        grouped = work.groupby(
+            [f"__key_{k}" for k in key_names], dropna=False, sort=False
+        )
+        for kv, sub in grouped:
+            if not isinstance(kv, tuple):
+                kv = (kv,)
+            row = {}
+            for name, val in zip(key_names, kv):
+                row[name] = None if _is_na(val) else val
+            sub_orig = sub[[c for c in pdf.columns]]
+            for c in sc.all_cols:
+                if id(c) in group_key_ids:
+                    continue
+                row[c.output_name] = _agg_one(sub_orig, c)
+            out_rows.append(row)
+        cols_order = [c.output_name for c in sc.all_cols]
+        res = pd.DataFrame(out_rows, columns=cols_order) if len(out_rows) > 0 else pd.DataFrame(columns=cols_order)
+    if having is not None:
+        res = eval_filter(res, having)
+    if sc.is_distinct:
+        res = res.drop_duplicates().reset_index(drop=True)
+    return res
+
+
+def _is_na(v: Any) -> bool:
+    try:
+        return v is None or (isinstance(v, float) and np.isnan(v)) or v is pd.NA or v is pd.NaT
+    except Exception:
+        return False
+
+
+def _agg_one(pdf: pd.DataFrame, c: ColumnExpr) -> Any:
+    """Evaluate one select column that contains aggregation(s)."""
+    if isinstance(c, _FuncExpr) and c.is_agg:
+        v = eval_agg(pdf, c)
+        if c.as_type is not None:
+            v = _cast_series(pd.Series([v]), c.as_type).iloc[0]
+        return v
+    # expression over aggregates, e.g. sum(a) + 1: substitute agg nodes
+    return _eval_scalar_expr(pdf, c)
+
+
+def _eval_scalar_expr(pdf: pd.DataFrame, c: ColumnExpr) -> Any:
+    if isinstance(c, _FuncExpr) and c.is_agg:
+        return eval_agg(pdf, c)
+    if isinstance(c, _LitColumnExpr):
+        return c.value
+    if isinstance(c, _BinaryOpExpr):
+        l = _eval_scalar_expr(pdf, c.left)
+        r = _eval_scalar_expr(pdf, c.right)
+        return {
+            "+": lambda: l + r,
+            "-": lambda: l - r,
+            "*": lambda: l * r,
+            "/": lambda: l / r,
+        }[c.op]()
+    if isinstance(c, _UnaryOpExpr) and c.op == "-":
+        return -_eval_scalar_expr(pdf, c.col)
+    raise NotImplementedError(f"can't evaluate scalar expression {c!r}")

@@ -61,7 +61,15 @@ class PandasDataFrame(LocalBoundedDataFrame):
                 s = Schema(pdf)
         elif isinstance(df, pd.Series):
             pdf = df.to_frame()
-            s = s or Schema(pdf)
+            if s is not None:
+                assert_or_throw(
+                    list(pdf.columns) == s.names,
+                    lambda: FugueDataFrameInitError(
+                        f"series name {list(pdf.columns)} != schema {s.names}"
+                    ),
+                )
+            else:
+                s = Schema(pdf)
         elif isinstance(df, Iterable):
             assert_or_throw(s is not None, FugueDataFrameInitError("schema is required"))
             data = list(df)
@@ -74,7 +82,14 @@ class PandasDataFrame(LocalBoundedDataFrame):
                 pdf = tbl.to_pandas(use_threads=False)
         else:
             raise FugueDataFrameInitError(f"can't build PandasDataFrame from {type(df)}")
-        if not pandas_df_wrapper and isinstance(df, pd.DataFrame):
+        if not pandas_df_wrapper:
+            missing = [c for c in s.names if c not in pdf.columns]
+            assert_or_throw(
+                len(missing) == 0,
+                lambda: FugueDataFrameInitError(
+                    f"columns {missing} in schema {s} not in data {list(pdf.columns)}"
+                ),
+            )
             pdf = _enforce_type(pdf, s)
         self._native = pdf
         super().__init__(s)

@@ -75,6 +75,10 @@ class Dataset(ABC):
         get_dataset_display(self).show(n=n, with_count=with_count, title=title)
 
     def __uuid__(self) -> str:
+        # intentionally object-identity based: a raw in-memory dataset is NOT
+        # cross-run deterministic, so workflow nodes rooted on one never
+        # false-hit a deterministic checkpoint (reference semantics; true
+        # resume is for creator-rooted chains and literal data)
         return to_uuid(str(type(self)), id(self))
 
 

@@ -27,12 +27,12 @@ class FugueDataFrameOperationError(FugueDataFrameError):
     """An operation on a DataFrame (rename/alter/head/...) is invalid."""
 
 
-class FugueDataFrameEmptyError(FugueDataFrameError):
-    """Operation requires a non-empty DataFrame (e.g. ``peek``)."""
+class FugueDatasetEmptyError(FugueDataFrameError):
+    """Operation requires a non-empty Dataset (e.g. ``peek``)."""
 
 
-class FugueDatasetEmptyError(FugueDataFrameEmptyError):
-    """Operation requires a non-empty Dataset."""
+# alias kept for parity with the reference's exception surface
+FugueDataFrameEmptyError = FugueDatasetEmptyError
 
 
 class FugueWorkflowError(FugueTPUError):

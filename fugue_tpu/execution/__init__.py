@@ -1,0 +1,54 @@
+from .execution_engine import (
+    EngineFacet,
+    ExecutionEngine,
+    FugueEngineBase,
+    MapEngine,
+    SQLEngine,
+)
+from .factory import (
+    infer_execution_engine,
+    make_execution_engine,
+    make_sql_engine,
+    parse_execution_engine,
+    register_default_execution_engine,
+    register_default_sql_engine,
+    register_execution_engine,
+    register_sql_engine,
+    try_get_context_execution_engine,
+)
+from .native_execution_engine import NativeExecutionEngine, PandasMapEngine
+
+# engine-injection annotated param: functions may take ExecutionEngine (code e)
+from ..dataframe.function_wrapper import AnnotatedParam, fugue_annotated_param
+
+
+@fugue_annotated_param(
+    code="e",
+    matcher=lambda a: isinstance(a, type) and issubclass(a, (ExecutionEngine, FugueEngineBase)),
+)
+class ExecutionEngineParam(AnnotatedParam):
+    pass
+
+
+register_execution_engine("native", lambda conf, **kwargs: NativeExecutionEngine(conf))
+register_execution_engine("pandas", lambda conf, **kwargs: NativeExecutionEngine(conf))
+
+__all__ = [
+    "EngineFacet",
+    "ExecutionEngine",
+    "ExecutionEngineParam",
+    "FugueEngineBase",
+    "MapEngine",
+    "SQLEngine",
+    "NativeExecutionEngine",
+    "PandasMapEngine",
+    "infer_execution_engine",
+    "make_execution_engine",
+    "make_sql_engine",
+    "parse_execution_engine",
+    "register_default_execution_engine",
+    "register_default_sql_engine",
+    "register_execution_engine",
+    "register_sql_engine",
+    "try_get_context_execution_engine",
+]

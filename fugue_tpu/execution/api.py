@@ -1,0 +1,434 @@
+"""Functional engine API: engine context + engine verbs on any dataframe.
+
+Parity with the reference (`fugue/execution/api.py`): ``engine_context``,
+``set_global_engine``, and engine-level verbs (repartition/broadcast/persist/
+join/union/.../select/filter/assign/aggregate) usable on *any* supported
+dataframe object.
+"""
+
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, List, Optional, Union
+
+from ..collections.partition import PartitionSpec
+from ..column import ColumnExpr, SelectColumns
+from ..dataframe import DataFrame
+from ..dataframe.api import as_fugue_df, get_native_as_df
+from .execution_engine import ExecutionEngine
+from .factory import make_execution_engine, try_get_context_execution_engine
+
+AnyDataFrame = Any
+AnyExecutionEngine = Any
+
+
+@contextmanager
+def engine_context(
+    engine: AnyExecutionEngine = None,
+    conf: Any = None,
+    infer_by: Optional[List[Any]] = None,
+) -> Iterator[ExecutionEngine]:
+    """Context manager making ``engine`` the contextual engine
+    (reference ``execution/api.py:22``)."""
+    e = make_execution_engine(engine, conf, infer_by=infer_by)
+    with e._as_context() as ctx:
+        yield ctx
+
+
+def set_global_engine(engine: AnyExecutionEngine, conf: Any = None) -> ExecutionEngine:
+    """Make an engine the process-global default
+    (reference ``execution/api.py:53``)."""
+    from .._utils.assertion import assert_or_throw
+
+    assert_or_throw(engine is not None, ValueError("engine can't be None"))
+    return make_execution_engine(engine, conf).set_global()
+
+
+def clear_global_engine() -> None:
+    ExecutionEngine.clear_global()
+
+
+def get_context_engine() -> ExecutionEngine:
+    """The current contextual or global engine; raises when none is set."""
+    e = try_get_context_execution_engine()
+    if e is None:
+        raise RuntimeError("no execution engine in context")
+    return e
+
+
+def run_engine_function(
+    func: Callable[[ExecutionEngine], Any],
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    as_local: bool = False,
+    infer_by: Optional[List[Any]] = None,
+) -> Any:
+    """Run a function with a resolved engine (reference ``:145``)."""
+    e = make_execution_engine(engine, engine_conf, infer_by=infer_by)
+    with e._as_context():
+        res = func(e)
+        if isinstance(res, DataFrame):
+            res = e.convert_yield_dataframe(res, as_local)
+            if not as_fugue:
+                return get_native_as_df(res)
+        return res
+
+
+def _engine_verb(
+    func: Callable[[ExecutionEngine, List[DataFrame]], Any],
+    dfs: List[AnyDataFrame],
+    engine: AnyExecutionEngine,
+    engine_conf: Any,
+    as_fugue: bool,
+    as_local: bool = False,
+) -> Any:
+    return run_engine_function(
+        lambda e: func(e, [e.to_df(as_fugue_df(d) if not isinstance(d, DataFrame) else d) for d in dfs]),
+        engine=engine,
+        engine_conf=engine_conf,
+        as_fugue=as_fugue or any(isinstance(d, DataFrame) for d in dfs),
+        as_local=as_local,
+        infer_by=dfs,
+    )
+
+
+def repartition(
+    df: AnyDataFrame,
+    partition: Any,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.repartition(d[0], PartitionSpec(partition)),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def broadcast(
+    df: AnyDataFrame,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(lambda e, d: e.broadcast(d[0]), [df], engine, engine_conf, as_fugue)
+
+
+def persist(
+    df: AnyDataFrame,
+    lazy: bool = False,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    **kwargs: Any,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.persist(d[0], lazy=lazy, **kwargs),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def distinct(
+    df: AnyDataFrame,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(lambda e, d: e.distinct(d[0]), [df], engine, engine_conf, as_fugue)
+
+
+def dropna(
+    df: AnyDataFrame,
+    how: str = "any",
+    thresh: Optional[int] = None,
+    subset: Optional[List[str]] = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.dropna(d[0], how=how, thresh=thresh, subset=subset),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def fillna(
+    df: AnyDataFrame,
+    value: Any,
+    subset: Optional[List[str]] = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.fillna(d[0], value, subset=subset),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def sample(
+    df: AnyDataFrame,
+    n: Optional[int] = None,
+    frac: Optional[float] = None,
+    replace: bool = False,
+    seed: Optional[int] = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.sample(d[0], n=n, frac=frac, replace=replace, seed=seed),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def take(
+    df: AnyDataFrame,
+    n: int,
+    presort: str = "",
+    na_position: str = "last",
+    partition: Any = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.take(
+            d[0],
+            n,
+            presort=presort,
+            na_position=na_position,
+            partition_spec=None if partition is None else PartitionSpec(partition),
+        ),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def join(
+    df1: AnyDataFrame,
+    df2: AnyDataFrame,
+    *dfs: AnyDataFrame,
+    how: str = "inner",
+    on: Optional[List[str]] = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _join(e: ExecutionEngine, d: List[DataFrame]) -> DataFrame:
+        res = e.join(d[0], d[1], how=how, on=on)
+        for x in d[2:]:
+            res = e.join(res, x, how=how, on=on)
+        return res
+
+    return _engine_verb(_join, [df1, df2, *dfs], engine, engine_conf, as_fugue)
+
+
+def semi_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="semi", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def anti_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="anti", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def inner_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="inner", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def left_outer_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="left_outer", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def right_outer_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="right_outer", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def full_outer_join(df1, df2, *dfs, on=None, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="full_outer", on=on, engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def cross_join(df1, df2, *dfs, engine=None, engine_conf=None, as_fugue=False):
+    return join(df1, df2, *dfs, how="cross", engine=engine, engine_conf=engine_conf, as_fugue=as_fugue)
+
+
+def union(
+    df1: AnyDataFrame,
+    df2: AnyDataFrame,
+    *dfs: AnyDataFrame,
+    distinct: bool = True,  # noqa: A002
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _union(e: ExecutionEngine, d: List[DataFrame]) -> DataFrame:
+        res = e.union(d[0], d[1], distinct=distinct)
+        for x in d[2:]:
+            res = e.union(res, x, distinct=distinct)
+        return res
+
+    return _engine_verb(_union, [df1, df2, *dfs], engine, engine_conf, as_fugue)
+
+
+def subtract(
+    df1: AnyDataFrame,
+    df2: AnyDataFrame,
+    *dfs: AnyDataFrame,
+    distinct: bool = True,  # noqa: A002
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _sub(e: ExecutionEngine, d: List[DataFrame]) -> DataFrame:
+        res = e.subtract(d[0], d[1], distinct=distinct)
+        for x in d[2:]:
+            res = e.subtract(res, x, distinct=distinct)
+        return res
+
+    return _engine_verb(_sub, [df1, df2, *dfs], engine, engine_conf, as_fugue)
+
+
+def intersect(
+    df1: AnyDataFrame,
+    df2: AnyDataFrame,
+    *dfs: AnyDataFrame,
+    distinct: bool = True,  # noqa: A002
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _int(e: ExecutionEngine, d: List[DataFrame]) -> DataFrame:
+        res = e.intersect(d[0], d[1], distinct=distinct)
+        for x in d[2:]:
+            res = e.intersect(res, x, distinct=distinct)
+        return res
+
+    return _engine_verb(_int, [df1, df2, *dfs], engine, engine_conf, as_fugue)
+
+
+def select(
+    df: AnyDataFrame,
+    *columns: Union[str, ColumnExpr],
+    where: Optional[ColumnExpr] = None,
+    having: Optional[ColumnExpr] = None,
+    distinct: bool = False,  # noqa: A002
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    from ..column import col as _col
+
+    cols = SelectColumns(
+        *[_col(c) if isinstance(c, str) else c for c in columns],
+        arg_distinct=distinct,
+    )
+    return _engine_verb(
+        lambda e, d: e.select(d[0], cols, where=where, having=having),
+        [df], engine, engine_conf, as_fugue,
+    )
+
+
+def filter(  # noqa: A001
+    df: AnyDataFrame,
+    condition: ColumnExpr,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return _engine_verb(
+        lambda e, d: e.filter(d[0], condition), [df], engine, engine_conf, as_fugue
+    )
+
+
+def assign(
+    df: AnyDataFrame,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    **columns: Any,
+) -> AnyDataFrame:
+    from ..column import lit
+
+    cols = [
+        (v if isinstance(v, ColumnExpr) else lit(v)).alias(k) for k, v in columns.items()
+    ]
+    return _engine_verb(
+        lambda e, d: e.assign(d[0], cols), [df], engine, engine_conf, as_fugue
+    )
+
+
+def aggregate(
+    df: AnyDataFrame,
+    partition_by: Any = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    **agg_kwcols: ColumnExpr,
+) -> AnyDataFrame:
+    cols = [v.alias(k) for k, v in agg_kwcols.items()]
+    spec = (
+        None
+        if partition_by is None
+        else PartitionSpec(by=[partition_by] if isinstance(partition_by, str) else list(partition_by))
+    )
+    return _engine_verb(
+        lambda e, d: e.aggregate(d[0], spec, cols), [df], engine, engine_conf, as_fugue
+    )
+
+
+def load(
+    path: Union[str, List[str]],
+    format_hint: Any = None,
+    columns: Any = None,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    **kwargs: Any,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.load_df(path, format_hint=format_hint, columns=columns, **kwargs),
+        engine=engine,
+        engine_conf=engine_conf,
+        as_fugue=as_fugue,
+    )
+
+
+def save(
+    df: AnyDataFrame,
+    path: str,
+    format_hint: Any = None,
+    mode: str = "overwrite",
+    partition: Any = None,
+    force_single: bool = False,
+    engine: AnyExecutionEngine = None,
+    engine_conf: Any = None,
+    **kwargs: Any,
+) -> None:
+    run_engine_function(
+        lambda e: e.save_df(
+            e.to_df(as_fugue_df(df) if not isinstance(df, DataFrame) else df),
+            path,
+            format_hint=format_hint,
+            mode=mode,
+            partition_spec=None if partition is None else PartitionSpec(partition),
+            force_single=force_single,
+            **kwargs,
+        ),
+        engine=engine,
+        engine_conf=engine_conf,
+        infer_by=[df],
+    )
+
+
+def get_current_parallelism(engine: AnyExecutionEngine = None, engine_conf: Any = None) -> int:
+    return run_engine_function(
+        lambda e: e.get_current_parallelism(), engine=engine, engine_conf=engine_conf
+    )
+
+
+def get_current_conf() -> Any:
+    """The conf of the current context engine (or global defaults)."""
+    from ..constants import _FUGUE_GLOBAL_CONF
+
+    e = try_get_context_execution_engine()
+    if e is not None:
+        return e.conf
+    return _FUGUE_GLOBAL_CONF

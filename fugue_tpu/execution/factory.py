@@ -1,0 +1,189 @@
+"""Engine factory and registry.
+
+Parity with the reference (`fugue/execution/factory.py`):
+``register_execution_engine``/``register_sql_engine`` by name or type,
+``make_execution_engine`` with the documented resolution order
+(explicit → context → global → infer_by → default, reference ``:258-276``),
+and the ``parse_execution_engine`` / ``infer_execution_engine`` plugins.
+"""
+
+import inspect
+from threading import RLock
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type, Union
+
+from .._utils.assertion import assert_or_throw
+from .._utils.params import ParamDict
+from .._utils.registry import fugue_plugin
+from ..exceptions import FuguePluginsRegistrationError
+from .execution_engine import (
+    _CONTEXT_ENGINE,
+    _GLOBAL_ENGINE,
+    ExecutionEngine,
+    SQLEngine,
+)
+
+_LOCK = RLock()
+_EXECUTION_ENGINE_REGISTRY: Dict[str, Callable] = {}
+_EXECUTION_ENGINE_TYPE_REGISTRY: Dict[Type, Callable] = {}
+_SQL_ENGINE_REGISTRY: Dict[str, Callable] = {}
+_DEFAULT_EXECUTION_ENGINE: List[Optional[Callable]] = [None]
+_DEFAULT_SQL_ENGINE: List[Optional[Callable]] = [None]
+
+
+def register_execution_engine(
+    name_or_type: Union[str, Type], func: Callable, on_dup: str = "overwrite"
+) -> None:
+    """Register an engine factory ``func(conf, **kwargs) -> ExecutionEngine``
+    under a name (e.g. ``"native"``) or a type (engine inference by object)."""
+    with _LOCK:
+        if isinstance(name_or_type, str):
+            if name_or_type in _EXECUTION_ENGINE_REGISTRY and on_dup == "throw":
+                raise FuguePluginsRegistrationError(f"{name_or_type} already registered")
+            if name_or_type in _EXECUTION_ENGINE_REGISTRY and on_dup == "ignore":
+                return
+            _EXECUTION_ENGINE_REGISTRY[name_or_type] = func
+        else:
+            _EXECUTION_ENGINE_TYPE_REGISTRY[name_or_type] = func
+
+
+def register_default_execution_engine(func: Callable, on_dup: str = "overwrite") -> None:
+    with _LOCK:
+        if _DEFAULT_EXECUTION_ENGINE[0] is not None and on_dup == "throw":
+            raise FuguePluginsRegistrationError("default engine already registered")
+        if _DEFAULT_EXECUTION_ENGINE[0] is not None and on_dup == "ignore":
+            return
+        _DEFAULT_EXECUTION_ENGINE[0] = func
+
+
+def register_sql_engine(name: str, func: Callable, on_dup: str = "overwrite") -> None:
+    """Register ``func(execution_engine) -> SQLEngine`` under a name."""
+    with _LOCK:
+        if name in _SQL_ENGINE_REGISTRY and on_dup == "throw":
+            raise FuguePluginsRegistrationError(f"{name} already registered")
+        if name in _SQL_ENGINE_REGISTRY and on_dup == "ignore":
+            return
+        _SQL_ENGINE_REGISTRY[name] = func
+
+
+def register_default_sql_engine(func: Callable, on_dup: str = "overwrite") -> None:
+    with _LOCK:
+        if _DEFAULT_SQL_ENGINE[0] is not None and on_dup == "throw":
+            raise FuguePluginsRegistrationError("default sql engine already registered")
+        if _DEFAULT_SQL_ENGINE[0] is not None and on_dup == "ignore":
+            return
+        _DEFAULT_SQL_ENGINE[0] = func
+
+
+@fugue_plugin
+def parse_execution_engine(engine: Any, conf: Any, **kwargs: Any) -> ExecutionEngine:
+    """Plugin: convert an engine spec into an ExecutionEngine
+    (reference ``factory.py:343``)."""
+    if isinstance(engine, str):
+        with _LOCK:
+            if engine in _EXECUTION_ENGINE_REGISTRY:
+                return _EXECUTION_ENGINE_REGISTRY[engine](conf, **kwargs)
+        raise FuguePluginsRegistrationError(
+            f"{engine!r} is not a registered execution engine"
+        )
+    if inspect.isclass(engine) and issubclass(engine, ExecutionEngine):
+        return engine(conf, **kwargs)
+    with _LOCK:
+        for tp, func in _EXECUTION_ENGINE_TYPE_REGISTRY.items():
+            if isinstance(engine, tp):
+                return func(engine, conf, **kwargs)
+    raise FuguePluginsRegistrationError(f"can't parse engine spec {engine!r}")
+
+
+@fugue_plugin
+def infer_execution_engine(objs: List[Any]) -> Any:
+    """Plugin: infer an engine spec from input objects
+    (reference ``factory.py:421``)."""
+    return None
+
+
+def try_get_context_execution_engine() -> Optional[ExecutionEngine]:
+    e = _CONTEXT_ENGINE.get()
+    if e is not None:
+        return e
+    return _GLOBAL_ENGINE[0]
+
+
+def is_pandas_or(objs: List[Any], obj_type: Any) -> bool:
+    """Whether all objs are local-ish or of obj_type (engine inference aid)."""
+    import pandas as pd
+
+    from ..dataframe.dataframe import LocalDataFrame
+
+    return all(
+        isinstance(o, (pd.DataFrame, LocalDataFrame, list, tuple)) or isinstance(o, obj_type)
+        for o in objs
+    )
+
+
+def make_execution_engine(
+    engine: Any = None,
+    conf: Any = None,
+    infer_by: Optional[List[Any]] = None,
+    **kwargs: Any,
+) -> ExecutionEngine:
+    """Resolution order (reference docstring ``factory.py:258-276``):
+    explicit → context engine → global engine → infer_by → registered default
+    → NativeExecutionEngine."""
+    sql_engine_spec: Any = None
+    if isinstance(engine, tuple):
+        engine, sql_engine_spec = engine
+    result: Optional[ExecutionEngine] = None
+    if engine is None:
+        ctx = try_get_context_execution_engine()
+        if ctx is not None:
+            result = ctx
+        elif infer_by is not None:
+            inferred = infer_execution_engine(infer_by)
+            if inferred is not None:
+                result = parse_execution_engine(inferred, conf, **kwargs)
+        if result is None:
+            with _LOCK:
+                default = _DEFAULT_EXECUTION_ENGINE[0]
+            if default is not None:
+                result = default(conf, **kwargs)
+            else:
+                from .native_execution_engine import NativeExecutionEngine
+
+                result = NativeExecutionEngine(conf)
+    elif isinstance(engine, ExecutionEngine):
+        if conf is not None:
+            engine.conf.update(ParamDict(conf))
+        result = engine
+    else:
+        result = parse_execution_engine(engine, conf, **kwargs)
+    if sql_engine_spec is not None:
+        result.set_sql_engine(make_sql_engine(sql_engine_spec, result))
+    elif _DEFAULT_SQL_ENGINE[0] is not None and result._sql_engine is None:
+        try:
+            result.set_sql_engine(_DEFAULT_SQL_ENGINE[0](result))
+        except Exception:
+            pass
+    return result
+
+
+def make_sql_engine(
+    engine: Any = None,
+    execution_engine: Optional[ExecutionEngine] = None,
+    **kwargs: Any,
+) -> SQLEngine:
+    if engine is None:
+        assert_or_throw(
+            execution_engine is not None,
+            FuguePluginsRegistrationError("execution_engine is required"),
+        )
+        return execution_engine.sql_engine  # type: ignore
+    if isinstance(engine, SQLEngine):
+        return engine
+    if isinstance(engine, str):
+        with _LOCK:
+            if engine in _SQL_ENGINE_REGISTRY:
+                return _SQL_ENGINE_REGISTRY[engine](execution_engine, **kwargs)
+        raise FuguePluginsRegistrationError(f"{engine!r} is not a registered sql engine")
+    if inspect.isclass(engine) and issubclass(engine, SQLEngine):
+        return engine(execution_engine, **kwargs)
+    raise FuguePluginsRegistrationError(f"can't parse sql engine spec {engine!r}")

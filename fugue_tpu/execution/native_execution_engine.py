@@ -1,0 +1,384 @@
+"""NativeExecutionEngine — single-process pandas engine, the correctness oracle.
+
+Parity with the reference (`fugue/execution/native_execution_engine.py:172`):
+``PandasMapEngine`` does sort + groupby-apply per logical partition
+(reference ``:81-169``); all relational ops run on pandas with SQL NULL
+semantics (null keys never match in joins). The derived
+select/filter/assign/aggregate come from the base class's column-IR path.
+"""
+
+import logging
+from typing import Any, Callable, List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from .._utils.io import load_df as _io_load_df
+from .._utils.io import save_df as _io_save_df
+from ..collections.partition import (
+    EMPTY_PARTITION_SPEC,
+    PartitionCursor,
+    PartitionSpec,
+    parse_presort_exp,
+)
+from .._utils.assertion import assert_or_throw
+from ..dataframe import (
+    ArrowDataFrame,
+    DataFrame,
+    DataFrames,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+)
+from ..dataframe.api import as_fugue_df
+from ..dataframe.utils import get_join_schemas, parse_join_type
+from ..exceptions import FugueInvalidOperation
+from ..schema import Schema
+from .execution_engine import ExecutionEngine, MapEngine, SQLEngine
+
+
+class PandasMapEngine(MapEngine):
+    """Sort + groupby-apply map engine (reference ``:81-169``)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    @property
+    def execution_engine_constraint(self) -> type:
+        return NativeExecutionEngine
+
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:
+        output_schema = (
+            output_schema if isinstance(output_schema, Schema) else Schema(output_schema)
+        )
+        input_df = self.to_df(df).as_local_bounded()
+        if input_df.empty:
+            return PandasDataFrame(None, output_schema)
+        cursor = partition_spec.get_cursor(input_df.schema, 0)
+        if on_init is not None:
+            on_init(0, input_df)
+        keys = partition_spec.partition_by
+        pdf = input_df.as_pandas()
+        sorts = partition_spec.get_sorts(input_df.schema, with_partition_keys=len(keys) > 0)
+        if len(sorts) > 0:
+            pdf = pdf.sort_values(
+                list(sorts.keys()),
+                ascending=list(sorts.values()),
+                na_position="first",
+            ).reset_index(drop=True)
+        schema = input_df.schema
+        if len(keys) == 0:
+            part = PandasDataFrame(pdf, schema, pandas_df_wrapper=True)
+            cursor.set(lambda: part.peek_array(), 0, 0)
+            out = map_func(cursor, part)
+            return _to_output(out, output_schema)
+        results: List[LocalDataFrame] = []
+        no = [0]
+
+        def _run_group(sub: pd.DataFrame) -> None:
+            part = PandasDataFrame(
+                sub.reset_index(drop=True), schema, pandas_df_wrapper=True
+            )
+            cursor.set(lambda: part.peek_array(), no[0], 0)
+            no[0] += 1
+            res = map_func(cursor, part)
+            results.append(res.as_local_bounded())
+
+        for _, sub in pdf.groupby(keys, dropna=False, sort=False):
+            _run_group(sub)
+        if len(results) == 0:
+            return PandasDataFrame(None, output_schema)
+        return _to_output(
+            LocalDataFrameIterableDataFrame(iter(results), output_schema), output_schema
+        )
+
+
+def _to_output(out: DataFrame, output_schema: Schema) -> LocalBoundedDataFrame:
+    res = out.as_local_bounded()
+    assert_or_throw(
+        res.schema == output_schema,
+        lambda: FugueInvalidOperation(
+            f"map output schema {res.schema} != declared {output_schema}"
+        ),
+    )
+    return res
+
+
+class _PlaceholderSQLEngine(SQLEngine):
+    """Raises until the in-tree SQL layer is attached (no qpd/duckdb here)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    def select(self, dfs: DataFrames, statement: Any) -> DataFrame:
+        try:
+            from ..sql.local_sql import LocalSQLEngine
+        except ImportError as e:  # SQL layer not built yet
+            raise NotImplementedError("in-tree SQL engine not available") from e
+        return LocalSQLEngine(self.execution_engine).select(dfs, statement)
+
+
+class NativeExecutionEngine(ExecutionEngine):
+    def __init__(self, conf: Any = None):
+        super().__init__(conf)
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    @property
+    def log(self) -> logging.Logger:
+        return logging.getLogger("NativeExecutionEngine")
+
+    def create_default_map_engine(self) -> MapEngine:
+        return PandasMapEngine(self)
+
+    def create_default_sql_engine(self) -> SQLEngine:
+        return _PlaceholderSQLEngine(self)
+
+    def get_current_parallelism(self) -> int:
+        return 1
+
+    def to_df(self, df: Any, schema: Any = None) -> LocalBoundedDataFrame:
+        if isinstance(df, DataFrame):
+            res = df.as_local_bounded()
+            if schema is not None and res.schema != Schema(schema):
+                res = ArrowDataFrame(res.as_arrow(), Schema(schema))
+            if df.has_metadata:
+                res.reset_metadata(df.metadata)
+            return res
+        if isinstance(df, (list, tuple)) or (
+            hasattr(df, "__iter__") and not hasattr(df, "columns") and not hasattr(df, "schema")
+        ):
+            from ..dataframe import ArrayDataFrame
+
+            return ArrayDataFrame(df, schema)
+        fdf = as_fugue_df(df, schema=schema) if schema is not None else as_fugue_df(df)
+        return fdf.as_local_bounded()
+
+    def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
+        # single-process engine: logical partitioning happens in map_dataframe
+        return df
+
+    def broadcast(self, df: DataFrame) -> DataFrame:
+        return df
+
+    def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
+        res = self.to_df(df)
+        if df.has_metadata:
+            res.reset_metadata(df.metadata)
+        return res
+
+    def join(
+        self,
+        df1: DataFrame,
+        df2: DataFrame,
+        how: str,
+        on: Optional[List[str]] = None,
+    ) -> DataFrame:
+        how = parse_join_type(how)
+        key_schema, output_schema = get_join_schemas(df1, df2, how=how, on=on)
+        keys = key_schema.names
+        d1 = self.to_df(df1).as_pandas()
+        d2 = self.to_df(df2).as_pandas()
+        if how == "cross":
+            res = d1.merge(d2, how="cross")
+            return PandasDataFrame(res, output_schema)
+        d1nn = d1.dropna(subset=keys)
+        d2nn = d2.dropna(subset=keys)
+        if how == "inner":
+            res = d1nn.merge(d2nn, how="inner", on=keys)
+        elif how == "left_outer":
+            res = d1.merge(d2nn, how="left", on=keys)
+        elif how == "right_outer":
+            res = d1nn.merge(d2, how="right", on=keys)
+        elif how == "full_outer":
+            matched = d1nn.merge(d2nn, how="outer", on=keys)
+            null1 = d1[d1[keys].isna().any(axis=1)]
+            null2 = d2[d2[keys].isna().any(axis=1)]
+            parts = [matched]
+            if len(null1) > 0:
+                parts.append(null1)
+            if len(null2) > 0:
+                parts.append(null2)
+            res = pd.concat(parts, ignore_index=True) if len(parts) > 1 else matched
+        elif how == "left_semi":
+            res = d1.merge(
+                d2nn[keys].drop_duplicates(), how="inner", on=keys
+            )
+        elif how == "left_anti":
+            merged = d1.merge(
+                d2nn[keys].drop_duplicates(),
+                how="left",
+                on=keys,
+                indicator=True,
+            )
+            res = merged[merged["_merge"] == "left_only"].drop(columns=["_merge"])
+        else:  # pragma: no cover
+            raise NotImplementedError(how)
+        res = res.reindex(columns=output_schema.names)
+        return PandasDataFrame(res.reset_index(drop=True), output_schema)
+
+    def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            lambda: FugueInvalidOperation(f"schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        d1 = self.to_df(df1).as_pandas()
+        d2 = self.to_df(df2).as_pandas()
+        res = pd.concat([d1, d2], ignore_index=True)
+        if distinct:
+            res = _drop_duplicates(res)
+        return PandasDataFrame(res, df1.schema)
+
+    def subtract(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            lambda: FugueInvalidOperation(f"schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        assert_or_throw(
+            distinct, NotImplementedError("EXCEPT ALL is not supported")
+        )
+        d1 = _drop_duplicates(self.to_df(df1).as_pandas())
+        d2 = self.to_df(df2).as_pandas()
+        merged = d1.merge(d2.drop_duplicates(), how="left", on=list(d1.columns), indicator=True)
+        res = merged[merged["_merge"] == "left_only"].drop(columns=["_merge"])
+        return PandasDataFrame(res.reset_index(drop=True), df1.schema)
+
+    def intersect(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            lambda: FugueInvalidOperation(f"schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        assert_or_throw(
+            distinct, NotImplementedError("INTERSECT ALL is not supported")
+        )
+        d1 = _drop_duplicates(self.to_df(df1).as_pandas())
+        d2 = _drop_duplicates(self.to_df(df2).as_pandas())
+        res = d1.merge(d2, how="inner", on=list(d1.columns))
+        return PandasDataFrame(res.reset_index(drop=True), df1.schema)
+
+    def distinct(self, df: DataFrame) -> DataFrame:
+        res = _drop_duplicates(self.to_df(df).as_pandas())
+        return PandasDataFrame(res, df.schema)
+
+    def dropna(
+        self,
+        df: DataFrame,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> DataFrame:
+        kw: dict = dict(subset=subset)
+        if thresh is not None:
+            kw["thresh"] = thresh
+        else:
+            kw["how"] = how
+        res = self.to_df(df).as_pandas().dropna(**kw)
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def fillna(self, df: DataFrame, value: Any, subset: Optional[List[str]] = None) -> DataFrame:
+        assert_or_throw(
+            (not isinstance(value, list)) and (value is not None),
+            FugueInvalidOperation("fillna value can't be None or a list"),
+        )
+        if isinstance(value, dict):
+            assert_or_throw(
+                (None not in value.values()) and (any(value.values())),
+                FugueInvalidOperation("fillna dict can't contain None values"),
+            )
+            mapping = value
+        else:
+            subset = subset or df.schema.names
+            mapping = {c: value for c in subset}
+        pdf = self.to_df(df).as_pandas().fillna(mapping)
+        return PandasDataFrame(pdf, df.schema)
+
+    def sample(
+        self,
+        df: DataFrame,
+        n: Optional[int] = None,
+        frac: Optional[float] = None,
+        replace: bool = False,
+        seed: Optional[int] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            (n is None and frac is not None) or (n is not None and frac is None),
+            FugueInvalidOperation("one and only one of n and frac should be set"),
+        )
+        res = self.to_df(df).as_pandas().sample(
+            n=n, frac=frac, replace=replace, random_state=seed
+        )
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def take(
+        self,
+        df: DataFrame,
+        n: int,
+        presort: str,
+        na_position: str = "last",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            isinstance(n, int),
+            FugueInvalidOperation("n needs to be an integer"),
+        )
+        spec = partition_spec or EMPTY_PARTITION_SPEC
+        pdf = self.to_df(df).as_pandas()
+        sorts = parse_presort_exp(presort) if presort else spec.presort
+        names = list(sorts.keys())
+        asc = list(sorts.values())
+        if len(spec.partition_by) == 0:
+            if len(names) > 0:
+                pdf = pdf.sort_values(names, ascending=asc, na_position=na_position)
+            res = pdf.head(n)
+        else:
+            if len(names) > 0:
+                pdf = pdf.sort_values(names, ascending=asc, na_position=na_position)
+            res = pdf.groupby(spec.partition_by, dropna=False, sort=False).head(n)
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def load_df(
+        self,
+        path: Union[str, List[str]],
+        format_hint: Any = None,
+        columns: Any = None,
+        **kwargs: Any,
+    ) -> DataFrame:
+        tbl, schema = _io_load_df(path, format_hint=format_hint, columns=columns, **kwargs)
+        return ArrowDataFrame(tbl)
+
+    def save_df(
+        self,
+        df: DataFrame,
+        path: str,
+        format_hint: Any = None,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        force_single: bool = False,
+        **kwargs: Any,
+    ) -> DataFrame:
+        _io_save_df(
+            self.to_df(df).as_arrow(), path, format_hint=format_hint, mode=mode, **kwargs
+        )
+        return df
+
+
+def _drop_duplicates(pdf: pd.DataFrame) -> pd.DataFrame:
+    """drop_duplicates that treats NaN == NaN (SQL DISTINCT semantics)."""
+    try:
+        return pdf.drop_duplicates(ignore_index=True)
+    except TypeError:  # unhashable columns (lists/dicts)
+        key = pdf.apply(lambda r: repr(list(r)), axis=1)
+        return pdf[~key.duplicated()].reset_index(drop=True)

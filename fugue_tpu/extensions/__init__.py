@@ -1,0 +1,37 @@
+from .context import ExtensionContext
+from .creator.creator import Creator
+from .creator.convert import creator, register_creator, _to_creator, parse_creator
+from .processor.processor import Processor
+from .processor.convert import processor, register_processor, _to_processor, parse_processor
+from .outputter.outputter import Outputter
+from .outputter.convert import outputter, register_outputter, _to_outputter, parse_outputter
+from .transformer.transformer import (
+    CoTransformer,
+    OutputCoTransformer,
+    OutputTransformer,
+    Transformer,
+)
+from .transformer.convert import (
+    cotransformer,
+    output_cotransformer,
+    output_transformer,
+    register_output_transformer,
+    register_transformer,
+    transformer,
+    _to_transformer,
+    _to_output_transformer,
+    parse_transformer,
+    parse_output_transformer,
+)
+
+__all__ = [
+    "ExtensionContext",
+    "Creator", "creator", "register_creator", "_to_creator", "parse_creator",
+    "Processor", "processor", "register_processor", "_to_processor", "parse_processor",
+    "Outputter", "outputter", "register_outputter", "_to_outputter", "parse_outputter",
+    "Transformer", "CoTransformer", "OutputTransformer", "OutputCoTransformer",
+    "transformer", "cotransformer", "output_transformer", "output_cotransformer",
+    "register_transformer", "register_output_transformer",
+    "_to_transformer", "_to_output_transformer",
+    "parse_transformer", "parse_output_transformer",
+]

@@ -1,0 +1,29 @@
+"""Built-in creators (reference ``fugue/extensions/_builtins/creators.py``)."""
+
+from typing import Any
+
+from ...collections.yielded import Yielded
+from ...dataframe import DataFrame
+from ..creator.creator import Creator
+
+
+class Load(Creator):
+    def create(self) -> DataFrame:
+        kwargs = self.params.get("params", dict())
+        path = self.params.get_or_throw("path", str)
+        format_hint = self.params.get("fmt", "")
+        columns = self.params.get_or_none("columns", object)
+        return self.execution_engine.load_df(
+            path=path, format_hint=format_hint or None, columns=columns, **kwargs
+        )
+
+
+class CreateData(Creator):
+    def create(self) -> DataFrame:
+        data = self.params.get_or_throw("data", object)
+        schema = self.params.get_or_none("schema", object)
+        if isinstance(data, Yielded):
+            return self.execution_engine.load_yielded(data)
+        if isinstance(data, DataFrame):
+            return self.execution_engine.to_df(data, schema=schema)
+        return self.execution_engine.to_df(data, schema=schema)

@@ -1,0 +1,344 @@
+"""Built-in processors — the implementations behind workflow verbs.
+
+Parity with the reference (`fugue/extensions/_builtins/processors.py`):
+RunTransformer, RunJoin, RunSetOperation, Distinct, Dropna, Fillna,
+RunSQLSelect, Zip, Select, Filter, Assign, Aggregate, Rename, AlterColumns,
+Sample, Take, DropColumns, SelectColumns.
+"""
+
+from typing import Any, List, Optional, Type
+
+from ..._utils.assertion import assert_or_throw
+from ...collections.partition import PartitionCursor, PartitionSpec
+from ...collections.sql import StructuredRawSQL
+from ...column import SelectColumns as ColSelectColumns
+from ...dataframe import ArrayDataFrame, DataFrame, DataFrames, LocalDataFrame
+from ...exceptions import FugueWorkflowError
+from ...rpc.base import to_rpc_handler
+from ...schema import Schema
+from .._utils import validate_input_schema
+from ..processor.processor import Processor
+from ..transformer.transformer import CoTransformer, Transformer
+
+
+class RunTransformer(Processor):
+    """Wrap a Transformer/CoTransformer into a map/comap call
+    (reference ``processors.py:23``)."""
+
+    @property
+    def validation_rules(self) -> dict:
+        return self._transformer.validation_rules  # type: ignore
+
+    def process(self, dfs: DataFrames) -> DataFrame:
+        df = dfs[0]
+        tf = self.params.get_or_throw("transformer", object)
+        ignore_errors = self.params.get("ignore_errors", [])
+        callback = self.params.get_or_none("callback", object)
+        save_partition = self.partition_spec
+        engine = self.execution_engine
+        tf._workflow_conf = engine.conf
+        tf._params = self.params.get("params", dict())
+        tf._partition_spec = save_partition
+        tf._execution_engine = engine
+        rpc_handler = to_rpc_handler(callback)
+        from ...rpc.base import EmptyRPCHandler
+
+        if not isinstance(rpc_handler, EmptyRPCHandler):
+            tf._callback = engine.rpc_server.make_client(rpc_handler)
+        else:
+            tf._callback = None
+        if isinstance(tf, CoTransformer):
+            return self._run_cotransform(df, tf, ignore_errors)
+        return self._run_transform(df, tf, ignore_errors)
+
+    def _run_transform(
+        self, df: DataFrame, tf: Transformer, ignore_errors: List[Any]
+    ) -> DataFrame:
+        engine = self.execution_engine
+        spec = self.partition_spec
+        df = engine.repartition(df, spec) if not spec.empty else df
+        validate_input_schema(df.schema, tf.validation_rules)
+        schema = Schema(tf.get_output_schema(df))
+        tf._output_schema = schema
+        tf._key_schema = spec.get_key_schema(df.schema)
+        runner = _TransformerRunner(df, tf, _parse_exceptions(ignore_errors))
+        fmt = tf.get_format_hint() if hasattr(tf, "get_format_hint") else None
+        return engine.map_engine.map_dataframe(
+            df,
+            runner.run,
+            output_schema=schema,
+            partition_spec=spec,
+            on_init=runner.on_init,
+            map_func_format_hint=fmt,
+        )
+
+    def _run_cotransform(
+        self, df: DataFrame, tf: CoTransformer, ignore_errors: List[Any]
+    ) -> DataFrame:
+        engine = self.execution_engine
+        assert_or_throw(
+            df.metadata.get("serialized", False),
+            FugueWorkflowError("the input of cotransform must be a zipped dataframe"),
+        )
+        spec = self.partition_spec
+        if spec.empty:
+            keys = df.metadata.get("keys", [])
+            spec = PartitionSpec(by=keys) if len(keys) > 0 else spec
+        empty_dfs = DataFrames(
+            {
+                (df.metadata["names"][i] if df.metadata.get("serialized_has_name", False) else f"_{i}"):
+                ArrayDataFrame([], s)
+                for i, s in enumerate(df.metadata["schemas"])
+            }
+        )
+        schema = Schema(tf.get_output_schema(empty_dfs))
+        tf._output_schema = schema
+        tf._key_schema = df.schema.extract(df.metadata.get("keys", []))
+        runner = _CoTransformerRunner(tf, _parse_exceptions(ignore_errors), schema)
+        return engine.comap(
+            df,
+            runner.run,
+            output_schema=schema,
+            partition_spec=spec,
+            on_init=runner.on_init,
+        )
+
+
+def _parse_exceptions(ignore_errors: List[Any]) -> List[Type[Exception]]:
+    from ..._utils.convert import to_type
+
+    return [to_type(x, Exception) for x in ignore_errors]  # type: ignore
+
+
+class _TransformerRunner:
+    def __init__(self, df: DataFrame, transformer: Transformer, ignore_errors: List[type]):
+        self.schema = df.schema
+        self.metadata = df.metadata if df.has_metadata else None
+        self.transformer = transformer
+        self.ignore_errors = tuple(ignore_errors)
+
+    def run(self, cursor: PartitionCursor, df: LocalDataFrame) -> LocalDataFrame:
+        self.transformer._cursor = cursor  # type: ignore
+        df._metadata = self.metadata
+        if len(self.ignore_errors) == 0:
+            return self.transformer.transform(df)
+        try:
+            return self.transformer.transform(df).as_local_bounded()
+        except self.ignore_errors:  # type: ignore
+            return ArrayDataFrame([], self.transformer.output_schema)
+
+    def on_init(self, partition_no: int, df: DataFrame) -> None:
+        s = self.transformer.partition_spec
+        self.transformer._cursor = s.get_cursor(self.schema, partition_no)  # type: ignore
+        self.transformer.on_init(df)
+
+
+class _CoTransformerRunner:
+    def __init__(self, transformer: CoTransformer, ignore_errors: List[type], schema: Schema):
+        self.transformer = transformer
+        self.ignore_errors = tuple(ignore_errors)
+        self.schema = schema
+
+    def run(self, cursor: PartitionCursor, dfs: DataFrames) -> LocalDataFrame:
+        self.transformer._cursor = cursor  # type: ignore
+        if len(self.ignore_errors) == 0:
+            return self.transformer.transform(dfs)
+        try:
+            return self.transformer.transform(dfs).as_local_bounded()
+        except self.ignore_errors:  # type: ignore
+            return ArrayDataFrame([], self.schema)
+
+    def on_init(self, partition_no: int, dfs: DataFrames) -> None:
+        self.transformer._cursor = PartitionCursor(  # type: ignore
+            Schema(), self.transformer.partition_spec, partition_no
+        )
+        self.transformer.on_init(dfs)
+
+
+class RunJoin(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        if len(dfs) == 1:
+            return dfs[0]
+        how = self.params.get_or_throw("how", str)
+        on = self.params.get("on", [])
+        df = dfs[0]
+        for i in range(1, len(dfs)):
+            df = self.execution_engine.join(df, dfs[i], how=how, on=on)
+        return df
+
+
+class RunSetOperation(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        if len(dfs) == 1:
+            return dfs[0]
+        how = self.params.get_or_throw("how", str)
+        unique = self.params.get("distinct", True)
+        ops = {
+            "union": self.execution_engine.union,
+            "subtract": self.execution_engine.subtract,
+            "intersect": self.execution_engine.intersect,
+        }
+        assert_or_throw(how in ops, FugueWorkflowError(f"invalid set op {how}"))
+        op = ops[how]
+        df = dfs[0]
+        for i in range(1, len(dfs)):
+            df = op(df, dfs[i], distinct=unique)
+        return df
+
+
+class Distinct(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("distinct takes one input"))
+        return self.execution_engine.distinct(dfs[0])
+
+
+class Dropna(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("dropna takes one input"))
+        how = self.params.get("how", "any")
+        assert_or_throw(
+            how in ("any", "all"),
+            FugueWorkflowError("how' needs to be either 'any' or 'all'"),
+        )
+        thresh = self.params.get_or_none("thresh", int)
+        subset = self.params.get_or_none("subset", list)
+        return self.execution_engine.dropna(dfs[0], how=how, thresh=thresh, subset=subset)
+
+
+class Fillna(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("fillna takes one input"))
+        value = self.params.get_or_none("value", object)
+        subset = self.params.get_or_none("subset", list)
+        return self.execution_engine.fillna(dfs[0], value=value, subset=subset)
+
+
+class RunSQLSelect(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        statement = self.params.get_or_throw("statement", StructuredRawSQL)
+        engine = self.execution_engine
+        sql_engine = engine.sql_engine
+        return sql_engine.select(dfs, statement)
+
+
+class Zip(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        how = self.params.get("how", "inner")
+        partition_spec = self.partition_spec
+        temp_path = self.params.get_or_none("temp_path", str)
+        to_file_threshold = self.params.get("to_file_threshold", -1)
+        return self.execution_engine.zip(
+            dfs,
+            how=how,
+            partition_spec=partition_spec,
+            temp_path=temp_path,
+            to_file_threshold=to_file_threshold,
+        )
+
+
+class Select(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("select takes one input"))
+        columns = self.params.get_or_throw("columns", ColSelectColumns)
+        where = self.params.get_or_none("where", object)
+        having = self.params.get_or_none("having", object)
+        return self.execution_engine.select(dfs[0], columns, where=where, having=having)
+
+
+class Filter(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("filter takes one input"))
+        condition = self.params.get_or_throw("condition", object)
+        return self.execution_engine.filter(dfs[0], condition)
+
+
+class Assign(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("assign takes one input"))
+        columns = self.params.get_or_throw("columns", list)
+        return self.execution_engine.assign(dfs[0], columns)
+
+
+class Aggregate(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("aggregate takes one input"))
+        columns = self.params.get_or_throw("columns", list)
+        return self.execution_engine.aggregate(dfs[0], self.partition_spec, columns)
+
+
+class Rename(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("rename takes one input"))
+        columns = self.params.get_or_throw("columns", dict)
+        return dfs[0].rename(columns)
+
+
+class AlterColumns(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("alter_columns takes one input"))
+        columns = self.params.get_or_throw("columns", object)
+        return dfs[0].alter_columns(columns)
+
+
+class DropColumns(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("drop takes one input"))
+        if_exists = self.params.get("if_exists", False)
+        columns = self.params.get_or_throw("columns", list)
+        if if_exists:
+            columns = [c for c in columns if c in dfs[0].schema]
+        return dfs[0].drop(columns)
+
+
+class SelectColumns(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("select takes one input"))
+        columns = self.params.get_or_throw("columns", list)
+        return dfs[0][columns]
+
+
+class Sample(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("sample takes one input"))
+        n = self.params.get_or_none("n", int)
+        frac = self.params.get_or_none("frac", float)
+        replace = self.params.get("replace", False)
+        seed = self.params.get_or_none("seed", int)
+        return self.execution_engine.sample(dfs[0], n=n, frac=frac, replace=replace, seed=seed)
+
+
+class Take(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("take takes one input"))
+        n = self.params.get_or_none("n", int)
+        presort = self.params.get("presort", "")
+        na_position = self.params.get("na_position", "last")
+        return self.execution_engine.take(
+            dfs[0],
+            n=n,  # type: ignore
+            presort=presort,
+            na_position=na_position,
+            partition_spec=self.partition_spec,
+        )
+
+
+class SaveAndUse(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, FugueWorkflowError("save takes one input"))
+        kwargs = self.params.get("params", dict())
+        path = self.params.get_or_throw("path", str)
+        format_hint = self.params.get("fmt", "")
+        mode = self.params.get("mode", "overwrite")
+        partition_spec = self.partition_spec
+        force_single = self.params.get("single", False)
+        engine = self.execution_engine
+        engine.save_df(
+            df=dfs[0],
+            path=path,
+            format_hint=format_hint or None,
+            mode=mode,
+            partition_spec=partition_spec,
+            force_single=force_single,
+            **kwargs,
+        )
+        return engine.load_df(path, format_hint=format_hint or None)

@@ -1,0 +1,97 @@
+"""Shared machinery for extension conversion (interfaceless support).
+
+Factors the common parts of the reference's per-extension ``convert.py``
+modules: name registries, caller-scope resolution, and ``# schema:`` comment
+parsing (reference ``fugue/_utils/interfaceless.py:9-67``).
+"""
+
+import inspect
+import re
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from .._utils.convert import get_caller_global_local_vars, to_function, to_type
+from ..exceptions import FugueInterfacelessError
+
+_SCHEMA_COMMENT_RE = re.compile(r"^\s*#\s*schema\s*:(.*)$")
+
+
+def comment_block_above(func: Callable) -> list:
+    """The contiguous comment lines directly above a function's ``def``
+    (the mechanism behind ``# schema:`` hints, reference
+    ``fugue/_utils/interfaceless.py:9-67``)."""
+    try:
+        lines, start = inspect.findsource(func)  # start = 0-based def index
+    except (OSError, TypeError):
+        return []
+    # skip decorators upwards
+    i = start - 1
+    while i >= 0 and lines[i].strip().startswith("@"):
+        i -= 1
+    block = []
+    while i >= 0:
+        stripped = lines[i].strip()
+        if stripped.startswith("#"):
+            block.insert(0, stripped[1:].strip())
+            i -= 1
+        elif stripped == "":
+            i -= 1
+        else:
+            break
+    return block
+
+
+def parse_comment_annotation(func: Callable, annotation: str = "schema") -> Optional[str]:
+    """Find ``# schema: ...`` (or other annotation) directly above a function."""
+    pattern = re.compile(r"^" + annotation + r"\s*:(.*)$")
+    result: Optional[str] = None
+    for line in comment_block_above(func):
+        m = pattern.match(line)
+        if m is not None:
+            result = m.group(1).strip()
+    return result
+
+
+class ExtensionRegistry:
+    """Name → extension object/function registry for one extension type."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._registry: Dict[str, Any] = {}
+
+    def register(self, name: str, extension: Any, on_dup: str = "overwrite") -> None:
+        if name in self._registry and on_dup == "throw":
+            raise KeyError(f"{name} is already registered as a {self._name}")
+        if name in self._registry and on_dup == "ignore":
+            return
+        self._registry[name] = extension
+
+    def get(self, name: str) -> Optional[Any]:
+        return self._registry.get(name, None)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._registry
+
+
+def resolve_extension_object(
+    obj: Any,
+    registry: ExtensionRegistry,
+    base_class: Type,
+    global_vars: Optional[Dict[str, Any]],
+    local_vars: Optional[Dict[str, Any]],
+) -> Any:
+    """Resolve str/class/function/instance into a concrete object to wrap."""
+    if isinstance(obj, str):
+        reg = registry.get(obj)
+        if reg is not None:
+            return reg
+        global_vars, local_vars = get_caller_global_local_vars(global_vars, local_vars)
+        try:
+            return to_function(obj, global_vars, local_vars)
+        except Exception:
+            pass
+        try:
+            return to_type(obj, base_class, global_vars, local_vars)
+        except Exception:
+            pass
+        raise FugueInterfacelessError(f"can't resolve {obj!r}")
+    return obj

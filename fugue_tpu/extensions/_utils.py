@@ -1,0 +1,107 @@
+"""Extension validation rules.
+
+Parity with the reference (`fugue/extensions/_utils.py:148`): compile-time
+rules validate the partition spec; runtime rules validate input schemas.
+Rules come from dicts or from ``# rulename:`` comments above functions.
+"""
+
+from typing import Any, Dict, List
+
+from .._utils.assertion import assert_or_throw
+from .._utils.params import to_list_of_str
+from ..collections.partition import PartitionSpec, parse_presort_exp
+from ..exceptions import (
+    FugueWorkflowCompileValidationError,
+    FugueWorkflowRuntimeValidationError,
+)
+from ..schema import Schema
+
+_COMPILE_RULES = {"partitionby_has", "partitionby_is", "presort_has", "presort_is"}
+_RUNTIME_RULES = {"input_has", "input_is"}
+ALL_RULES = _COMPILE_RULES | _RUNTIME_RULES
+
+
+def parse_validation_rules_from_comment(func: Any) -> Dict[str, Any]:
+    """Extract rules from ``# rulename: value`` comments above a function."""
+    from ._shared import comment_block_above
+
+    rules: Dict[str, Any] = {}
+    for body in comment_block_above(func):
+        for rule in ALL_RULES:
+            prefix = rule + ":"
+            if body.startswith(prefix):
+                rules[rule] = body[len(prefix):].strip()
+    return rules
+
+
+def to_validation_rules(params: Dict[str, Any]) -> Dict[str, Any]:
+    rules: Dict[str, Any] = {}
+    for k, v in params.items():
+        if k in ALL_RULES:
+            rules[k] = v
+        else:
+            raise NotImplementedError(f"{k} is not a valid validation rule")
+    return rules
+
+
+def validate_partition_spec(spec: PartitionSpec, rules: Dict[str, Any]) -> None:
+    for k, v in rules.items():
+        if k == "partitionby_has":
+            need = to_list_of_str(v.split(",") if isinstance(v, str) else v)
+            missing = [x.strip() for x in need if x.strip() not in spec.partition_by]
+            assert_or_throw(
+                len(missing) == 0,
+                lambda: FugueWorkflowCompileValidationError(
+                    f"partition by must contain {missing}, got {spec.partition_by}"
+                ),
+            )
+        elif k == "partitionby_is":
+            need = [x.strip() for x in (v.split(",") if isinstance(v, str) else v)]
+            assert_or_throw(
+                sorted(need) == sorted(spec.partition_by),
+                lambda: FugueWorkflowCompileValidationError(
+                    f"partition by must be {need}, got {spec.partition_by}"
+                ),
+            )
+        elif k == "presort_has":
+            need = parse_presort_exp(v)
+            for name, asc in need.items():
+                assert_or_throw(
+                    name in spec.presort and spec.presort[name] == asc,
+                    lambda: FugueWorkflowCompileValidationError(
+                        f"presort must contain {name} {'asc' if asc else 'desc'}"
+                    ),
+                )
+        elif k == "presort_is":
+            need = parse_presort_exp(v)
+            assert_or_throw(
+                list(need.items()) == list(spec.presort.items()),
+                lambda: FugueWorkflowCompileValidationError(
+                    f"presort must be {dict(need)}, got {dict(spec.presort)}"
+                ),
+            )
+
+
+def validate_input_schema(schema: Schema, rules: Dict[str, Any]) -> None:
+    for k, v in rules.items():
+        if k == "input_has":
+            items = v.split(",") if isinstance(v, str) else v
+            for item in items:
+                item = item.strip() if isinstance(item, str) else item
+                assert_or_throw(
+                    item in schema,
+                    lambda: FugueWorkflowRuntimeValidationError(
+                        f"input schema must contain {item}, got {schema}"
+                    ),
+                )
+        elif k == "input_is":
+            try:
+                expected = Schema(v)
+            except Exception as e:
+                raise FugueWorkflowCompileValidationError(f"invalid input_is {v}") from e
+            assert_or_throw(
+                schema == expected,
+                lambda: FugueWorkflowRuntimeValidationError(
+                    f"input schema must be {v}, got {schema}"
+                ),
+            )

@@ -1,0 +1,10 @@
+"""Creator — driver-side 0-input extension (reference
+``fugue/extensions/creator/creator.py``)."""
+
+from ...dataframe import DataFrame
+from ..context import ExtensionContext
+
+
+class Creator(ExtensionContext):
+    def create(self) -> DataFrame:
+        raise NotImplementedError

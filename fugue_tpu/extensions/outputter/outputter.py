@@ -1,0 +1,14 @@
+"""Outputter — driver-side n-input→0-output extension (reference
+``fugue/extensions/outputter/outputter.py``)."""
+
+from ...dataframe import DataFrames
+from ..context import ExtensionContext
+
+
+class Outputter(ExtensionContext):
+    def process(self, dfs: DataFrames) -> None:
+        raise NotImplementedError
+
+    @property
+    def validation_rules(self) -> dict:
+        return {}

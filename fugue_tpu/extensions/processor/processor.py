@@ -1,0 +1,14 @@
+"""Processor — driver-side n-input→1-output extension (reference
+``fugue/extensions/processor/processor.py``)."""
+
+from ...dataframe import DataFrame, DataFrames
+from ..context import ExtensionContext
+
+
+class Processor(ExtensionContext):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        raise NotImplementedError
+
+    @property
+    def validation_rules(self) -> dict:
+        return {}

@@ -1,0 +1,2 @@
+from .transformer import CoTransformer, OutputCoTransformer, OutputTransformer, Transformer
+from . import convert

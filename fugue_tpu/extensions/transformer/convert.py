@@ -1,0 +1,378 @@
+"""Transformer conversion: classes, functions, strings → Transformer.
+
+Parity with the reference (`fugue/extensions/transformer/convert.py:28,101,242,328,423`):
+``parse_transformer`` plugin, ``register_transformer``, ``@transformer`` /
+``@cotransformer`` / ``@output_transformer`` / ``@output_cotransformer``
+decorators, and the interfaceless ``_FuncAsTransformer`` (schema from
+argument or ``# schema:`` comment).
+"""
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ..._utils.assertion import assert_or_throw
+from ..._utils.convert import get_caller_global_local_vars, to_instance
+from ..._utils.hash import to_uuid
+from ..._utils.registry import fugue_plugin
+from ...dataframe import DataFrame, DataFrames, LocalDataFrame
+from ...dataframe.function_wrapper import DataFrameFunctionWrapper
+from ...exceptions import FugueInterfacelessError
+from ...schema import Schema
+from .._shared import ExtensionRegistry, parse_comment_annotation, resolve_extension_object
+from .._utils import parse_validation_rules_from_comment, to_validation_rules
+from .transformer import CoTransformer, OutputCoTransformer, OutputTransformer, Transformer
+
+OUTPUT_TRANSFORMER_DUMMY_SCHEMA = Schema("_0:int")
+
+_TRANSFORMER_REGISTRY = ExtensionRegistry("transformer")
+_OUT_TRANSFORMER_REGISTRY = ExtensionRegistry("output_transformer")
+
+
+def register_transformer(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _TRANSFORMER_REGISTRY.register(alias, obj, on_dup)
+
+
+def register_output_transformer(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _OUT_TRANSFORMER_REGISTRY.register(alias, obj, on_dup)
+
+
+@fugue_plugin
+def parse_transformer(obj: Any) -> Any:
+    """Plugin hook: custom transformer spec parsing (e.g. namespaced names)."""
+    return obj
+
+
+@fugue_plugin
+def parse_output_transformer(obj: Any) -> Any:
+    return obj
+
+
+def transformer(schema: Any, **validation_rules: Any) -> Callable[[Callable], "_FuncAsTransformer"]:
+    """Decorator version of transform functions (reference ``:242``)."""
+
+    def deco(func: Callable) -> _FuncAsTransformer:
+        assert_or_throw(
+            not _is_cotransform_func(func),
+            FugueInterfacelessError("multi-dataframe functions must use @cotransformer"),
+        )
+        return _FuncAsTransformer.from_func(
+            func, schema, validation_rules=to_validation_rules(validation_rules)
+        )
+
+    return deco
+
+
+def output_transformer(**validation_rules: Any) -> Callable[[Callable], "_FuncAsOutputTransformer"]:
+    def deco(func: Callable) -> _FuncAsOutputTransformer:
+        return _FuncAsOutputTransformer.from_func(
+            func, None, validation_rules=to_validation_rules(validation_rules)
+        )
+
+    return deco
+
+
+def cotransformer(schema: Any, **validation_rules: Any) -> Callable[[Callable], "_FuncAsCoTransformer"]:
+    def deco(func: Callable) -> _FuncAsCoTransformer:
+        return _FuncAsCoTransformer.from_func(
+            func, schema, validation_rules=to_validation_rules(validation_rules)
+        )
+
+    return deco
+
+
+def output_cotransformer(**validation_rules: Any) -> Callable[[Callable], "_FuncAsOutputCoTransformer"]:
+    def deco(func: Callable) -> _FuncAsOutputCoTransformer:
+        return _FuncAsOutputCoTransformer.from_func(
+            func, None, validation_rules=to_validation_rules(validation_rules)
+        )
+
+    return deco
+
+
+def _to_transformer(
+    obj: Any,
+    schema: Any = None,
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+) -> Union[Transformer, CoTransformer]:
+    global_vars, local_vars = get_caller_global_local_vars(global_vars, local_vars)
+    return _to_general_transformer(
+        obj, schema, global_vars, local_vars,
+        registry=_TRANSFORMER_REGISTRY,
+        parse=parse_transformer,
+        func_single=_FuncAsTransformer,
+        func_multi=_FuncAsCoTransformer,
+        bases=(Transformer, CoTransformer),
+    )
+
+
+def _to_output_transformer(
+    obj: Any,
+    global_vars: Optional[Dict[str, Any]] = None,
+    local_vars: Optional[Dict[str, Any]] = None,
+) -> Union[Transformer, CoTransformer]:
+    global_vars, local_vars = get_caller_global_local_vars(global_vars, local_vars)
+    return _to_general_transformer(
+        obj, None, global_vars, local_vars,
+        registry=_OUT_TRANSFORMER_REGISTRY,
+        parse=parse_output_transformer,
+        func_single=_FuncAsOutputTransformer,
+        func_multi=_FuncAsOutputCoTransformer,
+        bases=(Transformer, CoTransformer),
+    )
+
+
+def _to_general_transformer(
+    obj: Any,
+    schema: Any,
+    global_vars: Any,
+    local_vars: Any,
+    registry: ExtensionRegistry,
+    parse: Callable,
+    func_single: type,
+    func_multi: type,
+    bases: tuple,
+) -> Union[Transformer, CoTransformer]:
+    parsed = parse(obj)
+    resolved = resolve_extension_object(parsed, registry, bases[0], global_vars, local_vars)
+    if isinstance(resolved, bases):
+        copied = copy.copy(resolved)
+        assert_or_throw(
+            schema is None,
+            FugueInterfacelessError("schema must be None when using an interface class"),
+        )
+        return copied
+    if isinstance(resolved, type) and issubclass(resolved, bases):
+        return to_instance(resolved, object)
+    if callable(resolved):
+        if _is_cotransform_func(resolved):
+            return func_multi.from_func(resolved, schema, validation_rules={})
+        return func_single.from_func(resolved, schema, validation_rules={})
+    raise FugueInterfacelessError(f"can't convert {obj!r} to a transformer")
+
+
+def _is_cotransform_func(func: Callable) -> bool:
+    try:
+        w = DataFrameFunctionWrapper(func)
+    except FugueInterfacelessError:
+        return False
+    code = w.input_code
+    dfs = [c for c in code if c in "clspqd"]
+    return code.startswith("c") or len([c for c in code.split("x")[0] if c in "lspq"]) > 1
+
+
+class _FuncAsTransformer(Transformer):
+    """A plain function adapted into a Transformer (reference ``:328``)."""
+
+    @property
+    def validation_rules(self) -> Dict[str, Any]:
+        return self._validation_rules  # type: ignore
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        return _apply_schema_arg(df.schema, self._output_schema_arg)
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    @property
+    def using_callback(self) -> bool:
+        return any(c in self._wrapper.input_code for c in "fF")
+
+    @property
+    def callback_required(self) -> bool:
+        return "f" in self._wrapper.input_code
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        args: List[Any] = [df]
+        if self.using_callback:
+            args.append(
+                self.callback if self.has_callback or self.callback_required else None
+            )
+        return self._wrapper.run(  # type: ignore
+            args, self.params, ignore_unknown=False, output_schema=self.output_schema
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(
+            self._wrapper.__uuid__(),
+            str(self._output_schema_arg),
+            self._validation_rules,
+        )
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation_rules: Dict[str, Any]
+    ) -> "_FuncAsTransformer":
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        validation_rules = dict(validation_rules)
+        validation_rules.update(parse_validation_rules_from_comment(func))
+        tr = _FuncAsTransformer()
+        tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
+            func, "^[lspq][fF]?x*z?$", "^[lspqr]$"
+        )
+        tr._output_schema_arg = schema  # type: ignore
+        tr._validation_rules = validation_rules  # type: ignore
+        # interfaceless transformers ALWAYS need a declared output schema —
+        # engines must know it before execution (reference behavior)
+        assert_or_throw(
+            schema is not None,
+            FugueInterfacelessError(
+                "schema is required for interfaceless transformers "
+                "(pass schema=... or add a '# schema:' comment)"
+            ),
+        )
+        return tr
+
+
+class _FuncAsOutputTransformer(_FuncAsTransformer, OutputTransformer):
+    """Function → OutputTransformer (reference ``:412``)."""
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        return OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        args: List[Any] = [df]
+        if self.using_callback:
+            args.append(
+                self.callback if self.has_callback or self.callback_required else None
+            )
+        self._wrapper.run(args, self.params, ignore_unknown=False, output=False)  # type: ignore
+        from ...dataframe import ArrayDataFrame
+
+        return ArrayDataFrame([], OUTPUT_TRANSFORMER_DUMMY_SCHEMA)
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation_rules: Dict[str, Any]
+    ) -> "_FuncAsOutputTransformer":
+        assert_or_throw(
+            schema is None, FugueInterfacelessError("schema must be None for output transformers")
+        )
+        validation_rules = dict(validation_rules)
+        validation_rules.update(parse_validation_rules_from_comment(func))
+        tr = _FuncAsOutputTransformer()
+        tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
+            func, "^[lspq][fF]?x*z?$", "^[lspnqr]$"
+        )
+        tr._output_schema_arg = None  # type: ignore
+        tr._validation_rules = validation_rules  # type: ignore
+        return tr
+
+
+class _FuncAsCoTransformer(CoTransformer):
+    """Function → CoTransformer (reference ``:423``)."""
+
+    @property
+    def validation_rules(self) -> Dict[str, Any]:
+        return self._validation_rules  # type: ignore
+
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        # cotransform schema arg can't reference "*" (multiple inputs)
+        return Schema(self._output_schema_arg)  # type: ignore
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    @property
+    def using_callback(self) -> bool:
+        return any(c in self._wrapper.input_code for c in "fF")
+
+    @property
+    def callback_required(self) -> bool:
+        return "f" in self._wrapper.input_code
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        if self._dfs_input:  # type: ignore
+            args: List[Any] = [dfs]
+        else:
+            args = list(dfs.values())
+        if self.using_callback:
+            args.append(
+                self.callback if self.has_callback or self.callback_required else None
+            )
+        return self._wrapper.run(  # type: ignore
+            args, self.params, ignore_unknown=False, output_schema=self.output_schema
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(
+            self._wrapper.__uuid__(),
+            str(self._output_schema_arg),
+            self._validation_rules,
+        )
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation_rules: Dict[str, Any]
+    ) -> "_FuncAsCoTransformer":
+        assert_or_throw(
+            len(validation_rules) == 0 and len(parse_validation_rules_from_comment(func)) == 0,
+            FugueInterfacelessError("cotransformers take no validation rules"),
+        )
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        if isinstance(schema, Schema):
+            schema = str(schema)
+        tr = _FuncAsCoTransformer()
+        tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
+            func, "^(c|[lspq]+)[fF]?x*z?$", "^[lspqr]$"
+        )
+        tr._dfs_input = tr._wrapper.input_code.startswith("c")  # type: ignore
+        tr._output_schema_arg = schema  # type: ignore
+        tr._validation_rules = {}  # type: ignore
+        assert_or_throw(
+            schema is not None,
+            FugueInterfacelessError("schema is required for interfaceless cotransformers"),
+        )
+        return tr
+
+
+class _FuncAsOutputCoTransformer(_FuncAsCoTransformer, OutputCoTransformer):
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        return OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        if self._dfs_input:  # type: ignore
+            args: List[Any] = [dfs]
+        else:
+            args = list(dfs.values())
+        if self.using_callback:
+            args.append(
+                self.callback if self.has_callback or self.callback_required else None
+            )
+        self._wrapper.run(args, self.params, ignore_unknown=False, output=False)  # type: ignore
+        from ...dataframe import ArrayDataFrame
+
+        return ArrayDataFrame([], OUTPUT_TRANSFORMER_DUMMY_SCHEMA)
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation_rules: Dict[str, Any]
+    ) -> "_FuncAsOutputCoTransformer":
+        assert_or_throw(
+            schema is None, FugueInterfacelessError("schema must be None for output cotransformers")
+        )
+        tr = _FuncAsOutputCoTransformer()
+        tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
+            func, "^(c|[lspq]+)[fF]?x*z?$", "^[lspnqr]$"
+        )
+        tr._dfs_input = tr._wrapper.input_code.startswith("c")  # type: ignore
+        tr._output_schema_arg = None  # type: ignore
+        tr._validation_rules = {}  # type: ignore
+        return tr
+
+
+def _apply_schema_arg(input_schema: Schema, schema_arg: Any) -> Schema:
+    assert_or_throw(
+        schema_arg is not None,
+        FugueInterfacelessError("output schema is required but not provided"),
+    )
+    if isinstance(schema_arg, Schema):
+        return schema_arg
+    if callable(schema_arg):
+        return Schema(schema_arg(input_schema))
+    if isinstance(schema_arg, (list, tuple)):
+        return input_schema.transform(*schema_arg)
+    return input_schema.transform(schema_arg)
+

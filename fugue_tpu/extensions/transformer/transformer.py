@@ -1,0 +1,78 @@
+"""Transformer / CoTransformer interfaces — worker-side per-partition logic.
+
+Parity with the reference (`fugue/extensions/transformer/transformer.py:8,101,113,201`).
+"""
+
+from typing import Any
+
+from ...dataframe import DataFrame, DataFrames, LocalDataFrame
+from ..context import ExtensionContext
+
+
+class Transformer(ExtensionContext):
+    """Per-logical-partition transformation, instantiated on the driver,
+    executed on workers."""
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        raise NotImplementedError
+
+    def on_init(self, df: DataFrame) -> None:  # pragma: no cover - optional hook
+        pass
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        raise NotImplementedError
+
+    @property
+    def validation_rules(self) -> dict:
+        return {}
+
+
+class OutputTransformer(Transformer):
+    """Transformer with no output (side effects only); reference ``:101``."""
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        from . import convert
+
+        return convert.OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    def process(self, df: LocalDataFrame) -> None:
+        raise NotImplementedError
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        from ...dataframe import ArrayDataFrame
+
+        self.process(df)
+        return ArrayDataFrame([], self.get_output_schema(df))
+
+
+class CoTransformer(ExtensionContext):
+    """Per-co-partition transformation over zipped frames; reference ``:113``."""
+
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        raise NotImplementedError
+
+    def on_init(self, dfs: DataFrames) -> None:  # pragma: no cover - optional hook
+        pass
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        raise NotImplementedError
+
+    @property
+    def validation_rules(self) -> dict:
+        return {}
+
+
+class OutputCoTransformer(CoTransformer):
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        from . import convert
+
+        return convert.OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    def process(self, dfs: DataFrames) -> None:
+        raise NotImplementedError
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        from ...dataframe import ArrayDataFrame
+
+        self.process(dfs)
+        return ArrayDataFrame([], self.get_output_schema(dfs))

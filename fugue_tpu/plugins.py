@@ -1,0 +1,47 @@
+"""All extensible plugin hooks in one namespace.
+
+Parity with the reference (`fugue/plugins.py`): backends and user libraries
+register candidates on these hooks to extend the framework.
+"""
+
+from .collections.sql import transpile_sql  # noqa: F401
+from .dataset.api import as_fugue_dataset  # noqa: F401
+from .dataset.dataset import get_dataset_display  # noqa: F401
+from .dataframe.api import as_fugue_df, get_native_as_df  # noqa: F401
+from .dataframe.function_wrapper import fugue_annotated_param  # noqa: F401
+from .execution.factory import (  # noqa: F401
+    infer_execution_engine,
+    parse_execution_engine,
+    register_default_execution_engine,
+    register_default_sql_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from .extensions.creator.convert import parse_creator, register_creator  # noqa: F401
+from .extensions.outputter.convert import (  # noqa: F401
+    parse_outputter,
+    register_outputter,
+)
+from .extensions.processor.convert import (  # noqa: F401
+    parse_processor,
+    register_processor,
+)
+from .extensions.transformer.convert import (  # noqa: F401
+    parse_output_transformer,
+    parse_transformer,
+    register_output_transformer,
+    register_transformer,
+)
+
+
+def namespace_candidate(namespace: str, matcher: "callable") -> "callable":
+    """Build a matcher for namespaced string extensions like ``"viz:bar"``
+    (reference ``triad namespace_candidate`` usage in ``fugue_contrib``)."""
+
+    def _m(obj: "object", *args: "object", **kwargs: "object") -> bool:
+        if not isinstance(obj, str) or ":" not in obj:
+            return False
+        ns, expr = obj.split(":", 1)
+        return ns == namespace and matcher(expr)
+
+    return _m

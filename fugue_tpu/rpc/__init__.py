@@ -1,0 +1,23 @@
+from .base import (
+    EmptyRPCHandler,
+    NativeRPCClient,
+    NativeRPCServer,
+    RPCClient,
+    RPCFunc,
+    RPCHandler,
+    RPCServer,
+    make_rpc_server,
+    to_rpc_handler,
+)
+
+__all__ = [
+    "EmptyRPCHandler",
+    "NativeRPCClient",
+    "NativeRPCServer",
+    "RPCClient",
+    "RPCFunc",
+    "RPCHandler",
+    "RPCServer",
+    "make_rpc_server",
+    "to_rpc_handler",
+]

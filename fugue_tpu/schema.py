@@ -184,8 +184,6 @@ def to_pa_datatype(obj: Any) -> pa.DataType:
         return pa.date32()
     if isinstance(obj, (np.dtype, type)):
         return pa.from_numpy_dtype(obj)
-    if isinstance(obj, pd.api.types.pandas_dtype("int64").__class__.__mro__[-2]):
-        pass
     raise TypeError(f"can't convert {obj!r} to pyarrow DataType")
 
 
@@ -253,11 +251,8 @@ class Schema(IndexedOrderedDict):
     def _append_field(self, field: pa.Field) -> None:
         if field.name in self:
             raise SchemaError(f"duplicated field name {field.name!r}")
-        if field.name == "" or field.name.startswith("_"):
-            # leading-underscore names are reserved for framework internals
-            # (serialized-blob columns etc.), mirroring reference constraints
-            if field.name == "":
-                raise SchemaError("field name can't be empty")
+        if field.name == "":
+            raise SchemaError("field name can't be empty")
         field = pa.field(field.name, _normalize_type(field.type))
         self[field.name] = field
 
@@ -421,19 +416,15 @@ class Schema(IndexedOrderedDict):
                     raise SchemaError(f"can't remove {o}: type mismatch")
                 names.append(o.name)
             elif isinstance(o, (Schema, pa.Schema)):
-                for f in o:
-                    collect(f if isinstance(f, pa.Field) else self.get(f, pa.field(f, pa.null())) if isinstance(f, str) else f)
+                for f in (o.fields if isinstance(o, Schema) else list(o)):
+                    collect(f)
             elif isinstance(o, Iterable):
                 for x in o:
                     collect(x)
             else:
                 raise SchemaError(f"can't remove {o!r} from schema")
 
-        if isinstance(obj, (Schema, pa.Schema)):
-            for f in (obj.fields if isinstance(obj, Schema) else list(obj)):
-                collect(f)
-        else:
-            collect(obj)
+        collect(obj)
         missing = [n for n in names if n not in self]
         if len(missing) > 0 and not ignore_key_mismatch:
             raise SchemaError(f"fields {missing} not in schema {self}")
@@ -552,16 +543,29 @@ class Schema(IndexedOrderedDict):
         soft_subtract: List[str] = []
 
         def handle_expr(expr: str) -> None:
+            # "-a,b" / "~a,b": after a -/~ prefix, following bare names stay
+            # in drop mode until a typed field or "*" resets to add mode
+            mode = "add"
             for part in _split_top(expr, ","):
                 part = part.strip()
                 if part == "":
                     continue
                 if part == "*":
+                    mode = "add"
                     result.append(self)
                 elif part.startswith("-"):
+                    mode = "sub"
                     subtract.append(part[1:].strip())
                 elif part.startswith("~"):
+                    mode = "soft"
                     soft_subtract.append(part[1:].strip())
+                elif ":" in part:
+                    mode = "add"
+                    result.append(part)
+                elif mode == "sub":
+                    subtract.append(part)
+                elif mode == "soft":
+                    soft_subtract.append(part)
                 else:
                     result.append(part)
 

@@ -1,0 +1,15 @@
+from .workflow import FugueWorkflow, FugueWorkflowResult, WorkflowDataFrame
+from .api import out_transform, raw_sql, transform
+from ._checkpoint import Checkpoint, StrongCheckpoint, WeakCheckpoint
+
+__all__ = [
+    "FugueWorkflow",
+    "FugueWorkflowResult",
+    "WorkflowDataFrame",
+    "transform",
+    "out_transform",
+    "raw_sql",
+    "Checkpoint",
+    "StrongCheckpoint",
+    "WeakCheckpoint",
+]

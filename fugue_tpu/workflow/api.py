@@ -1,0 +1,117 @@
+"""Single-op workflow wrappers: transform / out_transform / raw_sql.
+
+Parity with the reference (`fugue/workflow/api.py:34,187,253`) — the
+flagship entrypoints: wrap one operation into a one-task DAG, run it, return
+native data.
+"""
+
+from typing import Any, Callable, List, Optional
+
+from .._utils.convert import get_caller_global_local_vars
+from ..collections.yielded import Yielded
+from ..dataframe import DataFrame
+from ..dataframe.api import get_native_as_df
+from ..exceptions import FugueWorkflowError
+from .workflow import FugueWorkflow, FugueWorkflowResult
+
+
+def transform(
+    df: Any,
+    using: Any,
+    schema: Any = None,
+    params: Any = None,
+    partition: Any = None,
+    callback: Any = None,
+    ignore_errors: Optional[List[Any]] = None,
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    as_local: bool = False,
+) -> Any:
+    """Transform a dataframe with any engine (reference ``workflow/api.py:34``)."""
+    global_vars, local_vars = get_caller_global_local_vars()
+    dag = FugueWorkflow()
+    src = dag.create_data(df) if not isinstance(df, str) else dag.load(df)
+    tdf = dag.transform(
+        src,
+        using=using,
+        schema=schema,
+        params=params,
+        pre_partition=partition,
+        ignore_errors=ignore_errors or [],
+        callback=callback,
+        global_vars=global_vars,
+        local_vars=local_vars,
+    )
+    tdf.yield_dataframe_as("result", as_local=as_local)
+    dag.run(engine, engine_conf, infer_by=[df])
+    result = dag.yields["result"].result  # type: ignore
+    return _adjust_result(result, df, as_fugue)
+
+
+def _adjust_result(result: DataFrame, original: Any, as_fugue: bool) -> Any:
+    """Return the result in the same family as the input (reference
+    ``workflow/api.py:182-184``)."""
+    import pandas as pd
+    import pyarrow as pa
+
+    if as_fugue or isinstance(original, (DataFrame, Yielded)):
+        return result
+    if isinstance(original, pd.DataFrame):
+        return result.as_pandas()
+    if isinstance(original, pa.Table):
+        return result.as_arrow()
+    return get_native_as_df(result)
+
+
+def out_transform(
+    df: Any,
+    using: Any,
+    params: Any = None,
+    partition: Any = None,
+    callback: Any = None,
+    ignore_errors: Optional[List[Any]] = None,
+    engine: Any = None,
+    engine_conf: Any = None,
+) -> None:
+    """Transform with no output (side effects), reference ``:187``."""
+    global_vars, local_vars = get_caller_global_local_vars()
+    dag = FugueWorkflow()
+    src = dag.create_data(df) if not isinstance(df, str) else dag.load(df)
+    dag.out_transform(
+        src,
+        using=using,
+        params=params,
+        pre_partition=partition,
+        ignore_errors=ignore_errors or [],
+        callback=callback,
+        global_vars=global_vars,
+        local_vars=local_vars,
+    )
+    dag.run(engine, engine_conf, infer_by=[df])
+
+
+def raw_sql(
+    *statements: Any,
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    as_local: bool = False,
+) -> Any:
+    """Run a SQL statement mixing strings and dataframes (reference ``:253``)."""
+    dag = FugueWorkflow()
+    parts: List[Any] = []
+    raw_inputs: List[Any] = []
+    for s in statements:
+        if isinstance(s, str):
+            parts.append(s)
+        else:
+            parts.append(dag.create_data(s))
+            raw_inputs.append(s)
+    res = dag.select(*parts)
+    res.yield_dataframe_as("result", as_local=as_local)
+    dag.run(engine, engine_conf, infer_by=raw_inputs)
+    result = dag.yields["result"].result  # type: ignore
+    if as_fugue or any(isinstance(s, (DataFrame, Yielded)) for s in raw_inputs):
+        return result
+    return get_native_as_df(result)

@@ -1,0 +1,12 @@
+"""Reusable contract test suites for fugue-tpu backends.
+
+Parity with the reference's ``fugue_test`` package (SURVEY.md §4): the same
+suite classes run against every engine/frame implementation — in-tree and
+third-party — so distributed semantics are exercised uniformly.
+"""
+
+from .dataframe_suite import DataFrameTests
+from .execution_suite import ExecutionEngineTests
+from .builtin_suite import BuiltInTests
+
+__all__ = ["DataFrameTests", "ExecutionEngineTests", "BuiltInTests"]

@@ -1,0 +1,456 @@
+"""Workflow-level contract suite.
+
+Modeled on the reference's ``fugue_test/builtin_suite.py`` coverage
+(``:70-1743``): create/show/assert, transforms in every interfaceless form,
+cotransform, partitioning + presort, checkpoints, yields, RPC callbacks,
+validation rules, ignore_errors, io through the workflow.
+"""
+
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu import (
+    ArrayDataFrame,
+    DataFrame,
+    FugueWorkflow,
+    PandasDataFrame,
+    Schema,
+    Transformer,
+)
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.dataframe import LocalDataFrame
+from fugue_tpu.exceptions import (
+    FugueInterfacelessError,
+    FugueWorkflowCompileValidationError,
+    FugueWorkflowError,
+)
+from fugue_tpu.execution import ExecutionEngine
+from fugue_tpu.workflow import out_transform, transform
+
+
+class BuiltInTests:
+    """Subclass ``BuiltInTests.Tests``; provide ``make_engine``."""
+
+    class Tests:
+        @pytest.fixture(autouse=True)
+        def _setup_engine(self, tmp_path):
+            self.engine: ExecutionEngine = self.make_engine()
+            self.tmpdir = str(tmp_path)
+            yield
+            self.engine.stop()
+
+        def make_engine(self) -> ExecutionEngine:
+            raise NotImplementedError
+
+        # -- basics ----------------------------------------------------------
+        def test_create_show(self):
+            with FugueWorkflow() as dag:
+                dag.df([[0]], "a:long").show()
+            dag.run(self.engine)
+
+        def test_create_process_output(self):
+            def double(df: pd.DataFrame) -> pd.DataFrame:
+                df["a"] = df["a"] * 2
+                return df
+
+            collected: List[Any] = []
+
+            def sink(df: pd.DataFrame) -> None:
+                collected.append(df["a"].tolist())
+
+            dag = FugueWorkflow()
+            a = dag.df([[1], [2]], "a:long")
+            b = dag.process(a, using=double, schema="a:long")
+            dag.output(b, using=sink)
+            dag.run(self.engine)
+            assert collected == [[2, 4]]
+
+        def test_assert_eq(self):
+            dag = FugueWorkflow()
+            a = dag.df([[0]], "a:long")
+            a.assert_eq(dag.df([[0]], "a:long"))
+            dag.run(self.engine)
+
+            dag2 = FugueWorkflow()
+            a2 = dag2.df([[0]], "a:long")
+            a2.assert_eq(dag2.df([[1]], "a:long"))
+            with pytest.raises(AssertionError):
+                dag2.run(self.engine)
+
+        def test_creator_interfaceless(self):
+            def make() -> pd.DataFrame:
+                return pd.DataFrame({"a": [1, 2]})
+
+            # schema: a:long
+            def make2() -> List[List[Any]]:
+                return [[5]]
+
+            dag = FugueWorkflow()
+            dag.create(make).assert_eq(dag.df([[1], [2]], "a:long"))
+            dag.create(make2).assert_eq(dag.df([[5]], "a:long"))
+            dag.run(self.engine)
+
+        # -- transform forms -------------------------------------------------
+        def test_transform_annotation_forms(self):
+            data = [[1, "a"], [2, "b"]]
+
+            def f_pandas(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            def f_arrow(df: pa.Table) -> pa.Table:
+                return df
+
+            def f_iter_list(rows: Iterable[List[Any]]) -> Iterable[List[Any]]:
+                for r in rows:
+                    yield r
+
+            def f_list_dict(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+                return rows
+
+            def f_ldf(df: LocalDataFrame) -> LocalDataFrame:
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df(data, "a:long,b:str")
+            for fn in [f_pandas, f_arrow, f_ldf, f_iter_list, f_list_dict]:
+                src.transform(fn, schema="*").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_transform_schema_expressions(self):
+            def with_col(df: pd.DataFrame) -> pd.DataFrame:
+                df["c"] = 1
+                return df
+
+            def drop_col(rows: Iterable[List[Any]]) -> Iterable[List[Any]]:
+                for r in rows:
+                    yield r[:-1]
+
+            dag = FugueWorkflow()
+            src = dag.df([[1, "a"]], "a:long,b:str")
+            src.transform(with_col, schema="*,c:long").assert_eq(
+                dag.df([[1, "a", 1]], "a:long,b:str,c:long")
+            )
+            src.transform(drop_col, schema="*,-b").assert_eq(dag.df([[1]], "a:long"))
+            dag.run(self.engine)
+
+        def test_transform_schema_comment(self):
+            # schema: a:long,n:long
+            def counter(df: pd.DataFrame) -> pd.DataFrame:
+                return pd.DataFrame({"a": [df["a"].iloc[0]], "n": [len(df)]})
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [1], [2]], "a:long")
+            src.partition_by("a").transform(counter).assert_eq(
+                dag.df([[1, 2], [2, 1]], "a:long,n:long")
+            )
+            dag.run(self.engine)
+
+        def test_transform_by_string_name(self):
+            dag = FugueWorkflow()
+            src = dag.df([[1]], "a:long")
+            src.transform("_string_ref_transformer", schema="a:long").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_transformer_class(self):
+            class MyTransformer(Transformer):
+                def get_output_schema(self, df: DataFrame) -> Any:
+                    return df.schema + "n:long"
+
+                def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+                    rows = [r + [len(r)] for r in df.as_array()]
+                    return ArrayDataFrame(rows, self.output_schema)
+
+            dag = FugueWorkflow()
+            src = dag.df([[1, "a"]], "a:long,b:str")
+            src.transform(MyTransformer).assert_eq(
+                dag.df([[1, "a", 2]], "a:long,b:str,n:long")
+            )
+            dag.run(self.engine)
+
+        def test_transform_iterable_chunks(self):
+            def chunks(dfs: Iterable[pd.DataFrame]) -> Iterable[pd.DataFrame]:
+                for c in dfs:
+                    yield c
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [2]], "a:long")
+            src.transform(chunks, schema="*").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_transform_binary(self):
+            def roundtrip(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[b"\x01\x02"]], "a:bytes")
+            src.transform(roundtrip, schema="*").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_transform_ignore_errors(self):
+            def fail_on_2(df: pd.DataFrame) -> pd.DataFrame:
+                if df["a"].iloc[0] == 2:
+                    raise NotImplementedError("boom")
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [2]], "a:long")
+            src.partition_by("a").transform(
+                fail_on_2, schema="*", ignore_errors=[NotImplementedError]
+            ).assert_eq(dag.df([[1]], "a:long"))
+            dag.run(self.engine)
+
+            dag2 = FugueWorkflow()
+            src2 = dag2.df([[2]], "a:long")
+            src2.partition_by("a").transform(fail_on_2, schema="*").show()
+            with pytest.raises(NotImplementedError):
+                dag2.run(self.engine)
+
+        def test_out_transform(self):
+            counts: List[int] = []
+
+            def sink(df: pd.DataFrame) -> None:
+                counts.append(len(df))
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [1], [2]], "a:long")
+            src.partition_by("a").out_transform(sink)
+            dag.run(self.engine)
+            assert sorted(counts) == [1, 2]
+
+        # -- cotransform -----------------------------------------------------
+        def test_cotransform(self):
+            def merge(d1: pd.DataFrame, d2: pd.DataFrame) -> pd.DataFrame:
+                return pd.DataFrame(
+                    {"k": [d1["k"].iloc[0]], "n1": [len(d1)], "n2": [len(d2)]}
+                )
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, "a"], [1, "b"], [2, "c"]], "k:long,v:str")
+            b = dag.df([[1, 1.0]], "k:long,w:double")
+            dag.zip(a, b, partition={"by": ["k"]}).transform(
+                merge, schema="k:long,n1:long,n2:long"
+            ).assert_eq(dag.df([[1, 2, 1]], "k:long,n1:long,n2:long"))
+            dag.run(self.engine)
+
+        def test_cotransform_left(self):
+            def merge(d1: pd.DataFrame, d2: pd.DataFrame) -> pd.DataFrame:
+                return pd.DataFrame(
+                    {"k": [d1["k"].iloc[0]], "n1": [len(d1)], "n2": [len(d2)]}
+                )
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, "a"], [2, "c"]], "k:long,v:str")
+            b = dag.df([[1, 1.0]], "k:long,w:double")
+            dag.zip(a, b, how="left_outer", partition={"by": ["k"]}).transform(
+                merge, schema="k:long,n1:long,n2:long"
+            ).assert_eq(dag.df([[1, 1, 1], [2, 1, 0]], "k:long,n1:long,n2:long"))
+            dag.run(self.engine)
+
+        # -- workflow ops ----------------------------------------------------
+        def test_workflow_relational_ops(self):
+            dag = FugueWorkflow()
+            a = dag.df([[1, "a"], [2, "b"], [2, "b"]], "x:long,y:str")
+            a.distinct().assert_eq(dag.df([[1, "a"], [2, "b"]], "x:long,y:str"))
+            a.drop(["y"]).assert_eq(dag.df([[1], [2], [2]], "x:long"))
+            a.rename({"x": "xx"}).assert_eq(
+                dag.df([[1, "a"], [2, "b"], [2, "b"]], "xx:long,y:str")
+            )
+            a.alter_columns("x:double").assert_eq(
+                dag.df([[1.0, "a"], [2.0, "b"], [2.0, "b"]], "x:double,y:str")
+            )
+            a[["y"]].assert_eq(dag.df([["a"], ["b"], ["b"]], "y:str"))
+            b = dag.df([[2, "b"]], "x:long,y:str")
+            a.union(b, distinct=False).assert_eq(
+                dag.df(
+                    [[1, "a"], [2, "b"], [2, "b"], [2, "b"]], "x:long,y:str"
+                )
+            )
+            a.subtract(b).assert_eq(dag.df([[1, "a"]], "x:long,y:str"))
+            a.intersect(b).assert_eq(dag.df([[2, "b"]], "x:long,y:str"))
+            a.inner_join(dag.df([[1, 5.0]], "x:long,z:double")).assert_eq(
+                dag.df([[1, "a", 5.0]], "x:long,y:str,z:double")
+            )
+            a.take(1, presort="y desc").assert_eq(dag.df([[2, "b"]], "x:long,y:str"))
+            dag.run(self.engine)
+
+        def test_workflow_dropna_fillna_sample(self):
+            dag = FugueWorkflow()
+            a = dag.df([[1.0, "a"], [None, None]], "x:double,y:str")
+            a.dropna().assert_eq(dag.df([[1.0, "a"]], "x:double,y:str"))
+            a.fillna(0.0, subset=["x"]).assert_eq(
+                dag.df([[1.0, "a"], [0.0, None]], "x:double,y:str")
+            )
+            s = dag.df([[i] for i in range(50)], "x:long").sample(n=5, seed=0)
+            dag.run(self.engine)
+            assert s.result.count() == 5
+
+        # -- checkpoints & yields -------------------------------------------
+        def test_checkpoint_requires_conf(self):
+            dag = FugueWorkflow()
+            dag.df([[0]], "a:long").checkpoint()
+            with pytest.raises(FugueWorkflowError):
+                dag.run(self.engine)
+
+        def test_checkpoint(self):
+            self.engine.conf["fugue.workflow.checkpoint.path"] = os.path.join(
+                self.tmpdir, "ck"
+            )
+            dag = FugueWorkflow()
+            a = dag.df([[0]], "a:long").checkpoint()
+            dag.df([[0]], "a:long").assert_eq(a)
+            dag.run(self.engine)
+
+        def test_deterministic_checkpoint(self):
+            self.engine.conf["fugue.workflow.checkpoint.path"] = os.path.join(
+                self.tmpdir, "ck"
+            )
+            temp_file = os.path.join(self.tmpdir, "t.parquet")
+
+            def mock_create(dummy: int = 1) -> pd.DataFrame:
+                return pd.DataFrame(np.random.rand(3, 2), columns=["a", "b"])
+
+            # strong checkpoint: not cross-execution
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).strong_checkpoint()
+            a.save(temp_file)
+            dag.run(self.engine)
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).strong_checkpoint()
+            dag.load(temp_file).assert_not_eq(a)
+            dag.run(self.engine)
+
+            # deterministic checkpoint: cross-execution resume
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).deterministic_checkpoint()
+            id1 = a.spec_uuid()
+            a.save(temp_file)
+            dag.run(self.engine)
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).deterministic_checkpoint()
+            dag.load(temp_file).assert_eq(a)
+            dag.run(self.engine)
+            # checkpoint spec doesn't change determinism
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).deterministic_checkpoint(
+                partition=PartitionSpec(num=2)
+            )
+            id2 = a.spec_uuid()
+            dag.load(temp_file).assert_eq(a)
+            dag.run(self.engine)
+            # dependency change does
+            dag = FugueWorkflow()
+            a = dag.create(mock_create, params={"dummy": 2}).deterministic_checkpoint()
+            id3 = a.spec_uuid()
+            dag.load(temp_file).assert_not_eq(a)
+            dag.run(self.engine)
+            assert id1 == id2
+            assert id1 != id3
+
+        def test_yield_dataframe(self):
+            dag = FugueWorkflow()
+            dag.df([[1]], "a:long").yield_dataframe_as("x", as_local=True)
+            res = dag.run(self.engine)
+            assert res.yields["x"].result.as_array() == [[1]]
+
+        def test_yield_file(self):
+            self.engine.conf["fugue.workflow.checkpoint.path"] = os.path.join(
+                self.tmpdir, "ck"
+            )
+            dag = FugueWorkflow()
+            dag.df([[1]], "a:long").yield_file_as("x")
+            res = dag.run(self.engine)
+            dag2 = FugueWorkflow()
+            dag2.df(res.yields["x"]).assert_eq(dag2.df([[1]], "a:long"))
+            dag2.run(self.engine)
+
+        # -- validation ------------------------------------------------------
+        def test_partition_validation(self):
+            # partitionby_has: a
+            def need_a(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[1, 2]], "a:long,b:long")
+            src.partition_by("a").transform(need_a, schema="*")
+            with pytest.raises(FugueWorkflowCompileValidationError):
+                dag2 = FugueWorkflow()
+                src2 = dag2.df([[1, 2]], "a:long,b:long")
+                src2.partition_by("b").transform(need_a, schema="*")
+            dag.run(self.engine)
+
+        def test_input_validation(self):
+            # input_has: a
+            def need_col(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            dag = FugueWorkflow()
+            dag.df([[1]], "x:long").transform(need_col, schema="*")
+            with pytest.raises(Exception):
+                dag.run(self.engine)
+
+        # -- callbacks -------------------------------------------------------
+        def test_rpc_callback(self):
+            from fugue_tpu.rpc.base import RPCHandler
+
+            class Collector(RPCHandler):
+                def __init__(self):
+                    super().__init__()
+                    self.values: List[int] = []
+
+                def __call__(self, value: int) -> str:
+                    self.values.append(value)
+                    return "ok"
+
+            collector = Collector()
+
+            def report(df: pd.DataFrame, cb: callable) -> pd.DataFrame:
+                cb(int(df["a"].sum()))
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [2]], "a:long")
+            src.partition_by("a").transform(report, schema="*", callback=collector).show()
+            dag.run(self.engine)
+            assert sorted(collector.values) == [1, 2]
+
+        # -- io through workflow --------------------------------------------
+        def test_workflow_save_load(self):
+            path = os.path.join(self.tmpdir, "wf.parquet")
+            dag = FugueWorkflow()
+            dag.df([[1, "a"]], "a:long,b:str").save(path)
+            dag.run(self.engine)
+            dag2 = FugueWorkflow()
+            dag2.load(path).assert_eq(dag2.df([[1, "a"]], "a:long,b:str"))
+            dag2.run(self.engine)
+
+        # -- single-op api ---------------------------------------------------
+        def test_transform_api(self):
+            def f(df: pd.DataFrame) -> pd.DataFrame:
+                df["b"] = 1
+                return df
+
+            res = transform(
+                pd.DataFrame({"a": [1, 2]}),
+                f,
+                schema="*,b:long",
+                engine=self.engine,
+            )
+            assert res.values.tolist() == [[1, 1], [2, 1]]
+
+        def test_out_transform_api(self):
+            hits: List[int] = []
+
+            def f(df: pd.DataFrame) -> None:
+                hits.append(len(df))
+
+            out_transform(pd.DataFrame({"a": [1, 2]}), f, engine=self.engine)
+            assert hits == [2]
+
+
+def _string_ref_transformer(df: pd.DataFrame) -> pd.DataFrame:
+    return df

@@ -1,0 +1,164 @@
+"""DataFrame contract suite — run against every frame type.
+
+Modeled on the reference's ``fugue_test/dataframe_suite.py`` coverage: init,
+conversions, nulls, nested types, binary, datetimes, alter/rename/drop/head,
+and iteration semantics.
+"""
+
+from datetime import date, datetime
+from typing import Any
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataframe.utils import _df_eq
+from fugue_tpu.exceptions import (
+    FugueDataFrameOperationError,
+    FugueDatasetEmptyError,
+)
+
+
+class DataFrameTests:
+    """Subclass ``DataFrameTests.Tests`` and implement ``df()``."""
+
+    class Tests:
+        def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+            raise NotImplementedError
+
+        # -- init & basics ---------------------------------------------------
+        def test_init_basic(self):
+            df = self.df([[1, "a"], [2, "b"]], "x:long,y:str")
+            assert df.schema == "x:long,y:str"
+            assert [x.name for x in df.schema.fields] == ["x", "y"]
+            assert df.columns == ["x", "y"]
+            assert not df.empty
+            if df.is_bounded:
+                assert df.count() == 2
+
+        def test_peek(self):
+            df = self.df([[1, "a"]], "x:long,y:str")
+            assert df.peek_array() == [1, "a"]
+            assert df.peek_dict() == dict(x=1, y="a")
+            edf = self.df([], "x:long,y:str")
+            with pytest.raises(FugueDatasetEmptyError):
+                edf.peek_array()
+
+        def test_empty(self):
+            df = self.df([], "x:long")
+            assert df.empty
+            assert df.as_array() == []
+
+        def test_as_array(self):
+            # one-pass frames are single-consumption: rebuild per assertion
+            assert self.df([[1, "a"], [2, None]], "x:long,y:str").as_array() == [
+                [1, "a"], [2, None],
+            ]
+            assert self.df([[1, "a"], [2, None]], "x:long,y:str").as_array(
+                columns=["y", "x"]
+            ) == [["a", 1], [None, 2]]
+            assert list(
+                self.df([[1, "a"], [2, None]], "x:long,y:str").as_array_iterable()
+            ) == [[1, "a"], [2, None]]
+
+        def test_as_dicts(self):
+            assert self.df([[1, "a"]], "x:long,y:str").as_dicts() == [dict(x=1, y="a")]
+            assert list(self.df([[1, "a"]], "x:long,y:str").as_dict_iterable()) == [
+                dict(x=1, y="a")
+            ]
+
+        def test_nulls(self):
+            df = self.df([[None, None]], "x:double,y:str")
+            assert df.as_array(type_safe=True) == [[None, None]]
+
+        def test_bool_nulls(self):
+            df = self.df([[True], [None], [False]], "x:bool")
+            assert df.as_array(type_safe=True) == [[True], [None], [False]]
+
+        def test_binary(self):
+            df = self.df([[b"\x01\x02"]], "x:bytes")
+            assert df.as_array(type_safe=True) == [[b"\x01\x02"]]
+
+        def test_datetimes(self):
+            d = date(2020, 1, 2)
+            ts = datetime(2020, 1, 2, 3, 4, 5)
+            df = self.df([[d, ts]], "x:date,y:datetime")
+            row = df.as_array(type_safe=True)[0]
+            assert row[0] == d
+            assert row[1] == ts
+
+        def test_nested_types(self):
+            df = self.df([[[1, 2], dict(a=1)]], "x:[long],y:{a:long}")
+            row = df.as_array(type_safe=True)[0]
+            assert row[0] == [1, 2]
+            assert row[1] == dict(a=1)
+
+        def test_map_type(self):
+            df = self.df([[[("a", 1), ("b", 2)]]], "x:<str,long>")
+            row = df.as_array(type_safe=True)[0]
+            assert sorted(row[0]) == [("a", 1), ("b", 2)]
+
+        # -- conversions ----------------------------------------------------
+        def test_as_pandas(self):
+            df = self.df([[1, "a"], [2, "b"]], "x:long,y:str")
+            pdf = df.as_pandas()
+            assert isinstance(pdf, pd.DataFrame)
+            assert pdf.values.tolist() == [[1, "a"], [2, "b"]]
+
+        def test_as_arrow(self):
+            df = self.df([[1, "a"]], "x:long,y:str")
+            tbl = df.as_arrow()
+            assert tbl.num_rows == 1
+            assert tbl.column_names == ["x", "y"]
+
+        def test_as_local(self):
+            df = self.df([[1]], "x:long")
+            local = df.as_local()
+            assert local.is_local
+            assert _df_eq(local, [[1]], "x:long", throw=True)
+
+        # -- ops ------------------------------------------------------------
+        def test_rename(self):
+            df = self.df([[1, "a"]], "x:long,y:str")
+            r = df.rename({"x": "xx"})
+            assert r.schema == "xx:long,y:str"
+            assert r.as_array() == [[1, "a"]]
+            with pytest.raises(Exception):
+                df.rename({"not_exist": "z"})
+
+        def test_drop_select(self):
+            df = self.df([[1, "a", 2.0]], "x:long,y:str,z:double")
+            assert df.drop(["y"]).schema == "x:long,z:double"
+            assert df[["z", "x"]].schema == "z:double,x:long"
+            with pytest.raises(FugueDataFrameOperationError):
+                df.drop(["x", "y", "z"])
+            with pytest.raises(FugueDataFrameOperationError):
+                df.drop(["not_exist"])
+
+        def test_alter_columns(self):
+            df = self.df([[1, "2"]], "x:long,y:str")
+            r = df.alter_columns("x:double,y:int")
+            assert r.schema == "x:double,y:int"
+            assert r.as_array(type_safe=True) == [[1.0, 2]]
+            same = df.alter_columns("x:long")
+            assert same.schema == df.schema
+
+        def test_head(self):
+            df = self.df([[i] for i in range(5)], "x:long")
+            h = df.head(3)
+            assert h.is_local and h.is_bounded
+            assert h.count() == 3
+            h2 = self.df([[i, "a"] for i in range(5)], "x:long,y:str").head(
+                2, columns=["y"]
+            )
+            assert h2.schema == "y:str"
+
+        def test_show(self, capsys: Any = None):
+            df = self.df([[1, "a"]], "x:long,y:str")
+            df.show()
+
+        def test_alter_columns_invalid(self):
+            df = self.df([["a"]], "x:str")
+            with pytest.raises(Exception):
+                r = df.alter_columns("x:[long]")
+                r.as_array()

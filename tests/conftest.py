@@ -1,0 +1,10 @@
+import os
+
+# force JAX onto a virtual 8-device CPU mesh BEFORE any jax import, mirroring
+# how the reference tests distributed semantics on local sessions (SURVEY §4)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

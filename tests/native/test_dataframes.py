@@ -1,0 +1,39 @@
+"""Apply the DataFrame contract suite to every local frame type."""
+
+from typing import Any
+
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    IterableDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+)
+from fugue_tpu_test import DataFrameTests
+
+
+class TestArrayDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return ArrayDataFrame(data, schema)
+
+
+class TestArrowDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return ArrowDataFrame(data, schema)
+
+
+class TestPandasDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return PandasDataFrame(data, schema)
+
+
+class TestIterableDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return IterableDataFrame(data, schema)
+
+
+class TestLocalDataFrameIterableDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        inner = ArrayDataFrame(data, schema)
+        return LocalDataFrameIterableDataFrame(iter([inner]), inner.schema)

@@ -1,0 +1,14 @@
+"""Apply engine + workflow contract suites to NativeExecutionEngine."""
+
+from fugue_tpu.execution import ExecutionEngine, NativeExecutionEngine
+from fugue_tpu_test import BuiltInTests, ExecutionEngineTests
+
+
+class TestNativeExecutionEngine(ExecutionEngineTests.Tests):
+    def make_engine(self) -> ExecutionEngine:
+        return NativeExecutionEngine(dict(test=True))
+
+
+class TestNativeBuiltIn(BuiltInTests.Tests):
+    def make_engine(self) -> ExecutionEngine:
+        return NativeExecutionEngine(dict(test=True))
