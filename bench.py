@@ -1,0 +1,86 @@
+"""Benchmark: transform()-style groupby aggregation, TPU engine vs pandas oracle.
+
+BASELINE.md config #1/#3: the reference's flagship workload is
+``transform()`` groupby-apply. Baseline = the same workload through the
+NativeExecutionEngine (pandas sort+groupby-apply, i.e. what the reference's
+default engine does). Ours = the JaxExecutionEngine two-phase device
+aggregate (sort+segment reduction on device, O(groups) host merge).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", "2000000"))
+N_GROUPS = int(os.environ.get("BENCH_GROUPS", "1000"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+
+def main() -> None:
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.collections import PartitionSpec
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = np.random.default_rng(42)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, N_GROUPS, N_ROWS),
+            "v": rng.random(N_ROWS),
+        }
+    )
+    aggs = lambda: [  # noqa: E731
+        ff.sum(col("v")).alias("s"),
+        ff.count(col("v")).alias("n"),
+        ff.avg(col("v")).alias("m"),
+    ]
+    spec = PartitionSpec(by=["k"])
+
+    # ---- baseline: pandas oracle engine (reference-default behavior) ------
+    host = NativeExecutionEngine()
+    hdf = host.to_df(pdf)
+    host.aggregate(hdf, spec, aggs())  # warmup
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        host.aggregate(hdf, spec, aggs())
+    host_rps = N_ROWS * REPEATS / (time.perf_counter() - t0)
+
+    # ---- ours: device two-phase aggregate ---------------------------------
+    eng = JaxExecutionEngine()
+    jdf = eng.to_df(pdf)
+    eng.persist(jdf)
+    res = eng.aggregate(jdf, spec, aggs())  # warmup + compile
+    # correctness spot check
+    got = res.as_pandas().sort_values("k").reset_index(drop=True)
+    exp = (
+        pdf.groupby("k")
+        .agg(s=("v", "sum"), n=("v", "count"), m=("v", "mean"))
+        .reset_index()
+    )
+    assert np.allclose(got[["s", "m"]], exp[["s", "m"]]) and (
+        got["n"] == exp["n"]
+    ).all(), "device aggregate mismatch"
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        eng.aggregate(jdf, spec, aggs())
+    jax_rps = N_ROWS * REPEATS / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "groupby_aggregate_rows_per_sec",
+                "value": round(jax_rps, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(jax_rps / host_rps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,10 @@ def _apply_schema(tbl: pa.Table, schema: Schema) -> pa.Table:
 
 
 def _save_parquet(df: pa.Table, p: str, mode: str, kwargs: Dict[str, Any]) -> None:
+    if mode == "append" and os.path.exists(p):
+        raise NotImplementedError(
+            "append mode is not supported for single parquet files"
+        )
     pq.write_table(df, p, **kwargs)
 
 

@@ -1,0 +1,119 @@
+"""jax.numpy evaluator for the column-expression IR.
+
+The device twin of ``eval.py``: the same ``ColumnExpr`` tree compiles to XLA
+over a dict of (sharded) jax arrays — projections/assignments on the TPU
+engine run fully on device, with XLA propagating shardings through the
+elementwise graph (no collectives needed for row-wise exprs).
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..exceptions import FugueInvalidOperation
+from .expressions import (
+    ColumnExpr,
+    _BinaryOpExpr,
+    _FuncExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _UnaryOpExpr,
+)
+
+
+def pa_type_to_np_dtype(tp: pa.DataType) -> Any:
+    if pa.types.is_boolean(tp):
+        return np.bool_
+    return tp.to_pandas_dtype()
+
+
+def evaluate_jnp(cols: Dict[str, Any], expr: ColumnExpr) -> Any:
+    """Evaluate a non-aggregate expression over jnp arrays (traceable)."""
+    import jax.numpy as jnp
+
+    res = _eval(cols, expr)
+    if expr.as_type is not None:
+        res = jnp.asarray(res).astype(pa_type_to_np_dtype(expr.as_type))
+    return res
+
+
+def _eval(cols: Dict[str, Any], expr: ColumnExpr) -> Any:
+    import jax.numpy as jnp
+
+    if isinstance(expr, _NamedColumnExpr):
+        if expr.name not in cols:
+            raise FugueInvalidOperation(f"column {expr.name} is not on device")
+        return cols[expr.name]
+    if isinstance(expr, _LitColumnExpr):
+        return expr.value
+    if isinstance(expr, _UnaryOpExpr):
+        v = evaluate_jnp(cols, expr.col)
+        if expr.op == "IS_NULL":
+            return jnp.isnan(v) if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else jnp.zeros_like(v, dtype=bool)
+        if expr.op == "NOT_NULL":
+            return ~jnp.isnan(v) if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else jnp.ones_like(v, dtype=bool)
+        if expr.op == "~":
+            return jnp.logical_not(v)
+        if expr.op == "-":
+            return -v
+        raise NotImplementedError(expr.op)
+    if isinstance(expr, _BinaryOpExpr):
+        l = evaluate_jnp(cols, expr.left)
+        r = evaluate_jnp(cols, expr.right)
+        op = expr.op
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        if op == "==":
+            return l == r
+        if op == "!=":
+            return l != r
+        if op == "&":
+            return jnp.logical_and(l, r)
+        if op == "|":
+            return jnp.logical_or(l, r)
+        raise NotImplementedError(op)
+    if isinstance(expr, _FuncExpr) and not expr.is_agg:
+        if expr.func.upper() == "COALESCE":
+            args = [evaluate_jnp(cols, a) for a in expr.args]
+            res = args[0]
+            for a in args[1:]:
+                res = jnp.where(jnp.isnan(res), a, res)
+            return res
+        raise NotImplementedError(f"function {expr.func} not supported on device")
+    raise NotImplementedError(f"can't evaluate {type(expr)} on device")
+
+
+def can_evaluate_on_device(
+    expr: ColumnExpr, device_cols: Any, check_agg: bool = True
+) -> bool:
+    """Whether the expression only references device columns and device ops."""
+    from .functions import is_agg
+
+    if check_agg and is_agg(expr):
+        return False
+    if isinstance(expr, _NamedColumnExpr):
+        return expr.name in device_cols and not expr.wildcard
+    if isinstance(expr, _LitColumnExpr):
+        # None (null) has no device representation yet -> host fallback
+        return expr.value is not None and isinstance(expr.value, (int, float, bool))
+    if isinstance(expr, _FuncExpr):
+        if expr.is_agg or expr.func.upper() != "COALESCE":
+            return False
+    return all(
+        can_evaluate_on_device(c, device_cols, check_agg=False) for c in expr.children
+    )

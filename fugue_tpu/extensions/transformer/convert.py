@@ -209,7 +209,7 @@ class _FuncAsTransformer(Transformer):
         validation_rules.update(parse_validation_rules_from_comment(func))
         tr = _FuncAsTransformer()
         tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
-            func, "^[lspq][fF]?x*z?$", "^[lspqr]$"
+            func, "^[lspqj][fF]?x*z?$", "^[lspqjr]$"
         )
         tr._output_schema_arg = schema  # type: ignore
         tr._validation_rules = validation_rules  # type: ignore
@@ -253,7 +253,7 @@ class _FuncAsOutputTransformer(_FuncAsTransformer, OutputTransformer):
         validation_rules.update(parse_validation_rules_from_comment(func))
         tr = _FuncAsOutputTransformer()
         tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
-            func, "^[lspq][fF]?x*z?$", "^[lspnqr]$"
+            func, "^[lspqj][fF]?x*z?$", "^[lspnqjr]$"
         )
         tr._output_schema_arg = None  # type: ignore
         tr._validation_rules = validation_rules  # type: ignore
@@ -316,7 +316,7 @@ class _FuncAsCoTransformer(CoTransformer):
             schema = str(schema)
         tr = _FuncAsCoTransformer()
         tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
-            func, "^(c|[lspq]+)[fF]?x*z?$", "^[lspqr]$"
+            func, "^(c|[lspqj]+)[fF]?x*z?$", "^[lspqjr]$"
         )
         tr._dfs_input = tr._wrapper.input_code.startswith("c")  # type: ignore
         tr._output_schema_arg = schema  # type: ignore
@@ -355,7 +355,7 @@ class _FuncAsOutputCoTransformer(_FuncAsCoTransformer, OutputCoTransformer):
         )
         tr = _FuncAsOutputCoTransformer()
         tr._wrapper = DataFrameFunctionWrapper(  # type: ignore
-            func, "^(c|[lspq]+)[fF]?x*z?$", "^[lspnqr]$"
+            func, "^(c|[lspqj]+)[fF]?x*z?$", "^[lspnqjr]$"
         )
         tr._dfs_input = tr._wrapper.input_code.startswith("c")  # type: ignore
         tr._output_schema_arg = None  # type: ignore

@@ -1,0 +1,11 @@
+# dataframe semantics need 64-bit ints/floats; jax defaults to x32
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .dataframe import JaxDataFrame
+from .execution_engine import JaxExecutionEngine, JaxMapEngine
+from . import params  # registers the Dict[str, jax.Array] annotation
+from . import registry  # registers engine names + inference
+
+__all__ = ["JaxDataFrame", "JaxExecutionEngine", "JaxMapEngine"]

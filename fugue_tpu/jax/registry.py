@@ -1,0 +1,35 @@
+"""Register the jax engine with the plugin system.
+
+Parity with backend registries in the reference (e.g.
+``fugue_spark/registry.py:63-80``): engine available by name ("jax", "tpu"),
+inferred from JaxDataFrame inputs, frames convertible via ``as_fugue_df``.
+"""
+
+from typing import Any, List
+
+from .._utils.registry import run_at_def
+from ..dataframe.api import as_fugue_df, get_native_as_df
+from ..dataset.dataset import get_dataset_display
+from ..execution.factory import (
+    infer_execution_engine,
+    register_execution_engine,
+)
+from .dataframe import JaxDataFrame
+from .execution_engine import JaxExecutionEngine
+
+
+@infer_execution_engine.candidate(
+    lambda objs: any(isinstance(o, JaxDataFrame) for o in objs)
+)
+def _infer_jax_engine(objs: List[Any]) -> Any:
+    return "jax"
+
+
+@run_at_def
+def _register() -> None:
+    register_execution_engine(
+        "jax", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
+    )
+    register_execution_engine(
+        "tpu", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
+    )

@@ -1,0 +1,239 @@
+"""Device-side segment (groupby) aggregation kernels.
+
+The TPU-native replacement for the reference's backend-SQL groupby
+(SURVEY §7.8): a two-phase aggregate —
+
+1. **Device phase (the O(rows) work)**: inside ``shard_map`` each shard
+   lexicographically sorts its rows by the key columns (``lax.sort`` with
+   ``num_keys``), derives segment ids, reduces values with
+   ``jax.ops.segment_*`` and packs group representatives to the front.
+   Everything is static-shape; the data-dependent group count is carried as
+   a per-shard scalar (SURVEY §7 hard parts: "mask, don't branch").
+2. **Host phase (the O(groups) work)**: only the first ``max_groups`` rows
+   per shard cross the wire (bounded transfer); partials merge by
+   re-aggregation on host.
+
+Compiled executables are cached per (mesh, key-count, agg signature) — jit
+re-tracing happens only on dtype/shape changes.
+
+Supported aggregations: sum, count, min, max (avg = sum+count at merge).
+"""
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_COMPILE_CACHE: Dict[Any, Any] = {}
+
+
+def _shard_kernel(num_keys: int, agg_specs: Sequence[Tuple[str, str]]):
+    """Per-shard kernel: (keys..., values..., valid) →
+    (nseg(1,), packed_keys...(n,), aggs...(n,)).
+
+    ``aggs[i][j]`` is the reduction of segment j; ``packed_keys[i][j]`` its
+    key — both valid for j < nseg.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_aggs = len(agg_specs)
+
+    def kernel(*args: Any):
+        keys = args[:num_keys]
+        values = args[num_keys : num_keys + n_aggs]
+        valid = args[num_keys + n_aggs]
+        n = keys[0].shape[0]
+        # sort invalid (padding) rows to the end, then lexicographic by keys;
+        # sort a row-index payload instead of f64 values (narrow comparator)
+        iota = lax.iota(jnp.int32, n)
+        sorted_ops = lax.sort(
+            (jnp.logical_not(valid),) + tuple(keys) + (iota,),
+            num_keys=1 + num_keys,
+        )
+        s_keys = sorted_ops[1 : 1 + num_keys]
+        perm = sorted_ops[-1]
+        s_valid = valid[perm]
+        s_values = [v[perm] for v in values]
+        change = jnp.zeros(n, dtype=bool).at[0].set(True)
+        for k in s_keys:
+            change = change | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+            )
+        change = change & s_valid
+        nseg = change.sum(dtype=jnp.int32)
+        seg_id = jnp.cumsum(change.astype(jnp.int32)) - 1
+        seg_id = jnp.where(s_valid, seg_id, n - 1)
+        outs = []
+        for (_, agg), v in zip(agg_specs, s_values):
+            if agg == "sum":
+                vv = jnp.where(s_valid, v, jnp.zeros_like(v))
+                outs.append(jax.ops.segment_sum(vv, seg_id, num_segments=n))
+            elif agg == "count":
+                outs.append(
+                    jax.ops.segment_sum(
+                        s_valid.astype(jnp.int64), seg_id, num_segments=n
+                    )
+                )
+            elif agg == "min":
+                big = jnp.where(s_valid, v, jnp.full_like(v, _max_of(jnp, v.dtype)))
+                outs.append(jax.ops.segment_min(big, seg_id, num_segments=n))
+            elif agg == "max":
+                small = jnp.where(s_valid, v, jnp.full_like(v, _min_of(jnp, v.dtype)))
+                outs.append(jax.ops.segment_max(small, seg_id, num_segments=n))
+            else:  # pragma: no cover
+                raise NotImplementedError(agg)
+        # pack each segment's representative key to the front: stable argsort
+        # on ~change puts segment-start rows first, in order
+        starts = jnp.argsort(jnp.logical_not(change), stable=True)
+        packed_keys = tuple(k[starts] for k in s_keys)
+        return (nseg[None],) + packed_keys + tuple(outs)
+
+    return kernel
+
+
+def _max_of(jnp: Any, dt: Any) -> Any:
+    return jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).max
+
+
+def _min_of(jnp: Any, dt: Any) -> Any:
+    return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
+
+
+def _get_compiled_kernel(mesh: Any, num_keys: int, agg_sig: Tuple[Tuple[str, str], ...]):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS
+
+    cache_key = ("kernel", mesh, num_keys, agg_sig)
+    if cache_key not in _COMPILE_CACHE:
+        kernel = _shard_kernel(num_keys, agg_sig)
+        n_in = num_keys + len(agg_sig) + 1
+        n_out = 1 + num_keys + len(agg_sig)
+        spec = P(ROW_AXIS)
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=tuple(spec for _ in range(n_in)),
+                out_specs=tuple(spec for _ in range(n_out)),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_slicer(mesh: Any, n_arrays: int, k: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS
+
+    cache_key = ("slice", mesh, n_arrays, k)
+    if cache_key not in _COMPILE_CACHE:
+        spec = P(ROW_AXIS)
+
+        def take_k(*arrs: Any):
+            return tuple(a[:k] for a in arrs)
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                take_k,
+                mesh=mesh,
+                in_specs=tuple(spec for _ in range(n_arrays)),
+                out_specs=tuple(spec for _ in range(n_arrays)),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_mask(mesh: Any):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS
+
+    cache_key = ("mask", mesh)
+    if cache_key not in _COMPILE_CACHE:
+
+        def mask(template: Any, row_count: Any):
+            def shard_fn(t: Any, rc: Any):
+                n_local = t.shape[0]
+                base = jax.lax.axis_index(ROW_AXIS).astype(jnp.int64) * n_local
+                return base + jax.lax.iota(jnp.int64, n_local) < rc
+
+            return jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS), P()),
+                out_specs=P(ROW_AXIS),
+            )(template, row_count)
+
+        _COMPILE_CACHE[cache_key] = jax.jit(mask)
+    return _COMPILE_CACHE[cache_key]
+
+
+def device_groupby_partials(
+    mesh: Any,
+    key_cols: Dict[str, Any],
+    agg_cols: List[Tuple[str, str, Any]],
+    row_count: int,
+) -> "Any":
+    """Run the device phase; return a host pandas frame of per-shard-group
+    partials. Only ``O(shards * max_groups_per_shard)`` rows are transferred.
+    """
+    import jax
+    import numpy as np_
+    import pandas as pd
+
+    from ..parallel.mesh import ROW_AXIS
+
+    key_names = list(key_cols.keys())
+    agg_sig = tuple((name, agg) for name, agg, _ in agg_cols)
+    compiled = _get_compiled_kernel(mesh, len(key_names), agg_sig)
+    template = next(iter(key_cols.values()))
+    valid = _get_compiled_mask(mesh)(template, np_.int64(row_count))
+    in_args = (
+        tuple(key_cols.values()) + tuple(arr for _, _, arr in agg_cols) + (valid,)
+    )
+    outs = compiled(*in_args)
+    nsegs = np_.asarray(jax.device_get(outs[0]))  # (shards,) tiny transfer
+    shards = mesh.shape[ROW_AXIS]
+    k_max = int(nsegs.max()) if len(nsegs) > 0 else 0
+    if k_max == 0:
+        return pd.DataFrame({n: [] for n in key_names + [n for n, _ in agg_sig]})
+    # round up to limit distinct compiled slicers
+    k = 1 << (k_max - 1).bit_length()
+    local_n = outs[1].shape[0] // shards
+    k = min(k, local_n)
+    sliced = _get_compiled_slicer(mesh, len(outs) - 1, k)(*outs[1:])
+    host = [np_.asarray(jax.device_get(a)).reshape(shards, k) for a in sliced]
+    # keep only the first nsegs[s] rows of each shard block
+    take = np_.arange(k)[None, :] < nsegs[:, None]
+    srow, idx = np_.nonzero(take)
+    data = {}
+    for name, arr in zip(key_names, host[: len(key_names)]):
+        data[name] = arr[srow, idx]
+    for (name, _), arr in zip(agg_sig, host[len(key_names) :]):
+        data[name] = arr[srow, idx]
+    return pd.DataFrame(data)
+
+
+def merge_partials(
+    partials: "Any", key_names: List[str], agg_specs: List[Tuple[str, str]]
+) -> "Any":
+    """Host phase: combine per-shard partials into final aggregates."""
+    agg_map = {}
+    for name, agg in agg_specs:
+        if agg in ("sum", "count"):
+            agg_map[name] = "sum"
+        elif agg in ("min", "max"):
+            agg_map[name] = agg
+        else:  # pragma: no cover
+            raise NotImplementedError(agg)
+    return (
+        partials.groupby(key_names, dropna=False, sort=False)
+        .agg(agg_map)
+        .reset_index()
+    )

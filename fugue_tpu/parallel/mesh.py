@@ -1,0 +1,60 @@
+"""Device-mesh management for the TPU engine.
+
+The engine's distribution model (SURVEY §5.8): one ``jax.sharding.Mesh``
+whose first axis ("rows") shards dataframe rows (data parallel over
+partitions — the reference's only parallelism, §2.14); additional axes are
+available to compiled UDFs for model-style sharding. Multi-host: the mesh is
+built over ALL processes' devices (``jax.devices()``), so collectives ride
+ICI within a slice and DCN across slices.
+"""
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ROW_AXIS = "rows"
+
+
+def build_mesh(
+    mesh_shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    devices: Optional[List[Any]] = None,
+):
+    """Build a Mesh; default is 1-D over all devices with axis "rows"."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = devices if devices is not None else jax.devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devs),)
+    if axis_names is None:
+        axis_names = (ROW_AXIS,) + tuple(f"ax{i}" for i in range(1, len(mesh_shape)))
+    n = int(np.prod(mesh_shape))
+    if n != len(devs):
+        devs = devs[:n]
+    arr = np.array(devs).reshape(tuple(mesh_shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def row_sharding(mesh: Any):
+    """NamedSharding placing axis 0 on the mesh row axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(ROW_AXIS))
+
+
+def replicated_sharding(mesh: Any):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def num_row_shards(mesh: Any) -> int:
+    return mesh.shape[ROW_AXIS]
+
+
+def pad_rows(n: int, shards: int) -> int:
+    """Rows after padding to a multiple of the shard count."""
+    if shards <= 1:
+        return n
+    return ((n + shards - 1) // shards) * shards

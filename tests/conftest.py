@@ -1,10 +1,15 @@
 import os
 
-# force JAX onto a virtual 8-device CPU mesh BEFORE any jax import, mirroring
-# how the reference tests distributed semantics on local sessions (SURVEY §4)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# virtual 8-device CPU mesh BEFORE any jax computation, mirroring how the
+# reference tests distributed semantics on local sessions (SURVEY §4).
+# NOTE: the axon TPU plugin overrides JAX_PLATFORMS env, so the config update
+# after import is the authoritative switch.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
