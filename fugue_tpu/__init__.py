@@ -71,4 +71,5 @@ from .workflow import (
     raw_sql,
     transform,
 )
+from .sql import FugueSQLWorkflow, fugue_sql, fugue_sql_flow, fsql
 from . import api  # noqa: F401

@@ -1,0 +1,50 @@
+"""Exception-traceback surgery: point user errors at user code.
+
+Parity with the reference (`fugue/_utils/exception.py` + conf keys
+``fugue.workflow.exception.{hide,inject,optimize}``): frames from framework
+modules are pruned from the traceback so the first visible frames are the
+user's own code.
+"""
+
+import sys
+from types import TracebackType
+from typing import Any, List, Optional
+
+from ..constants import (
+    FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE,
+)
+
+
+def modify_traceback(
+    exc: BaseException, conf: Any
+) -> BaseException:
+    """Prune framework/internal frames from ``exc.__traceback__``."""
+    try:
+        if not conf.get(FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE, True):
+            return exc
+        prefixes = [
+            p.strip()
+            for p in str(conf.get(FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE, "")).split(",")
+            if p.strip() != ""
+        ]
+        if len(prefixes) == 0:
+            return exc
+        tb = exc.__traceback__
+        frames: List[TracebackType] = []
+        while tb is not None:
+            mod = tb.tb_frame.f_globals.get("__name__", "")
+            if not any(mod == p.rstrip(".") or mod.startswith(p) for p in prefixes):
+                frames.append(tb)
+            tb = tb.tb_next
+        if len(frames) == 0:
+            return exc
+        # rebuild the chain from kept frames
+        new_tb: Optional[TracebackType] = None
+        for f in reversed(frames):
+            new_tb = TracebackType(
+                new_tb, f.tb_frame, f.tb_lasti, f.tb_lineno
+            )
+        return exc.with_traceback(new_tb)
+    except Exception:  # pragma: no cover - never mask the original error
+        return exc

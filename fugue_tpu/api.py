@@ -78,3 +78,4 @@ from .execution.api import (  # noqa: F401
     union,
 )
 from .workflow.api import out_transform, raw_sql, transform  # noqa: F401
+from .sql import fugue_sql, fugue_sql_flow, fsql  # noqa: F401

@@ -18,11 +18,38 @@ from ..schema import Schema
 from .expressions import (
     ColumnExpr,
     _BinaryOpExpr,
+    _CaseWhenExpr,
     _FuncExpr,
+    _InExpr,
+    _LikeExpr,
     _LitColumnExpr,
     _NamedColumnExpr,
     _UnaryOpExpr,
 )
+
+# scalar SQL functions on pandas series
+_SCALAR_FUNCS = {
+    "ABS": lambda s: s.abs(),
+    "UPPER": lambda s: s.str.upper(),
+    "LOWER": lambda s: s.str.lower(),
+    "LENGTH": lambda s: s.str.len().astype("int64"),
+    "TRIM": lambda s: s.str.strip(),
+    "FLOOR": lambda s: np.floor(s),
+    "CEIL": lambda s: np.ceil(s),
+    "CEILING": lambda s: np.ceil(s),
+    "ROUND": lambda s, *a: s.round(int(a[0]) if a else 0),
+    "SQRT": lambda s: np.sqrt(s),
+    "EXP": lambda s: np.exp(s),
+    "LN": lambda s: np.log(s),
+    "LOG": lambda s: np.log(s),
+    "SUBSTRING": lambda s, start, length=None: s.str.slice(
+        int(start) - 1, int(start) - 1 + int(length) if length is not None else None
+    ),
+    "SUBSTR": lambda s, start, length=None: s.str.slice(
+        int(start) - 1, int(start) - 1 + int(length) if length is not None else None
+    ),
+    "CONCAT": None,  # special-cased (multi-arg)
+}
 from .sql import SelectColumns
 
 
@@ -88,7 +115,57 @@ def _eval(pdf: pd.DataFrame, expr: ColumnExpr) -> Any:
         if op == "|":
             return _as_bool(l) | _as_bool(r)
         raise NotImplementedError(f"binary op {op}")
+    if isinstance(expr, _CaseWhenExpr):
+        # positional (numpy) evaluation: input frames from groupby carry
+        # non-default indexes, so label alignment would silently misalign
+        n = len(pdf)
+        result = np.empty(n, dtype=object)
+        decided = np.zeros(n, dtype=bool)
+        for c, v in expr.cases:
+            cond = _as_bool(evaluate(pdf, c))
+            cond_np = (
+                cond.to_numpy() if isinstance(cond, pd.Series) else np.full(n, bool(cond))
+            )
+            val = evaluate(pdf, v)
+            val_np = val.to_numpy() if isinstance(val, pd.Series) else None
+            pick = cond_np & ~decided
+            result[pick] = val_np[pick] if val_np is not None else val
+            decided |= cond_np
+        dval = evaluate(pdf, expr.default)
+        dval_np = dval.to_numpy() if isinstance(dval, pd.Series) else None
+        result[~decided] = dval_np[~decided] if dval_np is not None else dval
+        return pd.Series(result, index=pdf.index).infer_objects()
+    if isinstance(expr, _InExpr):
+        v = evaluate(pdf, expr.col)
+        if not isinstance(v, pd.Series):
+            v = pd.Series([v] * len(pdf))
+        res = v.isin(expr.values)
+        # SQL three-valued logic: NULL never satisfies IN or NOT IN
+        return res if expr.positive else (~res & v.notna())
+    if isinstance(expr, _LikeExpr):
+        import re as _re
+
+        v = evaluate(pdf, expr.col)
+        if not isinstance(v, pd.Series):
+            v = pd.Series([v] * len(pdf))
+        pat = _re.escape(expr.pattern).replace("%", ".*").replace("_", ".")
+        res = v.str.match(f"^{pat}$", na=False)
+        return res if expr.positive else (~res & v.notna())
     if isinstance(expr, _FuncExpr) and not expr.is_agg:
+        fname = expr.func.upper()
+        if fname == "CONCAT":
+            args = [evaluate(pdf, a) for a in expr.args]
+            res = None
+            for a in args:
+                part = a.astype(str) if isinstance(a, pd.Series) else str(a)
+                res = part if res is None else res + part
+            return res
+        if fname in _SCALAR_FUNCS and _SCALAR_FUNCS[fname] is not None:
+            args = [evaluate(pdf, a) for a in expr.args]
+            first = args[0]
+            if not isinstance(first, pd.Series):
+                first = pd.Series([first] * len(pdf))
+            return _SCALAR_FUNCS[fname](first, *args[1:])
         if expr.func.upper() == "COALESCE":
             args = [evaluate(pdf, a) for a in expr.args]
             res = None
@@ -123,13 +200,17 @@ def eval_agg(pdf: pd.DataFrame, expr: _FuncExpr) -> Any:
     if func == "COUNT":
         return int(v.notna().sum()) if expr.is_distinct else int(v.notna().sum())
     if func == "MIN":
-        return v.min()
+        nn = v.dropna()
+        return None if len(nn) == 0 else nn.min()
     if func == "MAX":
-        return v.max()
+        nn = v.dropna()
+        return None if len(nn) == 0 else nn.max()
     if func == "SUM":
-        return v.sum()
+        nn = v.dropna()
+        return None if len(nn) == 0 else nn.sum()
     if func == "AVG":
-        return v.mean()
+        nn = v.dropna()
+        return None if len(nn) == 0 else nn.mean()
     if func == "FIRST":
         nn = v.dropna()
         return nn.iloc[0] if len(nn) > 0 else None

@@ -384,3 +384,117 @@ def all_cols() -> ColumnExpr:
 
 def function(name: str, *args: Any, arg_distinct: bool = False, **kwargs: Any) -> ColumnExpr:
     return _FuncExpr(name, *args, arg_distinct=arg_distinct)
+
+
+class _CaseWhenExpr(ColumnExpr):
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE d END."""
+
+    def __init__(self, cases: List[Any], default: Any = None):
+        super().__init__()
+        self._cases = [(_to_col(c), _to_col(v)) for c, v in cases]
+        self._default = _to_col(default) if default is not None else lit(None)
+
+    @property
+    def cases(self) -> List[Any]:
+        return self._cases
+
+    @property
+    def default(self) -> ColumnExpr:
+        return self._default
+
+    @property
+    def children(self) -> List[ColumnExpr]:
+        res: List[ColumnExpr] = []
+        for c, v in self._cases:
+            res.extend([c, v])
+        res.append(self._default)
+        return res
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self.as_type is not None:
+            return self.as_type
+        return self._cases[0][1].infer_type(schema)
+
+    def __repr__(self) -> str:
+        inner = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self._cases)
+        return f"CASE {inner} ELSE {self._default!r} END"
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["case_when"]
+
+
+class _InExpr(ColumnExpr):
+    """expr IN (literals...) (optionally negated)."""
+
+    def __init__(self, expr: Any, values: List[Any], positive: bool = True):
+        super().__init__()
+        self._expr = _to_col(expr)
+        self._values = list(values)
+        self._positive = positive
+
+    @property
+    def col(self) -> ColumnExpr:
+        return self._expr
+
+    @property
+    def values(self) -> List[Any]:
+        return self._values
+
+    @property
+    def positive(self) -> bool:
+        return self._positive
+
+    @property
+    def children(self) -> List[ColumnExpr]:
+        return [self._expr]
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        return self.as_type if self.as_type is not None else pa.bool_()
+
+    def __repr__(self) -> str:
+        op = "IN" if self._positive else "NOT IN"
+        return f"({self._expr!r} {op} {tuple(self._values)})"
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["in", self._positive, repr(self._values)]
+
+
+class _LikeExpr(ColumnExpr):
+    """expr LIKE pattern (SQL % and _ wildcards), optionally negated."""
+
+    def __init__(self, expr: Any, pattern: str, positive: bool = True):
+        super().__init__()
+        self._expr = _to_col(expr)
+        self._pattern = pattern
+        self._positive = positive
+
+    @property
+    def col(self) -> ColumnExpr:
+        return self._expr
+
+    @property
+    def pattern(self) -> str:
+        return self._pattern
+
+    @property
+    def positive(self) -> bool:
+        return self._positive
+
+    @property
+    def children(self) -> List[ColumnExpr]:
+        return [self._expr]
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        return self.as_type if self.as_type is not None else pa.bool_()
+
+    def __repr__(self) -> str:
+        op = "LIKE" if self._positive else "NOT LIKE"
+        return f"({self._expr!r} {op} {self._pattern!r})"
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["like", self._positive, self._pattern]
+
+
+def case_when(*cases: Any, default: Any = None) -> ColumnExpr:
+    """Build CASE WHEN from (condition, value) pairs."""
+    return _CaseWhenExpr(list(cases), default=default)

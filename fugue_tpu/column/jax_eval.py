@@ -114,6 +114,9 @@ def can_evaluate_on_device(
     if isinstance(expr, _FuncExpr):
         if expr.is_agg or expr.func.upper() != "COALESCE":
             return False
+    elif not isinstance(expr, (_NamedColumnExpr, _LitColumnExpr, _BinaryOpExpr, _UnaryOpExpr)):
+        # unknown node types (CASE/IN/LIKE/...) have no jnp lowering yet
+        return False
     return all(
         can_evaluate_on_device(c, device_cols, check_agg=False) for c in expr.children
     )

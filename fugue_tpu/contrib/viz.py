@@ -1,0 +1,38 @@
+"""``viz:*`` namespaced outputters — plot dataframes from workflows.
+
+Parity with the reference (`fugue_contrib/viz/__init__.py:12-14`): strings
+like ``"viz:bar"`` parse as outputters that call pandas ``.plot``. Gated on
+matplotlib availability (not present in every environment).
+"""
+
+from typing import Any
+
+from ..dataframe import DataFrames
+from ..extensions.outputter.convert import parse_outputter
+from ..extensions.outputter.outputter import Outputter
+from ..plugins import namespace_candidate
+
+_PLOT_KINDS = {
+    "line", "bar", "barh", "hist", "box", "kde", "density", "area",
+    "pie", "scatter", "hexbin",
+}
+
+
+class _VizOutputter(Outputter):
+    def __init__(self, kind: str):
+        self._kind = kind
+
+    def process(self, dfs: DataFrames) -> None:
+        try:
+            import matplotlib  # noqa: F401
+        except ImportError as e:
+            raise NotImplementedError(
+                "viz:* outputters require matplotlib"
+            ) from e
+        for df in dfs.values():
+            df.as_pandas().plot(kind=self._kind, **dict(self.params))
+
+
+@parse_outputter.candidate(namespace_candidate("viz", lambda x: x in _PLOT_KINDS))
+def _parse_viz(obj: str) -> Outputter:
+    return _VizOutputter(obj.split(":", 1)[1])

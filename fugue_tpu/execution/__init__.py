@@ -33,6 +33,17 @@ class ExecutionEngineParam(AnnotatedParam):
 register_execution_engine("native", lambda conf, **kwargs: NativeExecutionEngine(conf))
 register_execution_engine("pandas", lambda conf, **kwargs: NativeExecutionEngine(conf))
 
+
+def _lazy_jax_engine(conf: object, **kwargs: object) -> "ExecutionEngine":
+    from ..jax import JaxExecutionEngine  # registers the full backend
+
+    return JaxExecutionEngine(conf, **kwargs)
+
+
+# lazy: importing fugue_tpu.jax pulls in jax itself, so defer to first use
+register_execution_engine("jax", _lazy_jax_engine)
+register_execution_engine("tpu", _lazy_jax_engine)
+
 __all__ = [
     "EngineFacet",
     "ExecutionEngine",

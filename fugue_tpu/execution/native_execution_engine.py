@@ -295,7 +295,7 @@ class NativeExecutionEngine(ExecutionEngine):
         )
         if isinstance(value, dict):
             assert_or_throw(
-                (None not in value.values()) and (any(value.values())),
+                all(v is not None for v in value.values()) and len(value) > 0,
                 FugueInvalidOperation("fillna dict can't contain None values"),
             )
             mapping = value

@@ -125,17 +125,21 @@ class JaxMapEngine(MapEngine):
         mesh = df.mesh
         template = next(iter(cols.values()))
         cols["__valid__"] = _get_compiled_mask(mesh)(template, np_.int64(df.count()))
-        mapped = jax.jit(
-            jax.shard_map(
-                fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS)
+        cache = self.execution_engine._jit_cache  # type: ignore
+        key = ("map", fn, mesh)
+        if key not in cache:
+            cache[key] = jax.jit(
+                jax.shard_map(
+                    fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS)
+                )
             )
-        )
+        mapped = cache[key]
         out = mapped(cols)
-        out = {k: v for k, v in out.items() if k != "__valid__"}
         assert_or_throw(
             isinstance(out, dict),
             FugueInvalidOperation("compiled transformer must return Dict[str, jax.Array]"),
         )
+        out = {k: v for k, v in out.items() if k != "__valid__"}
         first = next(iter(out.values()))
         return JaxDataFrame(
             mesh=mesh,
@@ -160,6 +164,7 @@ class JaxExecutionEngine(ExecutionEngine):
             mesh = build_mesh(shape if shape is None else tuple(shape))
         self._mesh = mesh
         self._host_engine = NativeExecutionEngine(conf)
+        self._jit_cache: dict = {}
 
     @property
     def mesh(self) -> Any:
@@ -315,6 +320,7 @@ class JaxExecutionEngine(ExecutionEngine):
             and having is None
             and not sc.has_agg
             and not sc.is_distinct
+            and len(jdf.device_cols) > 0
             and all(can_evaluate_on_device(c, jdf.device_cols) for c in sc.all_cols)
         ):
             return self._device_project(jdf, sc)
@@ -340,7 +346,10 @@ class JaxExecutionEngine(ExecutionEngine):
                 out[c.output_name] = v
             return out
 
-        out_cols = jax.jit(compute)(dict(jdf.device_cols))
+        cache_key = ("project", tuple(c.__uuid__() for c in exprs), jdf.mesh)
+        if cache_key not in self._jit_cache:
+            self._jit_cache[cache_key] = jax.jit(compute)
+        out_cols = self._jit_cache[cache_key](dict(jdf.device_cols))
         if schema is None:
             fields = []
             for c in exprs:

@@ -7,13 +7,7 @@ inferred from JaxDataFrame inputs, frames convertible via ``as_fugue_df``.
 
 from typing import Any, List
 
-from .._utils.registry import run_at_def
-from ..dataframe.api import as_fugue_df, get_native_as_df
-from ..dataset.dataset import get_dataset_display
-from ..execution.factory import (
-    infer_execution_engine,
-    register_execution_engine,
-)
+from ..execution.factory import infer_execution_engine
 from .dataframe import JaxDataFrame
 from .execution_engine import JaxExecutionEngine
 
@@ -25,11 +19,5 @@ def _infer_jax_engine(objs: List[Any]) -> Any:
     return "jax"
 
 
-@run_at_def
-def _register() -> None:
-    register_execution_engine(
-        "jax", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
-    )
-    register_execution_engine(
-        "tpu", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
-    )
+# engine names "jax"/"tpu" are registered lazily in fugue_tpu/execution/
+# __init__.py (single registration site); this module adds only inference

@@ -1,0 +1,50 @@
+"""Notebook integration: the ``%%fsql`` cell magic.
+
+Parity with the reference (`fugue_notebook/env.py:53-66`): running a
+``%%fsql [engine]`` cell compiles+runs FugueSQL and injects yielded
+dataframes into the notebook namespace. Gated on IPython availability.
+"""
+
+from typing import Any, Optional
+
+
+def _setup_magic() -> bool:
+    try:
+        from IPython import get_ipython
+        from IPython.core.magic import Magics, cell_magic, magics_class
+    except ImportError:
+        return False
+    ip = get_ipython()
+    if ip is None:
+        return False
+
+    from ..sql.fsql import FugueSQLCompiler, fill_sql_template
+    from ..sql import FugueSQLWorkflow
+
+    @magics_class
+    class _FugueSQLMagics(Magics):
+        @cell_magic("fsql")
+        def fsql(self, line: str, cell: str) -> None:
+            engine = line.strip() or None
+            ns = self.shell.user_ns
+            dag = FugueSQLWorkflow()
+            code = fill_sql_template(cell, dict(ns))
+            compiler = FugueSQLCompiler(dag, {}, dict(ns), dict(ns))
+            compiler.compile(code)
+            result = dag.run(engine)
+            for name, yielded in result.yields.items():
+                ns[name] = yielded
+
+    ip.register_magics(_FugueSQLMagics)
+    return True
+
+
+class NotebookSetup:
+    """Call ``setup()`` in a notebook to enable ``%%fsql``."""
+
+    def setup(self) -> bool:
+        return _setup_magic()
+
+
+def setup(**kwargs: Any) -> bool:
+    return NotebookSetup().setup()

@@ -1,0 +1,104 @@
+"""HTTP RPC server — worker→driver callbacks over the network.
+
+Replaces the reference's flask server (`fugue/rpc/flask.py:17` — flask is
+not in this environment) with a stdlib ``ThreadingHTTPServer``. Payloads are
+cloudpickle over POST. Conf keys mirror the reference:
+
+- ``fugue.rpc.http_server.host`` (default 127.0.0.1)
+- ``fugue.rpc.http_server.port`` (default 0 = ephemeral)
+- ``fugue.rpc.http_server.timeout`` (client timeout seconds)
+"""
+
+import base64
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib import request as _urlrequest
+
+import cloudpickle
+
+from .base import RPCClient, RPCServer
+
+
+class HttpRPCClient(RPCClient):
+    """Picklable client stub carrying only (host, port, key)."""
+
+    def __init__(self, host: str, port: int, key: str, timeout: float = 30.0):
+        self._host = host
+        self._port = port
+        self._key = key
+        self._timeout = timeout
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        payload = base64.b64encode(cloudpickle.dumps((self._key, args, kwargs)))
+        req = _urlrequest.Request(
+            f"http://{self._host}:{self._port}/invoke",
+            data=payload,
+            method="POST",
+        )
+        with _urlrequest.urlopen(req, timeout=self._timeout) as resp:
+            body = resp.read()
+        ok, result = cloudpickle.loads(base64.b64decode(body))
+        if not ok:
+            raise result
+        return result
+
+
+class HttpRPCServer(RPCServer):
+    """Stdlib HTTP RPC server (reference flask parity)."""
+
+    def __init__(self, conf: Any = None):
+        super().__init__(conf)
+        self._host = self.conf.get("fugue.rpc.http_server.host", "127.0.0.1")
+        self._port = int(self.conf.get("fugue.rpc.http_server.port", 0))
+        self._timeout = float(self.conf.get("fugue.rpc.http_server.timeout", 30.0))
+        self._httpd: Any = None
+        self._thread: Any = None
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def create_client(self, key: str) -> RPCClient:
+        return HttpRPCClient(self._host, self._port, key, self._timeout)
+
+    def start_server(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    key, args, kwargs = cloudpickle.loads(
+                        base64.b64decode(self.rfile.read(length))
+                    )
+                    try:
+                        result = (True, server.invoke(key, *args, **kwargs))
+                    except Exception as e:  # result is the exception itself
+                        result = (False, e)
+                    body = base64.b64encode(cloudpickle.dumps(result))
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception:  # pragma: no cover - transport error
+                    self.send_response(500)
+                    self.end_headers()
+
+            def log_message(self, *args: Any) -> None:  # silence
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop_server(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

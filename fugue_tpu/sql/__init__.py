@@ -1,0 +1,22 @@
+from .fsql import FugueSQLWorkflow, fugue_sql, fugue_sql_flow, fill_sql_template
+from .local_sql import LocalSQLEngine
+from .parser import SQLParser
+from .executor import SQLExecutor
+
+fsql = fugue_sql_flow  # reference-compatible alias
+
+__all__ = [
+    "FugueSQLWorkflow",
+    "fugue_sql",
+    "fugue_sql_flow",
+    "fsql",
+    "fill_sql_template",
+    "LocalSQLEngine",
+    "SQLParser",
+    "SQLExecutor",
+]
+
+from ..execution.factory import register_sql_engine
+
+register_sql_engine("local", lambda engine, **kw: LocalSQLEngine(engine))
+register_sql_engine("sql", lambda engine, **kw: LocalSQLEngine(engine))

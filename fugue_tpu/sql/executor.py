@@ -1,0 +1,128 @@
+"""Logical-plan executor over ExecutionEngine verbs.
+
+Each plan node lowers to engine operations (join/union/select/take/...), so
+SQL inherits every engine's execution strategy — on the TPU engine,
+aggregations take the device segment-reduction path and projections compile
+with the jnp evaluator.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import pandas as pd
+
+from ..column import SelectColumns
+from ..column.expressions import _LitColumnExpr, _NamedColumnExpr
+from ..column.functions import is_agg
+from ..dataframe import ArrayDataFrame, DataFrame, PandasDataFrame
+from ..exceptions import FugueSQLRuntimeError, FugueSQLSyntaxError
+from ..execution.execution_engine import ExecutionEngine
+from .parser import (
+    JoinNode,
+    LimitNode,
+    PlanNode,
+    Scan,
+    SelectNode,
+    SetOpNode,
+    SortNode,
+    Subquery,
+)
+
+
+class SQLExecutor:
+    def __init__(self, engine: ExecutionEngine, dfs: Dict[str, DataFrame]):
+        self._engine = engine
+        self._dfs = dict(dfs)
+
+    def run(self, plan: PlanNode) -> DataFrame:
+        return self._exec(plan)
+
+    def _exec(self, node: PlanNode) -> DataFrame:
+        e = self._engine
+        if isinstance(node, Scan):
+            if node.name not in self._dfs:
+                raise FugueSQLRuntimeError(
+                    f"table {node.name!r} not found; available: {sorted(self._dfs)}"
+                )
+            return self._dfs[node.name]
+        if isinstance(node, Subquery):
+            return self._exec(node.child)
+        if isinstance(node, JoinNode):
+            left = self._exec(node.left)
+            right = self._exec(node.right)
+            return e.join(left, right, how=node.how, on=node.on or None)
+        if isinstance(node, SetOpNode):
+            left = self._exec(node.left)
+            right = self._exec(node.right)
+            if node.op == "union":
+                return e.union(left, right, distinct=node.distinct)
+            if node.op == "except":
+                return e.subtract(left, right, distinct=True)
+            return e.intersect(left, right, distinct=True)
+        if isinstance(node, SortNode):
+            df = self._exec(node.child)
+            local = e.to_df(df).as_local_bounded()
+            pdf = local.as_pandas().sort_values(
+                [n for n, _ in node.by],
+                ascending=[a for _, a in node.by],
+                na_position="first",
+            )
+            return e.to_df(
+                PandasDataFrame(pdf.reset_index(drop=True), local.schema)
+            )
+        if isinstance(node, LimitNode):
+            df = self._exec(node.child)
+            return e.take(df, node.n, presort="")
+        if isinstance(node, SelectNode):
+            return self._exec_select(node)
+        raise FugueSQLSyntaxError(f"unknown plan node {type(node)}")
+
+    def _exec_select(self, node: SelectNode) -> DataFrame:
+        e = self._engine
+        if node.child is None:
+            # SELECT <literals> with no FROM → one constant row
+            row: List[Any] = []
+            fields = []
+            import pyarrow as pa
+
+            for i, c in enumerate(node.projections):
+                if not isinstance(c, _LitColumnExpr):
+                    raise FugueSQLSyntaxError(
+                        "SELECT without FROM supports only literals"
+                    )
+                name = c.output_name or f"_{i}"
+                row.append(c.value)
+                tp = c.infer_type(None) or pa.string()
+                fields.append(pa.field(name, tp))
+            from ..schema import Schema
+
+            return ArrayDataFrame([row], Schema(fields))
+        child = self._exec(node.child)
+        cols = SelectColumns(
+            *[c.infer_alias() for c in node.projections], arg_distinct=node.distinct
+        )
+        if len(node.group_by) > 0:
+            # validate GROUP BY matches the non-agg projections (the implicit
+            # grouping the IR derives); anything fancier isn't supported yet
+            gb_names = set()
+            for g in node.group_by:
+                if not isinstance(g, _NamedColumnExpr):
+                    raise NotImplementedError(
+                        "GROUP BY supports plain column references only"
+                    )
+                gb_names.add(g.name)
+            proj_keys = {
+                c.output_name
+                for c in cols.replace_wildcard(child.schema).all_cols
+                if not is_agg(c)
+            }
+            keys_in_proj_source = {
+                c.name
+                for c in cols.replace_wildcard(child.schema).all_cols
+                if isinstance(c, _NamedColumnExpr) and not is_agg(c)
+            }
+            if not (gb_names == proj_keys or gb_names == keys_in_proj_source):
+                raise NotImplementedError(
+                    f"GROUP BY {sorted(gb_names)} must match the non-aggregate "
+                    f"select columns {sorted(proj_keys)}"
+                )
+        return e.select(child, cols, where=node.where, having=node.having)

@@ -1,0 +1,733 @@
+"""Hand-rolled SQL tokenizer + SELECT parser.
+
+In-tree replacement for the reference's delegated SQL stack (qpd/DuckDB —
+neither exists in this environment, SURVEY §0). SQL parses into a logical
+plan over the column-expression IR (``fugue_tpu/column``), executed through
+ExecutionEngine verbs (``executor.py``) — so the same SQL statement runs on
+the pandas oracle AND distributed on the TPU engine.
+
+Grammar (spark-ish subset)::
+
+    query     := select (UNION [ALL] | EXCEPT | INTERSECT) select ...
+    select    := SELECT [DISTINCT] proj (, proj)*
+                 [FROM source (join)*] [WHERE expr]
+                 [GROUP BY expr (, expr)*] [HAVING expr]
+                 [ORDER BY name [ASC|DESC] (, ...)*] [LIMIT n]
+    source    := ident [AS alias] | ( query ) [AS alias]
+    join      := [INNER|LEFT|RIGHT|FULL|CROSS|SEMI|ANTI] JOIN source
+                 [ON eq (AND eq)*]
+    proj      := expr [AS name] | * | ident.*
+    expr      := standard precedence with CASE WHEN, CAST, IN, LIKE,
+                 BETWEEN, IS [NOT] NULL, functions, literals
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple, Union
+
+from ..column import ColumnExpr, col, function, lit
+from ..column.expressions import (
+    _BinaryOpExpr,
+    _CaseWhenExpr,
+    _InExpr,
+    _LikeExpr,
+    _UnaryOpExpr,
+)
+from ..column import functions as ff
+from ..exceptions import FugueSQLSyntaxError
+from ..schema import to_pa_datatype
+
+_AGG_FUNCS = {"SUM", "COUNT", "AVG", "MEAN", "MIN", "MAX", "FIRST", "LAST"}
+
+_KEYWORD_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "EXCEPT",
+    "INTERSECT", "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON",
+    "AS", "ASC", "DESC", "BY", "AND", "OR", "NOT", "IN", "IS", "NULL",
+    "BETWEEN", "LIKE", "CASE", "WHEN", "THEN", "ELSE", "END", "DISTINCT",
+    "ALL", "SEMI", "ANTI", "OUTER", "USING",
+}
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Token:
+    kind: str  # IDENT QIDENT STRING NUMBER OP PUNCT EOF
+    value: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":  # line comment
+            while i < n and sql[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and sql[i + 1] == "*":  # block comment
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c == "'" or c == '"':
+            quote = c
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == quote:
+                    if j + 1 < n and sql[j + 1] == quote:  # escaped quote
+                        buf.append(quote)
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            if j >= n:
+                raise FugueSQLSyntaxError(f"unterminated string at {i}")
+            tokens.append(Token("STRING", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise FugueSQLSyntaxError(f"unterminated identifier at {i}")
+            tokens.append(Token("QIDENT", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            while j < n and (sql[j].isdigit() or (sql[j] == "." and not seen_dot)):
+                if sql[j] == ".":
+                    seen_dot = True
+                j += 1
+            if j < n and sql[j] in "eE":
+                j += 1
+                if j < n and sql[j] in "+-":
+                    j += 1
+                while j < n and sql[j].isdigit():
+                    j += 1
+                seen_dot = True
+            tokens.append(Token("NUMBER", sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            tokens.append(Token("IDENT", sql[i:j], i))
+            i = j
+            continue
+        for op in ("<>", "<=", ">=", "!=", "=="):
+            if sql.startswith(op, i):
+                tokens.append(Token("OP", op, i))
+                i += len(op)
+                break
+        else:
+            if c in "+-*/%<>=":
+                tokens.append(Token("OP", c, i))
+                i += 1
+            elif c in "(),.;[]{}:?":
+                tokens.append(Token("PUNCT", c, i))
+                i += 1
+            elif c == "<":
+                tokens.append(Token("OP", c, i))
+                i += 1
+            else:
+                raise FugueSQLSyntaxError(f"unexpected character {c!r} at {i}")
+    tokens.append(Token("EOF", "", n))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# logical plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanNode:
+    pass
+
+
+@dataclass
+class Scan(PlanNode):
+    name: str
+
+
+@dataclass
+class Subquery(PlanNode):
+    child: PlanNode
+    alias: str = ""
+
+
+@dataclass
+class JoinNode(PlanNode):
+    left: PlanNode
+    right: PlanNode
+    how: str
+    on: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SelectNode(PlanNode):
+    child: Optional[PlanNode]
+    projections: List[ColumnExpr]
+    where: Optional[ColumnExpr] = None
+    group_by: List[ColumnExpr] = field(default_factory=list)
+    having: Optional[ColumnExpr] = None
+    distinct: bool = False
+
+
+@dataclass
+class SetOpNode(PlanNode):
+    op: str  # union | except | intersect
+    left: PlanNode
+    right: PlanNode
+    distinct: bool = True
+
+
+@dataclass
+class SortNode(PlanNode):
+    child: PlanNode
+    by: List[Tuple[str, bool]]
+
+
+@dataclass
+class LimitNode(PlanNode):
+    child: PlanNode
+    n: int
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+class SQLParser:
+    def __init__(self, sql: str):
+        self._tokens = tokenize(sql)
+        self._i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self, offset: int = 0) -> Token:
+        return self._tokens[min(self._i + offset, len(self._tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.peek()
+        self._i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "IDENT" and t.upper in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            t = self.peek()
+            raise FugueSQLSyntaxError(f"expected {kw}, got {t.value!r} at {t.pos}")
+
+    def at_punct(self, p: str) -> bool:
+        t = self.peek()
+        return t.kind == "PUNCT" and t.value == p
+
+    def eat_punct(self, p: str) -> bool:
+        if self.at_punct(p):
+            self.next()
+            return True
+        return False
+
+    def expect_punct(self, p: str) -> None:
+        if not self.eat_punct(p):
+            t = self.peek()
+            raise FugueSQLSyntaxError(f"expected {p!r}, got {t.value!r} at {t.pos}")
+
+    # -- entry -------------------------------------------------------------
+    def parse_query(self) -> PlanNode:
+        plan = self._parse_query_body()
+        # trailing ORDER BY / LIMIT apply to the whole set expression
+        plan = self._maybe_order_limit(plan)
+        return plan
+
+    def parse_full(self) -> PlanNode:
+        plan = self.parse_query()
+        self.eat_punct(";")
+        if self.peek().kind != "EOF":
+            t = self.peek()
+            raise FugueSQLSyntaxError(f"unexpected {t.value!r} at {t.pos}")
+        return plan
+
+    def _parse_query_body(self) -> PlanNode:
+        left = self._parse_select()
+        while True:
+            if self.at_kw("UNION"):
+                self.next()
+                distinct = not self.eat_kw("ALL")
+                self.eat_kw("DISTINCT")
+                right = self._parse_select()
+                left = SetOpNode("union", left, right, distinct)
+            elif self.at_kw("EXCEPT"):
+                self.next()
+                self.eat_kw("DISTINCT")
+                right = self._parse_select()
+                left = SetOpNode("except", left, right, True)
+            elif self.at_kw("INTERSECT"):
+                self.next()
+                self.eat_kw("DISTINCT")
+                right = self._parse_select()
+                left = SetOpNode("intersect", left, right, True)
+            else:
+                return left
+
+    def _maybe_order_limit(self, plan: PlanNode) -> PlanNode:
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            by: List[Tuple[str, bool]] = []
+            while True:
+                name = self._parse_name()
+                asc = True
+                if self.eat_kw("DESC"):
+                    asc = False
+                else:
+                    self.eat_kw("ASC")
+                by.append((name, asc))
+                if not self.eat_punct(","):
+                    break
+            plan = SortNode(plan, by)
+        if self.at_kw("LIMIT"):
+            self.next()
+            t = self.next()
+            if t.kind != "NUMBER":
+                raise FugueSQLSyntaxError(f"expected number after LIMIT at {t.pos}")
+            plan = LimitNode(plan, int(t.value))
+        return plan
+
+    def _parse_select(self) -> PlanNode:
+        if self.eat_punct("("):
+            inner = self._parse_query_body()
+            inner = self._maybe_order_limit(inner)
+            self.expect_punct(")")
+            return inner
+        self.expect_kw("SELECT")
+        distinct = self.eat_kw("DISTINCT")
+        projections: List[ColumnExpr] = []
+        while True:
+            projections.append(self._parse_projection())
+            if not self.eat_punct(","):
+                break
+        child: Optional[PlanNode] = None
+        if self.eat_kw("FROM"):
+            child = self._parse_source()
+            while True:
+                how = self._peek_join_type()
+                if how is None:
+                    break
+                right = self._parse_source()
+                on: List[str] = []
+                if self.eat_kw("ON"):
+                    on = self._parse_on_keys()
+                elif self.eat_kw("USING"):
+                    self.expect_punct("(")
+                    while True:
+                        on.append(self._parse_name())
+                        if not self.eat_punct(","):
+                            break
+                    self.expect_punct(")")
+                child = JoinNode(child, right, how, on)
+        where = None
+        if self.eat_kw("WHERE"):
+            where = self._parse_expr()
+        group_by: List[ColumnExpr] = []
+        if self.at_kw("GROUP"):
+            self.next()
+            self.expect_kw("BY")
+            while True:
+                group_by.append(self._parse_expr())
+                if not self.eat_punct(","):
+                    break
+        having = None
+        if self.eat_kw("HAVING"):
+            having = self._parse_expr()
+        node: PlanNode = SelectNode(
+            child, projections, where, group_by, having, distinct
+        )
+        return self._maybe_order_limit(node)
+
+    def _peek_join_type(self) -> Optional[str]:
+        if self.at_kw("JOIN"):
+            self.next()
+            return "inner"
+        for kw, how in (
+            ("INNER", "inner"),
+            ("CROSS", "cross"),
+            ("SEMI", "semi"),
+            ("ANTI", "anti"),
+        ):
+            if self.at_kw(kw) and self.peek(1).upper == "JOIN":
+                self.next()
+                self.next()
+                return how
+        for kw, how in (
+            ("LEFT", "left_outer"),
+            ("RIGHT", "right_outer"),
+            ("FULL", "full_outer"),
+        ):
+            if self.at_kw(kw):
+                nxt = self.peek(1).upper
+                if nxt == "JOIN":
+                    self.next(); self.next()
+                    return how
+                if nxt == "OUTER" and self.peek(2).upper == "JOIN":
+                    self.next(); self.next(); self.next()
+                    return how
+                if nxt in ("SEMI", "ANTI") and self.peek(2).upper == "JOIN":
+                    how2 = "semi" if nxt == "SEMI" else "anti"
+                    self.next(); self.next(); self.next()
+                    return how2
+        return None
+
+    def _parse_source(self) -> PlanNode:
+        if self.eat_punct("("):
+            inner = self._parse_query_body()
+            inner = self._maybe_order_limit(inner)
+            self.expect_punct(")")
+            alias = ""
+            if self.eat_kw("AS"):
+                alias = self._parse_name()
+            elif self.peek().kind in ("IDENT", "QIDENT") and not self._at_clause_kw():
+                alias = self._parse_name()
+            return Subquery(inner, alias)
+        name = self._parse_name()
+        if self.eat_kw("AS"):
+            self._parse_name()  # table aliases accepted and ignored
+        elif self.peek().kind in ("IDENT", "QIDENT") and not self._at_clause_kw():
+            self._parse_name()
+        return Scan(name)
+
+    def _at_clause_kw(self) -> bool:
+        t = self.peek()
+        return t.kind == "IDENT" and t.upper in _KEYWORD_STOP
+
+    def _parse_on_keys(self) -> List[str]:
+        keys: List[str] = []
+        while True:
+            l = self._parse_qualified_name()
+            t = self.next()
+            if not (t.kind == "OP" and t.value in ("=", "==")):
+                raise FugueSQLSyntaxError(
+                    f"only equi-join conditions are supported, got {t.value!r}"
+                )
+            r = self._parse_qualified_name()
+            if l != r:
+                raise FugueSQLSyntaxError(
+                    f"join keys must share a column name ({l} vs {r}); "
+                    "rename columns before joining (fugue convention)"
+                )
+            keys.append(l)
+            if not self.eat_kw("AND"):
+                break
+        return keys
+
+    def _parse_name(self) -> str:
+        t = self.next()
+        if t.kind not in ("IDENT", "QIDENT"):
+            raise FugueSQLSyntaxError(f"expected name, got {t.value!r} at {t.pos}")
+        return t.value
+
+    def _parse_qualified_name(self) -> str:
+        name = self._parse_name()
+        while self.at_punct("."):
+            self.next()
+            name = self._parse_name()  # keep last segment (unqualified)
+        return name
+
+    def _parse_projection(self) -> ColumnExpr:
+        t = self.peek()
+        if t.kind == "OP" and t.value == "*":
+            self.next()
+            return col("*")
+        if (
+            t.kind in ("IDENT", "QIDENT")
+            and self.peek(1).value == "."
+            and self.peek(2).value == "*"
+        ):
+            self.next(); self.next(); self.next()
+            return col("*")
+        e = self._parse_expr()
+        if self.eat_kw("AS"):
+            e = e.alias(self._parse_name())
+        elif self.peek().kind in ("IDENT", "QIDENT") and not self._at_clause_kw():
+            e = e.alias(self._parse_name())
+        return e
+
+    # -- expressions --------------------------------------------------------
+    def _parse_expr(self) -> ColumnExpr:
+        return self._parse_or()
+
+    def _parse_or(self) -> ColumnExpr:
+        left = self._parse_and()
+        while self.eat_kw("OR"):
+            left = _BinaryOpExpr("|", left, self._parse_and())
+        return left
+
+    def _parse_and(self) -> ColumnExpr:
+        left = self._parse_not()
+        while self.eat_kw("AND"):
+            left = _BinaryOpExpr("&", left, self._parse_not())
+        return left
+
+    def _parse_not(self) -> ColumnExpr:
+        if self.eat_kw("NOT"):
+            return _UnaryOpExpr("~", self._parse_not())
+        return self._parse_predicate()
+
+    def _parse_predicate(self) -> ColumnExpr:
+        left = self._parse_additive()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+                self.next()
+                op = {"=": "==", "<>": "!="}.get(t.value, t.value)
+                left = _BinaryOpExpr(op, left, self._parse_additive())
+                continue
+            if self.at_kw("IS"):
+                self.next()
+                negate = self.eat_kw("NOT")
+                self.expect_kw("NULL")
+                left = _UnaryOpExpr("NOT_NULL" if negate else "IS_NULL", left)
+                continue
+            if self.at_kw("IN") or (self.at_kw("NOT") and self.peek(1).upper == "IN"):
+                positive = not self.eat_kw("NOT")
+                self.expect_kw("IN")
+                self.expect_punct("(")
+                values: List[Any] = []
+                while True:
+                    values.append(self._parse_literal_value())
+                    if not self.eat_punct(","):
+                        break
+                self.expect_punct(")")
+                left = _InExpr(left, values, positive)
+                continue
+            if self.at_kw("BETWEEN") or (
+                self.at_kw("NOT") and self.peek(1).upper == "BETWEEN"
+            ):
+                positive = not self.eat_kw("NOT")
+                self.expect_kw("BETWEEN")
+                lo = self._parse_additive()
+                self.expect_kw("AND")
+                hi = self._parse_additive()
+                rng = _BinaryOpExpr("&", left >= lo, left <= hi)
+                left = rng if positive else _UnaryOpExpr("~", rng)
+                continue
+            if self.at_kw("LIKE") or (self.at_kw("NOT") and self.peek(1).upper == "LIKE"):
+                positive = not self.eat_kw("NOT")
+                self.expect_kw("LIKE")
+                p = self.next()
+                if p.kind != "STRING":
+                    raise FugueSQLSyntaxError(f"LIKE pattern must be a string at {p.pos}")
+                left = _LikeExpr(left, p.value, positive)
+                continue
+            return left
+
+    def _parse_additive(self) -> ColumnExpr:
+        left = self._parse_mult()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("+", "-"):
+                self.next()
+                left = _BinaryOpExpr(t.value, left, self._parse_mult())
+            else:
+                return left
+
+    def _parse_mult(self) -> ColumnExpr:
+        left = self._parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("*", "/", "%"):
+                if t.value == "*" and self._looks_like_projection_star():
+                    return left
+                self.next()
+                if t.value == "%":
+                    left = function("MOD", left, self._parse_unary())
+                else:
+                    left = _BinaryOpExpr(t.value, left, self._parse_unary())
+            else:
+                return left
+
+    def _looks_like_projection_star(self) -> bool:
+        nxt = self.peek(1)
+        return nxt.kind == "PUNCT" and nxt.value in (",",) or (
+            nxt.kind == "IDENT" and nxt.upper == "FROM"
+        )
+
+    def _parse_unary(self) -> ColumnExpr:
+        t = self.peek()
+        if t.kind == "OP" and t.value == "-":
+            self.next()
+            return _UnaryOpExpr("-", self._parse_unary())
+        if t.kind == "OP" and t.value == "+":
+            self.next()
+            return self._parse_unary()
+        return self._parse_primary()
+
+    def _parse_literal_value(self) -> Any:
+        t = self.next()
+        if t.kind == "STRING":
+            return t.value
+        if t.kind == "NUMBER":
+            return float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+        if t.kind == "IDENT" and t.upper == "NULL":
+            return None
+        if t.kind == "IDENT" and t.upper in ("TRUE", "FALSE"):
+            return t.upper == "TRUE"
+        if t.kind == "OP" and t.value == "-":
+            v = self._parse_literal_value()
+            return -v
+        raise FugueSQLSyntaxError(f"expected literal, got {t.value!r} at {t.pos}")
+
+    def _parse_primary(self) -> ColumnExpr:
+        t = self.peek()
+        if t.kind == "STRING":
+            self.next()
+            return lit(t.value)
+        if t.kind == "NUMBER":
+            self.next()
+            v = float(t.value) if "." in t.value or "e" in t.value.lower() else int(t.value)
+            return lit(v)
+        if t.kind == "PUNCT" and t.value == "(":
+            self.next()
+            e = self._parse_expr()
+            self.expect_punct(")")
+            return e
+        if t.kind == "QIDENT":
+            self.next()
+            return col(t.value)
+        if t.kind == "IDENT":
+            up = t.upper
+            if up == "NULL":
+                self.next()
+                return lit(None)
+            if up in ("TRUE", "FALSE"):
+                self.next()
+                return lit(up == "TRUE")
+            if up == "CASE":
+                return self._parse_case()
+            if up == "CAST":
+                self.next()
+                self.expect_punct("(")
+                e = self._parse_expr()
+                self.expect_kw("AS")
+                tp = self._parse_type_name()
+                self.expect_punct(")")
+                return e.cast(tp)
+            if self.peek(1).value == "(":  # function call
+                self.next()
+                self.next()
+                distinct = self.eat_kw("DISTINCT")
+                args: List[ColumnExpr] = []
+                if not self.at_punct(")"):
+                    while True:
+                        a = self.peek()
+                        if a.kind == "OP" and a.value == "*":
+                            self.next()
+                            args.append(lit(1))  # COUNT(*)
+                        else:
+                            args.append(self._parse_expr())
+                        if not self.eat_punct(","):
+                            break
+                self.expect_punct(")")
+                return self._make_func(up, args, distinct)
+            # plain or qualified column ref
+            self.next()
+            name = t.value
+            while self.at_punct(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
+                self.next()
+                name = self._parse_name()
+            return col(name)
+        raise FugueSQLSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def _parse_type_name(self) -> Any:
+        name = self._parse_name().lower()
+        # SQL type names → schema expression types
+        mapping = {
+            "integer": "int",
+            "bigint": "long",
+            "smallint": "short",
+            "tinyint": "byte",
+            "varchar": "str",
+            "text": "str",
+            "string": "str",
+            "real": "float",
+            "boolean": "bool",
+            "timestamp": "datetime",
+        }
+        base = mapping.get(name, name)
+        if self.eat_punct("("):  # e.g. VARCHAR(10), DECIMAL(10,2)
+            args = []
+            while not self.at_punct(")"):
+                args.append(self.next().value)
+                self.eat_punct(",")
+            self.expect_punct(")")
+            if base == "decimal":
+                return f"decimal({','.join(args)})"
+        return to_pa_datatype(base)
+
+    def _parse_case(self) -> ColumnExpr:
+        self.expect_kw("CASE")
+        cases: List[Tuple[ColumnExpr, ColumnExpr]] = []
+        base: Optional[ColumnExpr] = None
+        if not self.at_kw("WHEN"):
+            base = self._parse_expr()
+        while self.eat_kw("WHEN"):
+            cond = self._parse_expr()
+            if base is not None:
+                cond = _BinaryOpExpr("==", base, cond)
+            self.expect_kw("THEN")
+            val = self._parse_expr()
+            cases.append((cond, val))
+        default = None
+        if self.eat_kw("ELSE"):
+            default = self._parse_expr()
+        self.expect_kw("END")
+        return _CaseWhenExpr(cases, default)
+
+    def _make_func(self, name: str, args: List[ColumnExpr], distinct: bool) -> ColumnExpr:
+        if name in _AGG_FUNCS:
+            a = args[0] if len(args) > 0 else lit(1)
+            if name == "SUM":
+                e: ColumnExpr = ff.sum(a)
+            elif name == "COUNT":
+                e = ff.count_distinct(a) if distinct else ff.count(a)
+                return e
+            elif name in ("AVG", "MEAN"):
+                e = ff.avg(a)
+            elif name == "MIN":
+                e = ff.min(a)
+            elif name == "MAX":
+                e = ff.max(a)
+            elif name == "FIRST":
+                e = ff.first(a)
+            elif name == "LAST":
+                e = ff.last(a)
+            return e
+        return function(name, *args, arg_distinct=distinct)
+
+
+def parse_select(sql: str) -> PlanNode:
+    return SQLParser(sql).parse_full()

@@ -1,0 +1,112 @@
+"""Test-harness plugins: parameterize tests over backends.
+
+Parity with the reference (`fugue/test/plugins.py:39-96,99,226` +
+``fugue_test/fixtures.py``): backends register a session factory; suites
+bind to one with ``@fugue_test_suite("name")``; single tests parameterize
+with ``@with_backend("native", "jax")``.
+"""
+
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import pytest
+
+from ..execution.execution_engine import ExecutionEngine
+from ..execution.factory import make_execution_engine
+
+_TEST_BACKENDS: Dict[str, "FugueTestBackend"] = {}
+
+
+class FugueTestBackend:
+    """Subclass + register to expose a backend to the test harness."""
+
+    name = ""
+    session_conf: Dict[str, Any] = {}
+
+    @classmethod
+    @contextmanager
+    def session_context(cls) -> Iterator[Any]:
+        """Yield a live session object (engine spec) for the backend."""
+        yield cls.name
+
+    @classmethod
+    @contextmanager
+    def engine_context(cls) -> Iterator[ExecutionEngine]:
+        with cls.session_context() as session:
+            engine = make_execution_engine(session, dict(cls.session_conf))
+            try:
+                yield engine
+            finally:
+                engine.stop()
+
+
+def fugue_test_backend(cls: type) -> type:
+    """Class decorator registering a FugueTestBackend."""
+    assert issubclass(cls, FugueTestBackend) and cls.name != ""
+    _TEST_BACKENDS[cls.name] = cls  # type: ignore
+    return cls
+
+
+def get_test_backend(name: str) -> "FugueTestBackend":
+    if name not in _TEST_BACKENDS:
+        raise KeyError(
+            f"test backend {name!r} is not registered; have {sorted(_TEST_BACKENDS)}"
+        )
+    return _TEST_BACKENDS[name]  # type: ignore
+
+
+def fugue_test_suite(backend: str, mark_test: bool = False) -> Callable[[type], type]:
+    """Bind a test-suite class to a backend: injects ``make_engine`` and a
+    class-scoped engine fixture (reference ``@fugue_test_suite``)."""
+
+    def deco(cls: type) -> type:
+        backend_cls = get_test_backend(backend)
+
+        def make_engine(self: Any) -> ExecutionEngine:
+            return make_execution_engine(backend, dict(backend_cls.session_conf))
+
+        cls.make_engine = make_engine  # type: ignore
+        cls.backend = backend  # type: ignore
+        if mark_test:
+            cls = pytest.mark.__getattr__(backend)(cls)
+        return cls
+
+    return deco
+
+
+def with_backend(*backends: str) -> Callable:
+    """Parameterize one test over engines: the test receives ``backend_engine``."""
+
+    def deco(func: Callable) -> Callable:
+        @pytest.mark.parametrize("fugue_backend_name", list(backends))
+        def wrapper(*args: Any, fugue_backend_name: str, **kwargs: Any) -> Any:
+            backend_cls = get_test_backend(fugue_backend_name)
+            with backend_cls.engine_context() as engine:
+                return func(*args, backend_engine=engine, **kwargs)
+
+        wrapper.__name__ = func.__name__
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# built-in backends
+# ---------------------------------------------------------------------------
+
+
+@fugue_test_backend
+class NativeTestBackend(FugueTestBackend):
+    name = "native"
+
+
+@fugue_test_backend
+class PandasTestBackend(FugueTestBackend):
+    name = "pandas"
+
+
+@fugue_test_backend
+class JaxTestBackend(FugueTestBackend):
+    """The jax engine on whatever devices are visible (tests pin CPU mesh)."""
+
+    name = "jax"

@@ -1,6 +1,7 @@
 from .workflow import FugueWorkflow, FugueWorkflowResult, WorkflowDataFrame
 from .api import out_transform, raw_sql, transform
 from ._checkpoint import Checkpoint, StrongCheckpoint, WeakCheckpoint
+from .module import module
 
 __all__ = [
     "FugueWorkflow",
@@ -12,4 +13,5 @@ __all__ = [
     "Checkpoint",
     "StrongCheckpoint",
     "WeakCheckpoint",
+    "module",
 ]

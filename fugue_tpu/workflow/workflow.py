@@ -779,8 +779,13 @@ class FugueWorkflow:
         ctx = FugueWorkflowContext(e)
         self._last_context = ctx
         self._apply_auto_persist(e)
-        with e._as_context():
-            ctx.run(self._tasks)
+        try:
+            with e._as_context():
+                ctx.run(self._tasks)
+        except Exception as ex:
+            from .._utils.exception import modify_traceback
+
+            raise modify_traceback(ex, e.conf)
         return FugueWorkflowResult(self._yields)
 
     def get_result(self, df: WorkflowDataFrame) -> DataFrame:

@@ -1,0 +1,195 @@
+"""SQL layer tests: standard SQL on both engines + FugueSQL."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.dataframe import DataFrames
+from fugue_tpu.exceptions import FugueSQLSyntaxError
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.sql import fugue_sql, fugue_sql_flow
+from fugue_tpu.workflow import raw_sql
+
+
+def _q(engine, dfs, sql):
+    return engine.sql_engine.select(
+        DataFrames(dfs), StructuredRawSQL([(False, sql)], dialect="spark")
+    ).as_array(type_safe=True)
+
+
+@pytest.fixture
+def engine():
+    e = NativeExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture
+def dfs(engine):
+    a = engine.to_df(
+        [[1, "x", 10.0], [2, "y", 20.0], [1, "z", 5.0], [3, None, None]],
+        "k:long,s:str,v:double",
+    )
+    b = engine.to_df([[1, "A"], [3, "C"]], "k:long,t:str")
+    return {"a": a, "b": b}
+
+
+class TestStandardSQL:
+    def test_projection_filter(self, engine, dfs):
+        assert _q(engine, dfs, "SELECT k, v*2 AS vv FROM a WHERE v >= 10") == [
+            [1, 20.0], [2, 40.0],
+        ]
+
+    def test_group_by(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM a GROUP BY k ORDER BY k",
+        ) == [[1, 15.0, 2], [2, 20.0, 1], [3, None, 1]]
+
+    def test_having(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT k, COUNT(*) AS n FROM a GROUP BY k HAVING n > 1",
+        ) == [[1, 2]]
+
+    def test_joins(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT a.k, s, t FROM a INNER JOIN b ON a.k = b.k ORDER BY s",
+        ) == [[3, None, "C"], [1, "x", "A"], [1, "z", "A"]]
+        assert (
+            len(_q(engine, dfs, "SELECT a.k, s, t FROM a LEFT JOIN b ON a.k = b.k"))
+            == 4
+        )
+
+    def test_set_ops(self, engine, dfs):
+        assert _q(
+            engine, dfs, "SELECT k FROM a UNION SELECT k FROM b ORDER BY k"
+        ) == [[1], [2], [3]]
+        assert _q(
+            engine, dfs, "SELECT k FROM a EXCEPT SELECT k FROM b ORDER BY k"
+        ) == [[2]]
+
+    def test_case_in_like_between(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT k, CASE WHEN v >= 10 THEN 'hi' ELSE 'lo' END AS c "
+            "FROM a WHERE k IN (1, 2) ORDER BY k, c",
+        ) == [[1, "hi"], [1, "lo"], [2, "hi"]]
+        assert _q(
+            engine, dfs, "SELECT k FROM a WHERE s LIKE 'x%' OR k BETWEEN 3 AND 3 ORDER BY k"
+        ) == [[1], [3]]
+
+    def test_subquery_distinct_limit(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT DISTINCT k FROM (SELECT k FROM a WHERE v IS NOT NULL) t ORDER BY k LIMIT 2",
+        ) == [[1], [2]]
+
+    def test_scalar_functions(self, engine, dfs):
+        assert _q(
+            engine, dfs,
+            "SELECT UPPER(s) AS u FROM a WHERE s IS NOT NULL ORDER BY u",
+        ) == [["X"], ["Y"], ["Z"]]
+
+    def test_syntax_error(self, engine, dfs):
+        with pytest.raises(FugueSQLSyntaxError):
+            _q(engine, dfs, "SELEC k FROM a")
+
+    def test_missing_table(self, engine, dfs):
+        with pytest.raises(Exception):
+            _q(engine, dfs, "SELECT * FROM nope")
+
+
+class TestRawSQLAPI:
+    def test_raw_sql(self):
+        pdf = pd.DataFrame({"a": [1, 2, 3]})
+        res = raw_sql("SELECT SUM(a) AS s FROM ", pdf)
+        assert res.values.tolist() == [[6]]
+
+
+class TestFugueSQL:
+    def test_capture_local_var(self):
+        src = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql("SELECT k, SUM(v) AS s FROM src GROUP BY k ORDER BY k")
+        assert r["s"].tolist() == [3.0, 3.0]
+
+    def test_multi_statement_transform(self):
+        src = pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+
+        def double(df: pd.DataFrame) -> pd.DataFrame:
+            df["v"] = df["v"] * 2
+            return df
+
+        r = fugue_sql(
+            """
+            a = SELECT * FROM src WHERE v > 1
+            TRANSFORM a USING double SCHEMA *
+            """
+        )
+        assert r.values.tolist() == [[2, 4.0]]
+
+    def test_create_take_print(self, capsys):
+        r = fugue_sql(
+            """
+            x = CREATE [[0,"a"],[1,"b"],[2,"c"]] SCHEMA n:long,s:str
+            PRINT 2 ROWS FROM x TITLE "demo"
+            TAKE 2 ROWS FROM x PRESORT n DESC
+            """
+        )
+        assert r["n"].tolist() == [2, 1]
+        assert "demo" in capsys.readouterr().out
+
+    def test_save_load(self, tmp_path):
+        path = os.path.join(str(tmp_path), "x.parquet")
+        fugue_sql_flow(
+            f"""
+            a = CREATE [[1,"x"],[2,"y"]] SCHEMA id:long,s:str
+            SAVE a OVERWRITE PARQUET "{path}"
+            """
+        ).run()
+        r = fugue_sql(
+            f"""
+            b = LOAD PARQUET "{path}"
+            SELECT * FROM b WHERE id = 2
+            """
+        )
+        assert r.values.tolist() == [[2, "y"]]
+
+    def test_yields(self):
+        dag = fugue_sql_flow(
+            """
+            a = CREATE [[1],[2]] SCHEMA z:long
+            YIELD DATAFRAME AS out
+            """
+        )
+        res = dag.run()
+        assert res.yields["out"].result.as_array() == [[1], [2]]
+
+    def test_jinja_template(self):
+        threshold = 1
+        src = pd.DataFrame({"a": [1, 2, 3]})
+        r = fugue_sql("SELECT * FROM src WHERE a > {{threshold}}", threshold=threshold)
+        assert r["a"].tolist() == [2, 3]
+
+    def test_drop_fill_rename_alter_sample(self):
+        src = pd.DataFrame({"a": [1.0, None, 3.0], "b": ["x", "y", None]})
+        r = fugue_sql("DROP ROWS IF ANY NULL FROM src")
+        assert r.values.tolist() == [[1.0, "x"]]
+        r2 = fugue_sql("FILL NULLS PARAMS a:0 FROM src")
+        assert r2["a"].tolist() == [1.0, 0.0, 3.0]
+        r3 = fugue_sql("RENAME COLUMNS a:aa FROM src")
+        assert list(r3.columns) == ["aa", "b"]
+        r4 = fugue_sql("ALTER COLUMNS a:str FROM src", as_fugue=True)
+        assert str(r4.schema) == "a:str,b:str"
+
+    def test_fsql_on_jax_engine(self):
+        src = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT k, SUM(v) AS s FROM src GROUP BY k ORDER BY k",
+            engine="jax",
+        )
+        assert r["s"].tolist() == [3.0, 3.0]
