@@ -72,4 +72,5 @@ from .workflow import (
     transform,
 )
 from .sql import FugueSQLWorkflow, fugue_sql, fugue_sql_flow, fsql
+from . import jax_annotations as _jax_annotations  # registers Dict[str, jax.Array]
 from . import api  # noqa: F401

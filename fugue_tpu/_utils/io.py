@@ -89,8 +89,12 @@ def load_df(
     for p in paths:
         parser = FileParser(p, format_hint)
         fmt = parser.file_format
-        for f in parser.find_files():
-            tables.append(_LOADERS[fmt](f, columns, kwargs))
+        if fmt == "parquet" and not parser.has_glob:
+            # pyarrow datasets handle directories + hive partitioning
+            tables.append(_load_parquet(p, columns, kwargs))
+        else:
+            for f in parser.find_files():
+                tables.append(_LOADERS[fmt](f, columns, kwargs))
     assert_or_throw(len(tables) > 0, FugueDataFrameInitError(f"no files found at {path}"))
     tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
     return tbl, Schema(tbl.schema)
@@ -101,9 +105,24 @@ def save_df(
     path: str,
     format_hint: Optional[str] = None,
     mode: str = "overwrite",
+    partition_cols: Optional[List[str]] = None,
     **kwargs: Any,
 ) -> None:
     parser = FileParser(path, format_hint)
+    if partition_cols:
+        assert_or_throw(
+            parser.file_format == "parquet",
+            NotImplementedError("partitioned saves support parquet only"),
+        )
+        if os.path.exists(path):
+            if mode == "error":
+                raise FugueInvalidOperation(f"{path} already exists")
+            if mode == "overwrite":
+                import shutil
+
+                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+        pq.write_to_dataset(df, path, partition_cols=partition_cols, **kwargs)
+        return
     if os.path.exists(path):
         if mode == "error":
             raise FugueInvalidOperation(f"{path} already exists")

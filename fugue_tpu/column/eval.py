@@ -198,7 +198,7 @@ def eval_agg(pdf: pd.DataFrame, expr: _FuncExpr) -> Any:
     if expr.is_distinct:
         v = v.drop_duplicates()
     if func == "COUNT":
-        return int(v.notna().sum()) if expr.is_distinct else int(v.notna().sum())
+        return int(v.notna().sum())
     if func == "MIN":
         nn = v.dropna()
         return None if len(nn) == 0 else nn.min()

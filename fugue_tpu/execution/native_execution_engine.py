@@ -369,8 +369,18 @@ class NativeExecutionEngine(ExecutionEngine):
         force_single: bool = False,
         **kwargs: Any,
     ) -> DataFrame:
+        partition_cols = (
+            list(partition_spec.partition_by)
+            if partition_spec is not None and len(partition_spec.partition_by) > 0
+            else None
+        )
         _io_save_df(
-            self.to_df(df).as_arrow(), path, format_hint=format_hint, mode=mode, **kwargs
+            self.to_df(df).as_arrow(),
+            path,
+            format_hint=format_hint,
+            mode=mode,
+            partition_cols=partition_cols,
+            **kwargs,
         )
         return df
 

@@ -1,0 +1,71 @@
+"""Annotated param for device-compiled transformers.
+
+Functions annotated ``Dict[str, jax.Array] -> Dict[str, jax.Array]`` (code
+``j``) are the TPU-native transformer form: on the jax engine they compile
+into one ``shard_map`` over the mesh; on any other engine they degrade
+gracefully to a host conversion (numpy → jnp → numpy), preserving the
+"any transformer runs on any engine" contract.
+
+Contract: the input dict includes a reserved ``"__valid__"`` bool array
+marking real rows — on the jax engine rows are padded to a mesh multiple, so
+per-shard reductions MUST mask with it; elementwise code can ignore it.
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .dataframe import ArrowDataFrame, DataFrame
+from .dataframe.function_wrapper import LocalDataFrameParam, fugue_annotated_param
+from .schema import Schema
+
+
+def _is_jax_dict_annotation(a: Any) -> bool:
+    try:
+        import jax
+
+        return a == Dict[str, jax.Array]
+    except Exception:
+        return False
+
+
+@fugue_annotated_param(code="j", matcher=_is_jax_dict_annotation)
+class JaxDictParam(LocalDataFrameParam):
+    @property
+    def format_hint(self) -> Optional[str]:
+        return "jax"
+
+    @property
+    def need_schema(self) -> Optional[bool]:
+        return True
+
+    def to_input_data(self, df: DataFrame, ctx: Any = None) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from .jax.dataframe import JaxDataFrame, split_arrow_for_device
+
+        if isinstance(df, JaxDataFrame):
+            res = dict(df.device_cols)
+        else:
+            cols, _ = split_arrow_for_device(df.as_arrow())
+            res = {k: jnp.asarray(v) for k, v in cols.items()}
+        if len(res) > 0 and "__valid__" not in res:
+            n = next(iter(res.values())).shape[0]
+            res["__valid__"] = jnp.ones((n,), dtype=bool)
+        return res
+
+    def to_output_df(self, output: Any, schema: Optional[Schema], ctx: Any = None) -> DataFrame:
+        import jax
+
+        assert isinstance(output, dict), "jax transformer must return a dict"
+        arrays = []
+        for f in schema.fields:  # type: ignore
+            host = np.asarray(jax.device_get(output[f.name]))
+            arrays.append(pa.array(host).cast(f.type, safe=False))
+        return ArrowDataFrame(
+            pa.Table.from_arrays(arrays, schema=schema.pa_schema)  # type: ignore
+        )
+
+    def count(self, df: Dict[str, Any]) -> int:
+        return int(next(iter(df.values())).shape[0])

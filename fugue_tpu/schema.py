@@ -216,6 +216,8 @@ def _quote_name(name: str) -> str:
 
 def _normalize_type(tp: pa.DataType) -> pa.DataType:
     """Canonicalize types coming from external data (large_* → plain)."""
+    if pa.types.is_dictionary(tp):
+        return _normalize_type(tp.value_type)
     if pa.types.is_large_string(tp):
         return pa.string()
     if pa.types.is_large_binary(tp):

@@ -68,12 +68,16 @@ class _StatementSplitter:
         self._sql = sql
         self._tokens = tokenize(sql)
 
-    def split(self) -> List[List[Token]]:
-        statements: List[List[Token]] = []
+    def split(self) -> List[Any]:
+        """Returns a list of (tokens, end_pos) per statement."""
+        import bisect
+
+        newlines = [i for i, ch in enumerate(self._sql) if ch == "\n"]
+        statements: List[Any] = []
         cur: List[Token] = []
         depth = 0
         last_line = -1
-        for t in self._tokens:
+        for idx, t in enumerate(self._tokens):
             if t.kind == "EOF":
                 break
             if t.kind == "PUNCT" and t.value == "(":
@@ -82,31 +86,30 @@ class _StatementSplitter:
                 depth -= 1
             if t.kind == "PUNCT" and t.value == ";" and depth == 0:
                 if cur:
-                    statements.append(cur)
+                    statements.append((cur, t.pos))
                     cur = []
                 continue
-            line = _line_of(self._sql, t.pos)
+            line = bisect.bisect_left(newlines, t.pos)
             if (
                 depth == 0
                 and cur
                 and line > last_line
-                and self._starts_statement(t)
+                and self._starts_statement(t, idx)
             ):
-                statements.append(cur)
+                statements.append((cur, t.pos))
                 cur = []
             cur.append(t)
             last_line = line
         if cur:
-            statements.append(cur)
+            statements.append((cur, len(self._sql)))
         return statements
 
-    def _starts_statement(self, t: Token) -> bool:
+    def _starts_statement(self, t: Token, idx: int) -> bool:
         if t.kind != "IDENT" and t.kind != "QIDENT":
             return False
         if t.kind == "IDENT" and t.upper in _STATEMENT_KEYWORDS:
             return True
         # assignment: IDENT [?]= ...
-        idx = self._tokens.index(t)  # tokens are unique objects
         nxt = self._tokens[idx + 1] if idx + 1 < len(self._tokens) else None
         if nxt is not None and nxt.kind == "OP" and nxt.value in ("=",):
             return True
@@ -123,9 +126,10 @@ class _StatementSplitter:
 class _StatementParser:
     """Cursor over one statement's tokens."""
 
-    def __init__(self, tokens: List[Token], sql: str):
+    def __init__(self, tokens: List[Token], sql: str, end_pos: Optional[int] = None):
         self._tokens = tokens + [Token("EOF", "", -1)]
         self._sql = sql
+        self._end_pos = len(sql) if end_pos is None else end_pos
         self._i = 0
 
     def peek(self, offset: int = 0) -> Token:
@@ -173,8 +177,7 @@ class _StatementParser:
                 break
             self.next()
             if self.done():
-                nxt = self._tokens[self._i - 1]
-                end = nxt.pos + len(nxt.value) + (2 if nxt.kind in ("STRING", "QIDENT") else 0)
+                end = self._end_pos
         return self._sql[start:end].strip()
 
     def parse_params(self) -> Dict[str, Any]:
@@ -251,8 +254,8 @@ class FugueSQLCompiler:
         return self._last
 
     def compile(self, sql: str) -> None:
-        for tokens in _StatementSplitter(sql).split():
-            self._compile_statement(_StatementParser(tokens, sql), sql)
+        for tokens, end_pos in _StatementSplitter(sql).split():
+            self._compile_statement(_StatementParser(tokens, sql, end_pos), sql)
 
     # ------------------------------------------------------------------
     def _resolve_df(self, name: str) -> WorkflowDataFrame:
@@ -279,12 +282,17 @@ class FugueSQLCompiler:
         assign: Optional[str] = None
         t0, t1 = p.peek(0), p.peek(1)
         if t0.kind in ("IDENT", "QIDENT") and (
-            (t1.kind == "OP" and t1.value == "=")
-            and (t0.kind == "QIDENT" or t0.upper not in _STATEMENT_KEYWORDS)
+            t0.kind == "QIDENT" or t0.upper not in _STATEMENT_KEYWORDS
         ):
-            assign = t0.value
-            p.next()
-            p.next()
+            if t1.kind == "OP" and t1.value == "=":
+                assign = t0.value
+                p.next()
+                p.next()
+            elif t1.value == "?" and p.peek(2).value == "=":
+                assign = t0.value  # `?=` treated as plain assignment
+                p.next()
+                p.next()
+                p.next()
         result = self._statement_body(p, sql)
         # postfix modifiers on the produced frame
         while result is not None and not p.done():
@@ -607,7 +615,10 @@ class FugueSQLCompiler:
         self._wf.show(*dfs, n=n, with_count=with_count, title=title)
 
     def _stmt_select(self, p: _StatementParser, sql: str) -> WorkflowDataFrame:
-        text = p.text_until()  # rest of the statement
+        text = p.text_until(
+            "PERSIST", "BROADCAST", "CHECKPOINT", "DETERMINISTIC", "WEAK",
+            "STRONG", "YIELD",
+        )
         # find referenced table names: parse and collect Scan nodes
         from .parser import SQLParser, Scan as ScanNode, PlanNode, JoinNode, Subquery, SelectNode, SetOpNode, SortNode, LimitNode
 
@@ -764,21 +775,18 @@ def _inject_from(text: str) -> str:
 
 def _interleave(sql: str, mapping: Dict[str, WorkflowDataFrame]) -> List[Any]:
     """Split SQL text into [str, WorkflowDataFrame, str, ...] pieces for
-    ``FugueWorkflow.select`` (word-boundary replacement of table names)."""
-    import re
-
+    ``FugueWorkflow.select``. Token-aware: only IDENT tokens are replaced,
+    never content inside string literals or quoted identifiers."""
     if len(mapping) == 0:
         return [sql]
-    pattern = re.compile(
-        r"\b(" + "|".join(re.escape(n) for n in sorted(mapping, key=len, reverse=True)) + r")\b"
-    )
     parts: List[Any] = []
     pos = 0
-    for m in pattern.finditer(sql):
-        if m.start() > pos:
-            parts.append(sql[pos : m.start()])
-        parts.append(mapping[m.group(0)])
-        pos = m.end()
+    for t in tokenize(sql):
+        if t.kind == "IDENT" and t.value in mapping:
+            if t.pos > pos:
+                parts.append(sql[pos : t.pos])
+            parts.append(mapping[t.value])
+            pos = t.pos + len(t.value)
     if pos < len(sql):
         parts.append(sql[pos:])
     return parts

@@ -52,18 +52,45 @@ _KEYWORD_STOP = {
 # ---------------------------------------------------------------------------
 
 
-@dataclass
 class Token:
-    kind: str  # IDENT QIDENT STRING NUMBER OP PUNCT EOF
-    value: str
-    pos: int
+    """One SQL token. kinds: IDENT QIDENT STRING NUMBER OP PUNCT EOF."""
+
+    __slots__ = ("kind", "value", "pos", "_upper")
+
+    def __init__(self, kind: str, value: str, pos: int):
+        self.kind = kind
+        self.value = value
+        self.pos = pos
+        self._upper: Optional[str] = None
 
     @property
     def upper(self) -> str:
-        return self.value.upper()
+        if self._upper is None:
+            self._upper = self.value.upper()
+        return self._upper
+
+    def __repr__(self) -> str:
+        return f"Token({self.kind},{self.value!r},{self.pos})"
 
 
 def tokenize(sql: str) -> List[Token]:
+    """Tokenize SQL; uses the C++ tokenizer when available
+    (``fugue_tpu/native``), falling back to pure Python."""
+    import os
+
+    if os.environ.get("FUGUE_TPU_DISABLE_NATIVE", "") != "1":
+        try:
+            from ..native import tokenize_native
+
+            res = tokenize_native(sql)
+            if res is not None:
+                return res
+        except ImportError:  # pragma: no cover
+            pass
+    return _tokenize_py(sql)
+
+
+def _tokenize_py(sql: str) -> List[Token]:
     tokens: List[Token] = []
     i, n = 0, len(sql)
     while i < n:
@@ -709,23 +736,13 @@ class SQLParser:
 
     def _make_func(self, name: str, args: List[ColumnExpr], distinct: bool) -> ColumnExpr:
         if name in _AGG_FUNCS:
+            from ..column.functions import _SameTypeUnaryAggFuncExpr, _UnaryAggFuncExpr
+
             a = args[0] if len(args) > 0 else lit(1)
-            if name == "SUM":
-                e: ColumnExpr = ff.sum(a)
-            elif name == "COUNT":
-                e = ff.count_distinct(a) if distinct else ff.count(a)
-                return e
-            elif name in ("AVG", "MEAN"):
-                e = ff.avg(a)
-            elif name == "MIN":
-                e = ff.min(a)
-            elif name == "MAX":
-                e = ff.max(a)
-            elif name == "FIRST":
-                e = ff.first(a)
-            elif name == "LAST":
-                e = ff.last(a)
-            return e
+            fn = {"MEAN": "AVG"}.get(name, name)
+            if fn in ("SUM", "COUNT", "AVG"):
+                return _UnaryAggFuncExpr(fn, a, arg_distinct=distinct)
+            return _SameTypeUnaryAggFuncExpr(fn, a, arg_distinct=distinct)
         return function(name, *args, arg_distinct=distinct)
 
 

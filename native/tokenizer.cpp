@@ -1,0 +1,157 @@
+// Fast SQL tokenizer — native backend for fugue_tpu/sql/parser.py.
+//
+// Parity story: the reference ships an optional C++ ANTLR parser
+// ("cpp_sql_parser" extra, reference setup.py:50) for FugueSQL parsing
+// speed; this is the equivalent native layer for the in-tree SQL stack.
+// Exposed through a minimal C ABI consumed via ctypes (no pybind11 in the
+// build image).
+//
+// Token kinds (must match fugue_tpu/sql/parser.py):
+//   0 IDENT, 1 QIDENT, 2 STRING, 3 NUMBER, 4 OP, 5 PUNCT
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cctype>
+
+extern "C" {
+
+struct FtToken {
+    int kind;
+    int pos;   // byte offset of the token in the source
+    int len;   // byte length INCLUDING quotes for STRING/QIDENT
+};
+
+// Returns 0 on success; negative = error code, err holds a message.
+// On success *out_tokens is a malloc'd array of *out_count tokens the
+// caller must release with ft_free.
+int ft_tokenize(const char* sql, int n, FtToken** out_tokens, int* out_count,
+                char* err, int errcap) {
+    int cap = 256;
+    int count = 0;
+    FtToken* toks = (FtToken*)malloc(sizeof(FtToken) * cap);
+    if (toks == nullptr) return -1;
+
+    auto push = [&](int kind, int pos, int len) -> bool {
+        if (count == cap) {
+            cap *= 2;
+            FtToken* nt = (FtToken*)realloc(toks, sizeof(FtToken) * cap);
+            if (nt == nullptr) return false;
+            toks = nt;
+        }
+        toks[count].kind = kind;
+        toks[count].pos = pos;
+        toks[count].len = len;
+        ++count;
+        return true;
+    };
+
+    auto fail = [&](const char* msg, int pos) -> int {
+        if (err != nullptr && errcap > 0) {
+            snprintf(err, (size_t)errcap, "%s at %d", msg, pos);
+        }
+        free(toks);
+        return -2;
+    };
+
+    int i = 0;
+    while (i < n) {
+        unsigned char c = (unsigned char)sql[i];
+        if (isspace(c)) { ++i; continue; }
+        // comments
+        if (c == '-' && i + 1 < n && sql[i + 1] == '-') {
+            while (i < n && sql[i] != '\n') ++i;
+            continue;
+        }
+        if (c == '/' && i + 1 < n && sql[i + 1] == '*') {
+            int j = i + 2;
+            while (j + 1 < n && !(sql[j] == '*' && sql[j + 1] == '/')) ++j;
+            i = (j + 1 < n) ? j + 2 : n;
+            continue;
+        }
+        // strings (' or "), '' escapes
+        if (c == '\'' || c == '"') {
+            char q = (char)c;
+            int j = i + 1;
+            while (j < n) {
+                if (sql[j] == q) {
+                    if (j + 1 < n && sql[j + 1] == q) { j += 2; continue; }
+                    break;
+                }
+                ++j;
+            }
+            if (j >= n) return fail("unterminated string", i);
+            if (!push(2, i, j - i + 1)) return -1;
+            i = j + 1;
+            continue;
+        }
+        // backtick identifiers
+        if (c == '`') {
+            int j = i + 1;
+            while (j < n && sql[j] != '`') ++j;
+            if (j >= n) return fail("unterminated identifier", i);
+            if (!push(1, i, j - i + 1)) return -1;
+            i = j + 1;
+            continue;
+        }
+        // numbers
+        if (isdigit(c) || (c == '.' && i + 1 < n && isdigit((unsigned char)sql[i + 1]))) {
+            int j = i;
+            bool seen_dot = false;
+            while (j < n && (isdigit((unsigned char)sql[j]) ||
+                             (sql[j] == '.' && !seen_dot))) {
+                if (sql[j] == '.') seen_dot = true;
+                ++j;
+            }
+            if (j < n && (sql[j] == 'e' || sql[j] == 'E')) {
+                int k = j + 1;
+                if (k < n && (sql[k] == '+' || sql[k] == '-')) ++k;
+                if (k < n && isdigit((unsigned char)sql[k])) {
+                    while (k < n && isdigit((unsigned char)sql[k])) ++k;
+                    j = k;
+                }
+            }
+            if (!push(3, i, j - i)) return -1;
+            i = j;
+            continue;
+        }
+        // identifiers
+        if (isalpha(c) || c == '_') {
+            int j = i;
+            while (j < n && (isalnum((unsigned char)sql[j]) || sql[j] == '_')) ++j;
+            if (!push(0, i, j - i)) return -1;
+            i = j;
+            continue;
+        }
+        // two-char operators
+        if (i + 1 < n) {
+            char a = sql[i], b = sql[i + 1];
+            if ((a == '<' && (b == '>' || b == '=')) ||
+                (a == '>' && b == '=') ||
+                (a == '!' && b == '=') ||
+                (a == '=' && b == '=')) {
+                if (!push(4, i, 2)) return -1;
+                i += 2;
+                continue;
+            }
+        }
+        if (strchr("+-*/%<>=", c) != nullptr) {
+            if (!push(4, i, 1)) return -1;
+            ++i;
+            continue;
+        }
+        if (strchr("(),.;[]{}:?", c) != nullptr) {
+            if (!push(5, i, 1)) return -1;
+            ++i;
+            continue;
+        }
+        return fail("unexpected character", i);
+    }
+    *out_tokens = toks;
+    *out_count = count;
+    return 0;
+}
+
+void ft_free(FtToken* tokens) { free(tokens); }
+
+}  // extern "C"
