@@ -91,13 +91,22 @@ def load_df(
         fmt = parser.file_format
         if fmt == "parquet" and not parser.has_glob:
             # pyarrow datasets handle directories + hive partitioning
-            tables.append(_load_parquet(p, columns, kwargs))
+            tbl = _load_parquet(p, columns, kwargs)
+            sidecar = os.path.join(p, _SCHEMA_SIDECAR)
+            if columns is None and os.path.isdir(p) and os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    saved = Schema(f.read().strip())
+                tbl = tbl.select(saved.names).cast(saved.pa_schema)
+            tables.append(tbl)
         else:
             for f in parser.find_files():
                 tables.append(_LOADERS[fmt](f, columns, kwargs))
     assert_or_throw(len(tables) > 0, FugueDataFrameInitError(f"no files found at {path}"))
     tbl = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
     return tbl, Schema(tbl.schema)
+
+
+_SCHEMA_SIDECAR = "_fugue_schema"
 
 
 def save_df(
@@ -109,20 +118,10 @@ def save_df(
     **kwargs: Any,
 ) -> None:
     parser = FileParser(path, format_hint)
-    if partition_cols:
-        assert_or_throw(
-            parser.file_format == "parquet",
-            NotImplementedError("partitioned saves support parquet only"),
-        )
-        if os.path.exists(path):
-            if mode == "error":
-                raise FugueInvalidOperation(f"{path} already exists")
-            if mode == "overwrite":
-                import shutil
-
-                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
-        pq.write_to_dataset(df, path, partition_cols=partition_cols, **kwargs)
-        return
+    assert_or_throw(
+        mode in ("overwrite", "append", "error"),
+        lambda: NotImplementedError(f"invalid save mode {mode}"),
+    )
     if os.path.exists(path):
         if mode == "error":
             raise FugueInvalidOperation(f"{path} already exists")
@@ -133,10 +132,17 @@ def save_df(
                 shutil.rmtree(path)
             else:
                 os.remove(path)
-        elif mode == "append":
-            pass
-        else:
-            raise NotImplementedError(f"invalid save mode {mode}")
+    if partition_cols:
+        assert_or_throw(
+            parser.file_format == "parquet",
+            NotImplementedError("partitioned saves support parquet only"),
+        )
+        pq.write_to_dataset(df, path, partition_cols=partition_cols, **kwargs)
+        # sidecar records the exact schema so loads restore order and types
+        # (hive discovery otherwise infers partition keys as int32, last)
+        with open(os.path.join(path, _SCHEMA_SIDECAR), "w") as f:
+            f.write(str(Schema(df.schema)))
+        return
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     _SAVERS[parser.file_format](df, path, mode, kwargs)
 

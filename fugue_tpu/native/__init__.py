@@ -92,6 +92,10 @@ def tokenize_native(sql: str) -> Optional[List[Any]]:
     from ..sql.parser import Token
 
     raw = sql.encode("utf-8")
+    if len(raw) != len(sql):
+        # non-ASCII input: the C tokenizer is ASCII-only while the python
+        # tokenizer accepts unicode identifiers/whitespace — fall back
+        return None
     out_tokens = ctypes.POINTER(_FtToken)()
     out_count = ctypes.c_int(0)
     err = ctypes.create_string_buffer(256)

@@ -57,10 +57,6 @@ _CLAUSE_KEYWORDS = {
 }
 
 
-def _line_of(sql: str, pos: int) -> int:
-    return sql.count("\n", 0, pos)
-
-
 class _StatementSplitter:
     """Split a token stream into statements at depth-0 boundaries."""
 
@@ -782,11 +778,12 @@ def _interleave(sql: str, mapping: Dict[str, WorkflowDataFrame]) -> List[Any]:
     parts: List[Any] = []
     pos = 0
     for t in tokenize(sql):
-        if t.kind == "IDENT" and t.value in mapping:
+        if t.kind in ("IDENT", "QIDENT") and t.value in mapping:
             if t.pos > pos:
                 parts.append(sql[pos : t.pos])
             parts.append(mapping[t.value])
-            pos = t.pos + len(t.value)
+            # QIDENT spans include the backticks in the source
+            pos = t.pos + len(t.value) + (2 if t.kind == "QIDENT" else 0)
     if pos < len(sql):
         parts.append(sql[pos:])
     return parts

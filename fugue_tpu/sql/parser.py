@@ -32,7 +32,6 @@ from ..column.expressions import (
     _LikeExpr,
     _UnaryOpExpr,
 )
-from ..column import functions as ff
 from ..exceptions import FugueSQLSyntaxError
 from ..schema import to_pa_datatype
 
