@@ -39,7 +39,11 @@ def main() -> None:
     if not _tpu_reachable():
         # accelerator tunnel is down: fall back to the virtual CPU mesh so
         # the benchmark still completes and reports
-        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")

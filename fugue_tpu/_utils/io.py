@@ -122,6 +122,12 @@ def save_df(
         mode in ("overwrite", "append", "error"),
         lambda: NotImplementedError(f"invalid save mode {mode}"),
     )
+    if partition_cols:
+        # validate BEFORE any destructive step
+        assert_or_throw(
+            parser.file_format == "parquet",
+            NotImplementedError("partitioned saves support parquet only"),
+        )
     if os.path.exists(path):
         if mode == "error":
             raise FugueInvalidOperation(f"{path} already exists")
@@ -133,10 +139,6 @@ def save_df(
             else:
                 os.remove(path)
     if partition_cols:
-        assert_or_throw(
-            parser.file_format == "parquet",
-            NotImplementedError("partitioned saves support parquet only"),
-        )
         pq.write_to_dataset(df, path, partition_cols=partition_cols, **kwargs)
         # sidecar records the exact schema so loads restore order and types
         # (hive discovery otherwise infers partition keys as int32, last)

@@ -83,6 +83,7 @@ class JaxDataFrame(DataFrame):
             self._device_cols = _internal["device_cols"]
             self._host_tbl = _internal["host_tbl"]
             self._row_count = _internal["row_count"]
+            self._valid_mask = _internal.get("valid_mask", None)
             super().__init__(_internal["schema"])
             return
         s = None if schema is None else (schema if isinstance(schema, Schema) else Schema(schema))
@@ -95,6 +96,7 @@ class JaxDataFrame(DataFrame):
             self._device_cols = dict(df._device_cols)
             self._host_tbl = df._host_tbl
             self._row_count = df._row_count
+            self._valid_mask = df._valid_mask
             super().__init__(df.schema)
             return
         if isinstance(df, DataFrame):
@@ -123,6 +125,9 @@ class JaxDataFrame(DataFrame):
         self._device_cols = device_cols
         self._host_tbl = host_tbl
         self._row_count = n
+        # None = tail-padding semantics (rows [0, row_count) valid); a device
+        # bool array = explicit per-row validity (result of device filters)
+        self._valid_mask = None
 
     # -- properties ---------------------------------------------------------
     @property
@@ -136,6 +141,23 @@ class JaxDataFrame(DataFrame):
     @property
     def host_table(self) -> Optional[pa.Table]:
         return self._host_tbl
+
+    @property
+    def valid_mask(self) -> Any:
+        """Explicit device validity mask, or None for tail-padding."""
+        return self._valid_mask
+
+    def device_valid_mask(self) -> Any:
+        """A device bool array marking valid rows (built from the row count
+        when no explicit mask exists)."""
+        if self._valid_mask is not None:
+            return self._valid_mask
+        import numpy as _np
+
+        from ..ops.segment import _get_compiled_mask
+
+        template = next(iter(self._device_cols.values()))
+        return _get_compiled_mask(self._mesh)(template, _np.int64(self._row_count))
 
     @property
     def native(self) -> Dict[str, Any]:
@@ -155,27 +177,36 @@ class JaxDataFrame(DataFrame):
 
     @property
     def empty(self) -> bool:
-        return self._row_count == 0
+        return self.count() == 0
 
     def count(self) -> int:
+        if self._valid_mask is not None and self._row_count < 0:
+            import jax as _jax
+
+            self._row_count = int(_jax.device_get(self._valid_mask.sum()))
         return self._row_count
 
     # -- conversions --------------------------------------------------------
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
         import jax
 
+        mask: Optional[np.ndarray] = None
+        if self._valid_mask is not None:
+            mask = np.asarray(jax.device_get(self._valid_mask))
         arrays: List[pa.Array] = []
         for f in self.schema.fields:
             if f.name in self._device_cols:
-                host = np.asarray(jax.device_get(self._device_cols[f.name]))[
-                    : self._row_count
-                ]
+                host = np.asarray(jax.device_get(self._device_cols[f.name]))
+                host = host[mask] if mask is not None else host[: self._row_count]
                 arrays.append(pa.array(host).cast(f.type, safe=False))
             else:
                 assert self._host_tbl is not None
-                arrays.append(
-                    self._host_tbl.column(f.name).slice(0, self._row_count).combine_chunks()
-                )
+                col = self._host_tbl.column(f.name)
+                if mask is not None:
+                    col = col.filter(pa.array(mask[: len(col)]))
+                else:
+                    col = col.slice(0, self._row_count)
+                arrays.append(col.combine_chunks())
         return pa.Table.from_arrays(arrays, schema=self.schema.pa_schema)
 
     def as_local_bounded(self) -> LocalBoundedDataFrame:
@@ -209,6 +240,7 @@ class JaxDataFrame(DataFrame):
                 device_cols=device_cols,
                 host_tbl=host_tbl,
                 row_count=self._row_count,
+                valid_mask=self._valid_mask,
                 schema=schema,
             ),
         )

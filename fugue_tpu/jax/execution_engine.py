@@ -123,8 +123,7 @@ class JaxMapEngine(MapEngine):
             FugueInvalidOperation("no device columns to map on the compiled path"),
         )
         mesh = df.mesh
-        template = next(iter(cols.values()))
-        cols["__valid__"] = _get_compiled_mask(mesh)(template, np_.int64(df.count()))
+        cols["__valid__"] = df.device_valid_mask()
         cache = self.execution_engine._jit_cache  # type: ignore
         key = ("map", fn, mesh)
         if key not in cache:
@@ -141,12 +140,14 @@ class JaxMapEngine(MapEngine):
         )
         out = {k: v for k, v in out.items() if k != "__valid__"}
         first = next(iter(out.values()))
+        same_len = first.shape[0] == next(iter(cols.values())).shape[0]
         return JaxDataFrame(
             mesh=mesh,
             _internal=dict(
                 device_cols=dict(out),
                 host_tbl=None,
-                row_count=df.count() if first.shape[0] == next(iter(cols.values())).shape[0] else first.shape[0],
+                row_count=df.count() if same_len else first.shape[0],
+                valid_mask=df.valid_mask if same_len else None,
                 schema=output_schema,
             ),
         )
@@ -239,6 +240,44 @@ class JaxExecutionEngine(ExecutionEngine):
         return jdf
 
     # ---- relational ops ----------------------------------------------------
+    def filter(self, df: DataFrame, condition: Any) -> DataFrame:
+        """Device filter: the condition becomes a validity mask — no rows
+        move, downstream device ops and host conversion honor the mask."""
+        from ..column.jax_eval import can_evaluate_on_device
+
+        jdf = self.to_df(df)
+        if (
+            isinstance(jdf, JaxDataFrame)
+            and len(jdf.device_cols) > 0
+            and jdf.host_table is None
+            and can_evaluate_on_device(condition, jdf.device_cols)
+        ):
+            import jax
+
+            cache_key = ("filter", condition.__uuid__(), jdf.mesh)
+            if cache_key not in self._jit_cache:
+
+                def apply_mask(cols: Dict[str, Any], valid: Any) -> Any:
+                    from ..column.jax_eval import evaluate_jnp
+
+                    return valid & evaluate_jnp(cols, condition)
+
+                self._jit_cache[cache_key] = jax.jit(apply_mask)
+            new_mask = self._jit_cache[cache_key](
+                dict(jdf.device_cols), jdf.device_valid_mask()
+            )
+            return JaxDataFrame(
+                mesh=self._mesh,
+                _internal=dict(
+                    device_cols=dict(jdf.device_cols),
+                    host_tbl=None,
+                    row_count=-1,  # computed lazily from the mask
+                    valid_mask=new_mask,
+                    schema=jdf.schema,
+                ),
+            )
+        return self._back(self._host_engine.filter(self._host(df), condition))
+
     def _host(self, df: DataFrame) -> DataFrame:
         return df.as_local_bounded() if isinstance(df, JaxDataFrame) else self._host_engine.to_df(df)
 
@@ -315,6 +354,43 @@ class JaxExecutionEngine(ExecutionEngine):
     ) -> DataFrame:
         jdf = self.to_df(df)
         sc = cols.replace_wildcard(jdf.schema)
+        # WHERE lowers to a device mask filter when possible
+        if (
+            where is not None
+            and len(jdf.device_cols) > 0
+            and jdf.host_table is None
+            and can_evaluate_on_device(where, jdf.device_cols)
+        ):
+            jdf = self.filter(jdf, where)  # type: ignore
+            where = None
+        # grouped aggregation lowers to the device groupby
+        if where is None and sc.has_agg and not sc.is_distinct:
+            from ..collections.partition import PartitionSpec as _PSpec
+            from ..column.expressions import _NamedColumnExpr as _Named
+            from ..column.functions import is_agg as _is_agg
+
+            keys = [c for c in sc.all_cols if not _is_agg(c)]
+            aggs = [c for c in sc.all_cols if _is_agg(c)]
+            if (
+                len(keys) > 0
+                and all(
+                    isinstance(k, _Named) and k.as_type is None and k.as_name == ""
+                    for k in keys
+                )
+            ):
+                spec = _PSpec(by=[k.name for k in keys])
+                if _plan_device_agg(jdf, spec.partition_by, aggs) is not None:
+                    res = self.aggregate(jdf, spec, aggs)
+                    if having is not None:
+                        # the aggregate result is O(groups): host filter
+                        res = self._back(
+                            self._host_engine.filter(self._host(res), having)
+                        )
+                    # restore declared projection order
+                    order = [c.output_name for c in sc.all_cols]
+                    if res.schema.names != order:
+                        res = res[order]
+                    return res
         if (
             where is None
             and having is None
@@ -325,7 +401,9 @@ class JaxExecutionEngine(ExecutionEngine):
         ):
             return self._device_project(jdf, sc)
         return self._back(
-            self._host_engine.select(self._host(df), cols, where=where, having=having)
+            self._host_engine.select(
+                self._host(jdf), cols, where=where, having=having
+            )
         )
 
     def _device_project(self, jdf: JaxDataFrame, sc: SelectColumns) -> DataFrame:
@@ -363,7 +441,8 @@ class JaxExecutionEngine(ExecutionEngine):
             _internal=dict(
                 device_cols=out_cols,
                 host_tbl=None,
-                row_count=jdf.count(),
+                row_count=jdf._row_count,
+                valid_mask=jdf.valid_mask,
                 schema=schema,
             ),
         )
@@ -390,7 +469,7 @@ class JaxExecutionEngine(ExecutionEngine):
             self._mesh,
             key_cols,
             [(name, agg, jdf.device_cols[src]) for name, agg, src in plan["aggs"]],
-            jdf.count(),
+            jdf.device_valid_mask(),
         )
         merged = merge_partials(partials, keys, [(n, a) for n, a, _ in plan["aggs"]])
         # finalize: avg = sum/count; restore declared output order and names

@@ -108,25 +108,11 @@ def tokenize_native(sql: str) -> Optional[List[Any]]:
         return None  # allocation failure → python fallback
     try:
         result: List[Token] = []
-        # byte offsets need mapping back to str indexes; fast path: pure
-        # ascii means identity, otherwise build an offset table
-        if len(raw) == len(sql):
-            def b2s(off: int) -> int:
-                return off
-        else:
-            table = {}
-            boff = 0
-            for si, ch in enumerate(sql):
-                table[boff] = si
-                boff += len(ch.encode("utf-8"))
-            table[boff] = len(sql)
-
-            def b2s(off: int) -> int:
-                return table[off]
-
+        # input is guaranteed ASCII here (early return above), so byte
+        # offsets are str indexes
         for i in range(out_count.value):
             t = out_tokens[i]
-            s, e = b2s(t.pos), b2s(t.pos + t.len)
+            s, e = t.pos, t.pos + t.len
             kind = ("IDENT", "QIDENT", "STRING", "NUMBER", "OP", "PUNCT")[t.kind]
             text = sql[s:e]
             if kind == "STRING":

@@ -303,7 +303,7 @@ def device_groupby_partials(
     mesh: Any,
     key_cols: Dict[str, Any],
     agg_cols: List[Tuple[str, str, Any]],
-    row_count: int,
+    valid_mask: Any,
 ) -> "Any":
     """Run the device phase; return a host pandas frame of per-shard-group
     partials. Strategy: single int key with a small range → dense scatter-add
@@ -317,9 +317,8 @@ def device_groupby_partials(
     from ..parallel.mesh import ROW_AXIS
 
     key_names = list(key_cols.keys())
-    template0 = next(iter(key_cols.values()))
-    valid0 = _get_compiled_mask(mesh)(template0, np_.int64(row_count))
-    if len(key_names) == 1 and row_count > 0:
+    valid0 = valid_mask
+    if len(key_names) == 1:
         import jax.numpy as jnp
 
         karr = key_cols[key_names[0]]

@@ -1,0 +1,83 @@
+"""fa.* facade coverage: every engine verb through the functional API."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.column import col, functions as f
+
+
+@pytest.fixture
+def pdf():
+    return pd.DataFrame({"a": [1, 2, 2, None], "b": ["x", "y", "y", "z"]})
+
+
+class TestFacadeVerbs:
+    def test_dataset_utils(self, pdf):
+        assert fa.count(pdf) == 4
+        assert not fa.is_empty(pdf)
+        assert fa.is_local(pdf) and fa.is_bounded(pdf)
+        assert fa.get_column_names(pdf) == ["a", "b"]
+        assert str(fa.get_schema(pdf)) == "a:double,b:str"
+
+    def test_frame_utils(self, pdf):
+        r = fa.rename(pdf, {"a": "aa"})
+        assert list(r.columns) == ["aa", "b"]
+        assert list(fa.drop_columns(pdf, ["a"]).columns) == ["b"]
+        assert list(fa.select_columns(pdf, ["b"]).columns) == ["b"]
+        assert fa.head(pdf, 2).shape[0] == 2
+        assert fa.peek_dict(pdf) == {"a": 1.0, "b": "x"}
+        assert len(fa.as_dicts(pdf)) == 4
+
+    def test_relational_verbs(self, pdf):
+        assert len(fa.distinct(pdf)) == 3
+        assert len(fa.dropna(pdf)) == 3
+        assert fa.fillna(pdf, 0.0, subset=["a"])["a"].tolist() == [1, 2, 2, 0]
+        assert len(fa.sample(pdf, n=2, seed=1)) == 2
+        assert fa.take(pdf, 1, presort="a desc")["b"].tolist() == ["y"]
+
+    def test_select_filter_assign_aggregate(self, pdf):
+        s = fa.select(pdf, "b", (col("a") * 2).alias("a2"))
+        assert list(s.columns) == ["b", "a2"]
+        flt = fa.filter(pdf, col("a").not_null())
+        assert len(flt) == 3
+        asg = fa.assign(pdf, c=col("a") + 1)
+        assert "c" in asg.columns
+        agg = fa.aggregate(pdf, partition_by="b", n=f.count(col("a")))
+        # COUNT skips nulls: group z has a=None -> 0
+        assert sorted(agg["n"].tolist()) == [0, 1, 2]
+
+    def test_joins_setops(self):
+        d1 = pd.DataFrame({"k": [1, 2]})
+        d2 = pd.DataFrame({"k": [2, 3]})
+        assert fa.union(d1, d2)["k"].tolist() == [1, 2, 3]
+        assert fa.intersect(d1, d2)["k"].tolist() == [2]
+        assert fa.subtract(d1, d2)["k"].tolist() == [1]
+        d3 = pd.DataFrame({"k": [2], "v": ["x"]})
+        lj = fa.left_outer_join(d1, d3)
+        assert lj["k"].tolist() == [1, 2]
+        assert lj["v"].isna().tolist() == [True, False]
+        assert len(fa.cross_join(d1, d2.rename(columns={"k": "j"}))) == 4
+
+    def test_save_load_roundtrip(self, tmp_path, pdf):
+        p = str(tmp_path / "x.parquet")
+        fa.save(pdf, p)
+        back = fa.load(p, as_fugue=True)
+        assert back.count() == 4
+
+    def test_engine_context_nesting(self):
+        with fa.engine_context("native") as e1:
+            with fa.engine_context("pandas") as e2:
+                assert fa.get_context_engine() is e2
+            assert fa.get_context_engine() is e1
+
+    def test_global_engine(self):
+        e = fa.set_global_engine("native")
+        try:
+            assert fa.get_context_engine() is e
+        finally:
+            fa.clear_global_engine()
+
+    def test_parallelism(self):
+        assert fa.get_current_parallelism(engine="native") == 1

@@ -1,0 +1,112 @@
+"""Device-pipeline tests: mask filters, select decomposition, SQL lowering."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import SelectColumns, col, functions as f, lit
+from fugue_tpu.jax import JaxDataFrame, JaxExecutionEngine
+
+
+@pytest.fixture
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture
+def pdf():
+    rng = np.random.default_rng(7)
+    return pd.DataFrame({"k": rng.integers(0, 10, 5003), "v": rng.random(5003)})
+
+
+class TestDeviceFilter:
+    def test_filter_is_mask_only(self, engine, pdf):
+        jdf = engine.to_df(pdf)
+        flt = engine.filter(jdf, col("v") > 0.5)
+        assert isinstance(flt, JaxDataFrame)
+        assert flt.valid_mask is not None
+        # the underlying device buffers are the SAME objects — no data moved
+        assert flt.device_cols["v"] is jdf.device_cols["v"]
+        exp = pdf[pdf["v"] > 0.5]
+        assert flt.count() == len(exp)
+
+    def test_filter_roundtrip_values(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf), col("v") > 0.9)
+        exp = pdf[pdf["v"] > 0.9].reset_index(drop=True)
+        got = flt.as_pandas().reset_index(drop=True)
+        assert np.allclose(got["v"], exp["v"])
+
+    def test_chained_filters(self, engine, pdf):
+        e1 = engine.filter(engine.to_df(pdf), col("v") > 0.3)
+        e2 = engine.filter(e1, col("k") < 5)
+        exp = pdf[(pdf["v"] > 0.3) & (pdf["k"] < 5)]
+        assert e2.count() == len(exp)
+
+    def test_filter_none_pass(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf), col("v") > 2.0)
+        assert flt.count() == 0
+        assert flt.as_pandas().shape[0] == 0
+
+    def test_filtered_aggregate(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf), col("v") > 0.5)
+        agg = engine.aggregate(
+            flt, PartitionSpec(by=["k"]),
+            [f.sum(col("v")).alias("s"), f.count(col("v")).alias("n")],
+        )
+        g = agg.as_pandas().sort_values("k").reset_index(drop=True)
+        x = (
+            pdf[pdf["v"] > 0.5]
+            .groupby("k")
+            .agg(s=("v", "sum"), n=("v", "count"))
+            .reset_index()
+        )
+        assert np.allclose(g["s"], x["s"]) and (g["n"] == x["n"]).all()
+
+    def test_filtered_projection(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf), col("k") == 3)
+        proj = engine.select(flt, SelectColumns(col("k"), (col("v") * 2).alias("v2")))
+        exp = pdf[pdf["k"] == 3]
+        assert proj.count() == len(exp)
+        assert np.allclose(
+            np.sort(proj.as_pandas()["v2"]), np.sort(exp["v"] * 2)
+        )
+
+    def test_filtered_compiled_map(self, engine, pdf):
+        from typing import Dict
+
+        import jax
+
+        def double(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            return {"v2": cols["v"] * 2.0}
+
+        flt = engine.filter(engine.to_df(pdf), col("v") > 0.5)
+        out = fa.transform(flt, double, schema="v2:double", engine=engine, as_fugue=True)
+        exp = pdf[pdf["v"] > 0.5]
+        assert out.count() == len(exp)
+
+
+class TestSelectDecomposition:
+    def test_where_groupby_having_on_device(self, engine, pdf):
+        res = engine.select(
+            engine.to_df(pdf),
+            SelectColumns(col("k"), f.sum(col("v")).alias("s"), f.count(col("v")).alias("n")),
+            where=col("v") > 0.5,
+            having=col("n") > 100,
+        )
+        exp = pdf[pdf["v"] > 0.5].groupby("k").agg(s=("v", "sum"), n=("v", "count")).reset_index()
+        exp = exp[exp["n"] > 100]
+        g = res.as_pandas().sort_values("k").reset_index(drop=True)
+        assert np.allclose(g["s"], exp.sort_values("k")["s"])
+
+    def test_sql_full_pipeline(self, pdf):
+        r = fa.fugue_sql(
+            "SELECT k, SUM(v) AS s FROM pdf WHERE k < 5 GROUP BY k ORDER BY k",
+            engine="jax",
+        )
+        g = r.to_pandas() if hasattr(r, "to_pandas") else r
+        exp = pdf[pdf["k"] < 5].groupby("k").agg(s=("v", "sum")).reset_index()
+        assert np.allclose(g["s"], exp["s"])
