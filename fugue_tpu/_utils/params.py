@@ -18,7 +18,7 @@ _BOOL_FALSE = {"false", "no", "0", "off"}
 
 
 def _convert(value: Any, expected: Type[T]) -> T:
-    if expected is object or isinstance(value, expected):
+    if value is None or expected is object or isinstance(value, expected):
         return value  # type: ignore
     if expected is bool:
         if isinstance(value, (int, float)):

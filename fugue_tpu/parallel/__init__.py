@@ -1,0 +1,21 @@
+from .mesh import (
+    ROW_AXIS,
+    build_mesh,
+    num_row_shards,
+    pad_rows,
+    replicated_sharding,
+    row_sharding,
+)
+from .distributed import initialize_distributed, is_multihost, process_info
+
+__all__ = [
+    "ROW_AXIS",
+    "build_mesh",
+    "num_row_shards",
+    "pad_rows",
+    "replicated_sharding",
+    "row_sharding",
+    "initialize_distributed",
+    "is_multihost",
+    "process_info",
+]

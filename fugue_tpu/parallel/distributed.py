@@ -1,0 +1,63 @@
+"""Multi-host (pod-scale) initialization.
+
+The reference delegates multi-node transport to Spark/Dask/Ray clusters
+(SURVEY §5.8); here the equivalent is JAX's multi-controller runtime: every
+host runs the same program, ``jax.distributed.initialize`` wires the hosts
+into one runtime, and ``jax.devices()`` then spans the whole pod slice — so
+the engine's mesh (built over all devices) automatically scales collectives
+over ICI within a slice and DCN across slices with no framework changes.
+
+Typical pod usage::
+
+    from fugue_tpu.parallel import initialize_distributed
+    import fugue_tpu.api as fa
+
+    initialize_distributed()          # on every host (env-driven on TPU)
+    with fa.engine_context("tpu"):
+        fa.transform(...)             # rows sharded across ALL hosts' chips
+"""
+
+from typing import Any, Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs: Any,
+) -> None:
+    """Initialize the multi-host JAX runtime (idempotent).
+
+    On TPU pods all arguments are discovered from the environment; on other
+    platforms pass coordinator/num_processes/process_id explicitly.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as e:  # already initialized → idempotent
+        if "already" not in str(e).lower():
+            raise
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    """Host-level topology facts for logging/diagnostics."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -193,3 +193,90 @@ class TestFugueSQL:
             engine="jax",
         )
         assert r["s"].tolist() == [3.0, 3.0]
+
+
+def _make_df_for_fsql(n: int = 3) -> pd.DataFrame:
+    return pd.DataFrame({"a": range(n)})
+
+
+class TestFugueSQLStatements:
+    """The statement forms beyond SELECT/TRANSFORM."""
+
+    def test_create_using(self):
+        r = fugue_sql("CREATE USING _make_df_for_fsql(n=5)", as_fugue=True)
+        assert r.count() == 5
+
+    def test_process_output(self):
+        def double(df: pd.DataFrame) -> pd.DataFrame:
+            df["a"] = df["a"] * 2
+            return df
+
+        seen = []
+
+        def sink(df: pd.DataFrame) -> None:
+            seen.append(len(df))
+
+        r = fugue_sql(
+            """
+            x = CREATE USING _make_df_for_fsql(n=4)
+            y = PROCESS x USING double SCHEMA a:long
+            OUTPUT y USING sink
+            SELECT * FROM y WHERE a > 2
+            """,
+            as_fugue=True,
+        )
+        assert seen == [4]
+        assert r.as_array() == [[4], [6]]
+
+    def test_outtransform_prepartition(self):
+        counts = []
+
+        def tally(df: pd.DataFrame) -> None:
+            counts.append(len(df))
+
+        fugue_sql_flow(
+            """
+            x = CREATE [[1],[1],[2]] SCHEMA k:long
+            OUTTRANSFORM x PREPARTITION BY k USING tally
+            """
+        ).run()
+        assert sorted(counts) == [1, 2]
+
+    def test_transform_presort(self):
+        def first_row(df: pd.DataFrame) -> pd.DataFrame:
+            return df.head(1)
+
+        r = fugue_sql(
+            """
+            x = CREATE [[1,5],[1,9],[2,3]] SCHEMA k:long,v:long
+            TRANSFORM x PREPARTITION BY k PRESORT v DESC USING first_row SCHEMA *
+            """,
+            as_fugue=True,
+        )
+        assert sorted(r.as_array()) == [[1, 9], [2, 3]]
+
+    def test_sample_statement(self):
+        r = fugue_sql(
+            """
+            x = CREATE USING _make_df_for_fsql(n=100)
+            SAMPLE 10 ROWS SEED 42 FROM x
+            """,
+            as_fugue=True,
+        )
+        assert r.count() == 10
+
+    def test_yield_file(self, tmp_path):
+        dag = fugue_sql_flow(
+            """
+            x = CREATE [[7]] SCHEMA z:long
+            YIELD FILE AS saved
+            """
+        )
+        res = dag.run("native", {"fugue.workflow.checkpoint.path": str(tmp_path / "ck")})
+        assert res.yields["saved"].storage_type == "file"
+        assert os.path.exists(res.yields["saved"].name)
+
+    def test_print_without_title(self, capsys):
+        fugue_sql_flow("x = CREATE [[1]] SCHEMA z:long\nPRINT x").run()
+        out = capsys.readouterr().out
+        assert "None" not in out and "z:long" in out
