@@ -41,7 +41,8 @@ def initialize_distributed(
             **kwargs,
         )
     except RuntimeError as e:  # already initialized → idempotent
-        if "already" not in str(e).lower():
+        msg = str(e).lower()
+        if "already initialized" not in msg and "called once" not in msg:
             raise
 
 
