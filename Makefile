@@ -1,0 +1,13 @@
+.PHONY: test bench dryrun native
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c "import jax; jax.config.update('jax_platforms','cpu'); import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+native:
+	python -c "from fugue_tpu.native import build; assert build(force=True)"

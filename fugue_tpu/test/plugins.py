@@ -17,6 +17,15 @@ from ..execution.factory import make_execution_engine
 _TEST_BACKENDS: Dict[str, "FugueTestBackend"] = {}
 
 
+def pytest_configure(config: Any) -> None:
+    """pytest11 hook: register one marker per known backend so
+    ``fugue_test_suite(..., mark_test=True)`` classes filter cleanly."""
+    for name in _TEST_BACKENDS:
+        config.addinivalue_line(
+            "markers", f"{name}: tests bound to the {name!r} fugue-tpu backend"
+        )
+
+
 class FugueTestBackend:
     """Subclass + register to expose a backend to the test harness."""
 

@@ -5,8 +5,9 @@ suite classes run against every engine/frame implementation — in-tree and
 third-party — so distributed semantics are exercised uniformly.
 """
 
+from .bag_suite import BagTests
+from .builtin_suite import BuiltInTests
 from .dataframe_suite import DataFrameTests
 from .execution_suite import ExecutionEngineTests
-from .builtin_suite import BuiltInTests
 
-__all__ = ["DataFrameTests", "ExecutionEngineTests", "BuiltInTests"]
+__all__ = ["BagTests", "BuiltInTests", "DataFrameTests", "ExecutionEngineTests"]

@@ -1,0 +1,39 @@
+"""Bag contract suite (reference ``fugue_test/bag_suite.py``)."""
+
+from typing import Any
+
+import pytest
+
+from fugue_tpu.bag.bag import Bag
+from fugue_tpu.exceptions import FugueDatasetEmptyError
+
+
+class BagTests:
+    """Subclass ``BagTests.Tests`` and implement ``bag()``."""
+
+    class Tests:
+        def bag(self, data: Any = None) -> Bag:
+            raise NotImplementedError
+
+        def test_init(self):
+            b = self.bag([1, "x", None])
+            assert not b.empty
+            assert b.count() == 3
+            assert b.is_local and b.is_bounded
+
+        def test_empty(self):
+            b = self.bag([])
+            assert b.empty
+            with pytest.raises(FugueDatasetEmptyError):
+                b.peek()
+
+        def test_peek_as_array(self):
+            b = self.bag([5, 6])
+            assert b.peek() == 5
+            assert b.as_array() == [5, 6]
+
+        def test_head(self):
+            b = self.bag(list(range(10)))
+            h = b.head(3)
+            assert h.as_array() == [0, 1, 2]
+            assert h.is_bounded

@@ -119,3 +119,49 @@ class TestHarnessPlugins:
             with get_test_backend(b).engine_context() as e:
                 seen.append(type(e).__name__)
         assert len(seen) == 2
+
+
+class TestWorkflowDeterminism:
+    """uuid stability — the foundation of deterministic checkpoints
+    (reference ``tests/fugue/workflow/test_workflow_determinism.py``)."""
+
+    def test_same_dag_same_uuid(self):
+        import pandas as pd
+
+        def make() -> pd.DataFrame:
+            return pd.DataFrame({"a": [1]})
+
+        def build():
+            dag = FugueWorkflow()
+            x = dag.create(make)
+            return dag, x.drop(["a"], if_exists=True)
+
+        d1, a1 = build()
+        d2, a2 = build()
+        assert a1.spec_uuid() == a2.spec_uuid()
+        assert d1.spec_uuid() == d2.spec_uuid()
+
+    def test_param_changes_uuid(self):
+        import pandas as pd
+
+        def make(n: int = 1) -> pd.DataFrame:
+            return pd.DataFrame({"a": [n]})
+
+        dag = FugueWorkflow()
+        a = dag.create(make, params={"n": 1})
+        b = dag.create(make, params={"n": 2})
+        c = dag.create(make, params={"n": 1})
+        assert a.spec_uuid() != b.spec_uuid()
+        assert a.spec_uuid() == c.spec_uuid()
+
+    def test_partition_changes_uuid(self):
+        import pandas as pd
+
+        def ident(df: pd.DataFrame) -> pd.DataFrame:
+            return df
+
+        dag = FugueWorkflow()
+        src = dag.df([[1]], "a:long")
+        t1 = src.partition_by("a").transform(ident, schema="*")
+        t2 = src.transform(ident, schema="*")
+        assert t1.spec_uuid() != t2.spec_uuid()
