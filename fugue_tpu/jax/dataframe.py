@@ -160,8 +160,11 @@ class JaxDataFrame(DataFrame):
         return _get_compiled_mask(self._mesh)(template, _np.int64(self._row_count))
 
     @property
-    def native(self) -> Dict[str, Any]:
-        return self._device_cols
+    def native(self) -> "JaxDataFrame":
+        # the device frame IS the native object (like a Ray dataset); raw
+        # buffers are available via .device_cols — returning those from
+        # fa.* verbs would leak padding rows and drop the validity mask
+        return self
 
     @property
     def is_local(self) -> bool:

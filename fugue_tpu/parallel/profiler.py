@@ -1,0 +1,54 @@
+"""Tracing/profiling hooks (SURVEY §5.1).
+
+The reference has no built-in tracing (closest: the engine ``log``); the
+TPU build adds native JAX profiler integration: traces capture XLA
+compilation, device compute, and transfers, viewable in TensorBoard or
+Perfetto.
+
+Usage::
+
+    from fugue_tpu.parallel.profiler import profile
+
+    with profile("/tmp/fugue_trace"):
+        fa.transform(df, fn, engine="tpu")
+
+Conf-driven: setting ``fugue.tpu.profile.dir`` on an engine makes
+``profiled_engine_context`` trace everything inside the context.
+"""
+
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+FUGUE_TPU_CONF_PROFILE_DIR = "fugue.tpu.profile.dir"
+
+
+@contextmanager
+def profile(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a JAX profiler trace into ``log_dir``."""
+    import jax
+
+    with jax.profiler.trace(log_dir, create_perfetto_trace=False):
+        yield
+
+
+@contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a region in the trace (shows up in the profiler timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextmanager
+def profiled_engine_context(engine: Any = None, conf: Any = None) -> Iterator[Any]:
+    """``fa.engine_context`` that traces when the conf sets a profile dir."""
+    from ..execution.api import engine_context
+
+    with engine_context(engine, conf) as e:
+        log_dir = e.conf.get(FUGUE_TPU_CONF_PROFILE_DIR, "")
+        if log_dir == "":
+            yield e
+        else:
+            with profile(log_dir):
+                yield e

@@ -7,23 +7,31 @@ with ``@with_backend("native", "jax")``.
 """
 
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import TYPE_CHECKING, Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import pytest
 
-from ..execution.execution_engine import ExecutionEngine
-from ..execution.factory import make_execution_engine
+if TYPE_CHECKING:  # pragma: no cover - typing only; keep plugin import cheap
+    from ..execution.execution_engine import ExecutionEngine
 
 _TEST_BACKENDS: Dict[str, "FugueTestBackend"] = {}
+_PYTEST_CONFIG: List[Any] = [None]
 
 
-def pytest_configure(config: Any) -> None:
-    """pytest11 hook: register one marker per known backend so
-    ``fugue_test_suite(..., mark_test=True)`` classes filter cleanly."""
-    for name in _TEST_BACKENDS:
+def _register_marker(name: str) -> None:
+    config = _PYTEST_CONFIG[0]
+    if config is not None:
         config.addinivalue_line(
             "markers", f"{name}: tests bound to the {name!r} fugue-tpu backend"
         )
+
+
+def pytest_configure(config: Any) -> None:
+    """pytest11 hook: register one marker per backend; the config is kept so
+    backends registered later (nested conftests) also get markers."""
+    _PYTEST_CONFIG[0] = config
+    for name in _TEST_BACKENDS:
+        _register_marker(name)
 
 
 class FugueTestBackend:
@@ -40,7 +48,9 @@ class FugueTestBackend:
 
     @classmethod
     @contextmanager
-    def engine_context(cls) -> Iterator[ExecutionEngine]:
+    def engine_context(cls) -> Iterator["ExecutionEngine"]:
+        from ..execution.factory import make_execution_engine
+
         with cls.session_context() as session:
             engine = make_execution_engine(session, dict(cls.session_conf))
             try:
@@ -53,6 +63,7 @@ def fugue_test_backend(cls: type) -> type:
     """Class decorator registering a FugueTestBackend."""
     assert issubclass(cls, FugueTestBackend) and cls.name != ""
     _TEST_BACKENDS[cls.name] = cls  # type: ignore
+    _register_marker(cls.name)  # covers backends registered after configure
     return cls
 
 
@@ -71,7 +82,9 @@ def fugue_test_suite(backend: str, mark_test: bool = False) -> Callable[[type], 
     def deco(cls: type) -> type:
         backend_cls = get_test_backend(backend)
 
-        def make_engine(self: Any) -> ExecutionEngine:
+        def make_engine(self: Any) -> "ExecutionEngine":
+            from ..execution.factory import make_execution_engine
+
             return make_execution_engine(backend, dict(backend_cls.session_conf))
 
         cls.make_engine = make_engine  # type: ignore

@@ -1,0 +1,19 @@
+"""pytest11 entry-point shim for fugue-tpu.
+
+Keeps pytest startup safe and cheap-ish: the heavy fugue_tpu import happens
+inside pytest_configure behind a guard, so a broken accelerator stack in the
+environment can never prevent unrelated pytest runs from starting. Opt out
+entirely with FUGUE_TPU_DISABLE_PYTEST_PLUGIN=1.
+"""
+
+import os
+
+
+def pytest_configure(config):  # noqa: ANN001
+    if os.environ.get("FUGUE_TPU_DISABLE_PYTEST_PLUGIN", "") == "1":
+        return
+    try:
+        from fugue_tpu.test.plugins import pytest_configure as impl
+    except Exception:
+        return  # never break pytest startup for other projects
+    impl(config)
