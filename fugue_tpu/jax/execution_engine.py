@@ -304,6 +304,33 @@ class JaxExecutionEngine(ExecutionEngine):
         )
 
     def distinct(self, df: DataFrame) -> DataFrame:
+        """Device distinct when every column is device-resident: the groupby
+        kernel with a presence count — keys of the merged partials are the
+        distinct rows."""
+        from ..ops.segment import device_groupby_partials
+
+        jdf = self.to_df(df)
+        if (
+            isinstance(jdf, JaxDataFrame)
+            and jdf.host_table is None
+            and len(jdf.device_cols) > 0
+            and len(jdf.device_cols) == len(jdf.schema)
+        ):
+            cols = dict(jdf.device_cols)
+            first = next(iter(cols))
+            count_name = "__n__"
+            while count_name in jdf.schema:  # never shadow a user column
+                count_name = "_" + count_name
+            partials = device_groupby_partials(
+                self._mesh,
+                cols,
+                [(count_name, "count", cols[first])],
+                jdf.device_valid_mask(),
+            )
+            res = partials.drop(columns=[count_name]).drop_duplicates(
+                ignore_index=True
+            )
+            return self.to_df(PandasDataFrame(res, jdf.schema))
         return self._back(self._host_engine.distinct(self._host(df)))
 
     def dropna(self, df, how="any", thresh=None, subset=None) -> DataFrame:

@@ -23,7 +23,7 @@ FUGUE_TPU_CONF_PROFILE_DIR = "fugue.tpu.profile.dir"
 
 
 @contextmanager
-def profile(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+def profile(log_dir: str) -> Iterator[None]:
     """Capture a JAX profiler trace into ``log_dir``."""
     import jax
 

@@ -14,6 +14,9 @@ def pytest_configure(config):  # noqa: ANN001
         return
     try:
         from fugue_tpu.test.plugins import pytest_configure as impl
-    except Exception:
-        return  # never break pytest startup for other projects
+    except Exception as e:  # never break pytest startup for other projects
+        import warnings
+
+        warnings.warn(f"fugue-tpu pytest plugin disabled: {e!r}", stacklevel=1)
+        return
     impl(config)

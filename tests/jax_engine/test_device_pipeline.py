@@ -110,3 +110,25 @@ class TestSelectDecomposition:
         g = r.to_pandas() if hasattr(r, "to_pandas") else r
         exp = pdf[pdf["k"] < 5].groupby("k").agg(s=("v", "sum")).reset_index()
         assert np.allclose(g["s"], exp["s"])
+
+
+class TestDeviceDistinct:
+    def test_single_int_col(self, engine):
+        pdf = pd.DataFrame({"k": np.random.default_rng(0).integers(0, 50, 10000)})
+        d = engine.distinct(engine.to_df(pdf))
+        assert sorted(d.as_pandas()["k"]) == sorted(pdf["k"].drop_duplicates())
+
+    def test_multi_int_cols(self, engine):
+        rng = np.random.default_rng(1)
+        pdf = pd.DataFrame({"a": rng.integers(0, 5, 3000), "b": rng.integers(0, 5, 3000)})
+        d = engine.distinct(engine.to_df(pdf))
+        assert len(d.as_pandas()) == len(pdf.drop_duplicates())
+
+    def test_after_filter(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf[["k"]]), col("k") < 4)
+        d = engine.distinct(flt)
+        assert sorted(d.as_pandas()["k"]) == [0, 1, 2, 3]
+
+    def test_host_fallback_for_strings(self, engine):
+        d = engine.distinct(engine.to_df(pd.DataFrame({"s": ["a", "b", "a"]})))
+        assert sorted(d.as_pandas()["s"]) == ["a", "b"]
