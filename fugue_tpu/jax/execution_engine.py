@@ -285,6 +285,65 @@ class JaxExecutionEngine(ExecutionEngine):
         return self.to_df(df)
 
     def join(self, df1, df2, how: str, on=None) -> DataFrame:
+        """INNER fact×dim joins on a single int key run on device
+        (broadcast hash join, ``ops/join.py``); everything else host."""
+        from ..dataframe.utils import get_join_schemas, parse_join_type
+        from ..ops.join import device_broadcast_inner_join
+
+        if parse_join_type(how) == "inner" and isinstance(df1, DataFrame) and isinstance(df2, DataFrame):
+            import pyarrow as pa_
+
+            try:
+                key_schema, out_schema = get_join_schemas(df1, df2, how="inner", on=on)
+            except Exception:
+                key_schema = None
+            # cheap pre-checks on schemas BEFORE any device conversion
+            if (
+                key_schema is not None
+                and len(key_schema) == 1
+                and pa_.types.is_integer(key_schema.types[0])
+                and key_schema.names[0] in df2.schema
+                and pa_.types.is_integer(df2.schema[key_schema.names[0]].type)
+            ):
+                j1, j2 = self.to_df(df1), self.to_df(df2)
+            else:
+                j1 = j2 = None
+            if (
+                j1 is not None
+                and isinstance(j1, JaxDataFrame)
+                and isinstance(j2, JaxDataFrame)
+                and j2.host_table is None
+                and len(j2.device_cols) == len(j2.schema)
+                and key_schema.names[0] in j1.device_cols
+            ):
+                import jax
+
+                key = key_schema.names[0]
+                rep = replicated_sharding(self._mesh)
+                dim_cols = {
+                    n: jax.device_put(a, rep) for n, a in j2.device_cols.items()
+                }
+                dim_valid = jax.device_put(j2.device_valid_mask(), rep)
+                res = device_broadcast_inner_join(
+                    self._mesh,
+                    dict(j1.device_cols),
+                    j1.device_valid_mask(),
+                    key,
+                    dim_cols,
+                    dim_valid,
+                )
+                if res is not None:
+                    new_cols, match = res
+                    return JaxDataFrame(
+                        mesh=self._mesh,
+                        _internal=dict(
+                            device_cols={n: new_cols[n] for n in out_schema.names if n in new_cols},
+                            host_tbl=j1.host_table,
+                            row_count=-1,
+                            valid_mask=match,
+                            schema=out_schema,
+                        ),
+                    )
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
 
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
@@ -334,11 +393,96 @@ class JaxExecutionEngine(ExecutionEngine):
         return self._back(self._host_engine.distinct(self._host(df)))
 
     def dropna(self, df, how="any", thresh=None, subset=None) -> DataFrame:
+        """All-device frames: nulls only exist as NaN in float columns
+        (ingest rejects nullable columns, but device compute can produce
+        NaN) — drop by extending the validity mask, zero data movement."""
+        jdf = self.to_df(df)
+        if (
+            isinstance(jdf, JaxDataFrame)
+            and jdf.host_table is None
+            and len(jdf.device_cols) == len(jdf.schema)
+        ):
+            import jax
+            import jax.numpy as jnp
+
+            cols = subset or jdf.schema.names
+            key = ("dropna", tuple(cols), how, thresh, tuple(jdf.schema.names))
+            if key not in self._jit_cache:
+
+                def compute(dcols: Dict[str, Any], valid: Any) -> Any:
+                    notnull = [
+                        ~jnp.isnan(dcols[c])
+                        if jnp.issubdtype(dcols[c].dtype, jnp.floating)
+                        else jnp.ones_like(valid)
+                        for c in cols
+                    ]
+                    stacked = jnp.stack(notnull, axis=0)
+                    if thresh is not None:
+                        keep = stacked.sum(axis=0) >= thresh
+                    elif how == "all":
+                        keep = stacked.any(axis=0)
+                    else:
+                        keep = stacked.all(axis=0)
+                    return valid & keep
+
+                self._jit_cache[key] = jax.jit(compute)
+            mask = self._jit_cache[key](dict(jdf.device_cols), jdf.device_valid_mask())
+            return JaxDataFrame(
+                mesh=self._mesh,
+                _internal=dict(
+                    device_cols=dict(jdf.device_cols),
+                    host_tbl=None,
+                    row_count=-1,
+                    valid_mask=mask,
+                    schema=jdf.schema,
+                ),
+            )
         return self._back(
             self._host_engine.dropna(self._host(df), how=how, thresh=thresh, subset=subset)
         )
 
     def fillna(self, df, value, subset=None) -> DataFrame:
+        """All-device frames: fill NaN in float columns on device."""
+        jdf = self.to_df(df)
+        if (
+            isinstance(jdf, JaxDataFrame)
+            and jdf.host_table is None
+            and len(jdf.device_cols) == len(jdf.schema)
+        ):
+            import jax
+            import jax.numpy as jnp
+
+            # validate the value exactly like the host engine (no data moves)
+            empty = ArrowDataFrame(None, jdf.schema)
+            self._host_engine.fillna(empty, value, subset=subset)
+            if isinstance(value, dict):
+                fills = dict(value)
+            else:
+                fills = {c: value for c in (subset or jdf.schema.names)}
+            fill_sig = tuple(sorted((k, float(v)) for k, v in fills.items() if k in jdf.schema))
+            key = ("fillna", fill_sig, tuple(jdf.schema.names))
+            if key not in self._jit_cache:
+
+                def compute(dcols: Dict[str, Any]) -> Dict[str, Any]:
+                    out = dict(dcols)
+                    for c, v in fills.items():
+                        arr = dcols.get(c)
+                        if arr is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                            out[c] = jnp.where(jnp.isnan(arr), jnp.asarray(v, arr.dtype), arr)
+                    return out
+
+                self._jit_cache[key] = jax.jit(compute)
+            new_cols = self._jit_cache[key](dict(jdf.device_cols))
+            return JaxDataFrame(
+                mesh=self._mesh,
+                _internal=dict(
+                    device_cols=new_cols,
+                    host_tbl=None,
+                    row_count=jdf._row_count,
+                    valid_mask=jdf.valid_mask,
+                    schema=jdf.schema,
+                ),
+            )
         return self._back(self._host_engine.fillna(self._host(df), value, subset=subset))
 
     def sample(self, df, n=None, frac=None, replace=False, seed=None) -> DataFrame:

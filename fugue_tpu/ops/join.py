@@ -1,0 +1,123 @@
+"""Device broadcast join (fact × dimension).
+
+The reference delegates joins to backend SQL/shuffles (SURVEY §2.9); the
+first device join here is the common warehouse shape: a large row-sharded
+fact frame INNER-joined to a small dimension frame on a unique int key.
+
+Design (no data-dependent shapes anywhere):
+
+- the dimension side is replicated to every device and sorted by key once;
+- each shard binary-searches its fact keys against the sorted dim keys
+  (``searchsorted`` → O(n log m) on the VPU);
+- dim value columns gather by the found index; misses stay as garbage rows
+  but the frame's validity mask is ANDed with the match mask — the same
+  zero-copy mechanism device filters use, so an inner join never needs
+  compaction or null representation.
+
+Uniqueness of the dim key is verified on device (adjacent-equal check after
+the sort); non-unique or oversized dims fall back to the host join.
+"""
+
+from typing import Any, Dict
+
+_JOIN_CACHE: Dict[Any, Any] = {}
+
+# dimension sides larger than this stay on the host join path
+MAX_BROADCAST_ROWS = 1 << 21
+
+
+def _get_compiled_dim_prep(mesh: Any):
+    """Sort the replicated dim key + report uniqueness (cached per mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = ("dimprep", mesh)
+    if key not in _JOIN_CACHE:
+
+        def prep(dim_key: Any, dim_valid: Any):
+            # push invalid rows to the end so they never match
+            big = jnp.where(dim_valid, dim_key, jnp.iinfo(dim_key.dtype).max)
+            order = jnp.argsort(big)
+            k_sorted = big[order]
+            n_valid = dim_valid.sum()
+            dup = jnp.any(
+                (k_sorted[1:] == k_sorted[:-1])
+                & (jnp.arange(1, k_sorted.shape[0]) < n_valid)
+            )
+            return k_sorted, order, n_valid, dup
+
+        _JOIN_CACHE[key] = jax.jit(prep)
+    return _JOIN_CACHE[key]
+
+
+def _get_compiled_probe(mesh: Any, n_values: int):
+    """Probe fact keys against the sorted dim and gather value columns."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS
+
+    key = ("probe", mesh, n_values)
+    if key not in _JOIN_CACHE:
+
+        def probe(fact_key: Any, fact_valid: Any, k_sorted: Any, order: Any,
+                  n_valid: Any, *dim_values: Any):
+            def shard_fn(fk: Any, fv: Any, ks: Any, od: Any, nv: Any, *dvs: Any):
+                idx = jnp.searchsorted(ks, fk)
+                idx_c = jnp.clip(idx, 0, ks.shape[0] - 1)
+                match = (ks[idx_c] == fk) & (idx < nv) & fv
+                src = od[idx_c]
+                gathered = tuple(dv[src] for dv in dvs)
+                return (match,) + gathered
+
+            n_out = 1 + len(dim_values)
+            return jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS), P(ROW_AXIS), P(), P(), P())
+                + tuple(P() for _ in dim_values),
+                out_specs=tuple(P(ROW_AXIS) for _ in range(n_out)),
+            )(fact_key, fact_valid, k_sorted, order, n_valid, *dim_values)
+
+        _JOIN_CACHE[key] = jax.jit(probe)
+    return _JOIN_CACHE[key]
+
+
+def device_broadcast_inner_join(
+    mesh: Any,
+    fact_cols: Dict[str, Any],
+    fact_valid: Any,
+    key_name: str,
+    dim_cols: Dict[str, Any],
+    dim_valid: Any,
+) -> Any:
+    """Returns (new_device_cols, new_valid_mask) or None on fallback.
+
+    ``dim_cols`` must include the key column; all dim columns must be
+    replicated (caller replicates). Fallback (None) when the dim key is not
+    unique.
+    """
+    import jax
+
+    dim_key = dim_cols[key_name]
+    if dim_key.shape[0] > MAX_BROADCAST_ROWS:
+        return None
+    k_sorted, order, n_valid, dup = _get_compiled_dim_prep(mesh)(dim_key, dim_valid)
+    if bool(jax.device_get(dup)):
+        return None  # non-unique dim keys → host join (may multiply rows)
+    value_names = [n for n in dim_cols if n != key_name]
+    probe = _get_compiled_probe(mesh, len(value_names))
+    outs = probe(
+        fact_cols[key_name],
+        fact_valid,
+        k_sorted,
+        order,
+        n_valid,
+        *[dim_cols[n] for n in value_names],
+    )
+    match = outs[0]
+    new_cols = dict(fact_cols)
+    for name, arr in zip(value_names, outs[1:]):
+        new_cols[name] = arr
+    return new_cols, match

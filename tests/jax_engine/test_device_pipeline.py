@@ -132,3 +132,53 @@ class TestDeviceDistinct:
     def test_host_fallback_for_strings(self, engine):
         d = engine.distinct(engine.to_df(pd.DataFrame({"s": ["a", "b", "a"]})))
         assert sorted(d.as_pandas()["s"]) == ["a", "b"]
+
+
+class TestDeviceJoin:
+    def _frames(self, engine):
+        rng = np.random.default_rng(5)
+        fact = pd.DataFrame({"k": rng.integers(0, 50, 20001), "v": rng.random(20001)})
+        dim = pd.DataFrame({"k": np.arange(0, 40), "w": np.arange(0, 40) * 1.0})
+        return fact, dim, engine.to_df(fact), engine.to_df(dim)
+
+    def test_inner_broadcast_join(self, engine):
+        fact, dim, jf, jd = self._frames(engine)
+        res = engine.join(jf, jd, "inner", on=["k"])
+        assert isinstance(res, JaxDataFrame) and res.valid_mask is not None
+        exp = fact.merge(dim, on="k", how="inner")
+        assert res.count() == len(exp)
+        g = res.as_pandas()
+        assert np.allclose(sorted(g["w"] + g["v"]), sorted(exp["w"] + exp["v"]))
+
+    def test_join_then_aggregate_on_device(self, engine):
+        fact, dim, jf, jd = self._frames(engine)
+        res = engine.join(jf, jd, "inner", on=["k"])
+        agg = engine.aggregate(
+            res, PartitionSpec(by=["k"]), [f.sum(col("w")).alias("sw")]
+        )
+        exp = fact.merge(dim, on="k").groupby("k").agg(sw=("w", "sum")).reset_index()
+        g = agg.as_pandas().sort_values("k").reset_index(drop=True)
+        assert np.allclose(g["sw"], exp["sw"])
+
+    def test_filtered_fact_join(self, engine):
+        fact, dim, jf, jd = self._frames(engine)
+        flt = engine.filter(jf, col("v") > 0.5)
+        res = engine.join(flt, jd, "inner", on=["k"])
+        assert res.count() == len(fact[fact["v"] > 0.5].merge(dim, on="k"))
+
+    def test_non_unique_dim_falls_back(self, engine):
+        fact, _, jf, _ = self._frames(engine)
+        dim2 = pd.DataFrame({"k": [1, 1, 2], "x": [1.0, 2.0, 3.0]})
+        res = engine.join(jf, engine.to_df(dim2), "inner", on=["k"])
+        assert res.count() == len(fact.merge(dim2, on="k"))
+
+    def test_no_match_join(self, engine):
+        fact, _, jf, _ = self._frames(engine)
+        dim3 = pd.DataFrame({"k": np.arange(1000, 1010), "y": np.arange(10) * 1.0})
+        res = engine.join(jf, engine.to_df(dim3), "inner", on=["k"])
+        assert res.count() == 0
+
+    def test_left_join_host_path(self, engine):
+        fact, dim, jf, jd = self._frames(engine)
+        res = engine.join(jf, jd, "left_outer", on=["k"])
+        assert res.count() == len(fact)
