@@ -498,3 +498,64 @@ class _LikeExpr(ColumnExpr):
 def case_when(*cases: Any, default: Any = None) -> ColumnExpr:
     """Build CASE WHEN from (condition, value) pairs."""
     return _CaseWhenExpr(list(cases), default=default)
+
+
+class _WindowExpr(ColumnExpr):
+    """``func(args) OVER (PARTITION BY keys ORDER BY sorts)``.
+
+    Not an aggregate: it returns one value per input row.
+    """
+
+    def __init__(
+        self,
+        func: str,
+        args: List[Any],
+        partition_by: List[str],
+        order_by: List[Any],  # (name, ascending) pairs
+    ):
+        super().__init__()
+        self._func = func.upper()
+        self._args = [_to_col(a) for a in args]
+        self._partition_by = list(partition_by)
+        self._order_by = list(order_by)
+
+    @property
+    def func(self) -> str:
+        return self._func
+
+    @property
+    def args(self) -> List[ColumnExpr]:
+        return self._args
+
+    @property
+    def partition_by(self) -> List[str]:
+        return self._partition_by
+
+    @property
+    def order_by(self) -> List[Any]:
+        return self._order_by
+
+    @property
+    def children(self) -> List[ColumnExpr]:
+        return list(self._args)
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self.as_type is not None:
+            return self.as_type
+        if self._func in ("ROW_NUMBER", "RANK", "DENSE_RANK", "COUNT"):
+            return pa.int64()
+        if self._func == "AVG":
+            return pa.float64()
+        if len(self._args) > 0:
+            return self._args[0].infer_type(schema)
+        return None
+
+    def __repr__(self) -> str:
+        inner = ",".join(repr(a) for a in self._args)
+        pb = f" PARTITION BY {self._partition_by}" if self._partition_by else ""
+        ob = f" ORDER BY {self._order_by}" if self._order_by else ""
+        s = f"{self._func}({inner}) OVER ({pb}{ob} )"
+        return s if self.as_name == "" else f"{s} AS {self.as_name}"
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["window", self._func, self._partition_by, repr(self._order_by)]

@@ -1,0 +1,137 @@
+"""Window-function evaluation on pandas.
+
+Supports ``ROW_NUMBER/RANK/DENSE_RANK/LAG/LEAD`` and windowed aggregates
+(``SUM/AVG/MIN/MAX/COUNT/FIRST/LAST``) over ``PARTITION BY`` groups with
+``ORDER BY`` — output order preserves the input row order (SQL semantics).
+
+Aggregates WITH an ORDER BY are running aggregates over a ROWS frame
+(``ROWS UNBOUNDED PRECEDING .. CURRENT ROW``); without ORDER BY they cover
+the whole partition. NULL order keys rank last.
+"""
+
+from typing import Any, List
+
+import numpy as np
+import pandas as pd
+
+from ..exceptions import FugueSQLSyntaxError
+from .expressions import _NamedColumnExpr, _WindowExpr
+
+_WINDOW_AGGS = {"SUM": "sum", "AVG": "mean", "MIN": "min", "MAX": "max",
+                "COUNT": "count", "FIRST": "first", "LAST": "last"}
+
+
+def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
+    from .eval import evaluate
+
+    work = pdf.reset_index(drop=True)
+    order_names = [n for n, _ in expr.order_by]
+    asc = [a for _, a in expr.order_by]
+    if len(order_names) > 0:
+        ordered = work.sort_values(order_names, ascending=asc, kind="stable")
+    else:
+        ordered = work
+    if len(expr.partition_by) > 0:
+        grouped = ordered.groupby(expr.partition_by, dropna=False, sort=False)
+    else:
+        grouped = None
+    func = expr.func
+
+    def _arg_series(frame: pd.DataFrame) -> pd.Series:
+        v = evaluate(frame, expr.args[0])
+        if not isinstance(v, pd.Series):
+            v = pd.Series([v] * len(frame), index=frame.index)
+        return v
+
+    if func == "ROW_NUMBER":
+        res = (
+            grouped.cumcount() + 1
+            if grouped is not None
+            else pd.Series(np.arange(1, len(ordered) + 1), index=ordered.index)
+        )
+    elif func in ("RANK", "DENSE_RANK"):
+        if len(order_names) != 1:
+            raise FugueSQLSyntaxError(
+                f"{func} requires exactly one ORDER BY column"
+            )
+        method = "min" if func == "RANK" else "dense"
+        col = ordered[order_names[0]]
+        if grouped is not None:
+            res = col.groupby(
+                [ordered[c] for c in expr.partition_by], dropna=False
+            ).rank(method=method, ascending=asc[0], na_option="bottom")
+        else:
+            res = col.rank(method=method, ascending=asc[0], na_option="bottom")
+        res = res.astype("int64")
+    elif func in ("LAG", "LEAD"):
+        def _scalar_arg(i: int) -> Any:
+            # offset/default may be literals or constant expressions (-1.0)
+            v = evaluate(ordered.head(1), expr.args[i])
+            return v.iloc[0] if isinstance(v, pd.Series) else v
+
+        offset = int(_scalar_arg(1)) if len(expr.args) > 1 else 1
+        default = _scalar_arg(2) if len(expr.args) > 2 else None
+        shift = offset if func == "LAG" else -offset
+        v = _arg_series(ordered)
+        # mark in-partition positions so the default only fills positions
+        # whose offset falls OUTSIDE the partition (genuine NULLs pass through)
+        marker = pd.Series(True, index=ordered.index)
+        if grouped is not None:
+            keys = [ordered[c] for c in expr.partition_by]
+            res = v.groupby(keys, dropna=False).shift(shift)
+            inpart = marker.groupby(keys, dropna=False).shift(shift)
+        else:
+            res = v.shift(shift)
+            inpart = marker.shift(shift)
+        if default is not None:
+            res = res.where(inpart.notna(), default)
+    elif func in _WINDOW_AGGS:
+        v = _arg_series(ordered)
+        keys = (
+            [ordered[c] for c in expr.partition_by] if grouped is not None else None
+        )
+        if len(order_names) > 0:
+            # running aggregate over a ROWS frame up to the current row
+            res = _running_agg(v, keys, func)
+        elif keys is not None:
+            res = v.groupby(keys, dropna=False).transform(_WINDOW_AGGS[func])
+        else:
+            agg = getattr(v, _WINDOW_AGGS[func])() if func != "COUNT" else v.notna().sum()
+            res = pd.Series([agg] * len(ordered), index=ordered.index)
+    else:
+        raise FugueSQLSyntaxError(f"unsupported window function {func}")
+    # restore the original row order
+    return res.reindex(work.index)
+
+
+def _running_agg(v: pd.Series, keys: Any, func: str) -> pd.Series:
+    g = v.groupby(keys, dropna=False) if keys is not None else None
+
+    def _cum(attr: str) -> pd.Series:
+        return getattr(g, attr)() if g is not None else getattr(v, attr)()
+
+    if func == "SUM":
+        return _cum("cumsum")
+    if func == "MIN":
+        return _cum("cummin")
+    if func == "MAX":
+        return _cum("cummax")
+    if func == "COUNT":
+        nn = v.notna()
+        return (
+            nn.groupby(keys, dropna=False).cumsum() if keys is not None else nn.cumsum()
+        ).astype("int64")
+    if func == "AVG":
+        s = _cum("cumsum")
+        nn = v.notna()
+        n = (
+            nn.groupby(keys, dropna=False).cumsum() if keys is not None else nn.cumsum()
+        )
+        return s / n
+    if func == "FIRST":
+        return g.transform("first") if g is not None else pd.Series(
+            [v.iloc[0]] * len(v), index=v.index
+        )
+    if func == "LAST":  # running last = the current row's value
+        return v
+    raise FugueSQLSyntaxError(f"unsupported running window aggregate {func}")

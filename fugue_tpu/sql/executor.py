@@ -10,8 +10,8 @@ from typing import Any, Dict, List, Optional
 
 import pandas as pd
 
-from ..column import SelectColumns
-from ..column.expressions import _LitColumnExpr, _NamedColumnExpr
+from ..column import SelectColumns, col as _col
+from ..column.expressions import _LitColumnExpr, _NamedColumnExpr, _WindowExpr
 from ..column.functions import is_agg
 from ..dataframe import ArrayDataFrame, DataFrame, PandasDataFrame
 from ..exceptions import FugueSQLRuntimeError, FugueSQLSyntaxError
@@ -26,6 +26,12 @@ from .parser import (
     SortNode,
     Subquery,
 )
+
+
+def _contains_window(expr: Any) -> bool:
+    if isinstance(expr, _WindowExpr):
+        return True
+    return any(_contains_window(c) for c in getattr(expr, "children", []))
 
 
 class SQLExecutor:
@@ -97,6 +103,10 @@ class SQLExecutor:
 
             return ArrayDataFrame([row], Schema(fields))
         child = self._exec(node.child)
+        # window functions: computed on host after WHERE, before projection
+        has_window = any(_contains_window(c) for c in node.projections)
+        if has_window:
+            return self._exec_windowed_select(node, child)
         cols = SelectColumns(
             *[c.infer_alias() for c in node.projections], arg_distinct=node.distinct
         )
@@ -126,3 +136,51 @@ class SQLExecutor:
                     f"select columns {sorted(proj_keys)}"
                 )
         return e.select(child, cols, where=node.where, having=node.having)
+
+    def _exec_windowed_select(self, node: SelectNode, child: DataFrame) -> DataFrame:
+        """SQL evaluation order: WHERE → window → projection → DISTINCT."""
+        import pyarrow as pa
+
+        from ..column.eval import eval_filter
+        from ..column.window import eval_window
+        from ..schema import Schema
+
+        e = self._engine
+        if len(node.group_by) > 0 or node.having is not None:
+            raise NotImplementedError(
+                "window functions can't be combined with GROUP BY/HAVING yet"
+            )
+        local = e.to_df(child).as_local_bounded()
+        pdf = local.as_pandas()
+        if node.where is not None:
+            pdf = eval_filter(pdf, node.where)
+        schema = local.schema
+        projections: List[Any] = []
+        extra_fields: List[Any] = []
+        for i, c in enumerate(node.projections):
+            w = c
+            # unwrap nothing: only top-level windows supported
+            if isinstance(w, _WindowExpr):
+                name = w.output_name or f"_w{i}"
+                series = eval_window(pdf, w)
+                pdf = pdf.assign(**{f"__w{i}__": series})
+                tp = w.infer_type(schema)
+                extra_fields.append(
+                    pa.field(f"__w{i}__", tp if tp is not None else pa.float64())
+                )
+                sub = _col(f"__w{i}__").alias(name)
+                if w.as_type is not None:
+                    sub = sub.cast(w.as_type)
+                projections.append(sub)
+            elif _contains_window(c):
+                raise NotImplementedError(
+                    "window functions nested inside expressions are not supported"
+                )
+            else:
+                projections.append(c)
+        work_schema = Schema(list(schema.fields) + extra_fields)
+        work = PandasDataFrame(pdf, work_schema)
+        cols = SelectColumns(
+            *[c.infer_alias() for c in projections], arg_distinct=node.distinct
+        )
+        return e.select(work, cols)

@@ -678,6 +678,12 @@ class SQLParser:
                         if not self.eat_punct(","):
                             break
                 self.expect_punct(")")
+                if self.at_kw("OVER"):
+                    if distinct:
+                        raise FugueSQLSyntaxError(
+                            "DISTINCT is not supported in window functions"
+                        )
+                    return self._parse_over(up, args)
                 return self._make_func(up, args, distinct)
             # plain or qualified column ref
             self.next()
@@ -732,6 +738,36 @@ class SQLParser:
             default = self._parse_expr()
         self.expect_kw("END")
         return _CaseWhenExpr(cases, default)
+
+    def _parse_over(self, func: str, args: List[ColumnExpr]) -> ColumnExpr:
+        from ..column.expressions import _WindowExpr
+
+        self.expect_kw("OVER")
+        self.expect_punct("(")
+        partition_by: List[str] = []
+        order_by: List[Any] = []
+        if self.at_kw("PARTITION"):
+            self.next()
+            self.expect_kw("BY")
+            while True:
+                partition_by.append(self._parse_qualified_name())
+                if not self.eat_punct(","):
+                    break
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            while True:
+                name = self._parse_qualified_name()
+                asc = True
+                if self.eat_kw("DESC"):
+                    asc = False
+                else:
+                    self.eat_kw("ASC")
+                order_by.append((name, asc))
+                if not self.eat_punct(","):
+                    break
+        self.expect_punct(")")
+        return _WindowExpr(func, args, partition_by, order_by)
 
     def _make_func(self, name: str, args: List[ColumnExpr], distinct: bool) -> ColumnExpr:
         if name in _AGG_FUNCS:

@@ -280,3 +280,81 @@ class TestFugueSQLStatements:
         fugue_sql_flow("x = CREATE [[1]] SCHEMA z:long\nPRINT x").run()
         out = capsys.readouterr().out
         assert "None" not in out and "z:long" in out
+
+
+class TestWindowFunctions:
+    @pytest.fixture
+    def wdf(self):
+        return pd.DataFrame({"k": [1, 1, 1, 2, 2], "v": [10.0, 30.0, 20.0, 5.0, 15.0]})
+
+    def test_row_number(self, wdf):
+        r = fugue_sql(
+            "SELECT k, v, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v DESC) AS rn "
+            "FROM wdf ORDER BY k, rn"
+        )
+        assert r.values.tolist() == [
+            [1, 30.0, 1], [1, 20.0, 2], [1, 10.0, 3], [2, 15.0, 1], [2, 5.0, 2],
+        ]
+
+    def test_rank_dense_rank(self):
+        t = pd.DataFrame({"s": [10, 10, 5]})
+        r = fugue_sql(
+            "SELECT s, RANK() OVER (ORDER BY s DESC) AS r, "
+            "DENSE_RANK() OVER (ORDER BY s DESC) AS dr FROM t ORDER BY s DESC"
+        )
+        assert r.values.tolist() == [[10, 1, 1], [10, 1, 1], [5, 3, 2]]
+
+    def test_lag_lead(self, wdf):
+        r = fugue_sql(
+            "SELECT k, v, LAG(v, 1, -1.0) OVER (PARTITION BY k ORDER BY v) AS prev "
+            "FROM wdf ORDER BY k, v"
+        )
+        assert r["prev"].tolist() == [-1.0, 10.0, 20.0, -1.0, 5.0]
+
+    def test_windowed_aggregate(self, wdf):
+        r = fugue_sql(
+            "SELECT k, v, SUM(v) OVER (PARTITION BY k) AS total FROM wdf ORDER BY k, v"
+        )
+        assert r["total"].tolist() == [60.0] * 3 + [20.0] * 2
+
+    def test_where_applies_before_window(self, wdf):
+        r = fugue_sql(
+            "SELECT k, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v) AS rn "
+            "FROM wdf WHERE v > 10 ORDER BY k, rn"
+        )
+        assert r.values.tolist() == [[1, 1], [1, 2], [2, 1]]
+
+    def test_nested_window_rejected(self, wdf):
+        with pytest.raises(NotImplementedError):
+            fugue_sql("SELECT SUM(v) OVER (PARTITION BY k) + 1 AS x FROM wdf")
+
+    def test_window_with_groupby_rejected(self, wdf):
+        with pytest.raises(NotImplementedError):
+            fugue_sql(
+                "SELECT k, ROW_NUMBER() OVER (ORDER BY k) AS rn FROM wdf GROUP BY k"
+            )
+
+    def test_running_aggregate(self):
+        t = pd.DataFrame({"k": [1, 1, 1], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT v, SUM(v) OVER (PARTITION BY k ORDER BY v) AS s FROM t ORDER BY v"
+        )
+        assert r["s"].tolist() == [1.0, 3.0, 6.0]
+
+    def test_lag_default_only_outside_partition(self):
+        t = pd.DataFrame({"id": [1, 2, 3], "v": [10.0, None, 20.0]})
+        r = fugue_sql(
+            "SELECT id, LAG(v, 1, -1.0) OVER (ORDER BY id) AS p FROM t ORDER BY id"
+        )
+        got = [None if pd.isna(x) else x for x in r["p"]]
+        assert got == [-1.0, 10.0, None]
+
+    def test_rank_null_order_key(self):
+        t = pd.DataFrame({"s": [10.0, None, 5.0]})
+        r = fugue_sql("SELECT s, RANK() OVER (ORDER BY s) AS r FROM t ORDER BY r")
+        assert r["r"].tolist() == [1, 2, 3]
+
+    def test_distinct_in_window_rejected(self):
+        t = pd.DataFrame({"k": [1], "v": [1.0]})
+        with pytest.raises(FugueSQLSyntaxError):
+            fugue_sql("SELECT SUM(DISTINCT v) OVER (PARTITION BY k) AS s FROM t")
