@@ -15,7 +15,7 @@ import numpy as np
 import pandas as pd
 
 from ..exceptions import FugueSQLSyntaxError
-from .expressions import _NamedColumnExpr, _WindowExpr
+from .expressions import _WindowExpr
 
 _WINDOW_AGGS = {"SUM": "sum", "AVG": "mean", "MIN": "min", "MAX": "max",
                 "COUNT": "count", "FIRST": "first", "LAST": "last"}
@@ -50,18 +50,33 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
             else pd.Series(np.arange(1, len(ordered) + 1), index=ordered.index)
         )
     elif func in ("RANK", "DENSE_RANK"):
-        if len(order_names) != 1:
-            raise FugueSQLSyntaxError(
-                f"{func} requires exactly one ORDER BY column"
-            )
-        method = "min" if func == "RANK" else "dense"
-        col = ordered[order_names[0]]
+        if len(order_names) == 0:
+            raise FugueSQLSyntaxError(f"{func} requires an ORDER BY")
+        # composite ranks from the stable-sorted frame: a rank group starts
+        # wherever any order column differs from the previous row (within
+        # the partition); NULL order keys compare equal to each other
+        okeys = ordered[order_names]
+        changed = (okeys.ne(okeys.shift()) & ~(okeys.isna() & okeys.isna().shift(fill_value=False))).any(axis=1)
         if grouped is not None:
-            res = col.groupby(
-                [ordered[c] for c in expr.partition_by], dropna=False
-            ).rank(method=method, ascending=asc[0], na_option="bottom")
+            pos = grouped.cumcount()
+            part_start = pos == 0
+            changed = changed | part_start
+            if func == "DENSE_RANK":
+                res = changed.groupby(
+                    [ordered[c] for c in expr.partition_by], dropna=False
+                ).cumsum()
+            else:
+                start_pos = pos.where(changed)
+                res = start_pos.groupby(
+                    [ordered[c] for c in expr.partition_by], dropna=False
+                ).ffill() + 1
         else:
-            res = col.rank(method=method, ascending=asc[0], na_option="bottom")
+            changed.iloc[0] = True
+            if func == "DENSE_RANK":
+                res = changed.cumsum()
+            else:
+                pos = pd.Series(np.arange(len(ordered)), index=ordered.index)
+                res = pos.where(changed).ffill() + 1
         res = res.astype("int64")
     elif func in ("LAG", "LEAD"):
         def _scalar_arg(i: int) -> Any:
@@ -94,7 +109,12 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
             # running aggregate over a ROWS frame up to the current row
             res = _running_agg(v, keys, func)
         elif keys is not None:
-            res = v.groupby(keys, dropna=False).transform(_WINDOW_AGGS[func])
+            if func == "FIRST":
+                res = v.groupby(keys, dropna=False).transform(lambda x: x.iloc[0])
+            elif func == "LAST":
+                res = v.groupby(keys, dropna=False).transform(lambda x: x.iloc[-1])
+            else:
+                res = v.groupby(keys, dropna=False).transform(_WINDOW_AGGS[func])
         else:
             agg = getattr(v, _WINDOW_AGGS[func])() if func != "COUNT" else v.notna().sum()
             res = pd.Series([agg] * len(ordered), index=ordered.index)
@@ -105,33 +125,36 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
 
 
 def _running_agg(v: pd.Series, keys: Any, func: str) -> pd.Series:
-    g = v.groupby(keys, dropna=False) if keys is not None else None
+    """SQL aggregates skip NULLs: cumulative ops run over null-filled values
+    and positions with zero preceding non-null rows stay NULL."""
 
-    def _cum(attr: str) -> pd.Series:
-        return getattr(g, attr)() if g is not None else getattr(v, attr)()
+    def _grp(s: pd.Series) -> Any:
+        return s.groupby(keys, dropna=False) if keys is not None else s
 
-    if func == "SUM":
-        return _cum("cumsum")
-    if func == "MIN":
-        return _cum("cummin")
-    if func == "MAX":
-        return _cum("cummax")
+    nn = v.notna()
+    n = _grp(nn).cumsum() if keys is not None else nn.cumsum()
     if func == "COUNT":
-        nn = v.notna()
-        return (
-            nn.groupby(keys, dropna=False).cumsum() if keys is not None else nn.cumsum()
-        ).astype("int64")
-    if func == "AVG":
-        s = _cum("cumsum")
-        nn = v.notna()
-        n = (
-            nn.groupby(keys, dropna=False).cumsum() if keys is not None else nn.cumsum()
-        )
-        return s / n
+        return n.astype("int64")
+    has_null = bool((~nn).any())
+    if func in ("SUM", "AVG"):
+        filled = v.fillna(0) if has_null else v
+        cs = _grp(filled).cumsum() if keys is not None else filled.cumsum()
+        res = cs / n if func == "AVG" else cs
+        return res.where(n > 0) if has_null else res
+    if func in ("MIN", "MAX"):
+        if has_null:
+            fill = np.inf if func == "MIN" else -np.inf
+            filled = v.astype("float64").fillna(fill)
+        else:
+            filled = v
+        attr = "cummin" if func == "MIN" else "cummax"
+        cm = getattr(_grp(filled), attr)() if keys is not None else getattr(filled, attr)()
+        return cm.where(n > 0) if has_null else cm
     if func == "FIRST":
-        return g.transform("first") if g is not None else pd.Series(
-            [v.iloc[0]] * len(v), index=v.index
-        )
+        # FIRST_VALUE = the first ROW's value, nulls included
+        if keys is not None:
+            return v.groupby(keys, dropna=False).transform(lambda x: x.iloc[0])
+        return pd.Series([v.iloc[0]] * len(v), index=v.index)
     if func == "LAST":  # running last = the current row's value
         return v
     raise FugueSQLSyntaxError(f"unsupported running window aggregate {func}")

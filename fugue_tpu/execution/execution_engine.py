@@ -279,9 +279,11 @@ class ExecutionEngine(FugueEngineBase):
     @property
     def rpc_server(self) -> Any:
         if self._rpc_server is None:
-            from ..rpc.base import NativeRPCServer
+            from ..rpc.base import make_rpc_server
 
-            self._rpc_server = NativeRPCServer(self.conf)
+            # conf-driven: "fugue.rpc.server" names the server class
+            # (reference fugue/rpc/base.py:268); default is in-process
+            self._rpc_server = make_rpc_server(self.conf)
         return self._rpc_server
 
     def set_rpc_server(self, server: Any) -> None:

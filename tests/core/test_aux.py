@@ -165,3 +165,64 @@ class TestWorkflowDeterminism:
         t1 = src.partition_by("a").transform(ident, schema="*")
         t2 = src.transform(ident, schema="*")
         assert t1.spec_uuid() != t2.spec_uuid()
+
+
+class TestConfDrivenRPC:
+    def test_engine_uses_conf_server(self):
+        from fugue_tpu.execution import NativeExecutionEngine
+        from fugue_tpu.rpc.http import HttpRPCServer
+
+        e = NativeExecutionEngine(
+            {"fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer"}
+        )
+        assert isinstance(e.rpc_server, HttpRPCServer)
+        e.stop()
+
+    def test_callback_over_conf_http(self):
+        import pandas as pd
+
+        from fugue_tpu.execution import NativeExecutionEngine
+        from fugue_tpu.workflow import transform
+
+        e = NativeExecutionEngine(
+            {"fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer"}
+        )
+        hits = []
+
+        def report(df: pd.DataFrame, cb: callable) -> pd.DataFrame:
+            cb(len(df))
+            return df
+
+        transform(
+            pd.DataFrame({"a": [1, 1, 2]}),
+            report,
+            schema="*",
+            partition={"by": ["a"]},
+            callback=lambda n: hits.append(n),
+            engine=e,
+        )
+        assert sorted(hits) == [1, 2]
+        e.stop()
+
+
+class TestAutoPersist:
+    def test_multi_consumer_auto_persist(self):
+        import pandas as pd
+
+        from fugue_tpu import FugueWorkflow
+        from fugue_tpu.workflow._checkpoint import WeakCheckpoint
+
+        calls = []
+
+        def make() -> pd.DataFrame:
+            calls.append(1)
+            return pd.DataFrame({"a": [1], "b": [2]})
+
+        dag = FugueWorkflow()
+        a = dag.create(make)
+        a.drop(["a"]).show()
+        a.rename({"a": "aa"}).show()
+        dag.run("native", {"fugue.workflow.auto_persist": True})
+        # the shared node got a weak checkpoint applied
+        assert isinstance(a._task.checkpoint, WeakCheckpoint)
+        assert len(calls) == 1

@@ -358,3 +358,26 @@ class TestWindowFunctions:
         t = pd.DataFrame({"k": [1], "v": [1.0]})
         with pytest.raises(FugueSQLSyntaxError):
             fugue_sql("SELECT SUM(DISTINCT v) OVER (PARTITION BY k) AS s FROM t")
+
+    def test_running_agg_skips_nulls(self):
+        t = pd.DataFrame({"id": [1, 2, 3], "v": [1.0, None, 2.0]})
+        r = fugue_sql(
+            "SELECT id, SUM(v) OVER (ORDER BY id) AS s FROM t ORDER BY id"
+        )
+        assert r["s"].tolist() == [1.0, 1.0, 3.0]
+
+    def test_multi_column_rank(self):
+        t = pd.DataFrame({"a": [1, 1, 2], "b": [5, 5, 1]})
+        r = fugue_sql(
+            "SELECT RANK() OVER (ORDER BY a, b) AS r, "
+            "DENSE_RANK() OVER (ORDER BY a, b) AS dr FROM t ORDER BY r"
+        )
+        assert r["r"].tolist() == [1, 1, 3]
+        assert r["dr"].tolist() == [1, 1, 2]
+
+    def test_first_value_includes_null(self):
+        t = pd.DataFrame({"k": [1, 1], "id": [1, 2], "v": [None, 5.0]})
+        r = fugue_sql(
+            "SELECT FIRST(v) OVER (PARTITION BY k ORDER BY id) AS f FROM t"
+        )
+        assert all(pd.isna(x) for x in r["f"])
