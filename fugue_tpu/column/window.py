@@ -53,30 +53,33 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
         if len(order_names) == 0:
             raise FugueSQLSyntaxError(f"{func} requires an ORDER BY")
         # composite ranks from the stable-sorted frame: a rank group starts
-        # wherever any order column differs from the previous row (within
-        # the partition); NULL order keys compare equal to each other
+        # wherever any order column differs from the previous row WITHIN the
+        # partition; NULL order keys compare equal to each other
+        if len(ordered) == 0:
+            return pd.Series([], dtype="int64")
         okeys = ordered[order_names]
-        changed = (okeys.ne(okeys.shift()) & ~(okeys.isna() & okeys.isna().shift(fill_value=False))).any(axis=1)
         if grouped is not None:
+            pkeys = [ordered[c] for c in expr.partition_by]
+            prev = okeys.groupby(pkeys, dropna=False).shift()
             pos = grouped.cumcount()
-            part_start = pos == 0
-            changed = changed | part_start
-            if func == "DENSE_RANK":
-                res = changed.groupby(
-                    [ordered[c] for c in expr.partition_by], dropna=False
-                ).cumsum()
-            else:
-                start_pos = pos.where(changed)
-                res = start_pos.groupby(
-                    [ordered[c] for c in expr.partition_by], dropna=False
-                ).ffill() + 1
         else:
-            changed.iloc[0] = True
-            if func == "DENSE_RANK":
-                res = changed.cumsum()
-            else:
-                pos = pd.Series(np.arange(len(ordered)), index=ordered.index)
-                res = pos.where(changed).ffill() + 1
+            prev = okeys.shift()
+            pos = pd.Series(np.arange(len(ordered)), index=ordered.index)
+        equal_prev = (okeys.eq(prev) | (okeys.isna() & prev.isna())).all(axis=1)
+        changed = ~equal_prev | (pos == 0)
+        if func == "DENSE_RANK":
+            res = (
+                changed.groupby(pkeys, dropna=False).cumsum()
+                if grouped is not None
+                else changed.cumsum()
+            )
+        else:
+            start_pos = pos.where(changed)
+            res = (
+                start_pos.groupby(pkeys, dropna=False).ffill()
+                if grouped is not None
+                else start_pos.ffill()
+            ) + 1
         res = res.astype("int64")
     elif func in ("LAG", "LEAD"):
         def _scalar_arg(i: int) -> Any:
@@ -116,7 +119,14 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
             else:
                 res = v.groupby(keys, dropna=False).transform(_WINDOW_AGGS[func])
         else:
-            agg = getattr(v, _WINDOW_AGGS[func])() if func != "COUNT" else v.notna().sum()
+            if func == "FIRST":
+                agg = v.iloc[0] if len(v) > 0 else None
+            elif func == "LAST":
+                agg = v.iloc[-1] if len(v) > 0 else None
+            elif func == "COUNT":
+                agg = v.notna().sum()
+            else:
+                agg = getattr(v, _WINDOW_AGGS[func])()
             res = pd.Series([agg] * len(ordered), index=ordered.index)
     else:
         raise FugueSQLSyntaxError(f"unsupported window function {func}")
@@ -135,21 +145,15 @@ def _running_agg(v: pd.Series, keys: Any, func: str) -> pd.Series:
     n = _grp(nn).cumsum() if keys is not None else nn.cumsum()
     if func == "COUNT":
         return n.astype("int64")
-    has_null = bool((~nn).any())
-    if func in ("SUM", "AVG"):
-        filled = v.fillna(0) if has_null else v
-        cs = _grp(filled).cumsum() if keys is not None else filled.cumsum()
+    if func in ("SUM", "MIN", "MAX", "AVG"):
+        attr = {"SUM": "cumsum", "AVG": "cumsum", "MIN": "cummin", "MAX": "cummax"}[func]
+        cs = getattr(_grp(v), attr)() if keys is not None else getattr(v, attr)()
+        # pandas cum* skip NaN but leave NaN AT null positions; SQL carries
+        # the previous running value — ffill (dtype-preserving, works for
+        # datetimes too) and mask positions with zero preceding non-nulls
+        cs = cs.groupby(keys, dropna=False).ffill() if keys is not None else cs.ffill()
         res = cs / n if func == "AVG" else cs
-        return res.where(n > 0) if has_null else res
-    if func in ("MIN", "MAX"):
-        if has_null:
-            fill = np.inf if func == "MIN" else -np.inf
-            filled = v.astype("float64").fillna(fill)
-        else:
-            filled = v
-        attr = "cummin" if func == "MIN" else "cummax"
-        cm = getattr(_grp(filled), attr)() if keys is not None else getattr(filled, attr)()
-        return cm.where(n > 0) if has_null else cm
+        return res.where(n > 0)
     if func == "FIRST":
         # FIRST_VALUE = the first ROW's value, nulls included
         if keys is not None:

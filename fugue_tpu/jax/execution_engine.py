@@ -486,11 +486,118 @@ class JaxExecutionEngine(ExecutionEngine):
         return self._back(self._host_engine.fillna(self._host(df), value, subset=subset))
 
     def sample(self, df, n=None, frac=None, replace=False, seed=None) -> DataFrame:
+        """frac-sampling on device: a Bernoulli mask ANDed into validity —
+        zero data movement (n-sampling and replacement go host-side)."""
+        jdf = self.to_df(df)
+        if (
+            frac is not None
+            and n is None
+            and not replace
+            and isinstance(jdf, JaxDataFrame)
+            and jdf.host_table is None
+            and len(jdf.device_cols) > 0
+        ):
+            import jax
+            import jax.numpy as jnp
+
+            key = ("sample", jdf.mesh)
+            if key not in self._jit_cache:
+
+                def compute(valid: Any, rngkey: Any, p: Any) -> Any:
+                    u = jax.random.uniform(rngkey, valid.shape)
+                    return valid & (u < p)
+
+                self._jit_cache[key] = jax.jit(compute)
+            if seed is None:
+                import numpy as np_
+
+                seed = int(np_.random.default_rng().integers(0, 2**31 - 1))
+            rngkey = jax.random.PRNGKey(seed)
+            mask = self._jit_cache[key](
+                jdf.device_valid_mask(), rngkey, float(frac)
+            )
+            return JaxDataFrame(
+                mesh=self._mesh,
+                _internal=dict(
+                    device_cols=dict(jdf.device_cols),
+                    host_tbl=None,
+                    row_count=-1,
+                    valid_mask=mask,
+                    schema=jdf.schema,
+                ),
+            )
         return self._back(
             self._host_engine.sample(self._host(df), n=n, frac=frac, replace=replace, seed=seed)
         )
 
     def take(self, df, n, presort, na_position="last", partition_spec=None) -> DataFrame:
+        """Global top-n by a single device column runs on device: per-shard
+        ``lax.top_k`` then an O(shards·n) host merge."""
+        from ..collections.partition import parse_presort_exp
+
+        jdf = self.to_df(df)
+        sorts = parse_presort_exp(presort) if presort else (
+            partition_spec.presort if partition_spec is not None else {}
+        )
+        no_keys = partition_spec is None or len(partition_spec.partition_by) == 0
+        if (
+            no_keys
+            and len(sorts) == 1
+            and na_position == "last"
+            and isinstance(jdf, JaxDataFrame)
+            and jdf.host_table is None
+            and list(sorts.keys())[0] in jdf.device_cols
+            and n <= 4096
+        ):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np_
+            from jax.sharding import PartitionSpec as JP
+
+            sort_col, asc = next(iter(sorts.items()))
+            k = min(n, next(iter(jdf.device_cols.values())).shape[0] // num_row_shards(self._mesh))
+            if k > 0:
+                mesh = jdf.mesh  # bind locally: the closure must not pin jdf
+                cache_key = ("take", sort_col, asc, k, mesh, tuple(jdf.schema.names))
+                if cache_key not in self._jit_cache:
+
+                    def compute(cols: Dict[str, Any], valid: Any):
+                        def shard_fn(c: Dict[str, Any], v: Any):
+                            s = c[sort_col].astype(jnp.float64)
+                            # NaN sorts last (SQL default): exclude from top_k
+                            ok = v & ~jnp.isnan(s)
+                            score = jnp.where(ok, s if not asc else -s, -jnp.inf)
+                            _, idx = jax.lax.top_k(score, k)
+                            out = {name: arr[idx] for name, arr in c.items()}
+                            out["__take_valid__"] = v[idx] & ok[idx]
+                            return out
+
+                        return jax.shard_map(
+                            shard_fn,
+                            mesh=mesh,
+                            in_specs=(JP(ROW_AXIS), JP(ROW_AXIS)),
+                            out_specs=JP(ROW_AXIS),
+                        )(cols, valid)
+
+                    self._jit_cache[cache_key] = jax.jit(compute)
+                outs = self._jit_cache[cache_key](
+                    dict(jdf.device_cols), jdf.device_valid_mask()
+                )
+                host = {
+                    name: np_.asarray(jax.device_get(arr))
+                    for name, arr in outs.items()
+                }
+                valid = host.pop("__take_valid__")
+                pdf = pd.DataFrame({k2: v2[valid] for k2, v2 in host.items()})
+                pdf = pdf.sort_values(sort_col, ascending=asc).head(n)
+                # NaN rows were excluded from top_k; if they are needed to
+                # fill the result, fall back to the host for exactness
+                if len(pdf) >= n or len(pdf) >= jdf.count():
+                    return self.to_df(
+                        PandasDataFrame(
+                            pdf[jdf.schema.names].reset_index(drop=True), jdf.schema
+                        )
+                    )
         return self._back(
             self._host_engine.take(
                 self._host(df), n, presort, na_position=na_position, partition_spec=partition_spec

@@ -65,15 +65,46 @@ class SQLExecutor:
                 return e.subtract(left, right, distinct=True)
             return e.intersect(left, right, distinct=True)
         if isinstance(node, SortNode):
-            df = self._exec(node.child)
+            child = node.child
+            sort_names = [n for n, _ in node.by]
+            extras: List[str] = []
+            # standard SQL: ORDER BY may reference source columns that the
+            # projection drops — augment the projection, sort, then drop
+            if isinstance(child, SelectNode) and child.child is not None:
+                out_names = {
+                    c.output_name
+                    for c in child.projections
+                    if c.output_name not in ("", "*")
+                }
+                has_wildcard = any(
+                    isinstance(c, _NamedColumnExpr) and c.name == "*"
+                    for c in child.projections
+                )
+                missing = [
+                    n for n in sort_names if n not in out_names and not has_wildcard
+                ]
+                if len(missing) > 0 and len(child.group_by) == 0 and not child.distinct:
+                    child = SelectNode(
+                        child.child,
+                        list(child.projections) + [_col(n) for n in missing],
+                        child.where,
+                        child.group_by,
+                        child.having,
+                        child.distinct,
+                    )
+                    extras = missing
+            df = self._exec(child)
             local = e.to_df(df).as_local_bounded()
             pdf = local.as_pandas().sort_values(
-                [n for n, _ in node.by],
+                sort_names,
                 ascending=[a for _, a in node.by],
                 na_position="first",
             )
+            if len(extras) > 0:
+                pdf = pdf.drop(columns=extras)
+            schema = local.schema - extras if len(extras) > 0 else local.schema
             return e.to_df(
-                PandasDataFrame(pdf.reset_index(drop=True), local.schema)
+                PandasDataFrame(pdf.reset_index(drop=True), schema)
             )
         if isinstance(node, LimitNode):
             df = self._exec(node.child)

@@ -381,3 +381,31 @@ class TestWindowFunctions:
             "SELECT FIRST(v) OVER (PARTITION BY k ORDER BY id) AS f FROM t"
         )
         assert all(pd.isna(x) for x in r["f"])
+
+    def test_order_by_unprojected_column(self):
+        t = pd.DataFrame({"id": [3, 1, 2], "v": [30.0, 10.0, 20.0]})
+        r = fugue_sql("SELECT v FROM t ORDER BY id")
+        assert r["v"].tolist() == [10.0, 20.0, 30.0]
+        assert list(r.columns) == ["v"]
+
+    def test_rank_interleaved_partitions(self):
+        t = pd.DataFrame({"k": ["A", "B", "A", "B"], "v": [1, 1, 2, 2]})
+        r = fugue_sql(
+            "SELECT k, v, RANK() OVER (PARTITION BY k ORDER BY v) AS r FROM t "
+            "ORDER BY k, v"
+        )
+        assert r["r"].tolist() == [1, 2, 1, 2]
+
+    def test_running_min_datetime_null(self):
+        t = pd.DataFrame(
+            {"id": [1, 2, 3],
+             "d": pd.to_datetime(["2020-01-02", None, "2020-01-01"])}
+        )
+        r = fugue_sql("SELECT id, MIN(d) OVER (ORDER BY id) AS m FROM t ORDER BY id")
+        assert str(r["m"].iloc[1])[:10] == "2020-01-02"
+        assert str(r["m"].iloc[2])[:10] == "2020-01-01"
+
+    def test_empty_input_window(self):
+        t = pd.DataFrame({"a": [1.0]})
+        r = fugue_sql("SELECT RANK() OVER (ORDER BY a) AS r FROM t WHERE a > 5")
+        assert len(r) == 0

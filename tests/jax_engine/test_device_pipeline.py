@@ -182,3 +182,29 @@ class TestDeviceJoin:
         fact, dim, jf, jd = self._frames(engine)
         res = engine.join(jf, jd, "left_outer", on=["k"])
         assert res.count() == len(fact)
+
+
+class TestDeviceSampleTake:
+    def test_frac_sample_mask_only(self, engine, pdf):
+        s = engine.sample(engine.to_df(pdf), frac=0.2, seed=7)
+        assert isinstance(s, JaxDataFrame) and s.valid_mask is not None
+        assert 0.1 * len(pdf) < s.count() < 0.3 * len(pdf)
+        # deterministic
+        assert engine.sample(engine.to_df(pdf), frac=0.2, seed=7).count() == s.count()
+
+    def test_take_topn_device(self, engine, pdf):
+        t = engine.take(engine.to_df(pdf), 4, presort="v desc")
+        exp = pdf.sort_values("v", ascending=False).head(4)
+        assert np.allclose(sorted(t.as_pandas()["v"]), sorted(exp["v"]))
+
+    def test_take_keyed_fallback(self, engine, pdf):
+        t = engine.take(
+            engine.to_df(pdf), 1, presort="v desc",
+            partition_spec=PartitionSpec(by=["k"]),
+        )
+        assert t.count() == pdf["k"].nunique()
+
+    def test_sample_after_filter(self, engine, pdf):
+        flt = engine.filter(engine.to_df(pdf), col("v") > 0.5)
+        s = engine.sample(flt, frac=0.5, seed=3)
+        assert s.count() <= flt.count()
