@@ -556,7 +556,22 @@ class JaxExecutionEngine(ExecutionEngine):
 
             sort_col, asc = next(iter(sorts.items()))
             k = min(n, next(iter(jdf.device_cols.values())).shape[0] // num_row_shards(self._mesh))
-            if k > 0:
+            # the kernel scores in float64: int keys beyond 2^53 would
+            # collapse — verify the range with the cached min/max probe
+            fits_float = True
+            import jax.numpy as _jnp
+
+            if _jnp.issubdtype(jdf.device_cols[sort_col].dtype, _jnp.integer):
+                from ..ops.segment import _get_compiled_minmax
+
+                lo_a, hi_a = _get_compiled_minmax(self._mesh)(
+                    jdf.device_cols[sort_col], jdf.device_valid_mask()
+                )
+                import jax as _jax
+
+                lo, hi = int(_jax.device_get(lo_a)[0]), int(_jax.device_get(hi_a)[0])
+                fits_float = max(abs(lo), abs(hi)) < (1 << 53)
+            if k > 0 and fits_float:
                 mesh = jdf.mesh  # bind locally: the closure must not pin jdf
                 cache_key = ("take", sort_col, asc, k, mesh, tuple(jdf.schema.names))
                 if cache_key not in self._jit_cache:

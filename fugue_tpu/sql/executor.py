@@ -83,7 +83,12 @@ class SQLExecutor:
                 missing = [
                     n for n in sort_names if n not in out_names and not has_wildcard
                 ]
-                if len(missing) > 0 and len(child.group_by) == 0 and not child.distinct:
+                if (
+                    len(missing) > 0
+                    and len(child.group_by) == 0
+                    and not child.distinct
+                    and not any(is_agg(c) for c in child.projections)
+                ):
                     child = SelectNode(
                         child.child,
                         list(child.projections) + [_col(n) for n in missing],
@@ -95,6 +100,13 @@ class SQLExecutor:
                     extras = missing
             df = self._exec(child)
             local = e.to_df(df).as_local_bounded()
+            absent = [n for n in sort_names if n not in local.schema]
+            if len(absent) > 0:
+                raise FugueSQLSyntaxError(
+                    f"ORDER BY column(s) {absent} are not in the select output "
+                    f"{local.schema.names} (aggregated selects can only order "
+                    "by projected columns)"
+                )
             pdf = local.as_pandas().sort_values(
                 sort_names,
                 ascending=[a for _, a in node.by],

@@ -24,6 +24,30 @@ from ._checkpoint import Checkpoint, StrongCheckpoint
 from ..rpc.base import to_rpc_handler
 
 
+def _caller_site() -> str:
+    """The user-code location where this task was defined (first frame
+    outside the framework) — injected into runtime errors so they point at
+    the DAG construction site. Gated by the
+    ``fugue.workflow.exception.inject`` conf (0 disables); uses raw frame
+    walking (no source-line fetching) to stay cheap per task."""
+    import sys
+
+    from ..constants import (
+        FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT,
+        _FUGUE_GLOBAL_CONF,
+    )
+
+    if _FUGUE_GLOBAL_CONF.get(FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT, 3) <= 0:
+        return ""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "/fugue_tpu/" not in fn and "fugue_tpu_test" not in fn:
+            return f"{fn}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return ""
+
+
 class FugueTask:
     """One node of the workflow DAG."""
 
@@ -45,6 +69,7 @@ class FugueTask:
         self.yield_dataframe_handler: Optional[Callable[[DataFrame], None]] = None
         self.name = ""
         self._uuid: Optional[str] = None
+        self.defined_at = _caller_site()
         # compile-time validation of the partition spec against extension rules
         rules = getattr(extension, "validation_rules", {})
         if rules:

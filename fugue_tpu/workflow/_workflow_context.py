@@ -105,7 +105,12 @@ class FugueWorkflowContext:
                 self._results[id(task)] = df
                 return
         inputs = [self._results[id(d)] for d in task.inputs]
-        result = task.execute(self, inputs)
+        try:
+            result = task.execute(self, inputs)
+        except Exception as ex:
+            if task.defined_at and hasattr(ex, "add_note"):
+                ex.add_note(f"[fugue-tpu] failing task defined at {task.defined_at}")
+            raise
         if result is not None:
             result = task.set_result(self, result)
             self._results[id(task)] = result
