@@ -5,9 +5,27 @@ The flagship ML-inference pattern: wrap a jax model's forward pass as a
 ``shard_map`` across the TPU mesh — each shard computes its rows' embeddings
 on its own chip, with zero per-row Python.
 
-Run: python examples/batch_inference.py  (add JAX_PLATFORMS=cpu +
-XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh)
+Run: python examples/batch_inference.py [--cpu]
+(--cpu forces an 8-device virtual CPU mesh; the TPU plugin overrides the
+JAX_PLATFORMS env var, so the flag is the reliable switch)
 """
+
+import os
+import sys
+
+if "--cpu" in sys.argv:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+# allow running the example straight from a checkout
+if "__file__" in globals():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from typing import Dict
 

@@ -1,0 +1,52 @@
+"""Distributed hyperparameter sweep (BASELINE config #5).
+
+Each hyperparameter configuration is one logical partition; ``transform``
+fits/evaluates per partition in parallel across the engine — the same
+pattern the reference uses with sklearn/XGBoost per Spark/Ray worker, here
+with a numpy model so the example runs anywhere.
+
+Run: python examples/hpo_sweep.py
+"""
+
+import os
+import sys
+
+# allow running the example straight from a checkout
+if "__file__" in globals():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+import fugue_tpu.api as fa
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(512, 4))
+w_true = np.array([1.0, -2.0, 0.5, 3.0])
+y = X @ w_true + rng.normal(scale=0.1, size=512)
+
+
+# schema: lr:double,steps:long,mse:double
+def fit_eval(df: pd.DataFrame) -> pd.DataFrame:
+    lr = float(df["lr"].iloc[0])
+    steps = int(df["steps"].iloc[0])
+    w = np.zeros(4)
+    for _ in range(steps):  # plain gradient descent as the stand-in trainer
+        grad = X.T @ (X @ w - y) / len(y)
+        w -= lr * grad
+    mse = float(np.mean((X @ w - y) ** 2))
+    return pd.DataFrame({"lr": [lr], "steps": [steps], "mse": [mse]})
+
+
+def main() -> None:
+    grid = pd.DataFrame(
+        [(lr, s) for lr in (0.01, 0.05, 0.1) for s in (50, 200)],
+        columns=["lr", "steps"],
+    )
+    res = fa.transform(grid, fit_eval, partition={"by": ["lr", "steps"]})
+    best = res.sort_values("mse").head(3)
+    print(best.to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

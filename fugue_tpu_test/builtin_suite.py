@@ -17,6 +17,7 @@ import pytest
 from fugue_tpu import (
     ArrayDataFrame,
     DataFrame,
+    DataFrames,
     FugueWorkflow,
     PandasDataFrame,
     Schema,
@@ -250,6 +251,28 @@ class BuiltInTests:
                 merge, schema="k:long,n1:long,n2:long"
             ).assert_eq(dag.df([[1, 1, 1], [2, 1, 0]], "k:long,n1:long,n2:long"))
             dag.run(self.engine)
+
+        def test_cotransform_named_inputs(self):
+            """zip with dict inputs: the cotransformer sees frames by name."""
+
+            def merge(dfs: DataFrames) -> pd.DataFrame:
+                left, right = dfs["left"], dfs["right"]
+                return pd.DataFrame(
+                    {
+                        "k": [left.as_array()[0][0]],
+                        "n": [left.count() + right.count()],
+                    }
+                )
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, "x"], [1, "y"], [2, "z"]], "k:long,v:str")
+            b = dag.df([[1, 9.0], [2, 8.0]], "k:long,w:double")
+            z = dag.zip({"left": a, "right": b}, partition={"by": ["k"]})
+            z.transform(merge, schema="k:long,n:long").yield_dataframe_as(
+                "out", as_local=True
+            )
+            dag.run(self.engine)
+            assert sorted(dag.yields["out"].result.as_array()) == [[1, 3], [2, 2]]
 
         # -- workflow ops ----------------------------------------------------
         def test_workflow_relational_ops(self):
