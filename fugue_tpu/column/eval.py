@@ -42,6 +42,21 @@ _SCALAR_FUNCS = {
     "EXP": lambda s: np.exp(s),
     "LN": lambda s: np.log(s),
     "LOG": lambda s: np.log(s),
+    # SQL MOD: the result sign follows the DIVIDEND (unlike python %)
+    "MOD": lambda s, d: np.sign(s) * (abs(s) % abs(d)),
+    # SQL POWER returns double (negative int exponents are legal)
+    "POWER": lambda s, e: np.power(s.astype("float64"), e),
+    "POW": lambda s, e: np.power(s.astype("float64"), e),
+    "SIGN": lambda s: np.sign(s),
+    "REPLACE": lambda s, old, new: s.str.replace(old, new, regex=False),
+    # SQL LPAD/RPAD: multi-char pads allowed; result truncated to width
+    "LPAD": lambda s, w, c=" ": s.map(
+        lambda x: None if x is None else (str(c) * int(w) + x)[-int(w):]
+        if len(x) < int(w) else x[: int(w)]
+    ),
+    "RPAD": lambda s, w, c=" ": s.map(
+        lambda x: None if x is None else (x + str(c) * int(w))[: int(w)]
+    ),
     "SUBSTRING": lambda s, start, length=None: s.str.slice(
         int(start) - 1, int(start) - 1 + int(length) if length is not None else None
     ),

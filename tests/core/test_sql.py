@@ -409,3 +409,14 @@ class TestWindowFunctions:
         t = pd.DataFrame({"a": [1.0]})
         r = fugue_sql("SELECT RANK() OVER (ORDER BY a) AS r FROM t WHERE a > 5")
         assert len(r) == 0
+
+
+class TestScalarFunctions:
+    def test_modulo_and_friends(self):
+        t = pd.DataFrame({"a": [1, 2, 3, 4], "s": ["ab", "cd", "ef", "gh"]})
+        assert fugue_sql("SELECT a FROM t WHERE a % 2 = 0")["a"].tolist() == [2, 4]
+        assert fugue_sql("SELECT MOD(a, 3) AS m FROM t")["m"].tolist() == [1, 2, 0, 1]
+        assert fugue_sql("SELECT POWER(a, 2) AS p FROM t")["p"].tolist() == [1, 4, 9, 16]
+        assert fugue_sql("SELECT REPLACE(s, 'a', 'x') AS r FROM t")["r"].tolist() == [
+            "xb", "cd", "ef", "gh",
+        ]
