@@ -106,6 +106,13 @@ def can_evaluate_on_device(
 
     if check_agg and is_agg(expr):
         return False
+    if expr.as_type is not None and not (
+        pa.types.is_integer(expr.as_type)
+        or pa.types.is_floating(expr.as_type)
+        or pa.types.is_boolean(expr.as_type)
+    ):
+        # device arrays can't hold strings/binary/nested → host fallback
+        return False
     if isinstance(expr, _NamedColumnExpr):
         return expr.name in device_cols and not expr.wildcard
     if isinstance(expr, _LitColumnExpr):

@@ -39,7 +39,16 @@ from .execution_engine import ExecutionEngine, MapEngine, SQLEngine
 
 
 class PandasMapEngine(MapEngine):
-    """Sort + groupby-apply map engine (reference ``:81-169``)."""
+    """Sort + groupby-apply map engine (reference ``:81-169``).
+
+    ``parallelism_engine`` supplies CONCURRENCY for partition-number
+    expressions — distributed engines delegating their general map path
+    here pass themselves so num="CONCURRENCY" reflects the real mesh.
+    """
+
+    def __init__(self, execution_engine: Any, parallelism_engine: Any = None):
+        super().__init__(execution_engine)
+        self._parallelism_engine = parallelism_engine or execution_engine
 
     @property
     def is_distributed(self) -> bool:
@@ -78,10 +87,29 @@ class PandasMapEngine(MapEngine):
             ).reset_index(drop=True)
         schema = input_df.schema
         if len(keys) == 0:
-            part = PandasDataFrame(pdf, schema, pandas_df_wrapper=True)
-            cursor.set(lambda: part.peek_array(), 0, 0)
-            out = map_func(cursor, part)
-            return _to_output(out, output_schema)
+            num = partition_spec.get_num_partitions(
+                ROWCOUNT=lambda: len(pdf),
+                CONCURRENCY=self._parallelism_engine.get_current_parallelism,
+            )
+            if num <= 1:
+                part = PandasDataFrame(pdf, schema, pandas_df_wrapper=True)
+                cursor.set(lambda: part.peek_array(), 0, 0)
+                out = map_func(cursor, part)
+                return _to_output(out, output_schema)
+            # no keys but an explicit partition count (e.g. per_row =
+            # num:ROWCOUNT): split into even contiguous chunks (empty input
+            # returned above, so every chunk is non-empty)
+            chunks = np.array_split(np.arange(len(pdf)), min(num, len(pdf)))
+            results: List[LocalDataFrame] = []
+            for no, idx in enumerate(chunks):
+                sub = pdf.iloc[idx].reset_index(drop=True)
+                part = PandasDataFrame(sub, schema, pandas_df_wrapper=True)
+                cursor.set(lambda p=part: p.peek_array(), no, 0)
+                results.append(map_func(cursor, part).as_local_bounded())
+            return _to_output(
+                LocalDataFrameIterableDataFrame(iter(results), output_schema),
+                output_schema,
+            )
         results: List[LocalDataFrame] = []
         no = [0]
 

@@ -83,10 +83,13 @@ class JaxMapEngine(MapEngine):
             if raw is not None:
                 jdf = engine.to_df(df)
                 return self._compiled_map(jdf, raw, output_schema, on_init)
-        # general path: host-side partitioned execution, result back on device
+        # general path: host-side partitioned execution, result back on
+        # device; CONCURRENCY reflects the mesh, not the host engine
         host_engine = engine._host_engine
+        if not hasattr(self, "_host_map"):
+            self._host_map = PandasMapEngine(host_engine, parallelism_engine=engine)
         local = engine._host(df)
-        res = host_engine.map_engine.map_dataframe(
+        res = self._host_map.map_dataframe(
             local,
             map_func,
             output_schema,

@@ -441,6 +441,40 @@ class BuiltInTests:
             dag.run(self.engine)
             assert sorted(collector.values) == [1, 2]
 
+        def test_per_row_transform(self):
+            def one(df: pd.DataFrame) -> pd.DataFrame:
+                assert len(df) == 1
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[1], [2], [3]], "a:long")
+            src.per_row().transform(one, schema="*").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_optional_callback_unset(self):
+            from typing import Callable, Optional
+
+            def f(df: pd.DataFrame, cb: Optional[Callable] = None) -> pd.DataFrame:
+                assert cb is None
+                return df
+
+            dag = FugueWorkflow()
+            src = dag.df([[1]], "a:long")
+            src.transform(f, schema="*").assert_eq(src)
+            dag.run(self.engine)
+
+        def test_engine_param_in_creator(self):
+            from fugue_tpu.execution import ExecutionEngine
+
+            def make(e: ExecutionEngine) -> pd.DataFrame:
+                assert isinstance(e, ExecutionEngine)
+                return pd.DataFrame({"a": [e.get_current_parallelism()]})
+
+            dag = FugueWorkflow()
+            dag.create(make).yield_dataframe_as("x", as_local=True)
+            dag.run(self.engine)
+            assert dag.yields["x"].result.as_array()[0][0] >= 1
+
         # -- io through workflow --------------------------------------------
         def test_workflow_save_load(self):
             path = os.path.join(self.tmpdir, "wf.parquet")

@@ -379,3 +379,56 @@ class ExecutionEngineTests:
 
             with engine_context(self.engine) as e:
                 assert get_context_engine() is e
+
+        # -- additional contract behaviors ----------------------------------
+        def test_union_schema_mismatch_raises(self):
+            df1 = self.df([[1]], "a:long")
+            df2 = self.df([["x"]], "a:str")
+            with pytest.raises(Exception):
+                self.engine.union(df1, df2)
+
+        def test_take_na_position_first(self):
+            df = self.df([[1.0], [None], [3.0]], "a:double")
+            res = self.engine.take(df, 1, presort="a", na_position="first")
+            assert res.as_array(type_safe=True) == [[None]]
+
+        def test_map_per_row(self):
+            from fugue_tpu.dataframe import ArrayDataFrame
+
+            def m(cursor, df):
+                rows = df.as_array()
+                assert len(rows) == 1
+                return ArrayDataFrame([[rows[0][0] * 10]], "a:long")
+
+            df = self.df([[1], [2], [3]], "a:long")
+            res = self.engine.map_engine.map_dataframe(
+                df, m, "a:long", PartitionSpec("per_row")
+            )
+            assert sorted(res.as_array()) == [[10], [20], [30]]
+
+        def test_select_with_cast(self):
+            df = self.df([[1]], "a:long")
+            res = self.engine.select(
+                df, SelectColumns(col("a").cast("str").alias("s"))
+            )
+            assert res.as_array(type_safe=True) == [["1"]]
+
+        def test_comap_multiple_frames(self):
+            e = self.engine
+            d1 = self.df([[1, "a"]], "k:long,v:str")
+            d2 = self.df([[1, 1.0], [1, 2.0]], "k:long,w:double")
+            d3 = self.df([[1, True]], "k:long,b:bool")
+            z = e.zip(
+                DataFrames(d1, d2, d3), how="inner",
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+
+            def cm(cursor, dfs):
+                assert len(dfs) == 3
+                return ArrayDataFrame(
+                    [[cursor.key_value_array[0], dfs[0].count(), dfs[1].count(), dfs[2].count()]],
+                    "k:long,a:long,b:long,c:long",
+                )
+
+            res = e.comap(z, cm, "k:long,a:long,b:long,c:long")
+            assert res.as_array() == [[1, 1, 2, 1]]
