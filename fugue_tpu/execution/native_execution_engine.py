@@ -143,18 +143,31 @@ def _to_output(out: DataFrame, output_schema: Schema) -> LocalBoundedDataFrame:
 
 
 class _PlaceholderSQLEngine(SQLEngine):
-    """Raises until the in-tree SQL layer is attached (no qpd/duckdb here)."""
+    """Delegates lazily to the in-tree SQL layer (no qpd/duckdb here); the
+    indirection avoids an import cycle at module load."""
 
     @property
     def is_distributed(self) -> bool:
         return False
 
-    def select(self, dfs: DataFrames, statement: Any) -> DataFrame:
+    def _local(self) -> SQLEngine:
         try:
             from ..sql.local_sql import LocalSQLEngine
         except ImportError as e:  # SQL layer not built yet
             raise NotImplementedError("in-tree SQL engine not available") from e
-        return LocalSQLEngine(self.execution_engine).select(dfs, statement)
+        return LocalSQLEngine(self.execution_engine)
+
+    def select(self, dfs: DataFrames, statement: Any) -> DataFrame:
+        return self._local().select(dfs, statement)
+
+    def table_exists(self, table: str) -> bool:
+        return self._local().table_exists(table)
+
+    def save_table(self, df: DataFrame, table: str, **kwargs: Any) -> None:
+        self._local().save_table(df, table, **kwargs)
+
+    def load_table(self, table: str, **kwargs: Any) -> DataFrame:
+        return self._local().load_table(table, **kwargs)
 
 
 class NativeExecutionEngine(ExecutionEngine):

@@ -45,23 +45,31 @@ def _is_device_type(f: pa.Field) -> bool:
 
 
 def split_arrow_for_device(tbl: pa.Table) -> Any:
-    """Split an arrow table into (device_candidate_cols, host_cols).
+    """Split an arrow table into (device_candidate_cols, host_cols, nan_cols).
 
     Numeric/bool columns WITHOUT nulls go to device (floats may carry nulls
-    as NaN); everything else stays host-side.
+    as NaN); everything else stays host-side. ``nan_cols`` is the set of
+    device float columns that actually contain NaN — kernels skip NULL
+    masking for columns proved NaN-free (the common case).
     """
     device_cols: Dict[str, np.ndarray] = {}
     host_names: List[str] = []
+    nan_cols: set = set()
     for i, f in enumerate(tbl.schema):
         col = tbl.column(i)
         # nulls can't live on device yet (NaN would silently conflate with
         # null on the way back) — nullable columns stay host-resident
         if _is_device_type(f) and col.null_count == 0:
-            device_cols[f.name] = np.asarray(col.to_numpy(zero_copy_only=False))
+            arr = np.asarray(col.to_numpy(zero_copy_only=False))
+            device_cols[f.name] = arr
+            if np.issubdtype(arr.dtype, np.floating) and bool(
+                np.isnan(arr).any()
+            ):
+                nan_cols.add(f.name)
         else:
             host_names.append(f.name)
     host_tbl = tbl.select(host_names) if len(host_names) > 0 else None
-    return device_cols, host_tbl
+    return device_cols, host_tbl, nan_cols
 
 
 class JaxDataFrame(DataFrame):
@@ -84,6 +92,8 @@ class JaxDataFrame(DataFrame):
             self._host_tbl = _internal["host_tbl"]
             self._row_count = _internal["row_count"]
             self._valid_mask = _internal.get("valid_mask", None)
+            # None = unknown → treat every float column as possibly-NaN
+            self._nan_cols = _internal.get("nan_cols", None)
             super().__init__(_internal["schema"])
             return
         s = None if schema is None else (schema if isinstance(schema, Schema) else Schema(schema))
@@ -97,6 +107,7 @@ class JaxDataFrame(DataFrame):
             self._host_tbl = df._host_tbl
             self._row_count = df._row_count
             self._valid_mask = df._valid_mask
+            self._nan_cols = df._nan_cols
             super().__init__(df.schema)
             return
         if isinstance(df, DataFrame):
@@ -114,7 +125,7 @@ class JaxDataFrame(DataFrame):
         n = tbl.num_rows
         shards = num_row_shards(self._mesh)
         padded = pad_rows(max(n, shards), shards) if n > 0 else shards
-        np_cols, host_tbl = split_arrow_for_device(tbl)
+        np_cols, host_tbl, nan_cols = split_arrow_for_device(tbl)
         sharding = row_sharding(self._mesh)
         device_cols: Dict[str, Any] = {}
         for name, arr in np_cols.items():
@@ -128,6 +139,7 @@ class JaxDataFrame(DataFrame):
         # None = tail-padding semantics (rows [0, row_count) valid); a device
         # bool array = explicit per-row validity (result of device filters)
         self._valid_mask = None
+        self._nan_cols = nan_cols
 
     # -- properties ---------------------------------------------------------
     @property
@@ -146,6 +158,16 @@ class JaxDataFrame(DataFrame):
     def valid_mask(self) -> Any:
         """Explicit device validity mask, or None for tail-padding."""
         return self._valid_mask
+
+    def maybe_nan(self, name: str) -> bool:
+        """Whether device float column ``name`` may contain NaN (i.e. NULL).
+
+        False only when ingestion proved the column NaN-free; unknown
+        provenance (e.g. transformer outputs) is conservatively True.
+        """
+        if self._nan_cols is None:
+            return True
+        return name in self._nan_cols
 
     def device_valid_mask(self) -> Any:
         """A device bool array marking valid rows (built from the row count
@@ -244,6 +266,7 @@ class JaxDataFrame(DataFrame):
                 host_tbl=host_tbl,
                 row_count=self._row_count,
                 valid_mask=self._valid_mask,
+                nan_cols=self._nan_cols,
                 schema=schema,
             ),
         )
@@ -272,7 +295,10 @@ class JaxDataFrame(DataFrame):
             if self._host_tbl is not None
             else None
         )
-        return self._with(schema, dc, ht)
+        res = self._with(schema, dc, ht)
+        if self._nan_cols is not None:
+            res._nan_cols = {columns.get(n, n) for n in self._nan_cols}
+        return res
 
     def alter_columns(self, columns: Any) -> DataFrame:
         new_schema = self.schema.alter(columns)

@@ -186,7 +186,12 @@ class JaxExecutionEngine(ExecutionEngine):
         return JaxMapEngine(self)
 
     def create_default_sql_engine(self) -> SQLEngine:
-        return self._host_engine.create_default_sql_engine()
+        # bind the SQL facet to THIS engine (not the host fallback) so SQL
+        # lowers onto the device verbs and conf lookups (e.g. the checkpoint
+        # table warehouse) see this engine's live configuration
+        from ..execution.native_execution_engine import _PlaceholderSQLEngine
+
+        return _PlaceholderSQLEngine(self)
 
     def get_current_parallelism(self) -> int:
         return num_row_shards(self._mesh)
@@ -227,6 +232,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 device_cols=cols,
                 host_tbl=jdf.host_table,
                 row_count=jdf.count(),
+                nan_cols=jdf._nan_cols,
                 schema=jdf.schema,
             ),
         )
@@ -276,6 +282,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     host_tbl=None,
                     row_count=-1,  # computed lazily from the mask
                     valid_mask=new_mask,
+                    nan_cols=jdf._nan_cols,
                     schema=jdf.schema,
                 ),
             )
@@ -437,6 +444,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     host_tbl=None,
                     row_count=-1,
                     valid_mask=mask,
+                    nan_cols=jdf._nan_cols,
                     schema=jdf.schema,
                 ),
             )
@@ -483,6 +491,18 @@ class JaxExecutionEngine(ExecutionEngine):
                     host_tbl=None,
                     row_count=jdf._row_count,
                     valid_mask=jdf.valid_mask,
+                    # filled columns become NaN-free — unless the fill value
+                    # is itself NaN (a no-op fill must not fake the proof)
+                    nan_cols=(
+                        None
+                        if jdf._nan_cols is None
+                        else jdf._nan_cols
+                        - {
+                            c
+                            for c, v in fills.items()
+                            if not (isinstance(v, float) and v != v)
+                        }
+                    ),
                     schema=jdf.schema,
                 ),
             )
@@ -526,6 +546,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     host_tbl=None,
                     row_count=-1,
                     valid_mask=mask,
+                    nan_cols=jdf._nan_cols,
                     schema=jdf.schema,
                 ),
             )
@@ -732,6 +753,24 @@ class JaxExecutionEngine(ExecutionEngine):
                     pa.field(c.output_name, t if t is not None else pa.from_numpy_dtype(np.asarray(out_cols[c.output_name]).dtype))
                 )
             schema = Schema(fields)
+        # pass-through named columns keep their NaN-free proof; computed
+        # expressions are conservatively maybe-NaN (left out of the set is
+        # only safe when the set is known, so start from the source's)
+        from ..column.expressions import _NamedColumnExpr
+
+        nan_cols: Optional[set] = None
+        if jdf._nan_cols is not None:
+            nan_cols = set()
+            for c in exprs:
+                if isinstance(c, _NamedColumnExpr) and c.as_type is None:
+                    if c.name in jdf._nan_cols:
+                        nan_cols.add(c.output_name)
+                else:
+                    import numpy as _np
+
+                    arr = out_cols[c.output_name]
+                    if _np.issubdtype(_np.dtype(arr.dtype), _np.floating):
+                        nan_cols.add(c.output_name)
         return JaxDataFrame(
             mesh=self._mesh,
             _internal=dict(
@@ -739,6 +778,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 host_tbl=None,
                 row_count=jdf._row_count,
                 valid_mask=jdf.valid_mask,
+                nan_cols=nan_cols,
                 schema=schema,
             ),
         )
@@ -764,7 +804,10 @@ class JaxExecutionEngine(ExecutionEngine):
         partials = device_groupby_partials(
             self._mesh,
             key_cols,
-            [(name, agg, jdf.device_cols[src]) for name, agg, src in plan["aggs"]],
+            [
+                (name, agg, jdf.device_cols[src], jdf.maybe_nan(src))
+                for name, agg, src in plan["aggs"]
+            ],
             jdf.device_valid_mask(),
         )
         merged = merge_partials(partials, keys, [(n, a) for n, a, _ in plan["aggs"]])

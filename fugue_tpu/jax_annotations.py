@@ -48,7 +48,7 @@ class JaxDictParam(LocalDataFrameParam):
         if isinstance(df, JaxDataFrame):
             res = dict(df.device_cols)
         else:
-            cols, _ = split_arrow_for_device(df.as_arrow())
+            cols, _, _ = split_arrow_for_device(df.as_arrow())
             res = {k: jnp.asarray(v) for k, v in cols.items()}
         if len(res) > 0 and "__valid__" not in res:
             n = next(iter(res.values())).shape[0]

@@ -29,7 +29,13 @@ def build(force: bool = False) -> bool:
     """Compile the native library with g++. Returns True on success."""
     out = os.path.join(_LIB_DIR, _LIB_NAME)
     if os.path.exists(out) and not force:
-        return True
+        try:  # rebuild when the source is newer than the compiled lib
+            if not (
+                os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(out)
+            ):
+                return True
+        except OSError:
+            return True
     if not os.path.exists(_SRC):
         return False
     try:
@@ -53,7 +59,9 @@ def _get_lib() -> Optional[ctypes.CDLL]:
     if _load_failed:
         return None
     path = os.path.join(_LIB_DIR, _LIB_NAME)
-    if not os.path.exists(path) and not build():
+    # build() is a no-op when the lib exists and is newer than the source —
+    # routing every load through it keeps a stale .so from shadowing edits
+    if not build() and not os.path.exists(path):
         _load_failed = True
         return None
     try:

@@ -26,23 +26,125 @@ import numpy as np
 _COMPILE_CACHE: Dict[Any, Any] = {}
 
 
-def _shard_kernel(num_keys: int, agg_specs: Sequence[Tuple[str, str]]):
-    """Per-shard kernel: (keys..., values..., valid) →
+def _norm_specs(
+    agg_specs: Sequence[Tuple[Any, ...]]
+) -> Tuple[Tuple[Tuple[str, str, int, bool], ...], int]:
+    """Normalize agg specs to (name, agg, value_idx, nullable).
+
+    Short forms: ``(name, agg)`` → one distinct value column per spec,
+    nullable; ``(name, agg, vidx)`` → nullable. ``nullable`` means the
+    (float) column may contain NaN — NaN-as-NULL handling is skipped for
+    columns the caller proved null-free (the common pandas-ingestion case).
+    Returns (normalized_specs, num_value_columns).
+    """
+    norm: List[Tuple[str, str, int, bool]] = []
+    for i, spec in enumerate(agg_specs):
+        if len(spec) == 2:
+            norm.append((spec[0], spec[1], i, True))
+        elif len(spec) == 3:
+            norm.append((spec[0], spec[1], spec[2], True))
+        else:
+            norm.append(tuple(spec))  # type: ignore[arg-type]
+    num_vals = max(s[2] for s in norm) + 1 if len(norm) > 0 else 0
+    return tuple(norm), num_vals
+
+
+def _agg_outputs(
+    jnp: Any,
+    specs: Sequence[Tuple[str, str, int, bool]],
+    values: Sequence[Any],
+    valid: Any,
+    sum_of: Any,
+    min_of: Any,
+    max_of: Any,
+    count_all: Any = None,
+) -> List[Any]:
+    """Per-group aggregate arrays with NaN-as-NULL semantics — the single
+    implementation shared by the sort+segment and dense-bucket kernels.
+
+    ``sum_of``/``min_of``/``max_of`` inject the reduction primitive: they map
+    a masked full-length row array to a per-group array. ``count_all`` is an
+    optional precomputed per-group count of valid rows (the dense path's
+    presence table), reused for NaN-free columns.
+
+    NaN in a nullable float column IS null: excluded from every aggregate
+    (matching the oracle's dropna-first semantics) so results don't depend
+    on shard layout; all-null groups come out NaN (NULL). ev/nn/agg results
+    are memoized per value column — avg decomposes to sum+count of one
+    column, and XLA does not reliably CSE scatter/segment reductions.
+    """
+
+    def _null_of(vidx: int) -> bool:
+        nullable = any(s[2] == vidx and s[3] for s in specs)
+        return nullable and jnp.issubdtype(values[vidx].dtype, jnp.floating)
+
+    ev_cache: Dict[int, Any] = {}
+    nn_cache: Dict[int, Any] = {}
+    agg_cache: Dict[Tuple[str, int], Any] = {}
+
+    def _ev(vidx: int) -> Any:
+        if vidx not in ev_cache:
+            v = values[vidx]
+            ev_cache[vidx] = (valid & ~jnp.isnan(v)) if _null_of(vidx) else valid
+        return ev_cache[vidx]
+
+    def _nn(vidx: int) -> Any:
+        key = vidx if _null_of(vidx) else -1  # NaN-free columns share one count
+        if key not in nn_cache:
+            if key == -1 and count_all is not None:
+                nn_cache[key] = count_all
+            else:
+                nn_cache[key] = sum_of(_ev(vidx).astype(jnp.int64))
+        return nn_cache[key]
+
+    def _one(agg: str, vidx: int) -> Any:
+        ckey = (agg, vidx)
+        if ckey in agg_cache:
+            return agg_cache[ckey]
+        v = values[vidx]
+        ev = _ev(vidx)
+        may_null = _null_of(vidx)
+        if agg == "sum":
+            part = sum_of(jnp.where(ev, v, jnp.zeros_like(v)))
+            if may_null:
+                part = jnp.where(_nn(vidx) > 0, part, jnp.nan)  # all-null → NULL
+        elif agg == "count":
+            part = _nn(vidx)
+        elif agg == "min":
+            part = min_of(jnp.where(ev, v, jnp.full_like(v, _max_of(jnp, v.dtype))))
+            if may_null:
+                part = jnp.where(_nn(vidx) > 0, part, jnp.nan)
+        elif agg == "max":
+            part = max_of(jnp.where(ev, v, jnp.full_like(v, _min_of(jnp, v.dtype))))
+            if may_null:
+                part = jnp.where(_nn(vidx) > 0, part, jnp.nan)
+        else:  # pragma: no cover
+            raise NotImplementedError(agg)
+        agg_cache[ckey] = part
+        return part
+
+    return [_one(agg, vidx) for _, agg, vidx, _ in specs]
+
+
+def _shard_kernel(num_keys: int, agg_specs: Sequence[Tuple[Any, ...]]):
+    """Per-shard kernel: (keys..., values[num_vals], valid) →
     (nseg(1,), packed_keys...(n,), aggs...(n,)).
 
     ``aggs[i][j]`` is the reduction of segment j; ``packed_keys[i][j]`` its
-    key — both valid for j < nseg.
+    key — both valid for j < nseg. Value columns are deduplicated by index
+    (see ``_norm_specs``) so identical reductions are computed once — XLA
+    does not CSE scatter/segment ops reliably.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n_aggs = len(agg_specs)
+    specs, num_vals = _norm_specs(agg_specs)
 
     def kernel(*args: Any):
         keys = args[:num_keys]
-        values = args[num_keys : num_keys + n_aggs]
-        valid = args[num_keys + n_aggs]
+        values = args[num_keys : num_keys + num_vals]
+        valid = args[num_keys + num_vals]
         n = keys[0].shape[0]
         # sort invalid (padding) rows to the end, then lexicographic by keys;
         # sort a row-index payload instead of f64 values (narrow comparator)
@@ -64,25 +166,15 @@ def _shard_kernel(num_keys: int, agg_specs: Sequence[Tuple[str, str]]):
         nseg = change.sum(dtype=jnp.int32)
         seg_id = jnp.cumsum(change.astype(jnp.int32)) - 1
         seg_id = jnp.where(s_valid, seg_id, n - 1)
-        outs = []
-        for (_, agg), v in zip(agg_specs, s_values):
-            if agg == "sum":
-                vv = jnp.where(s_valid, v, jnp.zeros_like(v))
-                outs.append(jax.ops.segment_sum(vv, seg_id, num_segments=n))
-            elif agg == "count":
-                outs.append(
-                    jax.ops.segment_sum(
-                        s_valid.astype(jnp.int64), seg_id, num_segments=n
-                    )
-                )
-            elif agg == "min":
-                big = jnp.where(s_valid, v, jnp.full_like(v, _max_of(jnp, v.dtype)))
-                outs.append(jax.ops.segment_min(big, seg_id, num_segments=n))
-            elif agg == "max":
-                small = jnp.where(s_valid, v, jnp.full_like(v, _min_of(jnp, v.dtype)))
-                outs.append(jax.ops.segment_max(small, seg_id, num_segments=n))
-            else:  # pragma: no cover
-                raise NotImplementedError(agg)
+        outs = _agg_outputs(
+            jnp,
+            specs,
+            s_values,
+            s_valid,
+            sum_of=lambda a: jax.ops.segment_sum(a, seg_id, num_segments=n),
+            min_of=lambda a: jax.ops.segment_min(a, seg_id, num_segments=n),
+            max_of=lambda a: jax.ops.segment_max(a, seg_id, num_segments=n),
+        )
         # pack each segment's representative key to the front: stable argsort
         # on ~change puts segment-start rows first, in order
         starts = jnp.argsort(jnp.logical_not(change), stable=True)
@@ -100,16 +192,17 @@ def _min_of(jnp: Any, dt: Any) -> Any:
     return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
 
 
-def _get_compiled_kernel(mesh: Any, num_keys: int, agg_sig: Tuple[Tuple[str, str], ...]):
+def _get_compiled_kernel(mesh: Any, num_keys: int, agg_sig: Tuple[Tuple[Any, ...], ...]):
     import jax
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import ROW_AXIS
 
+    agg_sig, num_vals = _norm_specs(agg_sig)
     cache_key = ("kernel", mesh, num_keys, agg_sig)
     if cache_key not in _COMPILE_CACHE:
         kernel = _shard_kernel(num_keys, agg_sig)
-        n_in = num_keys + len(agg_sig) + 1
+        n_in = num_keys + num_vals + 1
         n_out = 1 + num_keys + len(agg_sig)
         spec = P(ROW_AXIS)
         _COMPILE_CACHE[cache_key] = jax.jit(
@@ -221,57 +314,75 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
 
     from ..parallel.mesh import ROW_AXIS
 
+    agg_sig, num_vals = _norm_specs(agg_sig)
     cache_key = ("dense", mesh, buckets, agg_sig)
     if cache_key not in _COMPILE_CACHE:
 
         def kernel(k: Any, kmin: Any, *rest: Any):
-            values = rest[:-1]
-            valid = rest[-1]
+            values = rest[:num_vals]
+            valid = rest[num_vals]
             idx = jnp.where(valid, (k - kmin).astype(jnp.int32), buckets - 1)
-            outs = []
             present = jnp.zeros(buckets, dtype=jnp.int64).at[idx].add(
                 valid.astype(jnp.int64)
             )
-            for (_, agg), v in zip(agg_sig, values):
-                if agg == "sum":
-                    vv = jnp.where(valid, v, jnp.zeros_like(v))
-                    outs.append(jnp.zeros(buckets, dtype=v.dtype).at[idx].add(vv))
-                elif agg == "count":
-                    outs.append(present)
-                elif agg == "min":
-                    big = jnp.where(valid, v, jnp.full_like(v, _max_of(jnp, v.dtype)))
-                    outs.append(
-                        jnp.full(buckets, _max_of(jnp, v.dtype), dtype=v.dtype)
-                        .at[idx]
-                        .min(big)
-                    )
-                elif agg == "max":
-                    small = jnp.where(valid, v, jnp.full_like(v, _min_of(jnp, v.dtype)))
-                    outs.append(
-                        jnp.full(buckets, _min_of(jnp, v.dtype), dtype=v.dtype)
-                        .at[idx]
-                        .max(small)
-                    )
-                else:  # pragma: no cover
-                    raise NotImplementedError(agg)
+            outs = _agg_outputs(
+                jnp,
+                agg_sig,
+                values,
+                valid,
+                sum_of=lambda a: jnp.zeros(buckets, dtype=a.dtype).at[idx].add(a),
+                min_of=lambda a: (
+                    jnp.full(buckets, _max_of(jnp, a.dtype), dtype=a.dtype)
+                    .at[idx]
+                    .min(a)
+                ),
+                max_of=lambda a: (
+                    jnp.full(buckets, _min_of(jnp, a.dtype), dtype=a.dtype)
+                    .at[idx]
+                    .max(a)
+                ),
+                count_all=present,
+            )
             return (present,) + tuple(outs)
 
         n_out = 1 + len(agg_sig)
         mapped = jax.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(P(ROW_AXIS), P()) + tuple(P(ROW_AXIS) for _ in range(len(agg_sig) + 1)),
+            in_specs=(P(ROW_AXIS), P()) + tuple(P(ROW_AXIS) for _ in range(num_vals + 1)),
             out_specs=tuple(P(ROW_AXIS) for _ in range(n_out)),
         )
         _COMPILE_CACHE[cache_key] = jax.jit(mapped)
     return _COMPILE_CACHE[cache_key]
 
 
+def _dedupe_cols(
+    agg_cols: Sequence[Tuple[Any, ...]]
+) -> Tuple[Tuple[Tuple[str, str, int, bool], ...], List[Any]]:
+    """Dedupe value arrays by identity → (specs with column indexes, arrays).
+
+    ``agg_cols`` entries are ``(name, agg, arr)`` or ``(name, agg, arr,
+    nullable)``; the same array referenced by several aggs (avg → sum+count)
+    is passed to the kernel once.
+    """
+    uniq: Dict[int, int] = {}
+    arrays: List[Any] = []
+    specs: List[Tuple[str, str, int, bool]] = []
+    for entry in agg_cols:
+        name, agg, arr = entry[0], entry[1], entry[2]
+        nullable = bool(entry[3]) if len(entry) > 3 else True
+        if id(arr) not in uniq:
+            uniq[id(arr)] = len(arrays)
+            arrays.append(arr)
+        specs.append((name, agg, uniq[id(arr)], nullable))
+    return tuple(specs), arrays
+
+
 def _dense_groupby_partials(
     mesh: Any,
     key_name: str,
     key_arr: Any,
-    agg_cols: List[Tuple[str, str, Any]],
+    agg_cols: List[Tuple[Any, ...]],
     valid: Any,
     kmin: int,
     buckets: int,
@@ -282,11 +393,9 @@ def _dense_groupby_partials(
 
     from ..parallel.mesh import ROW_AXIS
 
-    agg_sig = tuple((name, agg) for name, agg, _ in agg_cols)
+    agg_sig, arrays = _dedupe_cols(agg_cols)
     compiled = _get_compiled_dense(mesh, buckets, agg_sig)
-    outs = compiled(
-        key_arr, np_.int64(kmin), *[arr for _, _, arr in agg_cols], valid
-    )
+    outs = compiled(key_arr, np_.int64(kmin), *arrays, valid)
     shards = mesh.shape[ROW_AXIS]
     host = [np_.asarray(jax.device_get(o)).reshape(shards, buckets) for o in outs]
     present = host[0]
@@ -294,21 +403,25 @@ def _dense_groupby_partials(
     # only valid rows, so zero-presence buckets drop out naturally
     srow, idx = np_.nonzero(present > 0)
     data: Dict[str, Any] = {key_name: idx.astype(np_.int64) + kmin}
-    for (name, _), arr in zip(agg_sig, host[1:]):
-        data[name] = arr[srow, idx]
+    for spec, arr in zip(agg_sig, host[1:]):
+        data[spec[0]] = arr[srow, idx]
     return pd.DataFrame(data)
 
 
 def device_groupby_partials(
     mesh: Any,
     key_cols: Dict[str, Any],
-    agg_cols: List[Tuple[str, str, Any]],
+    agg_cols: List[Tuple[Any, ...]],
     valid_mask: Any,
 ) -> "Any":
     """Run the device phase; return a host pandas frame of per-shard-group
     partials. Strategy: single int key with a small range → dense scatter-add
     (no sort); otherwise lexicographic sort + segment reduction. Only
     ``O(shards * groups)`` rows are transferred either way.
+
+    ``agg_cols`` entries are ``(name, agg, arr)`` or ``(name, agg, arr,
+    nullable)`` — ``nullable=False`` marks a float column proved NaN-free,
+    which skips the NaN-as-NULL masking work in the kernels.
     """
     import jax
     import numpy as np_
@@ -334,18 +447,18 @@ def device_groupby_partials(
                 return _dense_groupby_partials(
                     mesh, key_names[0], karr, agg_cols, valid0, kmin, buckets
                 )
-    agg_sig = tuple((name, agg) for name, agg, _ in agg_cols)
+    agg_sig, arrays = _dedupe_cols(agg_cols)
     compiled = _get_compiled_kernel(mesh, len(key_names), agg_sig)
     valid = valid0
-    in_args = (
-        tuple(key_cols.values()) + tuple(arr for _, _, arr in agg_cols) + (valid,)
-    )
+    in_args = tuple(key_cols.values()) + tuple(arrays) + (valid,)
     outs = compiled(*in_args)
     nsegs = np_.asarray(jax.device_get(outs[0]))  # (shards,) tiny transfer
     shards = mesh.shape[ROW_AXIS]
     k_max = int(nsegs.max()) if len(nsegs) > 0 else 0
     if k_max == 0:
-        return pd.DataFrame({n: [] for n in key_names + [n for n, _ in agg_sig]})
+        return pd.DataFrame(
+            {n: [] for n in key_names + [s[0] for s in agg_sig]}
+        )
     # round up to limit distinct compiled slicers
     k = 1 << (k_max - 1).bit_length()
     local_n = outs[1].shape[0] // shards
@@ -358,25 +471,39 @@ def device_groupby_partials(
     data = {}
     for name, arr in zip(key_names, host[: len(key_names)]):
         data[name] = arr[srow, idx]
-    for (name, _), arr in zip(agg_sig, host[len(key_names) :]):
-        data[name] = arr[srow, idx]
+    for spec, arr in zip(agg_sig, host[len(key_names) :]):
+        data[spec[0]] = arr[srow, idx]
     return pd.DataFrame(data)
 
 
 def merge_partials(
     partials: "Any", key_names: List[str], agg_specs: List[Tuple[str, str]]
 ) -> "Any":
-    """Host phase: combine per-shard partials into final aggregates."""
-    agg_map = {}
+    """Host phase: combine per-shard partials into final aggregates.
+
+    NaN partials mean "this shard's group slice was all-NULL" — min/max use
+    pandas' skipna merge, and sum uses ``min_count=1`` so a group that is
+    all-NULL across every shard stays NULL instead of becoming 0.
+    """
+
+    sum_cols: List[str] = []
+    agg_map: Dict[str, Any] = {}
     for name, agg in agg_specs:
-        if agg in ("sum", "count"):
+        if agg == "sum":
+            sum_cols.append(name)
+        elif agg == "count":
             agg_map[name] = "sum"
         elif agg in ("min", "max"):
             agg_map[name] = agg
         else:  # pragma: no cover
             raise NotImplementedError(agg)
-    return (
-        partials.groupby(key_names, dropna=False, sort=False)
-        .agg(agg_map)
-        .reset_index()
-    )
+    grouped = partials.groupby(key_names, dropna=False, sort=False)
+    pieces = []
+    if len(sum_cols) > 0:
+        # vectorized (no per-group python) NULL-preserving sum
+        pieces.append(grouped[sum_cols].sum(min_count=1))
+    if len(agg_map) > 0:
+        pieces.append(grouped.agg(agg_map))
+    merged = pieces[0] if len(pieces) == 1 else pieces[0].join(pieces[1])
+    # restore the caller's column order
+    return merged[[n for n, _ in agg_specs]].reset_index()

@@ -138,12 +138,17 @@ def _tokenize_py(sql: str) -> List[Token]:
                     seen_dot = True
                 j += 1
             if j < n and sql[j] in "eE":
-                j += 1
-                if j < n and sql[j] in "+-":
-                    j += 1
-                while j < n and sql[j].isdigit():
-                    j += 1
-                seen_dot = True
+                # only consume the exponent when a digit follows the optional
+                # sign — '1e' / '2e+' must tokenize as NUMBER+IDENT, matching
+                # the native tokenizer's backtracking
+                k = j + 1
+                if k < n and sql[k] in "+-":
+                    k += 1
+                if k < n and sql[k].isdigit():
+                    while k < n and sql[k].isdigit():
+                        k += 1
+                    j = k
+                    seen_dot = True
             tokens.append(Token("NUMBER", sql[i:j], i))
             i = j
             continue

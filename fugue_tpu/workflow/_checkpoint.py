@@ -108,13 +108,19 @@ class StrongCheckpoint(Checkpoint):
         base = path.permanent_path if self.permanent else path.temp_path
         return os.path.join(base, self._tid + ".parquet")
 
+    def _table_name(self) -> str:
+        return "tbl_" + self._tid.replace("-", "")
+
     def exists(self, path: "CheckpointPath", tid: str) -> bool:
         if not self.deterministic:
             return False
         self.set_id(tid)
         if self.storage_type == "file":
             return os.path.exists(self._file_path(path))
-        return False
+        try:
+            return path.execution_engine.sql_engine.table_exists(self._table_name())
+        except Exception:  # engines without table support can't resume
+            return False
 
     def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
         engine = path.execution_engine
@@ -132,14 +138,21 @@ class StrongCheckpoint(Checkpoint):
                 )
             res = engine.load_df(fp, format_hint="parquet")
         else:
-            table = "tbl_" + self._tid.replace("-", "")
-            engine.sql_engine.save_table(df, table, **self.kwargs)
+            table = self._table_name()
+            if not (self.deterministic and engine.sql_engine.table_exists(table)):
+                engine.sql_engine.save_table(df, table, **self.kwargs)
             res = engine.sql_engine.load_table(table)
         if self.yielded is not None:
             self.yielded.set_value(fp if self.storage_type == "file" else table)
         return res
 
     def load(self, path: "CheckpointPath") -> DataFrame:
+        if self.storage_type == "table":
+            table = self._table_name()
+            res = path.execution_engine.sql_engine.load_table(table)
+            if self.yielded is not None:
+                self.yielded.set_value(table)
+            return res
         fp = self._file_path(path)
         res = path.execution_engine.load_df(fp, format_hint="parquet")
         if self.yielded is not None:

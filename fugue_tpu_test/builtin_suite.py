@@ -374,6 +374,28 @@ class BuiltInTests:
             assert id1 == id2
             assert id1 != id3
 
+        def test_deterministic_checkpoint_table(self):
+            # table-storage deterministic checkpoints resume across runs too
+            self.engine.conf["fugue.workflow.checkpoint.path"] = os.path.join(
+                self.tmpdir, "ckt"
+            )
+            calls: List[int] = []
+
+            def mock_create(dummy: int = 1) -> pd.DataFrame:
+                calls.append(1)
+                return pd.DataFrame([[1, 2]], columns=["a", "b"])
+
+            dag = FugueWorkflow()
+            dag.create(mock_create).deterministic_checkpoint(storage_type="table")
+            dag.run(self.engine)
+            n1 = len(calls)
+            assert n1 >= 1
+            dag = FugueWorkflow()
+            a = dag.create(mock_create).deterministic_checkpoint(storage_type="table")
+            a.assert_eq(dag.df([[1, 2]], "a:long,b:long"))
+            dag.run(self.engine)
+            assert len(calls) == n1  # creator skipped: resumed from the table
+
         def test_yield_dataframe(self):
             dag = FugueWorkflow()
             dag.df([[1]], "a:long").yield_dataframe_as("x", as_local=True)

@@ -36,7 +36,11 @@ int ft_tokenize(const char* sql, int n, FtToken** out_tokens, int* out_count,
         if (count == cap) {
             cap *= 2;
             FtToken* nt = (FtToken*)realloc(toks, sizeof(FtToken) * cap);
-            if (nt == nullptr) return false;
+            if (nt == nullptr) {
+                free(toks);  // realloc failure leaves the old block live
+                toks = nullptr;
+                return false;
+            }
             toks = nt;
         }
         toks[count].kind = kind;

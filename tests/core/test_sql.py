@@ -188,10 +188,12 @@ class TestFugueSQL:
 
     def test_fsql_on_jax_engine(self):
         src = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        # on the jax engine the native result is the distributed frame
         r = fugue_sql(
             "SELECT k, SUM(v) AS s FROM src GROUP BY k ORDER BY k",
             engine="jax",
-        )
+            as_fugue=True,
+        ).as_pandas()
         assert r["s"].tolist() == [3.0, 3.0]
 
 
@@ -420,3 +422,44 @@ class TestScalarFunctions:
         assert fugue_sql("SELECT REPLACE(s, 'a', 'x') AS r FROM t")["r"].tolist() == [
             "xb", "cd", "ef", "gh",
         ]
+
+
+class TestTokenizerParity:
+    """The Python and C++ tokenizers must produce identical tokens — the same
+    SQL must not parse differently depending on whether the native lib built."""
+
+    EDGE_INPUTS = [
+        "SELECT 1e5, 2E+3, 3e-2 FROM t",
+        "SELECT 1e FROM t",  # digit-less exponent: NUMBER '1' + IDENT 'e'
+        "SELECT 2e+ FROM t",  # NUMBER '2' + IDENT 'e' + OP '+'
+        "SELECT .5e2, 1.5e, x FROM t",
+        "SELECT a1e2 FROM t",  # identifier, not number
+        "SELECT 'it''s', `odd col` FROM t WHERE a <> 1 AND b != 2",
+        "SELECT * FROM t -- comment\nWHERE a >= 1 /* block */ OR b <= 2",
+    ]
+
+    def test_python_digitless_exponent(self):
+        from fugue_tpu.sql.parser import _tokenize_py
+
+        toks = _tokenize_py("1e")
+        assert [(t.kind, t.value) for t in toks[:2]] == [("NUMBER", "1"), ("IDENT", "e")]
+        toks = _tokenize_py("2e+")
+        assert [(t.kind, t.value) for t in toks[:3]] == [
+            ("NUMBER", "2"),
+            ("IDENT", "e"),
+            ("OP", "+"),
+        ]
+
+    def test_native_matches_python(self):
+        from fugue_tpu.native import native_available, tokenize_native
+        from fugue_tpu.sql.parser import _tokenize_py
+
+        if not native_available():
+            pytest.skip("native tokenizer unavailable")
+        for sql in self.EDGE_INPUTS:
+            py = _tokenize_py(sql)
+            nat = tokenize_native(sql)
+            assert nat is not None
+            assert [(t.kind, t.value, t.pos) for t in py] == [
+                (t.kind, t.value, t.pos) for t in nat
+            ], sql

@@ -106,8 +106,9 @@ class TestSelectDecomposition:
         r = fa.fugue_sql(
             "SELECT k, SUM(v) AS s FROM pdf WHERE k < 5 GROUP BY k ORDER BY k",
             engine="jax",
+            as_fugue=True,
         )
-        g = r.to_pandas() if hasattr(r, "to_pandas") else r
+        g = r.as_pandas()
         exp = pdf[pdf["k"] < 5].groupby("k").agg(s=("v", "sum")).reset_index()
         assert np.allclose(g["s"], exp["s"])
 

@@ -58,6 +58,48 @@ class TestJaxSpecific:
         assert np.allclose(got[["s", "m"]], exp[["s", "m"]])
         e.stop()
 
+    def test_device_aggregate_nan_is_null(self):
+        """NaN floats on device are NULLs: excluded from every aggregate and
+        all-NULL groups yield NULL — independent of shard layout (both the
+        dense-bucket and sort+segment kernels)."""
+        import numpy as np
+        import pyarrow as pa
+
+        from fugue_tpu.collections import PartitionSpec
+        from fugue_tpu.column import col, functions as f
+
+        e = JaxExecutionEngine()
+        # arrow keeps NaN as a value (null_count==0) → column goes to device
+        for keys in ([1, 1, 2, 2, 3, 3], [1, 1, 2, 2, 10**9, 10**9]):
+            tbl = pa.table(
+                {
+                    "k": pa.array(keys, pa.int64()),
+                    "v": pa.array(
+                        [1.0, np.nan, np.nan, np.nan, 2.0, 4.0], pa.float64()
+                    ),
+                }
+            )
+            jdf = e.to_df(tbl)
+            assert "v" in jdf.device_cols  # precondition: device path
+            res = e.aggregate(
+                jdf,
+                PartitionSpec(by=["k"]),
+                [
+                    f.sum(col("v")).alias("s"),
+                    f.count(col("v")).alias("n"),
+                    f.min(col("v")).alias("lo"),
+                    f.max(col("v")).alias("hi"),
+                    f.avg(col("v")).alias("m"),
+                ],
+            )
+            got = res.as_pandas().sort_values("k").reset_index(drop=True)
+            assert got["n"].tolist() == [1, 0, 2]
+            assert got["s"][0] == 1.0 and np.isnan(got["s"][1]) and got["s"][2] == 6.0
+            assert np.isnan(got["lo"][1]) and np.isnan(got["hi"][1])
+            assert got["lo"][2] == 2.0 and got["hi"][2] == 4.0
+            assert got["m"][0] == 1.0 and np.isnan(got["m"][1]) and got["m"][2] == 3.0
+        e.stop()
+
     def test_compiled_shard_map_transform(self):
         from typing import Dict
 
