@@ -1,12 +1,20 @@
-"""Benchmark: transform()-style groupby aggregation, TPU engine vs pandas oracle.
+"""Benchmark: the reference's flagship workloads, TPU engine vs pandas oracle.
 
-BASELINE.md config #1/#3: the reference's flagship workload is
-``transform()`` groupby-apply. Baseline = the same workload through the
-NativeExecutionEngine (pandas sort+groupby-apply, i.e. what the reference's
-default engine does). Ours = the JaxExecutionEngine two-phase device
-aggregate (sort+segment reduction on device, O(groups) host merge).
+Two measurements (BASELINE.md configs #1/#3):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- ``groupby_aggregate`` — the engine-verb path: ``aggregate()`` by key with
+  sum/count/avg. Ours = the JaxExecutionEngine two-phase device aggregate
+  (dense scatter-add or sort+segment reduction on device, O(groups) host
+  merge); baseline = the same verbs on the NativeExecutionEngine (pandas,
+  i.e. what the reference's default engine does).
+- ``transform_udf`` — BASELINE config #1: ``transform()`` groupby-APPLY with
+  a per-group pandas UDF, the reference's headline workload. Measured on
+  both engines with the same UDF.
+
+Prints ONE JSON line with the required keys ``metric/value/unit/vs_baseline``
+(the headline = device aggregate) plus ``platform``/``devices`` so the
+recorded number can never masquerade as a TPU result when it ran on the
+CPU mesh, and an ``extra`` block with the secondary measurement.
 """
 
 import json
@@ -16,6 +24,7 @@ import time
 N_ROWS = int(os.environ.get("BENCH_ROWS", "2000000"))
 N_GROUPS = int(os.environ.get("BENCH_GROUPS", "1000"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+UDF_ROWS = int(os.environ.get("BENCH_UDF_ROWS", "1000000"))
 
 
 def _tpu_reachable(timeout_s: float = 45.0) -> bool:
@@ -35,10 +44,18 @@ def _tpu_reachable(timeout_s: float = 45.0) -> bool:
         return False
 
 
+def _timeit(fn, repeats: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        fn()
+    return time.perf_counter() - t0
+
+
 def main() -> None:
     if not _tpu_reachable():
         # accelerator tunnel is down: fall back to the virtual CPU mesh so
-        # the benchmark still completes and reports
+        # the benchmark still completes and reports (the platform field
+        # records where it actually ran)
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -47,13 +64,18 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    import jax
     import numpy as np
     import pandas as pd
 
+    import fugue_tpu.api as fa
     from fugue_tpu.collections import PartitionSpec
     from fugue_tpu.column import col, functions as ff
     from fugue_tpu.execution import NativeExecutionEngine
     from fugue_tpu.jax import JaxExecutionEngine
+
+    devices = jax.devices()
+    platform = devices[0].platform
 
     rng = np.random.default_rng(42)
     pdf = pd.DataFrame(
@@ -69,21 +91,19 @@ def main() -> None:
     ]
     spec = PartitionSpec(by=["k"])
 
-    # ---- baseline: pandas oracle engine (reference-default behavior) ------
+    # ---- config #3: engine-verb aggregate ---------------------------------
     host = NativeExecutionEngine()
     hdf = host.to_df(pdf)
     host.aggregate(hdf, spec, aggs())  # warmup
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        host.aggregate(hdf, spec, aggs())
-    host_rps = N_ROWS * REPEATS / (time.perf_counter() - t0)
+    host_agg_rps = N_ROWS * REPEATS / _timeit(
+        lambda: host.aggregate(hdf, spec, aggs()), REPEATS
+    )
 
-    # ---- ours: device two-phase aggregate ---------------------------------
     eng = JaxExecutionEngine()
     jdf = eng.to_df(pdf)
     eng.persist(jdf)
     res = eng.aggregate(jdf, spec, aggs())  # warmup + compile
-    # correctness spot check
+    # correctness spot check against pandas
     got = res.as_pandas().sort_values("k").reset_index(drop=True)
     exp = (
         pdf.groupby("k")
@@ -93,18 +113,53 @@ def main() -> None:
     assert np.allclose(got[["s", "m"]], exp[["s", "m"]]) and (
         got["n"] == exp["n"]
     ).all(), "device aggregate mismatch"
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        eng.aggregate(jdf, spec, aggs())
-    jax_rps = N_ROWS * REPEATS / (time.perf_counter() - t0)
+    jax_agg_rps = N_ROWS * REPEATS / _timeit(
+        lambda: eng.aggregate(jdf, spec, aggs()), REPEATS
+    )
+
+    # ---- config #1: transform() groupby-apply (the UDF path) --------------
+    udf_pdf = pdf.iloc[:UDF_ROWS]
+
+    def demean(df: pd.DataFrame) -> pd.DataFrame:
+        df["v"] = df["v"] - df["v"].mean()
+        return df
+
+    fa.transform(
+        udf_pdf, demean, schema="*", partition=spec, engine=host
+    )  # warmup
+    host_udf_rps = UDF_ROWS / _timeit(
+        lambda: fa.transform(
+            udf_pdf, demean, schema="*", partition=spec, engine=host
+        ),
+        1,
+    )
+    fa.transform(udf_pdf, demean, schema="*", partition=spec, engine=eng)
+    jax_udf_rps = UDF_ROWS / _timeit(
+        lambda: fa.transform(
+            udf_pdf, demean, schema="*", partition=spec, engine=eng
+        ),
+        1,
+    )
 
     print(
         json.dumps(
             {
                 "metric": "groupby_aggregate_rows_per_sec",
-                "value": round(jax_rps, 1),
+                "value": round(jax_agg_rps, 1),
                 "unit": "rows/s",
-                "vs_baseline": round(jax_rps / host_rps, 3),
+                "vs_baseline": round(jax_agg_rps / host_agg_rps, 3),
+                "platform": platform,
+                "devices": len(devices),
+                "extra": {
+                    "transform_udf_rows_per_sec": round(jax_udf_rps, 1),
+                    "transform_udf_vs_baseline": round(
+                        jax_udf_rps / host_udf_rps, 3
+                    ),
+                    "baseline_aggregate_rows_per_sec": round(host_agg_rps, 1),
+                    "baseline_transform_udf_rows_per_sec": round(
+                        host_udf_rps, 1
+                    ),
+                },
             }
         )
     )

@@ -216,9 +216,64 @@ class JaxExecutionEngine(ExecutionEngine):
 
     # ---- distribution primitives ------------------------------------------
     def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
-        # row sharding is the physical layout; logical partitioning happens
-        # in map/aggregate via sort+segments, so this is metadata-only
-        return df
+        """Physically move rows between shards with an all-to-all exchange.
+
+        ``hash`` (or keyed default) co-locates equal keys on one shard —
+        the basis for shuffle joins and co-sharded cotransforms; ``even``
+        (or key-less default) rebalances row counts; ``rand`` scatters
+        randomly; ``coarse`` is metadata-only by definition. Frames with
+        host-resident columns keep their layout (logical partitioning in
+        map/aggregate still honors the spec) — that case logs a warning.
+        Matches the reference's per-backend repartition algorithms
+        (``fugue_spark/_utils/partition.py:15-117``).
+        """
+        from ..ops.shuffle import compute_dest, exchange_rows
+
+        if partition_spec is None or partition_spec.empty:
+            return df
+        jdf = self.to_df(df)
+        algo = partition_spec.algo
+        by = list(partition_spec.partition_by)
+        if algo == "coarse":
+            return jdf
+        if algo == "":
+            algo = "hash" if len(by) > 0 else "even"
+        if algo == "hash" and len(by) == 0:
+            algo = "even"
+        device_ok = (
+            isinstance(jdf, JaxDataFrame)
+            and len(jdf.device_cols) > 0
+            and jdf.host_table is None
+            and (algo != "hash" or all(k in jdf.device_cols for k in by))
+        )
+        if not device_ok:
+            self.log.warning(
+                "repartition(%s): frame has host-resident columns; physical "
+                "layout unchanged (logical partitioning still applies)",
+                algo,
+            )
+            return jdf
+        valid = jdf.device_valid_mask()
+        dest = compute_dest(
+            self._mesh,
+            algo,
+            [jdf.device_cols[k] for k in by] if algo == "hash" else [],
+            valid,
+        )
+        new_cols, new_valid, _ = exchange_rows(
+            self._mesh, dict(jdf.device_cols), valid, dest
+        )
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols=new_cols,
+                host_tbl=None,
+                row_count=jdf.count(),
+                valid_mask=new_valid,
+                nan_cols=jdf._nan_cols,
+                schema=jdf.schema,
+            ),
+        )
 
     def broadcast(self, df: DataFrame) -> DataFrame:
         import jax
@@ -295,66 +350,117 @@ class JaxExecutionEngine(ExecutionEngine):
         return self.to_df(df)
 
     def join(self, df1, df2, how: str, on=None) -> DataFrame:
-        """INNER fact×dim joins on a single int key run on device
-        (broadcast hash join, ``ops/join.py``); everything else host."""
-        from ..dataframe.utils import get_join_schemas, parse_join_type
-        from ..ops.join import device_broadcast_inner_join
+        """Hash joins on numeric keys run on device (``ops/join.py``):
+        inner / left_outer / left_semi / left_anti, multi-key, with a
+        broadcast strategy for small right sides and a shuffle
+        (co-partition + shard-local probe) strategy for large×large.
+        Non-unique right keys, non-numeric keys, and right/full_outer /
+        cross go to the host engine."""
+        from ..dataframe.utils import parse_join_type
 
-        if parse_join_type(how) == "inner" and isinstance(df1, DataFrame) and isinstance(df2, DataFrame):
-            import pyarrow as pa_
-
-            try:
-                key_schema, out_schema = get_join_schemas(df1, df2, how="inner", on=on)
-            except Exception:
-                key_schema = None
-            # cheap pre-checks on schemas BEFORE any device conversion
-            if (
-                key_schema is not None
-                and len(key_schema) == 1
-                and pa_.types.is_integer(key_schema.types[0])
-                and key_schema.names[0] in df2.schema
-                and pa_.types.is_integer(df2.schema[key_schema.names[0]].type)
-            ):
-                j1, j2 = self.to_df(df1), self.to_df(df2)
-            else:
-                j1 = j2 = None
-            if (
-                j1 is not None
-                and isinstance(j1, JaxDataFrame)
-                and isinstance(j2, JaxDataFrame)
-                and j2.host_table is None
-                and len(j2.device_cols) == len(j2.schema)
-                and key_schema.names[0] in j1.device_cols
-            ):
-                import jax
-
-                key = key_schema.names[0]
-                rep = replicated_sharding(self._mesh)
-                dim_cols = {
-                    n: jax.device_put(a, rep) for n, a in j2.device_cols.items()
-                }
-                dim_valid = jax.device_put(j2.device_valid_mask(), rep)
-                res = device_broadcast_inner_join(
-                    self._mesh,
-                    dict(j1.device_cols),
-                    j1.device_valid_mask(),
-                    key,
-                    dim_cols,
-                    dim_valid,
-                )
-                if res is not None:
-                    new_cols, match = res
-                    return JaxDataFrame(
-                        mesh=self._mesh,
-                        _internal=dict(
-                            device_cols={n: new_cols[n] for n in out_schema.names if n in new_cols},
-                            host_tbl=j1.host_table,
-                            row_count=-1,
-                            valid_mask=match,
-                            schema=out_schema,
-                        ),
-                    )
+        jt = parse_join_type(how)
+        if jt in ("inner", "left_outer", "left_semi", "left_anti"):
+            kernel_how = {
+                "inner": "inner",
+                "left_outer": "left_outer",
+                "left_semi": "semi",
+                "left_anti": "anti",
+            }[jt]
+            res = self._join_device(df1, df2, kernel_how, on)
+            if res is not None:
+                return res
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
+
+    def _join_device(self, df1, df2, kernel_how: str, on) -> Optional[DataFrame]:
+        """Try the device hash join; None → host fallback."""
+        from ..dataframe.utils import get_join_schemas
+        from ..ops.join import MAX_BROADCAST_ROWS, device_hash_join
+
+        if not (isinstance(df1, DataFrame) and isinstance(df2, DataFrame)):
+            return None
+        how_for_schema = {
+            "inner": "inner",
+            "left_outer": "left_outer",
+            "semi": "left_semi",
+            "anti": "left_anti",
+        }[kernel_how]
+        try:
+            key_schema, out_schema = get_join_schemas(
+                df1, df2, how=how_for_schema, on=on
+            )
+        except Exception:
+            return None
+        keys = key_schema.names
+        # cheap schema pre-checks BEFORE any device conversion
+        numeric = all(
+            pa.types.is_integer(t) or pa.types.is_floating(t) or pa.types.is_boolean(t)
+            for t in key_schema.types
+        )
+        if len(keys) == 0 or not numeric:
+            return None
+        j1, j2 = self.to_df(df1), self.to_df(df2)
+        if not (
+            isinstance(j1, JaxDataFrame)
+            and isinstance(j2, JaxDataFrame)
+            and j2.host_table is None
+            and len(j2.device_cols) == len(j2.schema)
+            and all(k in j1.device_cols for k in keys)
+        ):
+            return None
+        value_names = [
+            n for n in j2.schema.names if n not in keys and n in out_schema
+        ]
+        import jax
+
+        n_right = next(iter(j2.device_cols.values())).shape[0]
+        if n_right <= MAX_BROADCAST_ROWS:
+            strategy = "broadcast"
+            rep = replicated_sharding(self._mesh)
+            right_cols = {
+                n: jax.device_put(a, rep) for n, a in j2.device_cols.items()
+            }
+            right_valid = jax.device_put(j2.device_valid_mask(), rep)
+            left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
+            host_tbl = j1.host_table  # rows stay in place → stays aligned
+            nan_cols = j1._nan_cols
+        else:
+            strategy = "shuffle"
+            if j1.host_table is not None:
+                return None  # rows move; host columns can't follow
+            right_cols, right_valid = dict(j2.device_cols), j2.device_valid_mask()
+            left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
+            host_tbl = None
+            nan_cols = None
+        res = device_hash_join(
+            self._mesh,
+            kernel_how,
+            left_cols,
+            left_valid,
+            right_cols,
+            right_valid,
+            keys,
+            value_names,
+            strategy=strategy,
+        )
+        if res is None:
+            return None
+        new_cols, match = res
+        if kernel_how == "left_outer" and nan_cols is not None:
+            # gathered right values may be NaN-filled on misses
+            nan_cols = set(nan_cols) | set(value_names)
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols={
+                    n: new_cols[n] for n in out_schema.names if n in new_cols
+                },
+                host_tbl=host_tbl,
+                row_count=-1,
+                valid_mask=match,
+                nan_cols=nan_cols,
+                schema=out_schema,
+            ),
+        )
 
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
         res = self._back(

@@ -1,87 +1,245 @@
-"""Device broadcast join (fact × dimension).
+"""Device joins: broadcast and shuffle hash joins over the mesh.
 
-The reference delegates joins to backend SQL/shuffles (SURVEY §2.9); the
-first device join here is the common warehouse shape: a large row-sharded
-fact frame INNER-joined to a small dimension frame on a unique int key.
+The reference delegates joins to backend SQL engines / task shuffles
+(SURVEY §2.9, ``fugue_duckdb/execution_engine.py:233+``); here they are
+static-shape XLA kernels (SURVEY §7 "mask, don't branch"):
 
-Design (no data-dependent shapes anywhere):
+- keys (one or many, int/float/bool) are mixed into a u64 row hash; the
+  right side is sorted by hash, the left probes with ``searchsorted``
+  (O(n log m) on the VPU) and verifies REAL key equality on the gathered
+  row, so hash collisions can only cause a fallback (duplicate hashes on
+  the right are detected at prep), never a wrong match;
+- join types map onto the frame validity mask: ``inner``/``semi`` AND the
+  match in, ``anti`` ANDs its negation, ``left_outer`` keeps all left rows
+  and NaN-fills gathered values (device NULL) — so no join ever compacts
+  or materializes variable-shape output;
+- strategies: **broadcast** replicates a small right side to every device;
+  **shuffle** co-partitions both sides by key hash with the all-to-all
+  exchange (``ops/shuffle.py``) and probes shard-locally — the large×large
+  path. Both require unique join keys on the right (verified on device);
+  many-to-many joins fall back to the host engine.
 
-- the dimension side is replicated to every device and sorted by key once;
-- each shard binary-searches its fact keys against the sorted dim keys
-  (``searchsorted`` → O(n log m) on the VPU);
-- dim value columns gather by the found index; misses stay as garbage rows
-  but the frame's validity mask is ANDed with the match mask — the same
-  zero-copy mechanism device filters use, so an inner join never needs
-  compaction or null representation.
-
-Uniqueness of the dim key is verified on device (adjacent-equal check after
-the sort); non-unique or oversized dims fall back to the host join.
+NULL keys never match (SQL semantics): NaN float keys are excluded from
+both sides' match sets on device.
 """
 
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..parallel.mesh import ROW_AXIS, num_row_shards
+from .shuffle import _hash_cols
 
 _JOIN_CACHE: Dict[Any, Any] = {}
 
-# dimension sides larger than this stay on the host join path
-MAX_BROADCAST_ROWS = 1 << 21
+# right sides larger than this use the shuffle strategy
+MAX_BROADCAST_ROWS = 1 << 20
 
 
-def _get_compiled_dim_prep(mesh: Any):
-    """Sort the replicated dim key + report uniqueness (cached per mesh)."""
+def _key_hash_and_valid(jnp: Any, key_cols: List[Any], valid: Any):
+    """(u64 hash, validity excluding NaN keys) for a set of key columns."""
+    kv = valid
+    for c in key_cols:
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            kv = kv & ~jnp.isnan(c)
+    return _hash_cols(jnp, key_cols), kv
+
+
+def _probe_body(
+    jnp: Any,
+    how: str,
+    fk_cols: Tuple[Any, ...],
+    f_valid: Any,
+    rk_sorted_hash: Any,
+    r_order: Any,
+    r_nvalid: Any,
+    rk_cols: Tuple[Any, ...],
+    r_values: Tuple[Any, ...],
+):
+    """Shared probe: fact hashes against the hash-sorted right side."""
+    fh, fkv = _key_hash_and_valid(jnp, list(fk_cols), f_valid)
+    idx = jnp.searchsorted(rk_sorted_hash, fh)
+    idx_c = jnp.clip(idx, 0, rk_sorted_hash.shape[0] - 1)
+    cand = (rk_sorted_hash[idx_c] == fh) & (idx < r_nvalid) & fkv
+    src = r_order[idx_c]
+    # verify true key equality on the candidate row (collision safety)
+    eq = cand
+    for fk, rk in zip(fk_cols, rk_cols):
+        eq = eq & (rk[src] == fk)
+    if how == "inner":
+        new_valid = f_valid & eq
+        gathered = tuple(rv[src] for rv in r_values)
+    elif how == "left_outer":
+        new_valid = f_valid
+        gathered = tuple(
+            jnp.where(eq, rv[src], jnp.nan).astype(rv.dtype) for rv in r_values
+        )
+    elif how == "semi":
+        new_valid = f_valid & eq
+        gathered = ()
+    elif how == "anti":
+        new_valid = f_valid & ~eq
+        gathered = ()
+    else:  # pragma: no cover
+        raise NotImplementedError(how)
+    return (new_valid,) + gathered
+
+
+def _get_compiled_right_prep(mesh: Any, n_keys: int, dtypes: Any, local: bool):
+    """Hash + sort the right side; report duplicate hashes among valid rows.
+
+    ``local=True`` preps each shard's block independently (shuffle join);
+    ``local=False`` preps a replicated array (broadcast join).
+    """
     import jax
     import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
 
-    key = ("dimprep", mesh)
+    key = ("rprep", mesh, n_keys, dtypes, local)
     if key not in _JOIN_CACHE:
 
-        def prep(dim_key: Any, dim_valid: Any):
-            # push invalid rows to the end so they never match
-            big = jnp.where(dim_valid, dim_key, jnp.iinfo(dim_key.dtype).max)
-            order = jnp.argsort(big)
-            k_sorted = big[order]
-            n_valid = dim_valid.sum()
+        def prep(valid: Any, *key_cols: Any):
+            h, kv = _key_hash_and_valid(jnp, list(key_cols), valid)
+            n = h.shape[0]
+            inv = jnp.logical_not(kv)
+            iota = lax.iota(jnp.int32, n)
+            s_inv, s_h, order = lax.sort((inv, h, iota), num_keys=2)
+            nv = kv.sum(dtype=jnp.int64)
             dup = jnp.any(
-                (k_sorted[1:] == k_sorted[:-1])
-                & (jnp.arange(1, k_sorted.shape[0]) < n_valid)
+                (s_h[1:] == s_h[:-1])
+                & jnp.logical_not(s_inv[1:])
+                & jnp.logical_not(s_inv[:-1])
             )
-            return k_sorted, order, n_valid, dup
+            # invalid rows sit at the tail but keep arbitrary hashes — pin
+            # them to the max so the array stays globally sorted for
+            # searchsorted (the idx < nv guard keeps them unmatchable)
+            s_h = jnp.where(s_inv, jnp.uint64(0xFFFFFFFFFFFFFFFF), s_h)
+            return s_h, order, nv[None], dup[None]
 
-        _JOIN_CACHE[key] = jax.jit(prep)
+        if local:
+            spec = P(ROW_AXIS)
+            _JOIN_CACHE[key] = jax.jit(
+                jax.shard_map(
+                    prep,
+                    mesh=mesh,
+                    in_specs=tuple(spec for _ in range(1 + n_keys)),
+                    out_specs=(spec, spec, spec, spec),
+                )
+            )
+        else:
+            _JOIN_CACHE[key] = jax.jit(prep)
     return _JOIN_CACHE[key]
 
 
-def _get_compiled_probe(mesh: Any, n_values: int):
-    """Probe fact keys against the sorted dim and gather value columns."""
+def _get_compiled_probe(
+    mesh: Any, how: str, n_keys: int, n_values: int, dtypes: Any, local: bool
+):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import ROW_AXIS
-
-    key = ("probe", mesh, n_values)
+    key = ("probe", mesh, how, n_keys, n_values, dtypes, local)
     if key not in _JOIN_CACHE:
 
-        def probe(fact_key: Any, fact_valid: Any, k_sorted: Any, order: Any,
-                  n_valid: Any, *dim_values: Any):
-            def shard_fn(fk: Any, fv: Any, ks: Any, od: Any, nv: Any, *dvs: Any):
-                idx = jnp.searchsorted(ks, fk)
-                idx_c = jnp.clip(idx, 0, ks.shape[0] - 1)
-                match = (ks[idx_c] == fk) & (idx < nv) & fv
-                src = od[idx_c]
-                gathered = tuple(dv[src] for dv in dvs)
-                return (match,) + gathered
+        def probe(*args: Any):
+            (f_valid, s_h, order, nv) = args[:4]
+            fk = args[4 : 4 + n_keys]
+            rk = args[4 + n_keys : 4 + 2 * n_keys]
+            rv = args[4 + 2 * n_keys :]
 
-            n_out = 1 + len(dim_values)
+            def shard_fn(fv_, sh_, od_, nv_, *rest: Any):
+                fk_ = rest[:n_keys]
+                rk_ = rest[n_keys : 2 * n_keys]
+                rv_ = rest[2 * n_keys :]
+                return _probe_body(
+                    jnp, how, fk_, fv_, sh_, od_, nv_[0], rk_, rv_
+                )
+
+            row = P(ROW_AXIS)
+            right = row if local else P()
+            n_out = 1 + (n_values if how in ("inner", "left_outer") else 0)
             return jax.shard_map(
                 shard_fn,
                 mesh=mesh,
-                in_specs=(P(ROW_AXIS), P(ROW_AXIS), P(), P(), P())
-                + tuple(P() for _ in dim_values),
-                out_specs=tuple(P(ROW_AXIS) for _ in range(n_out)),
-            )(fact_key, fact_valid, k_sorted, order, n_valid, *dim_values)
+                in_specs=(row, right, right, right)
+                + tuple(row for _ in range(n_keys))
+                + tuple(right for _ in range(n_keys + n_values)),
+                out_specs=tuple(row for _ in range(n_out)),
+            )(f_valid, s_h, order, nv, *fk, *rk, *rv)
 
         _JOIN_CACHE[key] = jax.jit(probe)
     return _JOIN_CACHE[key]
+
+
+def device_hash_join(
+    mesh: Any,
+    how: str,
+    left_cols: Dict[str, Any],
+    left_valid: Any,
+    right_cols: Dict[str, Any],
+    right_valid: Any,
+    key_names: List[str],
+    value_names: List[str],
+    strategy: str = "broadcast",
+) -> Optional[Tuple[Dict[str, Any], Any]]:
+    """Join ``left`` with ``right`` on ``key_names``; gather ``value_names``
+    from the right. Returns (new_device_cols, new_valid) or None on host
+    fallback (non-unique right keys, or a ``left_outer`` whose right value
+    columns cannot represent NULL on device).
+
+    ``strategy="broadcast"`` expects the right side replicated to every
+    device; ``strategy="shuffle"`` expects both sides row-sharded and
+    co-partitions them by key hash with the all-to-all exchange first.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if how == "left_outer" and any(
+        not jnp.issubdtype(right_cols[v].dtype, jnp.floating)
+        for v in value_names
+    ):
+        return None  # NaN is the only device NULL; int/bool misses can't fill
+    shuffle = strategy == "shuffle"
+    if shuffle:
+        from .shuffle import compute_dest, exchange_rows
+
+        # co-partition both sides by the same key hash
+        l_dest = compute_dest(
+            mesh, "hash", [left_cols[k] for k in key_names], left_valid
+        )
+        r_dest = compute_dest(
+            mesh, "hash", [right_cols[k] for k in key_names], right_valid
+        )
+        left_cols, left_valid, _ = exchange_rows(
+            mesh, dict(left_cols), left_valid, l_dest
+        )
+        right_cols, right_valid, _ = exchange_rows(
+            mesh, dict(right_cols), right_valid, r_dest
+        )
+    kdt = tuple(str(right_cols[k].dtype) for k in key_names)
+    prep = _get_compiled_right_prep(mesh, len(key_names), kdt, local=shuffle)
+    s_h, order, nv, dup = prep(right_valid, *[right_cols[k] for k in key_names])
+    if bool(np.asarray(jax.device_get(dup)).any()):
+        return None  # duplicate keys (or hash collision) → host join
+    vdt = tuple(str(right_cols[v].dtype) for v in value_names)
+    probe = _get_compiled_probe(
+        mesh, how, len(key_names), len(value_names), (kdt, vdt), local=shuffle
+    )
+    outs = probe(
+        left_valid,
+        s_h,
+        order,
+        nv,
+        *[left_cols[k] for k in key_names],
+        *[right_cols[k] for k in key_names],
+        *[right_cols[v] for v in value_names],
+    )
+    new_valid = outs[0]
+    new_cols = dict(left_cols)
+    if how in ("inner", "left_outer"):
+        for name, arr in zip(value_names, outs[1:]):
+            new_cols[name] = arr
+    return new_cols, new_valid
 
 
 def device_broadcast_inner_join(
@@ -92,32 +250,15 @@ def device_broadcast_inner_join(
     dim_cols: Dict[str, Any],
     dim_valid: Any,
 ) -> Any:
-    """Returns (new_device_cols, new_valid_mask) or None on fallback.
-
-    ``dim_cols`` must include the key column; all dim columns must be
-    replicated (caller replicates). Fallback (None) when the dim key is not
-    unique.
-    """
-    import jax
-
-    dim_key = dim_cols[key_name]
-    if dim_key.shape[0] > MAX_BROADCAST_ROWS:
-        return None
-    k_sorted, order, n_valid, dup = _get_compiled_dim_prep(mesh)(dim_key, dim_valid)
-    if bool(jax.device_get(dup)):
-        return None  # non-unique dim keys → host join (may multiply rows)
+    """Back-compat single-key INNER wrapper over :func:`device_hash_join`."""
     value_names = [n for n in dim_cols if n != key_name]
-    probe = _get_compiled_probe(mesh, len(value_names))
-    outs = probe(
-        fact_cols[key_name],
+    return device_hash_join(
+        mesh,
+        "inner",
+        fact_cols,
         fact_valid,
-        k_sorted,
-        order,
-        n_valid,
-        *[dim_cols[n] for n in value_names],
+        dim_cols,
+        dim_valid,
+        [key_name],
+        value_names,
     )
-    match = outs[0]
-    new_cols = dict(fact_cols)
-    for name, arr in zip(value_names, outs[1:]):
-        new_cols[name] = arr
-    return new_cols, match

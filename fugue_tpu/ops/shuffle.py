@@ -1,0 +1,301 @@
+"""Device shuffle: all-to-all row exchange over the mesh rows axis.
+
+The TPU-native replacement for the reference's per-backend repartition
+algorithms (``fugue_spark/_utils/partition.py:15-117`` hash/rand/even and
+``fugue_dask/_utils.py:44-123``): instead of a task-graph shuffle, rows move
+between shards with ONE ``lax.all_to_all`` collective inside ``shard_map``
+— the layout XLA maps onto ICI links.
+
+Protocol (static shapes throughout, SURVEY §7 "mask, don't branch"):
+
+1. every row gets a destination shard (hash of keys / even rank / random);
+2. a tiny per-(shard, dest) count matrix comes to host to negotiate a
+   static block ``capacity`` (pow2-rounded so compiled variants are reused);
+3. the exchange kernel sorts rows by destination, scatters them into a
+   ``(shards, capacity)`` send buffer, ``all_to_all``s the buffers, and
+   returns the received rows + validity mask.
+
+Worst-case skew (every row to one shard) allocates ``shards × capacity``
+per shard — inherent to the result layout, acceptable at mesh sizes where
+this engine runs; a multi-round exchange is the escalation path.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import ROW_AXIS, num_row_shards
+
+_COMPILE_CACHE: Dict[Any, Any] = {}
+
+# splitmix64 multipliers — the standard 64-bit finalizer mix
+_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+
+
+def _hash_cols(jnp: Any, cols: List[Any]) -> Any:
+    """Combine columns into a well-mixed uint64 row hash (device-side)."""
+    h = jnp.zeros(cols[0].shape, dtype=jnp.uint64)
+    for c in cols:
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            # bitcast so equal keys hash equally; normalize -0.0 to +0.0
+            c = jnp.where(c == 0, jnp.zeros_like(c), c)
+            x = jax_bitcast_u64(jnp, c)
+        elif c.dtype == jnp.bool_:
+            x = c.astype(jnp.uint64)
+        else:
+            x = c.astype(jnp.uint64)
+        x = (x ^ (x >> 30)) * _MIX1
+        x = (x ^ (x >> 27)) * _MIX2
+        x = x ^ (x >> 31)
+        h = h * np.uint64(31) + x
+    return h
+
+
+def jax_bitcast_u64(jnp: Any, c: Any) -> Any:
+    import jax.lax as lax
+
+    if c.dtype == jnp.float64:
+        return lax.bitcast_convert_type(c, jnp.uint64)
+    return lax.bitcast_convert_type(c.astype(jnp.float64), jnp.uint64)
+
+
+def _get_compiled_dest_hash(mesh: Any, n_keys: int, dtypes: Tuple[Any, ...]):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("dest_hash", mesh, n_keys, dtypes)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(*cols: Any):
+            h = _hash_cols(jnp, list(cols))
+            return (h % np.uint64(shards)).astype(jnp.int32)
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=tuple(P(ROW_AXIS) for _ in range(n_keys)),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_dest_even(mesh: Any):
+    """dest = global rank of the valid row, spread evenly over shards
+    (invalid rows keep their shard — they're masked anyway)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("dest_even", mesh)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(valid: Any):
+            local = jnp.cumsum(valid.astype(jnp.int64)) - 1  # local rank
+            counts = jax.lax.all_gather(valid.sum(dtype=jnp.int64), ROW_AXIS)
+            me = jax.lax.axis_index(ROW_AXIS)
+            offset = jnp.where(
+                jax.lax.iota(jnp.int64, shards) < me, counts, 0
+            ).sum()
+            total = counts.sum()
+            rank = local + offset
+            # ceil-sized blocks: shard i gets ranks [i*block, (i+1)*block)
+            block = jnp.maximum((total + shards - 1) // shards, 1)
+            return jnp.clip(rank // block, 0, shards - 1).astype(jnp.int32)
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS)
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_dest_rand(mesh: Any):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("dest_rand", mesh)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(template: Any, seed: Any):
+            me = jax.lax.axis_index(ROW_AXIS)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed[0]), me)
+            return jax.random.randint(
+                key, template.shape, 0, shards, dtype=jnp.int32
+            )
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS), P()),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_counts(mesh: Any):
+    """Per-shard destination histogram → host (shards × shards, tiny)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("shuffle_counts", mesh)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(dest: Any, valid: Any):
+            return (
+                jnp.zeros(shards, dtype=jnp.int32)
+                .at[dest]
+                .add(valid.astype(jnp.int32))
+            )
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_exchange(
+    mesh: Any, dtypes: Tuple[Any, ...], capacity: int
+):
+    """The all-to-all exchange for ``len(dtypes)`` row-aligned arrays.
+
+    Per shard: sort rows by destination, scatter each destination's rows
+    into its block of a ``(shards, capacity)`` send buffer, exchange
+    blocks with ``lax.all_to_all``, return flattened received arrays and
+    the received-validity mask. Output local length = shards × capacity.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("exchange", mesh, dtypes, capacity)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(dest: Any, valid: Any, *arrs: Any):
+            n = dest.shape[0]
+            big_dest = jnp.where(valid, dest, shards)  # invalid rows last
+            iota = lax.iota(jnp.int32, n)
+            sd, perm = lax.sort((big_dest, iota), num_keys=1)
+            # position of each sorted row within its destination block
+            starts_tbl = jnp.zeros(shards + 1, dtype=jnp.int32).at[sd].add(1)
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(starts_tbl[:shards])]
+            )
+            pos = iota - starts[jnp.clip(sd, 0, shards - 1)]
+            ok = (sd < shards) & (pos < capacity)
+            flat = jnp.where(
+                ok, jnp.clip(sd, 0, shards - 1) * capacity + pos, shards * capacity
+            )
+            send_valid = (
+                jnp.zeros(shards * capacity, dtype=bool)
+                .at[flat]
+                .set(True, mode="drop")
+            )
+            recv_valid = lax.all_to_all(
+                send_valid.reshape(shards, capacity),
+                ROW_AXIS,
+                split_axis=0,
+                concat_axis=0,
+            ).reshape(-1)
+            outs = [recv_valid]
+            for a in arrs:
+                sa = a[perm]
+                send = (
+                    jnp.zeros(shards * capacity, dtype=a.dtype)
+                    .at[flat]
+                    .set(sa, mode="drop")
+                )
+                outs.append(
+                    lax.all_to_all(
+                        send.reshape(shards, capacity),
+                        ROW_AXIS,
+                        split_axis=0,
+                        concat_axis=0,
+                    ).reshape(-1)
+                )
+            return tuple(outs)
+
+        n_in = 2 + len(dtypes)
+        n_out = 1 + len(dtypes)
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=tuple(P(ROW_AXIS) for _ in range(n_in)),
+                out_specs=tuple(P(ROW_AXIS) for _ in range(n_out)),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def compute_dest(
+    mesh: Any,
+    algo: str,
+    key_cols: List[Any],
+    valid: Any,
+    seed: Optional[int] = None,
+) -> Any:
+    """Destination shard per row for the given algorithm."""
+    import numpy as np_
+
+    if algo == "hash":
+        dtypes = tuple(str(c.dtype) for c in key_cols)
+        return _get_compiled_dest_hash(mesh, len(key_cols), dtypes)(*key_cols)
+    if algo == "even":
+        return _get_compiled_dest_even(mesh)(valid)
+    if algo == "rand":
+        if seed is None:
+            seed = int(np_.random.default_rng().integers(0, 2**31 - 1))
+        template = valid
+        return _get_compiled_dest_rand(mesh)(
+            template, np_.asarray([seed], dtype=np_.uint32)
+        )
+    raise ValueError(f"unknown shuffle algo {algo!r}")
+
+
+def exchange_rows(
+    mesh: Any,
+    arrays: Dict[str, Any],
+    valid: Any,
+    dest: Any,
+) -> Tuple[Dict[str, Any], Any, int]:
+    """Move rows to their destination shards.
+
+    Returns (new_arrays, new_valid_mask, received_row_count). The new
+    arrays have padded local length ``shards × capacity`` per shard.
+    """
+    import jax
+
+    shards = num_row_shards(mesh)
+    counts = np.asarray(
+        jax.device_get(_get_compiled_counts(mesh)(dest, valid))
+    ).reshape(shards, shards)
+    cap = int(counts.max())
+    if cap == 0:
+        cap = 1
+    capacity = 1 << (cap - 1).bit_length()  # pow2 → reuse compiled variants
+    dtypes = tuple(str(a.dtype) for a in arrays.values())
+    compiled = _get_compiled_exchange(mesh, dtypes, capacity)
+    outs = compiled(dest, valid, *arrays.values())
+    new_valid = outs[0]
+    new_arrays = {k: v for k, v in zip(arrays.keys(), outs[1:])}
+    return new_arrays, new_valid, int(counts.sum())

@@ -1,0 +1,144 @@
+"""Device hash-join tests: type matrix vs the pandas oracle, both
+strategies (broadcast and shuffle), multi-key, NaN keys, fallbacks."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import fugue_tpu.ops.join as oj
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxDataFrame, JaxExecutionEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    e = NativeExecutionEngine()
+    yield e
+    e.stop()
+
+
+def _check(engine, oracle, df1, df2, how, on=None):
+    got = engine.join(engine.to_df(df1), engine.to_df(df2), how=how, on=on)
+    exp = oracle.join(oracle.to_df(df1), oracle.to_df(df2), how=how, on=on)
+    g = got.as_pandas()
+    e = exp.as_pandas()
+    assert list(g.columns) == list(e.columns)
+    order = list(g.columns)
+    g = g.sort_values(order).reset_index(drop=True)
+    e = e.sort_values(order).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, e, check_dtype=False)
+    return got
+
+
+@pytest.fixture(scope="module")
+def fact():
+    rng = np.random.default_rng(0)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 50, 500),
+            "v": rng.random(500),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def dim():
+    # unique keys 0..39 → some fact keys miss
+    rng = np.random.default_rng(1)
+    return pd.DataFrame({"k": np.arange(40), "w": rng.random(40)})
+
+
+def test_inner(engine, oracle, fact, dim):
+    got = _check(engine, oracle, fact, dim, "inner")
+    assert isinstance(got, JaxDataFrame) and got.host_table is None
+
+
+def test_left_outer_float_values(engine, oracle, fact, dim):
+    got = _check(engine, oracle, fact, dim, "left_outer")
+    assert isinstance(got, JaxDataFrame)
+
+
+def test_left_outer_int_values_falls_back(engine, oracle, fact):
+    dim_int = pd.DataFrame({"k": np.arange(40), "w": np.arange(40)})
+    _check(engine, oracle, fact, dim_int, "left_outer")  # host path, correct
+
+
+def test_semi_anti(engine, oracle, fact, dim):
+    _check(engine, oracle, fact, dim, "semi")
+    _check(engine, oracle, fact, dim, "anti")
+
+
+def test_multi_key(engine, oracle):
+    rng = np.random.default_rng(2)
+    left = pd.DataFrame(
+        {
+            "a": rng.integers(0, 6, 300),
+            "b": rng.integers(0, 6, 300),
+            "v": rng.random(300),
+        }
+    )
+    pairs = [(a, b) for a in range(5) for b in range(5)]
+    right = pd.DataFrame(
+        {
+            "a": [p[0] for p in pairs],
+            "b": [p[1] for p in pairs],
+            "w": np.linspace(0, 1, len(pairs)),
+        }
+    )
+    for how in ["inner", "left_outer", "semi", "anti"]:
+        _check(engine, oracle, left, right, how)
+
+
+def test_float_key_and_nan_never_matches(engine, oracle):
+    # arrow keeps NaN as a value → device-resident float key with NaN
+    left = pa.table(
+        {
+            "k": pa.array([1.0, 2.0, np.nan, 4.0], pa.float64()),
+            "v": pa.array([10.0, 20.0, 30.0, 40.0], pa.float64()),
+        }
+    )
+    right = pa.table(
+        {
+            "k": pa.array([1.0, np.nan, 4.0], pa.float64()),
+            "w": pa.array([0.1, 0.2, 0.4], pa.float64()),
+        }
+    )
+    got = engine.join(engine.to_df(left), engine.to_df(right), how="inner")
+    g = got.as_pandas().sort_values("k").reset_index(drop=True)
+    # NaN keys never match (SQL NULL semantics)
+    assert g["k"].tolist() == [1.0, 4.0]
+    assert g["w"].tolist() == [0.1, 0.4]
+
+
+def test_non_unique_right_falls_back(engine, oracle, fact):
+    dup = pd.DataFrame({"k": [1, 1, 2], "w": [0.1, 0.2, 0.3]})
+    _check(engine, oracle, fact, dup, "inner")  # host path, still correct
+
+
+def test_shuffle_strategy(engine, oracle, monkeypatch):
+    """Force the shuffle path with a tiny broadcast threshold."""
+    monkeypatch.setattr(oj, "MAX_BROADCAST_ROWS", 8)
+    rng = np.random.default_rng(3)
+    left = pd.DataFrame(
+        {
+            "k": rng.integers(0, 200, 1000),
+            "v": rng.random(1000),
+        }
+    )
+    right = pd.DataFrame({"k": np.arange(150), "w": rng.random(150)})
+    for how in ["inner", "left_outer", "semi", "anti"]:
+        got = _check(engine, oracle, left, right, how)
+        assert isinstance(got, JaxDataFrame) and got.host_table is None
+
+
+def test_right_and_full_outer_on_host(engine, oracle, fact, dim):
+    _check(engine, oracle, fact, dim, "right_outer")
+    _check(engine, oracle, fact, dim, "full_outer")

@@ -1,0 +1,120 @@
+"""Device shuffle (repartition via all_to_all exchange) tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.jax import JaxDataFrame, JaxExecutionEngine
+from fugue_tpu.parallel.mesh import ROW_AXIS, num_row_shards
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+def _shard_rows(jdf: JaxDataFrame):
+    """Valid row count and key values per shard block."""
+    import jax
+
+    shards = num_row_shards(jdf.mesh)
+    valid = np.asarray(jax.device_get(jdf.device_valid_mask()))
+    per_shard = valid.reshape(shards, -1)
+    return per_shard
+
+
+def test_even_repartition_balances(engine):
+    # skewed ingestion: all rows sit in the low shards after a filter
+    pdf = pd.DataFrame({"a": np.arange(800, dtype=np.int64)})
+    jdf = engine.to_df(pdf)
+    from fugue_tpu.column import col
+
+    skewed = engine.filter(jdf, col("a") < 100)  # only low shards populated
+    res = engine.repartition(skewed, PartitionSpec(algo="even", num=8))
+    assert isinstance(res, JaxDataFrame)
+    per_shard = _shard_rows(res).sum(axis=1)
+    assert per_shard.sum() == 100
+    assert per_shard.max() - per_shard.min() <= np.ceil(100 / len(per_shard))
+    # content preserved
+    got = sorted(res.as_pandas()["a"].tolist())
+    assert got == list(range(100))
+
+
+def test_hash_repartition_colocates_keys(engine):
+    import jax
+
+    rng = np.random.default_rng(0)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 37, 1000),
+            "v": rng.random(1000),
+        }
+    )
+    jdf = engine.to_df(pdf)
+    res = engine.repartition(jdf, PartitionSpec(algo="hash", by=["k"]))
+    assert isinstance(res, JaxDataFrame)
+    shards = num_row_shards(res.mesh)
+    valid = np.asarray(jax.device_get(res.device_valid_mask())).reshape(
+        shards, -1
+    )
+    keys = np.asarray(jax.device_get(res.device_cols["k"])).reshape(shards, -1)
+    seen = {}
+    for s in range(shards):
+        for k in np.unique(keys[s][valid[s]]):
+            assert seen.setdefault(int(k), s) == s, "key split across shards"
+    # all rows preserved with their values
+    got = res.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    exp = pdf.sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+
+
+def test_multi_key_hash_repartition(engine):
+    rng = np.random.default_rng(1)
+    pdf = pd.DataFrame(
+        {
+            "a": rng.integers(0, 5, 300),
+            "b": rng.random(300).round(1),  # float key column
+            "v": np.arange(300, dtype=np.int64),
+        }
+    )
+    jdf = engine.to_df(pdf)
+    res = engine.repartition(jdf, PartitionSpec(algo="hash", by=["a", "b"]))
+    got = res.as_pandas().sort_values("v").reset_index(drop=True)
+    exp = pdf.sort_values("v").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+
+
+def test_rand_repartition_preserves_rows(engine):
+    pdf = pd.DataFrame({"a": np.arange(500, dtype=np.int64)})
+    jdf = engine.to_df(pdf)
+    res = engine.repartition(jdf, PartitionSpec(algo="rand", num=8))
+    assert sorted(res.as_pandas()["a"].tolist()) == list(range(500))
+
+
+def test_coarse_and_host_frames_unchanged(engine):
+    pdf = pd.DataFrame({"a": [1, 2, 3], "s": ["x", "y", "z"]})
+    jdf = engine.to_df(pdf)  # string col → host-resident
+    res = engine.repartition(jdf, PartitionSpec(algo="hash", by=["a"]))
+    assert res is jdf  # layout unchanged, logged
+    num = engine.to_df(pd.DataFrame({"a": [1, 2, 3]}))
+    assert engine.repartition(num, PartitionSpec(algo="coarse", num=4)) is num
+
+
+def test_repartition_then_aggregate(engine):
+    """The shuffle composes with the device aggregate."""
+    rng = np.random.default_rng(2)
+    pdf = pd.DataFrame({"k": rng.integers(0, 11, 400), "v": rng.random(400)})
+    from fugue_tpu.column import col, functions as f
+
+    jdf = engine.repartition(
+        engine.to_df(pdf), PartitionSpec(algo="hash", by=["k"])
+    )
+    res = engine.aggregate(
+        jdf, PartitionSpec(by=["k"]), [f.sum(col("v")).alias("s")]
+    )
+    got = res.as_pandas().sort_values("k").reset_index(drop=True)
+    exp = pdf.groupby("k").agg(s=("v", "sum")).reset_index()
+    assert np.allclose(got["s"], exp["s"])
