@@ -98,6 +98,273 @@ def _eval(cols: Dict[str, Any], expr: ColumnExpr) -> Any:
     raise NotImplementedError(f"can't evaluate {type(expr)} on device")
 
 
+# ---------------------------------------------------------------------------
+# three-valued (SQL NULL) evaluation over encoded device frames
+# ---------------------------------------------------------------------------
+#
+# Each node evaluates to (value, isnull). NULL semantics follow SQL/Kleene:
+# comparisons/arithmetic propagate NULL; AND/OR use three-valued logic;
+# IS_NULL/COALESCE consume the null flag. String predicates on
+# dictionary-encoded columns evaluate HOST-SIDE over the dictionary (via the
+# pandas evaluator) into a lookup table the device gathers by code — the
+# TPU-native way to run string filters without device strings.
+
+
+class _DictLookup:
+    """Marks a subtree to be computed as dictionary-table lookup."""
+
+    def __init__(self, col_name: str, expr: ColumnExpr):
+        self.col_name = col_name
+        self.expr = expr
+
+
+def _contains_null_ops(expr: ColumnExpr) -> bool:
+    """Whether the subtree consumes NULL flags (IS_NULL/NOT_NULL/COALESCE) —
+    such subtrees must NOT evaluate over the dictionary (which has no
+    nulls); the three-valued evaluator handles them with code<0."""
+    if isinstance(expr, _UnaryOpExpr) and expr.op in ("IS_NULL", "NOT_NULL"):
+        return True
+    if isinstance(expr, _FuncExpr) and expr.func.upper() == "COALESCE":
+        return True
+    return any(_contains_null_ops(c) for c in expr.children)
+
+
+def _dict_subtree_col(expr: ColumnExpr, encodings: Dict[str, dict]) -> Optional[str]:
+    """If the subtree references exactly ONE dict-encoded column (and any
+    literals) and consumes no NULL flags, return its name — the whole
+    subtree can evaluate over the dictionary on host. None otherwise."""
+    names: set = set()
+
+    def walk(e: ColumnExpr) -> bool:
+        if isinstance(e, _NamedColumnExpr):
+            if e.wildcard:
+                return False
+            names.add(e.name)
+            return True
+        if isinstance(e, _LitColumnExpr):
+            return True
+        return all(walk(c) for c in e.children)
+
+    if not walk(expr) or _contains_null_ops(expr):
+        return None
+    if len(names) == 1:
+        n = next(iter(names))
+        if n in encodings and encodings[n]["kind"] == "dict":
+            return n
+    return None
+
+
+def _eval_over_dictionary(expr: ColumnExpr, name: str, dictionary: Any) -> Any:
+    """Evaluate the subtree on the host over the dictionary values → a
+    numpy table of len(dictionary) results."""
+    import pandas as pd
+
+    from .eval import evaluate as eval_pd
+
+    pdf = pd.DataFrame({name: dictionary.to_pandas()})
+    res = eval_pd(pdf, expr)
+    if not isinstance(res, pd.Series):
+        res = pd.Series([res] * len(pdf))
+    return np.asarray(res.to_numpy())
+
+
+def evaluate_jnp_3v(
+    cols: Dict[str, Any],
+    masks: Dict[str, Any],
+    dict_tables: Dict[str, Any],
+    expr: ColumnExpr,
+    code_cols: Any = frozenset(),
+) -> Any:
+    """Evaluate with SQL NULL semantics → (value, isnull) jnp arrays.
+
+    ``dict_tables`` maps dict-encoded column names to HOST-precomputed
+    lookup tables for the dict subtrees found by
+    :func:`plan_dict_lookups` — keyed by the subtree expression uuid.
+    ``code_cols`` are dictionary-encoded column names whose raw value is
+    the int32 code (NULL = −1) — the planner only lets them appear where
+    just the null flag is consumed (IS_NULL/NOT_NULL).
+    """
+    import jax.numpy as jnp
+
+    def ev(e: ColumnExpr) -> Any:
+        key = e.__uuid__()
+        if key in dict_tables:
+            name, table = dict_tables[key]
+            code = cols[name]
+            idx = jnp.clip(code, 0, max(table.shape[0] - 1, 0))
+            val = jnp.asarray(table)[idx] if table.shape[0] > 0 else jnp.zeros_like(code, dtype=table.dtype)
+            return val, code < 0
+        if isinstance(e, _NamedColumnExpr):
+            v = cols[e.name]
+            if e.name in code_cols:
+                return v, v < 0  # only the null flag is meaningful
+            if e.name in masks:
+                return v, masks[e.name]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                return v, jnp.isnan(v)
+            return v, jnp.zeros(v.shape, dtype=bool)
+        if isinstance(e, _LitColumnExpr):
+            # np scalar (not python bool): `~` must mean logical not
+            return e.value, np.False_
+        if isinstance(e, _UnaryOpExpr):
+            v, nl = ev(e.col)
+            if e.op == "IS_NULL":
+                return nl, np.False_
+            if e.op == "NOT_NULL":
+                return jnp.logical_not(nl), np.False_
+            if e.op == "~":
+                return jnp.logical_not(v), nl
+            if e.op == "-":
+                return -v, nl
+            raise NotImplementedError(e.op)
+        if isinstance(e, _BinaryOpExpr):
+            lv, ln = ev(e.left)
+            rv, rn = ev(e.right)
+            op = e.op
+            if op in ("&", "|"):
+                lb = jnp.asarray(lv, dtype=bool)
+                rb = jnp.asarray(rv, dtype=bool)
+                if op == "&":
+                    # Kleene AND: FALSE dominates NULL
+                    val = lb & rb
+                    nul = (ln | rn) & ~((~ln & ~lb) | (~rn & ~rb))
+                else:
+                    # Kleene OR: TRUE dominates NULL
+                    val = lb | rb
+                    nul = (ln | rn) & ~((~ln & lb) | (~rn & rb))
+                return val, nul
+            nul = ln | rn
+            if op == "+":
+                return lv + rv, nul
+            if op == "-":
+                return lv - rv, nul
+            if op == "*":
+                return lv * rv, nul
+            if op == "/":
+                return lv / rv, nul
+            if op == "<":
+                return lv < rv, nul
+            if op == "<=":
+                return lv <= rv, nul
+            if op == ">":
+                return lv > rv, nul
+            if op == ">=":
+                return lv >= rv, nul
+            if op == "==":
+                return lv == rv, nul
+            if op == "!=":
+                return lv != rv, nul
+            raise NotImplementedError(op)
+        if isinstance(e, _FuncExpr) and not e.is_agg:
+            if e.func.upper() == "COALESCE":
+                parts = [ev(a) for a in e.args]
+                val, nul = parts[-1]
+                for pv, pn in reversed(parts[:-1]):
+                    val = jnp.where(pn, val, pv)
+                    nul = pn & nul
+                return val, nul
+            raise NotImplementedError(f"function {e.func} not supported on device")
+        raise NotImplementedError(f"can't evaluate {type(e)} on device")
+
+    v, nl = ev(expr)
+    if expr.as_type is not None:
+        import jax.numpy as jnp_
+
+        v = jnp_.asarray(v).astype(pa_type_to_np_dtype(expr.as_type))
+    return v, nl
+
+
+def plan_dict_lookups(
+    expr: ColumnExpr, encodings: Dict[str, dict]
+) -> Optional[Dict[str, Any]]:
+    """Find maximal dict-column subtrees and precompute their host lookup
+    tables. Returns {subtree_uuid: (col_name, np table)} or None when the
+    expression cannot run on device (a dict column used outside a
+    host-evaluable subtree)."""
+    tables: Dict[str, Any] = {}
+
+    def plan(e: ColumnExpr, under_null: bool = False) -> bool:
+        name = _dict_subtree_col(e, encodings)
+        if name is not None and not isinstance(e, _NamedColumnExpr):
+            try:
+                table = _eval_over_dictionary(e, name, encodings[name]["dictionary"])
+            except Exception:
+                return False
+            if table.dtype == object:
+                return False  # string-valued result has no device type
+            tables[e.__uuid__()] = (name, table)
+            return True
+        if isinstance(e, _NamedColumnExpr):
+            # a bare dict column produces no device VALUE — it is only
+            # allowed where just its null flag is consumed
+            if e.name in encodings and encodings[e.name]["kind"] == "dict":
+                return under_null
+            return True
+        if isinstance(e, _LitColumnExpr):
+            return True
+        if isinstance(e, _UnaryOpExpr) and e.op in ("IS_NULL", "NOT_NULL"):
+            return plan(e.col, under_null=True)
+        return all(plan(c) for c in e.children)
+
+    return tables if plan(expr) else None
+
+
+def device_predicate_plan(
+    expr: ColumnExpr, device_cols: Any, encodings: Dict[str, dict]
+) -> Optional[Dict[str, Any]]:
+    """Gate + plan for three-valued device evaluation of a predicate.
+
+    Returns the dict-lookup tables (possibly empty) when the expression can
+    run on device with :func:`evaluate_jnp_3v`, else None. Dict-encoded
+    columns are allowed only inside host-reducible subtrees; datetime
+    encodings are not supported in predicates yet (host fallback).
+    """
+    from .functions import is_agg
+
+    if is_agg(expr):
+        return None
+    tables = plan_dict_lookups(expr, encodings)
+    if tables is None:
+        return None
+
+    def ok(e: ColumnExpr, under_null: bool = False) -> bool:
+        if e.__uuid__() in tables:
+            return True
+        if e.as_type is not None and not (
+            pa.types.is_integer(e.as_type)
+            or pa.types.is_floating(e.as_type)
+            or pa.types.is_boolean(e.as_type)
+        ):
+            return False
+        if isinstance(e, _NamedColumnExpr):
+            if e.wildcard or e.name not in device_cols:
+                return False
+            if e.name in encodings:
+                # dict codes: only the null flag is usable; epoch datetimes
+                # have no literal comparison support yet
+                return under_null and encodings[e.name]["kind"] == "dict"
+            return True
+        if isinstance(e, _LitColumnExpr):
+            return e.value is not None and isinstance(e.value, (int, float, bool))
+        if isinstance(e, _UnaryOpExpr):
+            if e.op in ("IS_NULL", "NOT_NULL"):
+                return ok(e.col, under_null=True)
+            return e.op in ("~", "-") and ok(e.col)
+        if isinstance(e, _BinaryOpExpr):
+            return e.op in (
+                "+", "-", "*", "/", "<", "<=", ">", ">=", "==", "!=", "&", "|"
+            ) and ok(e.left) and ok(e.right)
+        if isinstance(e, _FuncExpr):
+            return (
+                not e.is_agg
+                and e.func.upper() == "COALESCE"
+                and all(ok(a) for a in e.args)
+            )
+        return False
+
+    return tables if ok(expr) else None
+
+
 def can_evaluate_on_device(
     expr: ColumnExpr, device_cols: Any, check_agg: bool = True
 ) -> bool:

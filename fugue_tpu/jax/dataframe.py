@@ -3,10 +3,18 @@
 The TPU-native distributed frame (SURVEY §7.1 "ShardedJaxDataFrame"):
 
 - numeric/bool columns live on device, padded to a multiple of the mesh row
-  axis and sharded ``NamedSharding(mesh, P("rows"))``;
-- variable-width / nullable-int / nested columns stay host-resident as an
-  arrow table aligned by row position (the reference leans on arrow for the
-  same data, SURVEY §7 hard parts);
+  axis and sharded ``NamedSharding(mesh, P("rows"))``; floats carry NULL as
+  NaN;
+- string columns are DICTIONARY-ENCODED: an int32 code array on device
+  (−1 = NULL) plus the small host-side ``pa.Array`` dictionary — groupby /
+  distinct / filter on strings run on device over codes, and string
+  predicates evaluate host-side over the dictionary into a lookup table
+  gathered by code (SURVEY §7 hard parts);
+- nullable int/bool columns carry a per-column device null mask; timestamps
+  and dates live as epoch int64/int32 with the original arrow type restored
+  on conversion;
+- anything else (binary, nested, decimal) stays host-resident as an arrow
+  table aligned by row position;
 - ``row_count`` tracks the unpadded logical length; padding is masked out in
   device ops and sliced off on conversion back to arrow.
 """
@@ -45,31 +53,100 @@ def _is_device_type(f: pa.Field) -> bool:
 
 
 def split_arrow_for_device(tbl: pa.Table) -> Any:
-    """Split an arrow table into (device_candidate_cols, host_cols, nan_cols).
+    """Back-compat split: (plain_device_cols, host_cols, nan_cols).
 
-    Numeric/bool columns WITHOUT nulls go to device (floats may carry nulls
-    as NaN); everything else stays host-side. ``nan_cols`` is the set of
-    device float columns that actually contain NaN — kernels skip NULL
-    masking for columns proved NaN-free (the common case).
+    Only null-free numeric/bool columns are treated as device candidates —
+    the encoding-aware path is :func:`encode_arrow_for_device`.
+    """
+    device_cols, host_tbl, meta = encode_arrow_for_device(tbl, encode=False)
+    return device_cols, host_tbl, meta["nan_cols"]
+
+
+def encode_arrow_for_device(tbl: pa.Table, encode: bool = True) -> Any:
+    """Encode an arrow table for the device: (device_cols, host_tbl, meta).
+
+    ``meta`` has:
+
+    - ``nan_cols``: float columns that may contain NaN (device NULL);
+    - ``encodings``: ``{name: {"kind": "dict"|"datetime", "dictionary":
+      pa.Array|None, "type": pa.DataType}}`` — internal representations
+      whose original arrow type is restored on conversion back;
+    - ``null_masks``: ``{name: np bool array}`` — per-column null masks for
+      nullable int/bool/datetime columns (True = NULL).
     """
     device_cols: Dict[str, np.ndarray] = {}
     host_names: List[str] = []
-    nan_cols: set = set()
+    meta: Dict[str, Any] = {"nan_cols": set(), "encodings": {}, "null_masks": {}}
     for i, f in enumerate(tbl.schema):
-        col = tbl.column(i)
-        # nulls can't live on device yet (NaN would silently conflate with
-        # null on the way back) — nullable columns stay host-resident
-        if _is_device_type(f) and col.null_count == 0:
-            arr = np.asarray(col.to_numpy(zero_copy_only=False))
-            device_cols[f.name] = arr
-            if np.issubdtype(arr.dtype, np.floating) and bool(
-                np.isnan(arr).any()
-            ):
-                nan_cols.add(f.name)
-        else:
-            host_names.append(f.name)
+        col = tbl.column(i).combine_chunks()
+        t = f.type
+        if _is_device_type(f):
+            if col.null_count == 0:
+                arr = np.asarray(col.to_numpy(zero_copy_only=False))
+                device_cols[f.name] = arr
+                if np.issubdtype(arr.dtype, np.floating) and bool(
+                    np.isnan(arr).any()
+                ):
+                    meta["nan_cols"].add(f.name)
+                continue
+            if encode and pa.types.is_floating(t):
+                # arrow float→numpy turns nulls into NaN — the device NULL
+                arr = np.asarray(col.to_numpy(zero_copy_only=False))
+                device_cols[f.name] = arr
+                meta["nan_cols"].add(f.name)
+                continue
+            if encode:  # nullable int/bool: value array + null mask
+                mask = np.asarray(col.is_null().to_numpy(zero_copy_only=False))
+                fill = False if pa.types.is_boolean(t) else 0
+                vals = np.asarray(
+                    col.fill_null(fill).to_numpy(zero_copy_only=False)
+                )
+                device_cols[f.name] = vals
+                meta["null_masks"][f.name] = mask
+                continue
+        if encode and (pa.types.is_string(t) or pa.types.is_large_string(t)):
+            plain = (
+                col.chunk(0)
+                if isinstance(col, pa.ChunkedArray) and col.num_chunks == 1
+                else (
+                    pa.array([], type=t)
+                    if isinstance(col, pa.ChunkedArray) and col.num_chunks == 0
+                    else col
+                )
+            )
+            if isinstance(plain, pa.ChunkedArray):  # pragma: no cover
+                plain = pa.concat_arrays(plain.chunks)
+            d = plain.dictionary_encode()
+            codes = np.asarray(
+                d.indices.fill_null(-1).to_numpy(zero_copy_only=False)
+            ).astype(np.int32)
+            device_cols[f.name] = codes
+            meta["encodings"][f.name] = {
+                "kind": "dict",
+                "dictionary": d.dictionary.cast(t),
+                "type": t,
+            }
+            continue
+        if encode and (pa.types.is_timestamp(t) or pa.types.is_date(t)):
+            storage = pa.int64() if not pa.types.is_date32(t) else pa.int32()
+            ints = col.cast(storage)
+            if col.null_count > 0:
+                meta["null_masks"][f.name] = np.asarray(
+                    col.is_null().to_numpy(zero_copy_only=False)
+                )
+                ints = ints.fill_null(0)
+            device_cols[f.name] = np.asarray(
+                ints.to_numpy(zero_copy_only=False)
+            )
+            meta["encodings"][f.name] = {
+                "kind": "datetime",
+                "dictionary": None,
+                "type": t,
+            }
+            continue
+        host_names.append(f.name)
     host_tbl = tbl.select(host_names) if len(host_names) > 0 else None
-    return device_cols, host_tbl, nan_cols
+    return device_cols, host_tbl, meta
 
 
 class JaxDataFrame(DataFrame):
@@ -94,6 +171,8 @@ class JaxDataFrame(DataFrame):
             self._valid_mask = _internal.get("valid_mask", None)
             # None = unknown → treat every float column as possibly-NaN
             self._nan_cols = _internal.get("nan_cols", None)
+            self._encodings = _internal.get("encodings", None) or {}
+            self._null_masks = _internal.get("null_masks", None) or {}
             super().__init__(_internal["schema"])
             return
         s = None if schema is None else (schema if isinstance(schema, Schema) else Schema(schema))
@@ -108,6 +187,8 @@ class JaxDataFrame(DataFrame):
             self._row_count = df._row_count
             self._valid_mask = df._valid_mask
             self._nan_cols = df._nan_cols
+            self._encodings = dict(df._encodings)
+            self._null_masks = dict(df._null_masks)
             super().__init__(df.schema)
             return
         if isinstance(df, DataFrame):
@@ -125,21 +206,26 @@ class JaxDataFrame(DataFrame):
         n = tbl.num_rows
         shards = num_row_shards(self._mesh)
         padded = pad_rows(max(n, shards), shards) if n > 0 else shards
-        np_cols, host_tbl, nan_cols = split_arrow_for_device(tbl)
+        np_cols, host_tbl, meta = encode_arrow_for_device(tbl)
         sharding = row_sharding(self._mesh)
-        device_cols: Dict[str, Any] = {}
-        for name, arr in np_cols.items():
+
+        def _pad_put(arr: np.ndarray) -> Any:
             if len(arr) < padded:
                 pad_val = np.zeros(padded - len(arr), dtype=arr.dtype)
                 arr = np.concatenate([arr, pad_val])
-            device_cols[name] = jax.device_put(arr, sharding)
-        self._device_cols = device_cols
+            return jax.device_put(arr, sharding)
+
+        self._device_cols = {k: _pad_put(v) for k, v in np_cols.items()}
         self._host_tbl = host_tbl
         self._row_count = n
         # None = tail-padding semantics (rows [0, row_count) valid); a device
         # bool array = explicit per-row validity (result of device filters)
         self._valid_mask = None
-        self._nan_cols = nan_cols
+        self._nan_cols = meta["nan_cols"]
+        self._encodings = meta["encodings"]
+        self._null_masks = {
+            k: _pad_put(v) for k, v in meta["null_masks"].items()
+        }
 
     # -- properties ---------------------------------------------------------
     @property
@@ -168,6 +254,23 @@ class JaxDataFrame(DataFrame):
         if self._nan_cols is None:
             return True
         return name in self._nan_cols
+
+    @property
+    def encodings(self) -> Dict[str, dict]:
+        """Per-column internal device representations (dict/datetime)."""
+        return self._encodings
+
+    @property
+    def null_masks(self) -> Dict[str, Any]:
+        """Per-column device null masks (True = NULL) for nullable columns."""
+        return self._null_masks
+
+    @property
+    def has_encoded(self) -> bool:
+        """True when any device column is not plainly-typed (encoded or
+        masked) — device fast paths that assume plain semantics must gate
+        on this."""
+        return len(self._encodings) > 0 or len(self._null_masks) > 0
 
     def device_valid_mask(self) -> Any:
         """A device bool array marking valid rows (built from the row count
@@ -223,7 +326,32 @@ class JaxDataFrame(DataFrame):
             if f.name in self._device_cols:
                 host = np.asarray(jax.device_get(self._device_cols[f.name]))
                 host = host[mask] if mask is not None else host[: self._row_count]
-                arrays.append(pa.array(host).cast(f.type, safe=False))
+                nulls: Optional[np.ndarray] = None
+                if f.name in self._null_masks:
+                    nulls = np.asarray(jax.device_get(self._null_masks[f.name]))
+                    nulls = (
+                        nulls[mask] if mask is not None else nulls[: self._row_count]
+                    )
+                enc = self._encodings.get(f.name)
+                if enc is None:
+                    # device convention: NaN float IS NULL — restore nulls on
+                    # the way out (skipped for columns proved NaN-free)
+                    if np.issubdtype(host.dtype, np.floating) and (
+                        self._nan_cols is None or f.name in self._nan_cols
+                    ):
+                        nn = np.isnan(host)
+                        nulls = nn if nulls is None else (nulls | nn)
+                    arr = pa.array(host, mask=nulls)
+                elif enc["kind"] == "dict":
+                    # codes → dictionary values; −1 = NULL
+                    arr = enc["dictionary"].take(
+                        pa.array(host.astype(np.int64), mask=host < 0)
+                    )
+                elif enc["kind"] == "datetime":
+                    arr = pa.array(host, mask=nulls).cast(enc["type"])
+                else:  # pragma: no cover
+                    raise NotImplementedError(enc["kind"])
+                arrays.append(arr.cast(f.type, safe=False))
             else:
                 assert self._host_tbl is not None
                 col = self._host_tbl.column(f.name)
@@ -267,6 +395,12 @@ class JaxDataFrame(DataFrame):
                 row_count=self._row_count,
                 valid_mask=self._valid_mask,
                 nan_cols=self._nan_cols,
+                encodings={
+                    k: v for k, v in self._encodings.items() if k in device_cols
+                },
+                null_masks={
+                    k: v for k, v in self._null_masks.items() if k in device_cols
+                },
                 schema=schema,
             ),
         )
@@ -295,9 +429,27 @@ class JaxDataFrame(DataFrame):
             if self._host_tbl is not None
             else None
         )
-        res = self._with(schema, dc, ht)
-        if self._nan_cols is not None:
-            res._nan_cols = {columns.get(n, n) for n in self._nan_cols}
+        res = JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols=dc,
+                host_tbl=ht,
+                row_count=self._row_count,
+                valid_mask=self._valid_mask,
+                nan_cols=(
+                    None
+                    if self._nan_cols is None
+                    else {columns.get(n, n) for n in self._nan_cols}
+                ),
+                encodings={
+                    columns.get(k, k): v for k, v in self._encodings.items()
+                },
+                null_masks={
+                    columns.get(k, k): v for k, v in self._null_masks.items()
+                },
+                schema=schema,
+            ),
+        )
         return res
 
     def alter_columns(self, columns: Any) -> DataFrame:

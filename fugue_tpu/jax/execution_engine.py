@@ -82,7 +82,10 @@ class JaxMapEngine(MapEngine):
             raw = _sniff_jax_func(map_func)
             if raw is not None:
                 jdf = engine.to_df(df)
-                return self._compiled_map(jdf, raw, output_schema, on_init)
+                # encoded/masked columns have non-plain semantics the UDF
+                # can't see — host path renders them as real values
+                if isinstance(jdf, JaxDataFrame) and not jdf.has_encoded:
+                    return self._compiled_map(jdf, raw, output_schema, on_init)
         # general path: host-side partitioned execution, result back on
         # device; CONCURRENCY reflects the mesh, not the host engine
         host_engine = engine._host_engine
@@ -260,9 +263,17 @@ class JaxExecutionEngine(ExecutionEngine):
             [jdf.device_cols[k] for k in by] if algo == "hash" else [],
             valid,
         )
-        new_cols, new_valid, _ = exchange_rows(
-            self._mesh, dict(jdf.device_cols), valid, dest
+        # null masks are row-aligned — they travel with their columns
+        payload = dict(jdf.device_cols)
+        for c, m in jdf.null_masks.items():
+            payload[f"__mask__{c}"] = m
+        new_payload, new_valid, _ = exchange_rows(
+            self._mesh, payload, valid, dest
         )
+        new_cols = {c: new_payload[c] for c in jdf.device_cols}
+        new_masks = {
+            c: new_payload[f"__mask__{c}"] for c in jdf.null_masks
+        }
         return JaxDataFrame(
             mesh=self._mesh,
             _internal=dict(
@@ -271,6 +282,8 @@ class JaxExecutionEngine(ExecutionEngine):
                 row_count=jdf.count(),
                 valid_mask=new_valid,
                 nan_cols=jdf._nan_cols,
+                encodings=dict(jdf.encodings),
+                null_masks=new_masks,
                 schema=jdf.schema,
             ),
         )
@@ -288,6 +301,10 @@ class JaxExecutionEngine(ExecutionEngine):
                 host_tbl=jdf.host_table,
                 row_count=jdf.count(),
                 nan_cols=jdf._nan_cols,
+                encodings=dict(jdf.encodings),
+                null_masks={
+                    k: jax.device_put(v, rep) for k, v in jdf.null_masks.items()
+                },
                 schema=jdf.schema,
             ),
         )
@@ -306,41 +323,77 @@ class JaxExecutionEngine(ExecutionEngine):
     # ---- relational ops ----------------------------------------------------
     def filter(self, df: DataFrame, condition: Any) -> DataFrame:
         """Device filter: the condition becomes a validity mask — no rows
-        move, downstream device ops and host conversion honor the mask."""
-        from ..column.jax_eval import can_evaluate_on_device
+        move, downstream device ops and host conversion honor the mask.
+
+        Runs with SQL three-valued NULL semantics (rows where the predicate
+        is NULL are dropped): NaN floats and per-column null masks are
+        NULLs, and predicates on dictionary-encoded string columns evaluate
+        host-side over the dictionary into a lookup table gathered by code.
+        """
+        from ..column.jax_eval import device_predicate_plan
 
         jdf = self.to_df(df)
         if (
             isinstance(jdf, JaxDataFrame)
             and len(jdf.device_cols) > 0
             and jdf.host_table is None
-            and can_evaluate_on_device(condition, jdf.device_cols)
         ):
-            import jax
-
-            cache_key = ("filter", condition.__uuid__(), jdf.mesh)
-            if cache_key not in self._jit_cache:
-
-                def apply_mask(cols: Dict[str, Any], valid: Any) -> Any:
-                    from ..column.jax_eval import evaluate_jnp
-
-                    return valid & evaluate_jnp(cols, condition)
-
-                self._jit_cache[cache_key] = jax.jit(apply_mask)
-            new_mask = self._jit_cache[cache_key](
-                dict(jdf.device_cols), jdf.device_valid_mask()
+            tables = device_predicate_plan(
+                condition, jdf.device_cols, jdf.encodings
             )
-            return JaxDataFrame(
-                mesh=self._mesh,
-                _internal=dict(
-                    device_cols=dict(jdf.device_cols),
-                    host_tbl=None,
-                    row_count=-1,  # computed lazily from the mask
-                    valid_mask=new_mask,
-                    nan_cols=jdf._nan_cols,
-                    schema=jdf.schema,
-                ),
-            )
+            if tables is not None:
+                import jax
+
+                uuids = tuple(sorted(tables.keys()))
+                names = {u: tables[u][0] for u in uuids}
+                code_cols = frozenset(
+                    c for c, e in jdf.encodings.items() if e["kind"] == "dict"
+                )
+                cache_key = (
+                    "filter3v", condition.__uuid__(), jdf.mesh, uuids, code_cols
+                )
+                if cache_key not in self._jit_cache:
+
+                    def apply_mask(
+                        cols: Dict[str, Any],
+                        masks: Dict[str, Any],
+                        tarrs: Any,
+                        valid: Any,
+                    ) -> Any:
+                        import jax.numpy as jnp
+
+                        from ..column.jax_eval import evaluate_jnp_3v
+
+                        dt = {u: (names[u], t) for u, t in zip(uuids, tarrs)}
+                        v, nl = evaluate_jnp_3v(
+                            cols, masks, dt, condition, code_cols
+                        )
+                        return (
+                            valid
+                            & jnp.asarray(v, dtype=bool)
+                            & jnp.logical_not(nl)
+                        )
+
+                    self._jit_cache[cache_key] = jax.jit(apply_mask)
+                new_mask = self._jit_cache[cache_key](
+                    dict(jdf.device_cols),
+                    dict(jdf.null_masks),
+                    tuple(tables[u][1] for u in uuids),
+                    jdf.device_valid_mask(),
+                )
+                return JaxDataFrame(
+                    mesh=self._mesh,
+                    _internal=dict(
+                        device_cols=dict(jdf.device_cols),
+                        host_tbl=None,
+                        row_count=-1,  # computed lazily from the mask
+                        valid_mask=new_mask,
+                        nan_cols=jdf._nan_cols,
+                        encodings=dict(jdf.encodings),
+                        null_masks=dict(jdf.null_masks),
+                        schema=jdf.schema,
+                    ),
+                )
         return self._back(self._host_engine.filter(self._host(df), condition))
 
     def _host(self, df: DataFrame) -> DataFrame:
@@ -404,7 +457,13 @@ class JaxExecutionEngine(ExecutionEngine):
             and isinstance(j2, JaxDataFrame)
             and j2.host_table is None
             and len(j2.device_cols) == len(j2.schema)
+            and not j2.has_encoded  # value gather assumes plain semantics
             and all(k in j1.device_cols for k in keys)
+            # encoded/masked join keys (dict codes don't align across
+            # frames; masked NULL keys must never match) go host
+            and all(
+                k not in j1.encodings and k not in j1.null_masks for k in keys
+            )
         ):
             return None
         value_names = [
@@ -413,6 +472,8 @@ class JaxExecutionEngine(ExecutionEngine):
         import jax
 
         n_right = next(iter(j2.device_cols.values())).shape[0]
+        encodings: Dict[str, Any] = {}
+        null_masks: Dict[str, Any] = {}
         if n_right <= MAX_BROADCAST_ROWS:
             strategy = "broadcast"
             rep = replicated_sharding(self._mesh)
@@ -423,10 +484,13 @@ class JaxExecutionEngine(ExecutionEngine):
             left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
             host_tbl = j1.host_table  # rows stay in place → stays aligned
             nan_cols = j1._nan_cols
+            encodings = dict(j1.encodings)  # non-key left cols ride along
+            null_masks = dict(j1.null_masks)
         else:
             strategy = "shuffle"
-            if j1.host_table is not None:
-                return None  # rows move; host columns can't follow
+            if j1.host_table is not None or j1.has_encoded:
+                # rows move; host columns / per-column masks can't follow yet
+                return None
             right_cols, right_valid = dict(j2.device_cols), j2.device_valid_mask()
             left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
             host_tbl = None
@@ -458,6 +522,8 @@ class JaxExecutionEngine(ExecutionEngine):
                 row_count=-1,
                 valid_mask=match,
                 nan_cols=nan_cols,
+                encodings=encodings,
+                null_masks=null_masks,
                 schema=out_schema,
             ),
         )
@@ -478,10 +544,58 @@ class JaxExecutionEngine(ExecutionEngine):
             self._host_engine.intersect(self._host(df1), self._host(df2), distinct=distinct)
         )
 
+    def _group_key_cols(self, jdf: JaxDataFrame, names: List[str]) -> Any:
+        """(key_cols_for_kernel, mask_col_names) — nullable columns add
+        their null mask as an extra key so NULL forms its own group distinct
+        from the fill value."""
+        key_cols: Dict[str, Any] = {}
+        mask_names: Dict[str, str] = {}
+        for c in names:
+            key_cols[c] = jdf.device_cols[c]
+            if c in jdf.null_masks:
+                mn = f"__null__{c}"
+                while mn in jdf.schema:
+                    mn = "_" + mn
+                key_cols[mn] = jdf.null_masks[c]
+                mask_names[c] = mn
+        return key_cols, mask_names
+
+    def _decode_partial_keys(
+        self, jdf: JaxDataFrame, partials: pd.DataFrame, mask_names: Dict[str, str]
+    ) -> pd.DataFrame:
+        """Restore original key semantics on host partials: dictionary codes
+        → values, epoch ints → timestamps, masked cells → NA."""
+        res = partials
+        for c, mn in mask_names.items():
+            res[c] = res[c].mask(res[mn].astype(bool))
+            res = res.drop(columns=[mn])
+        for c, enc in jdf.encodings.items():
+            if c not in res.columns:
+                continue
+            if enc["kind"] == "dict":
+                codes = res[c].to_numpy()
+                valid = codes >= 0
+                decoded = enc["dictionary"].take(
+                    pa.array(
+                        np.where(valid, codes, 0).astype(np.int64), mask=~valid
+                    )
+                )
+                res[c] = decoded.to_pandas()
+            elif enc["kind"] == "datetime":
+                ints = res[c]
+                na = ints.isna()
+                arr = pa.array(
+                    ints.fillna(0).to_numpy().astype(np.int64),
+                    mask=na.to_numpy() if na.any() else None,
+                ).cast(enc["type"])
+                res[c] = arr.to_pandas()
+        return res
+
     def distinct(self, df: DataFrame) -> DataFrame:
         """Device distinct when every column is device-resident: the groupby
         kernel with a presence count — keys of the merged partials are the
-        distinct rows."""
+        distinct rows. Dictionary codes / epoch ints / null masks group by
+        their device identity and decode on the O(groups) host result."""
         from ..ops.segment import device_groupby_partials
 
         jdf = self.to_df(df)
@@ -491,27 +605,28 @@ class JaxExecutionEngine(ExecutionEngine):
             and len(jdf.device_cols) > 0
             and len(jdf.device_cols) == len(jdf.schema)
         ):
-            cols = dict(jdf.device_cols)
-            first = next(iter(cols))
+            key_cols, mask_names = self._group_key_cols(jdf, jdf.schema.names)
+            first = next(iter(key_cols))
             count_name = "__n__"
             while count_name in jdf.schema:  # never shadow a user column
                 count_name = "_" + count_name
             partials = device_groupby_partials(
                 self._mesh,
-                cols,
-                [(count_name, "count", cols[first])],
+                key_cols,
+                [(count_name, "count", key_cols[first])],
                 jdf.device_valid_mask(),
             )
             res = partials.drop(columns=[count_name]).drop_duplicates(
                 ignore_index=True
             )
-            return self.to_df(PandasDataFrame(res, jdf.schema))
+            res = self._decode_partial_keys(jdf, res, mask_names)
+            return self.to_df(PandasDataFrame(res[jdf.schema.names], jdf.schema))
         return self._back(self._host_engine.distinct(self._host(df)))
 
     def dropna(self, df, how="any", thresh=None, subset=None) -> DataFrame:
-        """All-device frames: nulls only exist as NaN in float columns
-        (ingest rejects nullable columns, but device compute can produce
-        NaN) — drop by extending the validity mask, zero data movement."""
+        """All-device frames: NULL = NaN float, null-masked cell, or
+        negative dictionary code — drop by extending the validity mask,
+        zero data movement."""
         jdf = self.to_df(df)
         if (
             isinstance(jdf, JaxDataFrame)
@@ -522,16 +637,33 @@ class JaxExecutionEngine(ExecutionEngine):
             import jax.numpy as jnp
 
             cols = subset or jdf.schema.names
-            key = ("dropna", tuple(cols), how, thresh, tuple(jdf.schema.names))
+            dict_cols = frozenset(
+                c for c, e in jdf.encodings.items() if e["kind"] == "dict"
+            )
+            key = (
+                "dropna",
+                tuple(cols),
+                how,
+                thresh,
+                tuple(jdf.schema.names),
+                dict_cols,
+                frozenset(jdf.null_masks),
+            )
             if key not in self._jit_cache:
 
-                def compute(dcols: Dict[str, Any], valid: Any) -> Any:
-                    notnull = [
-                        ~jnp.isnan(dcols[c])
-                        if jnp.issubdtype(dcols[c].dtype, jnp.floating)
-                        else jnp.ones_like(valid)
-                        for c in cols
-                    ]
+                def compute(
+                    dcols: Dict[str, Any], masks: Dict[str, Any], valid: Any
+                ) -> Any:
+                    notnull = []
+                    for c in cols:
+                        nn = jnp.ones_like(valid)
+                        if jnp.issubdtype(dcols[c].dtype, jnp.floating):
+                            nn = nn & ~jnp.isnan(dcols[c])
+                        if c in masks:
+                            nn = nn & ~masks[c]
+                        if c in dict_cols:
+                            nn = nn & (dcols[c] >= 0)
+                        notnull.append(nn)
                     stacked = jnp.stack(notnull, axis=0)
                     if thresh is not None:
                         keep = stacked.sum(axis=0) >= thresh
@@ -542,7 +674,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     return valid & keep
 
                 self._jit_cache[key] = jax.jit(compute)
-            mask = self._jit_cache[key](dict(jdf.device_cols), jdf.device_valid_mask())
+            mask = self._jit_cache[key](
+                dict(jdf.device_cols), dict(jdf.null_masks), jdf.device_valid_mask()
+            )
             return JaxDataFrame(
                 mesh=self._mesh,
                 _internal=dict(
@@ -551,6 +685,8 @@ class JaxExecutionEngine(ExecutionEngine):
                     row_count=-1,
                     valid_mask=mask,
                     nan_cols=jdf._nan_cols,
+                    encodings=dict(jdf.encodings),
+                    null_masks=dict(jdf.null_masks),
                     schema=jdf.schema,
                 ),
             )
@@ -559,7 +695,9 @@ class JaxExecutionEngine(ExecutionEngine):
         )
 
     def fillna(self, df, value, subset=None) -> DataFrame:
-        """All-device frames: fill NaN in float columns on device."""
+        """All-device frames: fill NaN floats and null-masked numeric cells
+        on device (filled masks clear). Fills targeting dictionary/datetime
+        encoded columns go to the host engine."""
         jdf = self.to_df(df)
         if (
             isinstance(jdf, JaxDataFrame)
@@ -576,20 +714,38 @@ class JaxExecutionEngine(ExecutionEngine):
                 fills = dict(value)
             else:
                 fills = {c: value for c in (subset or jdf.schema.names)}
+            if any(c in jdf.encodings for c in fills):
+                return self._back(
+                    self._host_engine.fillna(self._host(df), value, subset=subset)
+                )
+            masked_fills = frozenset(c for c in fills if c in jdf.null_masks)
             fill_sig = tuple(sorted((k, float(v)) for k, v in fills.items() if k in jdf.schema))
-            key = ("fillna", fill_sig, tuple(jdf.schema.names))
+            key = ("fillna", fill_sig, tuple(jdf.schema.names), masked_fills)
             if key not in self._jit_cache:
 
-                def compute(dcols: Dict[str, Any]) -> Dict[str, Any]:
+                def compute(
+                    dcols: Dict[str, Any], masks: Dict[str, Any]
+                ) -> Dict[str, Any]:
                     out = dict(dcols)
                     for c, v in fills.items():
                         arr = dcols.get(c)
-                        if arr is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                        if arr is None:
+                            continue
+                        if c in masked_fills:
+                            out[c] = jnp.where(
+                                masks[c], jnp.asarray(v, arr.dtype), arr
+                            )
+                        elif jnp.issubdtype(arr.dtype, jnp.floating):
                             out[c] = jnp.where(jnp.isnan(arr), jnp.asarray(v, arr.dtype), arr)
                     return out
 
                 self._jit_cache[key] = jax.jit(compute)
-            new_cols = self._jit_cache[key](dict(jdf.device_cols))
+            new_cols = self._jit_cache[key](
+                dict(jdf.device_cols), dict(jdf.null_masks)
+            )
+            new_masks = {
+                c: m for c, m in jdf.null_masks.items() if c not in masked_fills
+            }
             return JaxDataFrame(
                 mesh=self._mesh,
                 _internal=dict(
@@ -609,6 +765,8 @@ class JaxExecutionEngine(ExecutionEngine):
                             if not (isinstance(v, float) and v != v)
                         }
                     ),
+                    encodings=dict(jdf.encodings),
+                    null_masks=new_masks,
                     schema=jdf.schema,
                 ),
             )
@@ -653,6 +811,8 @@ class JaxExecutionEngine(ExecutionEngine):
                     row_count=-1,
                     valid_mask=mask,
                     nan_cols=jdf._nan_cols,
+                    encodings=dict(jdf.encodings),
+                    null_masks=dict(jdf.null_masks),
                     schema=jdf.schema,
                 ),
             )
@@ -676,6 +836,7 @@ class JaxExecutionEngine(ExecutionEngine):
             and na_position == "last"
             and isinstance(jdf, JaxDataFrame)
             and jdf.host_table is None
+            and not jdf.has_encoded  # code/epoch order ≠ value order semantics
             and list(sorts.keys())[0] in jdf.device_cols
             and n <= 4096
         ):
@@ -775,6 +936,8 @@ class JaxExecutionEngine(ExecutionEngine):
         where: Optional[ColumnExpr] = None,
         having: Optional[ColumnExpr] = None,
     ) -> DataFrame:
+        from ..column.jax_eval import device_predicate_plan
+
         jdf = self.to_df(df)
         sc = cols.replace_wildcard(jdf.schema)
         # WHERE lowers to a device mask filter when possible
@@ -782,7 +945,8 @@ class JaxExecutionEngine(ExecutionEngine):
             where is not None
             and len(jdf.device_cols) > 0
             and jdf.host_table is None
-            and can_evaluate_on_device(where, jdf.device_cols)
+            and device_predicate_plan(where, jdf.device_cols, jdf.encodings)
+            is not None
         ):
             jdf = self.filter(jdf, where)  # type: ignore
             where = None
@@ -814,13 +978,22 @@ class JaxExecutionEngine(ExecutionEngine):
                     if res.schema.names != order:
                         res = res[order]
                     return res
+        plain_cols = {
+            k: v
+            for k, v in jdf.device_cols.items()
+            if k not in jdf.encodings and k not in jdf.null_masks
+        }
         if (
             where is None
             and having is None
             and not sc.has_agg
             and not sc.is_distinct
             and len(jdf.device_cols) > 0
-            and all(can_evaluate_on_device(c, jdf.device_cols) for c in sc.all_cols)
+            and all(
+                _is_passthrough(c, jdf.device_cols)
+                or can_evaluate_on_device(c, plain_cols)
+                for c in sc.all_cols
+            )
         ):
             return self._device_project(jdf, sc)
         return self._back(
@@ -834,23 +1007,39 @@ class JaxExecutionEngine(ExecutionEngine):
 
         schema = sc.infer_schema(jdf.schema)
         exprs = sc.all_cols
+        # pass-through named columns (any encoding) copy arrays + metadata;
+        # only computed expressions go through the compiled projection
+        passthrough = [c for c in exprs if _is_passthrough(c, jdf.device_cols)]
+        computed = [c for c in exprs if not _is_passthrough(c, jdf.device_cols)]
+        out_encodings: Dict[str, Any] = {}
+        out_masks: Dict[str, Any] = {}
+        out_cols: Dict[str, Any] = {}
+        for c in passthrough:
+            out_cols[c.output_name] = jdf.device_cols[c.name]
+            if c.name in jdf.encodings:
+                out_encodings[c.output_name] = jdf.encodings[c.name]
+            if c.name in jdf.null_masks:
+                out_masks[c.output_name] = jdf.null_masks[c.name]
 
-        def compute(cols: Dict[str, Any]) -> Dict[str, Any]:
-            import jax.numpy as jnp
+        if len(computed) > 0:
 
-            out = {}
-            for c in exprs:
-                v = evaluate_jnp(cols, c)
-                if not hasattr(v, "shape") or getattr(v, "ndim", 0) == 0:
-                    n = next(iter(cols.values())).shape[0]
-                    v = jnp.full((n,), v)
-                out[c.output_name] = v
-            return out
+            def compute(cols: Dict[str, Any]) -> Dict[str, Any]:
+                import jax.numpy as jnp
 
-        cache_key = ("project", tuple(c.__uuid__() for c in exprs), jdf.mesh)
-        if cache_key not in self._jit_cache:
-            self._jit_cache[cache_key] = jax.jit(compute)
-        out_cols = self._jit_cache[cache_key](dict(jdf.device_cols))
+                out = {}
+                for c in computed:
+                    v = evaluate_jnp(cols, c)
+                    if not hasattr(v, "shape") or getattr(v, "ndim", 0) == 0:
+                        n = next(iter(cols.values())).shape[0]
+                        v = jnp.full((n,), v)
+                    out[c.output_name] = v
+                return out
+
+            cache_key = ("project", tuple(c.__uuid__() for c in computed), jdf.mesh)
+            if cache_key not in self._jit_cache:
+                self._jit_cache[cache_key] = jax.jit(compute)
+            out_cols.update(self._jit_cache[cache_key](dict(jdf.device_cols)))
+        out_cols = {c.output_name: out_cols[c.output_name] for c in exprs}
         if schema is None:
             fields = []
             for c in exprs:
@@ -885,6 +1074,8 @@ class JaxExecutionEngine(ExecutionEngine):
                 row_count=jdf._row_count,
                 valid_mask=jdf.valid_mask,
                 nan_cols=nan_cols,
+                encodings=out_encodings,
+                null_masks=out_masks,
                 schema=schema,
             ),
         )
@@ -906,17 +1097,47 @@ class JaxExecutionEngine(ExecutionEngine):
             return self._back(
                 self._host_engine.aggregate(self._host(df), partition_spec, agg_cols)
             )
-        key_cols = {k: jdf.device_cols[k] for k in keys}
+        # dict codes / epoch ints group by device identity; nullable keys add
+        # their mask as an extra key so NULL is its own group
+        key_cols, mask_names = self._group_key_cols(jdf, keys)
+        value_arrs = {}
+        for src in {s for _, _, s in plan["aggs"]}:
+            arr = jdf.device_cols[src]
+            if src in plan["masked_srcs"]:
+                # nullable int/bool value → float64 view with NaN as NULL
+                # (exact: 64-bit ints with nulls were rejected in the plan)
+                cache_key = ("nullview", jdf.mesh)
+                if cache_key not in self._jit_cache:
+                    import jax
+                    import jax.numpy as jnp
+
+                    self._jit_cache[cache_key] = jax.jit(
+                        lambda a, m: jnp.where(
+                            m, jnp.nan, a.astype(jnp.float64)
+                        )
+                    )
+                arr = self._jit_cache[cache_key](arr, jdf.null_masks[src])
+            value_arrs[src] = arr
         partials = device_groupby_partials(
             self._mesh,
             key_cols,
             [
-                (name, agg, jdf.device_cols[src], jdf.maybe_nan(src))
+                (
+                    name,
+                    agg,
+                    value_arrs[src],
+                    jdf.maybe_nan(src) or src in plan["masked_srcs"],
+                )
                 for name, agg, src in plan["aggs"]
             ],
             jdf.device_valid_mask(),
         )
-        merged = merge_partials(partials, keys, [(n, a) for n, a, _ in plan["aggs"]])
+        merged = merge_partials(
+            partials,
+            keys + list(mask_names.values()),
+            [(n, a) for n, a, _ in plan["aggs"]],
+        )
+        merged = self._decode_partial_keys(jdf, merged, mask_names)
         # finalize: avg = sum/count; restore declared output order and names
         out = pd.DataFrame()
         for k in keys:
@@ -925,6 +1146,19 @@ class JaxExecutionEngine(ExecutionEngine):
             out[spec["name"]] = spec["fn"](merged)
         out_schema = plan["schema"]
         return self.to_df(PandasDataFrame(out, out_schema))
+
+
+def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:
+    """A bare (possibly renamed) named column over a device column — copies
+    arrays and metadata without evaluation, so any encoding is fine."""
+    from ..column.expressions import _NamedColumnExpr
+
+    return (
+        isinstance(c, _NamedColumnExpr)
+        and not c.wildcard
+        and c.as_type is None
+        and c.name in device_cols
+    )
 
 
 def _plan_device_agg(
@@ -938,6 +1172,7 @@ def _plan_device_agg(
         return None
     aggs: List[Any] = []
     post: List[dict] = []
+    masked_srcs: set = set()
     fields: List[pa.Field] = [jdf.schema[k] for k in keys]
     for c in agg_cols:
         if not isinstance(c, _FuncExpr) or not c.is_agg or c.is_distinct:
@@ -945,8 +1180,15 @@ def _plan_device_agg(
         if len(c.args) != 1 or not isinstance(c.args[0], _NamedColumnExpr):
             return None
         src = c.args[0].name
-        if src not in jdf.device_cols:
-            return None
+        if src not in jdf.device_cols or src in jdf.encodings:
+            return None  # dict/datetime values don't reduce on device yet
+        if src in jdf.null_masks:
+            import numpy as np_
+
+            dt = np_.dtype(jdf.device_cols[src].dtype)
+            if dt.kind in ("i", "u") and dt.itemsize >= 8:
+                return None  # 64-bit ints with NULLs lose exactness as f64
+            masked_srcs.add(src)
         func = c.func.upper()
         name = c.output_name
         if name == "":
@@ -970,7 +1212,12 @@ def _plan_device_agg(
         else:
             return None
         fields.append(pa.field(name, tp if tp is not None else pa.float64()))
-    return {"aggs": aggs, "post": post, "schema": Schema(fields)}
+    return {
+        "aggs": aggs,
+        "post": post,
+        "schema": Schema(fields),
+        "masked_srcs": masked_srcs,
+    }
 
 
 def _sniff_jax_func(map_func: Callable) -> Optional[Callable]:

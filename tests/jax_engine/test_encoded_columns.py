@@ -1,0 +1,242 @@
+"""Device-resident encoded columns: dictionary strings, nullable ints,
+datetimes — the VERDICT #4 goals, oracle-verified, with device-residency
+asserted (not just correctness)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as f, lit
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxDataFrame, JaxExecutionEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    e = NativeExecutionEngine()
+    yield e
+    e.stop()
+
+
+class TestIngestion:
+    def test_strings_are_dict_encoded_on_device(self, engine):
+        pdf = pd.DataFrame({"s": ["a", "b", None, "a"], "v": [1.0, 2, 3, 4]})
+        jdf = engine.to_df(pdf)
+        assert isinstance(jdf, JaxDataFrame)
+        assert "s" in jdf.device_cols and jdf.host_table is None
+        assert jdf.encodings["s"]["kind"] == "dict"
+        # round trip restores values and nulls
+        back = jdf.as_pandas()
+        assert back["s"].tolist()[:2] == ["a", "b"]
+        assert back["s"].isna().tolist() == [False, False, True, False]
+
+    def test_nullable_ints_on_device_with_mask(self, engine):
+        pdf = pd.DataFrame({"a": pd.array([1, None, 3], dtype="Int64")})
+        jdf = engine.to_df(pdf)
+        assert "a" in jdf.device_cols and jdf.host_table is None
+        assert "a" in jdf.null_masks
+        back = jdf.as_pandas()
+        assert back["a"].isna().tolist() == [False, True, False]
+        assert back["a"].dropna().tolist() == [1, 3]
+
+    def test_floats_with_arrow_nulls_on_device(self, engine):
+        tbl = pa.table({"v": pa.array([1.0, None, 3.0], pa.float64())})
+        jdf = engine.to_df(tbl)
+        assert "v" in jdf.device_cols  # used to pin the frame to host
+        back = jdf.as_pandas()
+        assert back["v"].isna().tolist() == [False, True, False]
+
+    def test_datetimes_on_device(self, engine):
+        pdf = pd.DataFrame(
+            {"t": pd.to_datetime(["2020-01-01", "2020-06-01", None])}
+        )
+        jdf = engine.to_df(pdf)
+        assert "t" in jdf.device_cols
+        assert jdf.encodings["t"]["kind"] == "datetime"
+        back = jdf.as_pandas()
+        assert back["t"].isna().tolist() == [False, False, True]
+        assert str(back["t"].iloc[0])[:10] == "2020-01-01"
+
+
+class TestStringGroupby:
+    def test_groupby_string_key_on_device(self, engine, oracle):
+        rng = np.random.default_rng(0)
+        pdf = pd.DataFrame(
+            {
+                "s": rng.choice(["apple", "pear", "fig", None], 400).tolist(),
+                "v": rng.random(400),
+            }
+        )
+        jdf = engine.to_df(pdf)
+        assert "s" in jdf.device_cols and jdf.host_table is None
+        spec = PartitionSpec(by=["s"])
+        aggs = [f.sum(col("v")).alias("t"), f.count(col("v")).alias("n")]
+        got = (
+            engine.aggregate(jdf, spec, aggs)
+            .as_pandas()
+            .sort_values("s", na_position="last")
+            .reset_index(drop=True)
+        )
+        exp = (
+            oracle.aggregate(oracle.to_df(pdf), spec, aggs)
+            .as_pandas()
+            .sort_values("s", na_position="last")
+            .reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_distinct_with_strings_and_nulls(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "s": ["x", "y", None, "x", None],
+                "a": pd.array([1, 2, 3, 1, 3], dtype="Int64"),
+            }
+        )
+        got = engine.distinct(engine.to_df(pdf)).as_pandas()
+        exp = oracle.distinct(oracle.to_df(pdf)).as_pandas()
+        key = lambda d: d.sort_values(  # noqa: E731
+            ["s", "a"], na_position="last"
+        ).reset_index(drop=True)
+        pd.testing.assert_frame_equal(key(got), key(exp), check_dtype=False)
+
+
+class TestStringFilter:
+    def test_eq_and_like_on_device(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "s": ["apple", "pear", None, "apricot", "fig"],
+                "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+            }
+        )
+        jdf = engine.to_df(pdf)
+        assert jdf.host_table is None
+        got = engine.filter(jdf, col("s") == "apple")
+        assert isinstance(got, JaxDataFrame)  # stayed on device
+        assert got.as_pandas()["v"].tolist() == [1.0]
+        from fugue_tpu.column.expressions import _LikeExpr
+
+        got2 = engine.filter(jdf, _LikeExpr(col("s"), "ap%"))
+        assert sorted(got2.as_pandas()["v"].tolist()) == [1.0, 4.0]
+        got3 = engine.filter(jdf, col("s").is_null())
+        assert got3.as_pandas()["v"].tolist() == [3.0]
+        # oracle agreement on a compound predicate
+        cond = _LikeExpr(col("s"), "%p%") & (col("v") > 1)
+        exp = oracle.filter(oracle.to_df(pdf), cond).as_pandas()
+        g = engine.filter(jdf, cond).as_pandas()
+        pd.testing.assert_frame_equal(
+            g.reset_index(drop=True), exp.reset_index(drop=True), check_dtype=False
+        )
+
+
+class TestNullableIntFilter:
+    def test_filter_nullable_int_on_device(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "a": pd.array([1, None, 3, 4, None, 6], dtype="Int64"),
+                "v": np.arange(6, dtype=np.float64),
+            }
+        )
+        jdf = engine.to_df(pdf)
+        assert "a" in jdf.null_masks and jdf.host_table is None
+        got = engine.filter(jdf, col("a") > 2)
+        assert isinstance(got, JaxDataFrame)
+        assert got.as_pandas()["v"].tolist() == [2.0, 3.0, 5.0]
+        # NULL semantics: IS_NULL / COALESCE
+        got2 = engine.filter(jdf, col("a").is_null())
+        assert got2.as_pandas()["v"].tolist() == [1.0, 4.0]
+        got3 = engine.filter(jdf, f.coalesce(col("a"), lit(0)) == 0)
+        assert got3.as_pandas()["v"].tolist() == [1.0, 4.0]
+        # oracle agreement
+        cond = (col("a") >= 3) | col("a").is_null()
+        exp = oracle.filter(oracle.to_df(pdf), cond).as_pandas()
+        g = engine.filter(jdf, cond).as_pandas()
+        assert g["v"].tolist() == exp["v"].tolist()
+
+    def test_aggregate_nullable_int_values(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "k": [1, 1, 2, 2, 3],
+                "a": pd.array([10, None, None, None, 5], dtype="Int32"),
+            }
+        )
+        jdf = engine.to_df(pdf)
+        assert "a" in jdf.null_masks
+        spec = PartitionSpec(by=["k"])
+        aggs = [
+            f.sum(col("a")).alias("s"),
+            f.count(col("a")).alias("n"),
+            f.max(col("a")).alias("m"),
+        ]
+        got = engine.aggregate(jdf, spec, aggs).as_pandas().sort_values("k")
+        assert got["n"].tolist() == [1, 0, 1]
+        assert got["s"].tolist()[0] == 10 and got["s"].tolist()[2] == 5
+        assert pd.isna(got["s"].tolist()[1])
+
+    def test_groupby_nullable_int_key(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "k": pd.array([1, 1, None, None, 2], dtype="Int64"),
+                "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+            }
+        )
+        spec = PartitionSpec(by=["k"])
+        aggs = [f.sum(col("v")).alias("s")]
+        got = (
+            engine.aggregate(engine.to_df(pdf), spec, aggs)
+            .as_pandas()
+            .sort_values("k", na_position="last")
+            .reset_index(drop=True)
+        )
+        # NULL key forms its own group, distinct from the 0 fill value
+        assert got["s"].tolist() == [3.0, 5.0, 7.0]
+        assert got["k"].isna().tolist() == [False, False, True]
+
+
+class TestDatetime:
+    def test_groupby_datetime_key(self, engine, oracle):
+        pdf = pd.DataFrame(
+            {
+                "d": pd.to_datetime(
+                    ["2020-01-01", "2020-01-01", "2021-05-05", None]
+                ),
+                "v": [1.0, 2.0, 3.0, 4.0],
+            }
+        )
+        spec = PartitionSpec(by=["d"])
+        aggs = [f.sum(col("v")).alias("s")]
+        got = (
+            engine.aggregate(engine.to_df(pdf), spec, aggs)
+            .as_pandas()
+            .sort_values("d", na_position="last")
+            .reset_index(drop=True)
+        )
+        assert got["s"].tolist() == [3.0, 3.0, 4.0]
+        assert str(got["d"].iloc[0])[:10] == "2020-01-01"
+        assert got["d"].isna().tolist() == [False, False, True]
+
+
+class TestShuffleWithEncodings:
+    def test_repartition_carries_masks_and_dicts(self, engine):
+        pdf = pd.DataFrame(
+            {
+                "k": np.arange(100, dtype=np.int64) % 7,
+                "s": [f"v{i % 5}" for i in range(100)],
+                "a": pd.array(
+                    [i if i % 3 else None for i in range(100)], dtype="Int32"
+                ),
+            }
+        )
+        jdf = engine.to_df(pdf)
+        res = engine.repartition(jdf, PartitionSpec(algo="hash", by=["k"]))
+        got = res.as_pandas().sort_values(["k", "s", "a"]).reset_index(drop=True)
+        exp = pdf.sort_values(["k", "s", "a"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)

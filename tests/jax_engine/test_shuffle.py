@@ -94,11 +94,20 @@ def test_rand_repartition_preserves_rows(engine):
     assert sorted(res.as_pandas()["a"].tolist()) == list(range(500))
 
 
-def test_coarse_and_host_frames_unchanged(engine):
+def test_string_frames_exchange_and_host_frames_unchanged(engine):
+    import pyarrow as pa
+
+    # strings are dict-encoded on device → they move with the exchange
     pdf = pd.DataFrame({"a": [1, 2, 3], "s": ["x", "y", "z"]})
-    jdf = engine.to_df(pdf)  # string col → host-resident
+    jdf = engine.to_df(pdf)
     res = engine.repartition(jdf, PartitionSpec(algo="hash", by=["a"]))
-    assert res is jdf  # layout unchanged, logged
+    assert res is not jdf
+    got = res.as_pandas().sort_values("a").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, pdf)
+    # nested columns stay host-resident → layout unchanged, logged
+    tbl = pa.table({"a": [1, 2, 3], "l": [[1], [2, 2], [3]]})
+    hjdf = engine.to_df(tbl)
+    assert engine.repartition(hjdf, PartitionSpec(algo="hash", by=["a"])) is hjdf
     num = engine.to_df(pd.DataFrame({"a": [1, 2, 3]}))
     assert engine.repartition(num, PartitionSpec(algo="coarse", num=4)) is num
 
