@@ -528,6 +528,163 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    # ---- co-sharded zip/comap ---------------------------------------------
+    def zip(
+        self,
+        dfs: DataFrames,
+        how: str = "inner",
+        partition_spec: Optional[PartitionSpec] = None,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> DataFrame:
+        """Device zip: hash-co-partition every input by the zip keys with
+        the all-to-all exchange — no arrow-IPC blobs (SURVEY §5.8 redesign
+        of the reference's serialize-by-partition protocol). Falls back to
+        the host blob protocol for cross zips, host-resident frames, and
+        keys whose device form isn't comparable across frames (strings /
+        nullable / NaN-able keys)."""
+        from ..collections.partition import PartitionSpec as _PSpec
+        from .zipped import ZippedJaxDataFrame
+
+        spec = partition_spec if partition_spec is not None else _PSpec()
+        keys = list(spec.partition_by)
+        if how.lower() != "cross" and len(keys) == 0 and len(dfs) > 0:
+            keys = [
+                n
+                for n in dfs[0].schema.names
+                if all(n in d.schema for d in dfs.values())
+            ]
+        if how.lower() != "cross" and len(keys) > 0:
+            jdfs = [self.to_df(d) for d in dfs.values()]
+            device_ok = all(
+                isinstance(j, JaxDataFrame)
+                and j.host_table is None
+                and len(j.device_cols) == len(j.schema)
+                and all(
+                    k in j.device_cols
+                    and k not in j.encodings  # codes differ across frames
+                    and k not in j.null_masks  # NULL keys → host grouping
+                    and not j.maybe_nan(k)
+                    for k in keys
+                )
+                for j in jdfs
+            )
+            if device_ok:
+                co = [
+                    self.repartition(j, _PSpec(algo="hash", by=keys))
+                    for j in jdfs
+                ]
+                return ZippedJaxDataFrame(
+                    frames=co,  # type: ignore[arg-type]
+                    names=list(dfs.keys()),
+                    named=dfs.has_key,
+                    how=how.lower(),
+                    keys=keys,
+                    schemas=[j.schema for j in jdfs],
+                    mesh=self._mesh,
+                )
+        return super().zip(
+            dfs,
+            how=how,
+            partition_spec=partition_spec,
+            temp_path=temp_path,
+            to_file_threshold=to_file_threshold,
+        )
+
+    def comap(
+        self,
+        df: DataFrame,
+        map_func: Callable,
+        output_schema: Any,
+        partition_spec: Optional[PartitionSpec] = None,
+        on_init: Optional[Callable] = None,
+    ) -> DataFrame:
+        """Comap over a device-zipped frame: each co-sharded frame transfers
+        to host once (shard-local on multi-host meshes — the exchange
+        already placed each key's rows on its owner), groups by the zip
+        keys, and the cotransform runs per key group. No blob rows are
+        ever built or parsed."""
+        from ..collections.partition import PartitionSpec as _PSpec
+        from ..dataframe import ArrayDataFrame
+        from .zipped import ZippedJaxDataFrame
+
+        if not isinstance(df, ZippedJaxDataFrame):
+            return super().comap(
+                df,
+                map_func,
+                output_schema,
+                partition_spec=partition_spec,
+                on_init=on_init,
+            )
+        out_schema = (
+            output_schema
+            if isinstance(output_schema, Schema)
+            else Schema(output_schema)
+        )
+        keys = df._zip_keys
+        how = df._zip_how
+        schemas = df._zip_schemas
+        names = [
+            df._zip_names[i] if df._zip_named else f"_{i}"
+            for i in range(len(schemas))
+        ]
+        spec = _PSpec(partition_spec, by=keys) if partition_spec is not None else _PSpec(by=keys)
+        cursor = spec.get_cursor(df.schema, 0)
+        if on_init is not None:
+            on_init(
+                0,
+                DataFrames(
+                    {n: ArrayDataFrame([], s) for n, s in zip(names, schemas)}
+                ),
+            )
+        frames_pd = [f.as_pandas() for f in df.zip_frames]
+        grouped: List[Dict[Any, pd.DataFrame]] = []
+        key_order: List[Any] = []
+        seen: set = set()
+        for p in frames_pd:
+            g: Dict[Any, pd.DataFrame] = {}
+            if len(p) > 0:
+                for kv, sub in p.groupby(keys, dropna=False, sort=False):
+                    kt = kv if isinstance(kv, tuple) else (kv,)
+                    g[kt] = sub
+                    if kt not in seen:
+                        seen.add(kt)
+                        key_order.append(kt)
+            grouped.append(g)
+        results: List[pa.Table] = []
+        no = 0
+        for kt in key_order:
+            subs = [g.get(kt) for g in grouped]
+            if how == "inner" and any(s is None for s in subs):
+                continue
+            if how == "left_outer" and subs[0] is None:
+                continue
+            if how == "right_outer" and subs[-1] is None:
+                continue
+            dfs_obj = DataFrames(
+                {
+                    n: (
+                        PandasDataFrame(
+                            s.reset_index(drop=True), sch, pandas_df_wrapper=True
+                        )
+                        if s is not None
+                        else ArrayDataFrame([], sch)
+                    )
+                    for n, s, sch in zip(names, subs, schemas)
+                }
+            )
+            row = list(kt) + [None] * len(schemas)
+            cursor.set(lambda r=row: r, no, 0)
+            no += 1
+            out = map_func(cursor, dfs_obj)
+            results.append(out.as_local_bounded().as_arrow())
+        if len(results) == 0:
+            return self.to_df(ArrayDataFrame([], out_schema))
+        tbl = pa.concat_tables(
+            [t.cast(out_schema.pa_schema) for t in results]
+        )
+        return self.to_df(ArrowDataFrame(tbl))
+
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
         res = self._back(
             self._host_engine.union(self._host(df1), self._host(df2), distinct=distinct)

@@ -1,0 +1,131 @@
+"""Co-sharded zip: the device-native replacement for blob serialization.
+
+The reference's zip/comap protocol serializes every key partition into an
+arrow-IPC blob row and unions the blob frames
+(``fugue/execution/execution_engine.py:962-1111``). On a device mesh that
+roundtrip is replaced by LAYOUT: every input frame hash-repartitions by the
+zip keys with the all-to-all exchange (``ops/shuffle.py``), so all rows of
+a key live on the same shard of every frame. The zipped result is a thin
+wrapper holding the co-sharded frames — no blobs exist unless something
+outside the comap path forces materialization (then the host protocol runs
+once as a fallback).
+"""
+
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+from ..dataframe import DataFrame, DataFrames, LocalBoundedDataFrame
+from ..schema import Schema
+from .dataframe import JaxDataFrame
+
+_BLOB_PREFIX = "__fugue_blob__"
+
+
+class ZippedJaxDataFrame(JaxDataFrame):
+    """Result of a device-side ``zip``: co-sharded frames + zip metadata.
+
+    Presents the same logical schema as the host blob protocol (zip keys +
+    binary blob columns) so downstream metadata checks are identical, but
+    physically holds the hash-co-partitioned device frames.
+    """
+
+    def __init__(
+        self,
+        frames: List[JaxDataFrame],
+        names: List[str],
+        named: bool,
+        how: str,
+        keys: List[str],
+        schemas: List[Schema],
+        mesh: Any,
+    ):
+        key_schema = schemas[0].extract(keys)
+        blob_fields = ",".join(
+            f"{_BLOB_PREFIX}{i}:binary" for i in range(len(frames))
+        )
+        blob_schema = (
+            Schema(str(key_schema) + "," + blob_fields)
+            if len(keys) > 0
+            else Schema(blob_fields)
+        )
+        super().__init__(
+            mesh=mesh,
+            _internal=dict(
+                device_cols={},
+                host_tbl=None,
+                row_count=-1,
+                valid_mask=None,
+                schema=blob_schema,
+            ),
+        )
+        self._zip_frames = frames
+        self._zip_names = names
+        self._zip_named = named
+        self._zip_how = how
+        self._zip_keys = keys
+        self._zip_schemas = schemas
+        self._mat: Optional[LocalBoundedDataFrame] = None
+        self.reset_metadata(
+            {
+                "serialized": True,
+                "serialized_cols": [
+                    f"{_BLOB_PREFIX}{i}" for i in range(len(frames))
+                ],
+                "schemas": [str(s) for s in schemas],
+                "serialized_has_name": named,
+                "names": names,
+                "how": how,
+                "keys": keys,
+                "device_zip": True,
+            }
+        )
+
+    @property
+    def zip_frames(self) -> List[JaxDataFrame]:
+        return self._zip_frames
+
+    # -- materialization fallback (anything outside the comap path) ---------
+    def _materialize(self) -> LocalBoundedDataFrame:
+        """Build the blob representation once via the host protocol."""
+        if self._mat is None:
+            from ..collections.partition import PartitionSpec
+            from ..execution.native_execution_engine import NativeExecutionEngine
+
+            e = NativeExecutionEngine()
+            if self._zip_named:
+                dfs = DataFrames(
+                    {
+                        n: f.as_local_bounded()
+                        for n, f in zip(self._zip_names, self._zip_frames)
+                    }
+                )
+            else:
+                dfs = DataFrames([f.as_local_bounded() for f in self._zip_frames])
+            res = e.zip(
+                dfs,
+                how=self._zip_how,
+                partition_spec=PartitionSpec(by=self._zip_keys)
+                if len(self._zip_keys) > 0
+                else None,
+            )
+            mat = res.as_local_bounded()
+            mat.reset_metadata(self.metadata)
+            self._mat = mat
+        return self._mat
+
+    def count(self) -> int:
+        return self._materialize().count()
+
+    @property
+    def empty(self) -> bool:
+        return all(f.empty for f in self._zip_frames)
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return self._materialize().as_arrow()
+
+    def as_local_bounded(self) -> LocalBoundedDataFrame:
+        return self._materialize()
+
+    def peek_array(self) -> List[Any]:
+        return self._materialize().peek_array()

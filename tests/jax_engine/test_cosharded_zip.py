@@ -1,0 +1,127 @@
+"""Device co-sharded zip/comap: no blob serialization for device frames."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.dataframe import DataFrames
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.zipped import ZippedJaxDataFrame
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+def test_zip_device_frames_produces_cosharded(engine):
+    a = pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"k": [2, 3, 4], "w": [20.0, 30.0, 40.0]})
+    z = engine.zip(
+        DataFrames([engine.to_df(a), engine.to_df(b)]),
+        partition_spec=PartitionSpec(by=["k"]),
+    )
+    assert isinstance(z, ZippedJaxDataFrame)
+    assert z.metadata["device_zip"] is True
+    assert z.metadata["keys"] == ["k"]
+    # the co-sharded frames preserved all rows
+    assert sorted(z.zip_frames[0].as_pandas()["k"].tolist()) == [1, 2, 3]
+    assert sorted(z.zip_frames[1].as_pandas()["k"].tolist()) == [2, 3, 4]
+
+
+def test_comap_matches_oracle(engine, monkeypatch):
+    rng = np.random.default_rng(0)
+    a = pd.DataFrame({"k": rng.integers(0, 10, 200), "v": rng.random(200)})
+    b = pd.DataFrame({"k": rng.integers(0, 12, 150), "w": rng.random(150)})
+
+    def merge_stats(df1: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame(
+            {
+                "k": [df1["k"].iloc[0]],
+                "sv": [df1["v"].sum()],
+                "sw": [df2["w"].sum()],
+            }
+        )
+
+    def run(eng):
+        from fugue_tpu.workflow import FugueWorkflow
+
+        dag = FugueWorkflow()
+        z = dag.df(a).zip(dag.df(b), partition=dict(by=["k"]))
+        z.transform(
+            merge_stats, schema="k:long,sv:double,sw:double"
+        ).yield_dataframe_as("r", as_local=True)
+        res = dag.run(eng)
+        return (
+            res.yields["r"].result.as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+
+    exp = run(NativeExecutionEngine())
+    # prove the device path: the jax engine must never build blob rows
+    def _no_blobs(*a, **k):
+        raise AssertionError("blob serialization used on the device zip path")
+
+    monkeypatch.setattr(engine, "_serialize_by_partition", _no_blobs)
+    got = run(engine)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_comap_outer_semantics(engine):
+    a = pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+    b = pd.DataFrame({"k": [2, 3], "w": [20.0, 30.0]})
+
+    def count_sides(df1: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"n1": [len(df1)], "n2": [len(df2)]})
+
+    from fugue_tpu.workflow import FugueWorkflow
+
+    for how, expected in [
+        ("inner", [(1, 1)]),
+        ("left_outer", [(1, 0), (1, 1)]),
+        ("full_outer", [(0, 1), (1, 0), (1, 1)]),
+    ]:
+        z = engine.zip(
+            DataFrames([engine.to_df(a), engine.to_df(b)]),
+            how=how,
+            partition_spec=PartitionSpec(by=["k"]),
+        )
+        assert isinstance(z, ZippedJaxDataFrame), how
+        dag = FugueWorkflow()
+        dag.df(a).zip(dag.df(b), how=how, partition=dict(by=["k"])).transform(
+            count_sides, schema="n1:int,n2:int"
+        ).yield_dataframe_as("r", as_local=True)
+        res = dag.run(engine).yields["r"].result.as_pandas()
+        got = sorted(map(tuple, res[["n1", "n2"]].to_numpy().tolist()))
+        assert got == sorted(expected), how
+
+
+def test_zip_string_keys_falls_back_to_blob_protocol(engine):
+    a = pd.DataFrame({"s": ["x", "y"], "v": [1.0, 2.0]})
+    b = pd.DataFrame({"s": ["y", "z"], "w": [3.0, 4.0]})
+    z = engine.zip(
+        DataFrames([engine.to_df(a), engine.to_df(b)]),
+        partition_spec=PartitionSpec(by=["s"]),
+    )
+    # dict codes don't align across frames → host blob protocol
+    assert not isinstance(z, ZippedJaxDataFrame)
+    assert z.metadata["serialized"] is True
+
+
+def test_zipped_frame_materializes_for_non_comap_use(engine):
+    a = pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+    b = pd.DataFrame({"k": [1, 2], "w": [3.0, 4.0]})
+    z = engine.zip(
+        DataFrames([engine.to_df(a), engine.to_df(b)]),
+        partition_spec=PartitionSpec(by=["k"]),
+    )
+    assert isinstance(z, ZippedJaxDataFrame)
+    tbl = z.as_arrow()  # blob fallback materialization
+    assert tbl.num_rows == 4  # 2 keys × 2 frames
+    assert z.count() == 4
