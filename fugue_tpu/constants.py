@@ -31,6 +31,8 @@ FUGUE_CONF_CACHE_PATH = "fugue.workflow.cache.path"
 FUGUE_TPU_CONF_MESH_SHAPE = "fugue.tpu.mesh_shape"
 FUGUE_TPU_CONF_ROW_AXIS = "fugue.tpu.row_axis"
 FUGUE_TPU_CONF_DEFAULT_BATCH_ROWS = "fugue.tpu.default_batch_rows"
+# cap on O(shards x groups) partial-row transfers (distinct cardinality guard)
+FUGUE_TPU_CONF_MAX_PARTIAL_ROWS = "fugue.tpu.max_partial_rows"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

@@ -755,6 +755,9 @@ class JaxExecutionEngine(ExecutionEngine):
         their device identity and decode on the O(groups) host result."""
         from ..ops.segment import device_groupby_partials
 
+        from ..constants import FUGUE_TPU_CONF_MAX_PARTIAL_ROWS
+        from ..ops.segment import PartialsTooLarge
+
         jdf = self.to_df(df)
         if (
             isinstance(jdf, JaxDataFrame)
@@ -767,12 +770,19 @@ class JaxExecutionEngine(ExecutionEngine):
             count_name = "__n__"
             while count_name in jdf.schema:  # never shadow a user column
                 count_name = "_" + count_name
-            partials = device_groupby_partials(
-                self._mesh,
-                key_cols,
-                [(count_name, "count", key_cols[first])],
-                jdf.device_valid_mask(),
-            )
+            try:
+                partials = device_groupby_partials(
+                    self._mesh,
+                    key_cols,
+                    [(count_name, "count", key_cols[first])],
+                    jdf.device_valid_mask(),
+                    max_partial_rows=self.conf.get(
+                        FUGUE_TPU_CONF_MAX_PARTIAL_ROWS, 1 << 22
+                    ),
+                )
+            except PartialsTooLarge:
+                # near-unique rows: the O(groups) transfer stops paying off
+                return self._back(self._host_engine.distinct(self._host(df)))
             res = partials.drop(columns=[count_name]).drop_duplicates(
                 ignore_index=True
             )

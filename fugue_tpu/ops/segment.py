@@ -19,7 +19,7 @@ re-tracing happens only on dtype/shape changes.
 Supported aggregations: sum, count, min, max (avg = sum+count at merge).
 """
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,6 +58,7 @@ def _agg_outputs(
     min_of: Any,
     max_of: Any,
     count_all: Any = None,
+    merge_ops: Optional[Dict[str, Any]] = None,
 ) -> List[Any]:
     """Per-group aggregate arrays with NaN-as-NULL semantics — the single
     implementation shared by the sort+segment and dense-bucket kernels.
@@ -65,7 +66,14 @@ def _agg_outputs(
     ``sum_of``/``min_of``/``max_of`` inject the reduction primitive: they map
     a masked full-length row array to a per-group array. ``count_all`` is an
     optional precomputed per-group count of valid rows (the dense path's
-    presence table), reused for NaN-free columns.
+    presence table), reused for NaN-free columns — when ``merge_ops`` is
+    given it must already be cross-shard merged.
+
+    ``merge_ops`` (optional ``{"sum", "min", "max"}`` → collective) merges
+    the per-shard tables across shards ON DEVICE (psum/pmin/pmax) before
+    the NULL-ify step, so the host receives one table instead of
+    shards × buckets — the order matters: NULL-ify must see the GLOBAL
+    non-null count, not the per-shard one.
 
     NaN in a nullable float column IS null: excluded from every aggregate
     (matching the oracle's dropna-first semantics) so results don't depend
@@ -82,6 +90,9 @@ def _agg_outputs(
     nn_cache: Dict[int, Any] = {}
     agg_cache: Dict[Tuple[str, int], Any] = {}
 
+    def _merge(kind: str, table: Any) -> Any:
+        return merge_ops[kind](table) if merge_ops is not None else table
+
     def _ev(vidx: int) -> Any:
         if vidx not in ev_cache:
             v = values[vidx]
@@ -92,9 +103,11 @@ def _agg_outputs(
         key = vidx if _null_of(vidx) else -1  # NaN-free columns share one count
         if key not in nn_cache:
             if key == -1 and count_all is not None:
-                nn_cache[key] = count_all
+                nn_cache[key] = count_all  # pre-merged by the caller
             else:
-                nn_cache[key] = sum_of(_ev(vidx).astype(jnp.int64))
+                nn_cache[key] = _merge(
+                    "sum", sum_of(_ev(vidx).astype(jnp.int64))
+                )
         return nn_cache[key]
 
     def _one(agg: str, vidx: int) -> Any:
@@ -105,17 +118,23 @@ def _agg_outputs(
         ev = _ev(vidx)
         may_null = _null_of(vidx)
         if agg == "sum":
-            part = sum_of(jnp.where(ev, v, jnp.zeros_like(v)))
+            part = _merge("sum", sum_of(jnp.where(ev, v, jnp.zeros_like(v))))
             if may_null:
                 part = jnp.where(_nn(vidx) > 0, part, jnp.nan)  # all-null → NULL
         elif agg == "count":
             part = _nn(vidx)
         elif agg == "min":
-            part = min_of(jnp.where(ev, v, jnp.full_like(v, _max_of(jnp, v.dtype))))
+            part = _merge(
+                "min",
+                min_of(jnp.where(ev, v, jnp.full_like(v, _max_of(jnp, v.dtype)))),
+            )
             if may_null:
                 part = jnp.where(_nn(vidx) > 0, part, jnp.nan)
         elif agg == "max":
-            part = max_of(jnp.where(ev, v, jnp.full_like(v, _min_of(jnp, v.dtype))))
+            part = _merge(
+                "max",
+                max_of(jnp.where(ev, v, jnp.full_like(v, _min_of(jnp, v.dtype)))),
+            )
             if may_null:
                 part = jnp.where(_nn(vidx) > 0, part, jnp.nan)
         else:  # pragma: no cover
@@ -302,14 +321,18 @@ def _get_compiled_minmax(mesh: Any):
 
 
 def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str], ...]):
-    """Sort-free per-shard groupby: scatter-add into a dense bucket table.
+    """Sort-free per-shard groupby: scatter-add into a dense bucket table,
+    merged ACROSS shards on device (psum/pmin/pmax over the rows axis).
 
     Applies when the key range fits ``buckets`` — the common case — and
     avoids ``lax.sort`` entirely (sorts are the slow path on TPU; scatter
-    reductions vectorize on the VPU).
+    reductions vectorize on the VPU). The cross-shard merge rides ICI and
+    leaves ONE replicated table, so the host transfer is O(buckets), not
+    O(shards × buckets).
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import ROW_AXIS
@@ -322,8 +345,11 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
             values = rest[:num_vals]
             valid = rest[num_vals]
             idx = jnp.where(valid, (k - kmin).astype(jnp.int32), buckets - 1)
-            present = jnp.zeros(buckets, dtype=jnp.int64).at[idx].add(
-                valid.astype(jnp.int64)
+            present = lax.psum(
+                jnp.zeros(buckets, dtype=jnp.int64).at[idx].add(
+                    valid.astype(jnp.int64)
+                ),
+                ROW_AXIS,
             )
             outs = _agg_outputs(
                 jnp,
@@ -342,6 +368,11 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
                     .max(a)
                 ),
                 count_all=present,
+                merge_ops={
+                    "sum": lambda t: lax.psum(t, ROW_AXIS),
+                    "min": lambda t: lax.pmin(t, ROW_AXIS),
+                    "max": lambda t: lax.pmax(t, ROW_AXIS),
+                },
             )
             return (present,) + tuple(outs)
 
@@ -350,7 +381,7 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
             kernel,
             mesh=mesh,
             in_specs=(P(ROW_AXIS), P()) + tuple(P(ROW_AXIS) for _ in range(num_vals + 1)),
-            out_specs=tuple(P(ROW_AXIS) for _ in range(n_out)),
+            out_specs=tuple(P() for _ in range(n_out)),
         )
         _COMPILE_CACHE[cache_key] = jax.jit(mapped)
     return _COMPILE_CACHE[cache_key]
@@ -396,16 +427,21 @@ def _dense_groupby_partials(
     agg_sig, arrays = _dedupe_cols(agg_cols)
     compiled = _get_compiled_dense(mesh, buckets, agg_sig)
     outs = compiled(key_arr, np_.int64(kmin), *arrays, valid)
-    shards = mesh.shape[ROW_AXIS]
-    host = [np_.asarray(jax.device_get(o)).reshape(shards, buckets) for o in outs]
+    # outputs are cross-shard merged + replicated: ONE table comes to host
+    host = [np_.asarray(jax.device_get(o)) for o in outs]
     present = host[0]
     # the overflow bucket (buckets-1) may mix padding rows; presence counts
     # only valid rows, so zero-presence buckets drop out naturally
-    srow, idx = np_.nonzero(present > 0)
+    (idx,) = np_.nonzero(present > 0)
     data: Dict[str, Any] = {key_name: idx.astype(np_.int64) + kmin}
     for spec, arr in zip(agg_sig, host[1:]):
-        data[spec[0]] = arr[srow, idx]
+        data[spec[0]] = arr[idx]
     return pd.DataFrame(data)
+
+
+class PartialsTooLarge(Exception):
+    """The per-shard group count is too high for the O(shards × groups)
+    host transfer — callers should fall back to a host-side plan."""
 
 
 def device_groupby_partials(
@@ -413,6 +449,7 @@ def device_groupby_partials(
     key_cols: Dict[str, Any],
     agg_cols: List[Tuple[Any, ...]],
     valid_mask: Any,
+    max_partial_rows: Optional[int] = None,
 ) -> "Any":
     """Run the device phase; return a host pandas frame of per-shard-group
     partials. Strategy: single int key with a small range → dense scatter-add
@@ -454,6 +491,12 @@ def device_groupby_partials(
     outs = compiled(*in_args)
     nsegs = np_.asarray(jax.device_get(outs[0]))  # (shards,) tiny transfer
     shards = mesh.shape[ROW_AXIS]
+    if max_partial_rows is not None and int(nsegs.sum()) > max_partial_rows:
+        # cardinality guard: shipping this many partial rows would beat the
+        # purpose of the bounded-transfer design
+        raise PartialsTooLarge(
+            f"{int(nsegs.sum())} partial rows > limit {max_partial_rows}"
+        )
     k_max = int(nsegs.max()) if len(nsegs) > 0 else 0
     if k_max == 0:
         return pd.DataFrame(
