@@ -33,6 +33,9 @@ FUGUE_TPU_CONF_ROW_AXIS = "fugue.tpu.row_axis"
 FUGUE_TPU_CONF_DEFAULT_BATCH_ROWS = "fugue.tpu.default_batch_rows"
 # cap on O(shards x groups) partial-row transfers (distinct cardinality guard)
 FUGUE_TPU_CONF_MAX_PARTIAL_ROWS = "fugue.tpu.max_partial_rows"
+# debug: cross-check compiled shard_map transformers against the masked
+# reference on shard 0 (catches UDFs ignoring the __valid__ contract)
+FUGUE_TPU_CONF_VALIDATE_COMPILED = "fugue.tpu.validate_compiled"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

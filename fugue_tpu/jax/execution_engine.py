@@ -147,6 +147,10 @@ class JaxMapEngine(MapEngine):
         out = {k: v for k, v in out.items() if k != "__valid__"}
         first = next(iter(out.values()))
         same_len = first.shape[0] == next(iter(cols.values())).shape[0]
+        from ..constants import FUGUE_TPU_CONF_VALIDATE_COMPILED
+
+        if self.execution_engine.conf.get(FUGUE_TPU_CONF_VALIDATE_COMPILED, False):
+            self._validate_compiled(df, fn, cols, out, same_len)
         return JaxDataFrame(
             mesh=mesh,
             _internal=dict(
@@ -157,6 +161,73 @@ class JaxMapEngine(MapEngine):
                 schema=output_schema,
             ),
         )
+
+
+    def _validate_compiled(
+        self,
+        df: JaxDataFrame,
+        fn: Callable,
+        cols: Dict[str, Any],
+        out: Dict[str, Any],
+        same_len: bool,
+    ) -> None:
+        """Debug cross-check (``fugue.tpu.validate_compiled``): run the UDF
+        eagerly on ONE shard's VALID rows only — the reference semantics a
+        correct, mask-honoring UDF must reproduce — and compare with the
+        compiled output's block for that shard. The shard with the most
+        padding is chosen (a mask-ignoring reduction only diverges where
+        padding exists). Catches UDFs that reduce over padding rows because
+        they ignored the ``__valid__`` mask."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np_
+
+        shards = num_row_shards(df.mesh)
+        local_n = next(iter(cols.values())).shape[0] // shards
+        valid_all = np_.asarray(jax.device_get(cols["__valid__"])).reshape(
+            shards, local_n
+        )
+        per_shard = valid_all.sum(axis=1)
+        # the shard with the most padding (possibly all-padding: the
+        # reference then runs on zero rows — exactly what a correct UDF
+        # must reproduce)
+        s = int(per_shard.argmin())
+        valid0 = valid_all[s]
+        sl = slice(s * local_n, (s + 1) * local_n)
+        ref_in = {
+            k: jnp.asarray(np_.asarray(jax.device_get(v))[sl][valid0])
+            for k, v in cols.items()
+            if k != "__valid__"
+        }
+        ref_in["__valid__"] = jnp.ones(int(valid0.sum()), dtype=bool)
+        try:
+            ref_out = fn(ref_in)
+        except Exception:  # collectives etc. can't run eagerly — skip
+            self.execution_engine.log.debug(
+                "validate_compiled: UDF not eagerly runnable; skipped"
+            )
+            return
+        for name, arr in out.items():
+            out_local = arr.shape[0] // shards
+            block = np_.asarray(jax.device_get(arr))[
+                s * out_local : (s + 1) * out_local
+            ]
+            if same_len:
+                block = block[valid0]
+            ref = np_.asarray(jax.device_get(ref_out[name]))
+            ok = block.shape == ref.shape and (
+                np_.allclose(block, ref, equal_nan=True)
+                if np_.issubdtype(block.dtype, np_.floating)
+                else bool((block == ref).all())
+            )
+            assert_or_throw(
+                ok,
+                FugueInvalidOperation(
+                    f"compiled transformer output {name!r} differs from the "
+                    "masked reference on shard 0 — the UDF likely ignores "
+                    "the __valid__ mask and read padding rows"
+                ),
+            )
 
 
 class JaxExecutionEngine(ExecutionEngine):

@@ -121,6 +121,49 @@ class TestJaxSpecific:
         assert out.as_pandas()["a"].tolist() == list(range(1, 101))
         e.stop()
 
+    def test_validate_compiled_catches_mask_ignoring_udf(self):
+        """fugue.tpu.validate_compiled: a per-shard reduction that ignores
+        the __valid__ mask reads padding rows — the debug cross-check
+        raises instead of silently corrupting results."""
+        from typing import Dict
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import pandas as pd
+
+        import fugue_tpu.api as fa
+        from fugue_tpu.exceptions import FugueInvalidOperation
+
+        e = JaxExecutionEngine({"fugue.tpu.validate_compiled": True})
+        # 10 rows over 8 shards → padding rows exist
+        pdf = pd.DataFrame({"a": np.arange(10, dtype=np.float64) + 1.0})
+        jdf = e.to_df(pdf)
+
+        def bad_mean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            return {"s": cols["a"].mean()[None]}  # ignores __valid__
+
+        with pytest.raises(FugueInvalidOperation, match="__valid__"):
+            fa.transform(jdf, bad_mean, schema="s:double", engine=e, as_fugue=True)
+
+        def good_sum(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            import jax.numpy as jnp
+
+            v = jnp.where(cols["__valid__"], cols["a"], 0.0)
+            return {"s": v.sum()[None]}
+
+        out = fa.transform(jdf, good_sum, schema="s:double", engine=e, as_fugue=True)
+        assert float(out.as_pandas()["s"].sum()) == float(pdf["a"].sum())
+        # elementwise UDFs pass the check untouched
+        def plus(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            return {"a": cols["a"] + 1}
+
+        out2 = fa.transform(jdf, plus, schema="a:double", engine=e, as_fugue=True)
+        assert sorted(out2.as_pandas()["a"].tolist()) == [
+            float(x) for x in range(2, 12)
+        ]
+        e.stop()
+
     def test_broadcast_replicates(self):
         import pandas as pd
 
