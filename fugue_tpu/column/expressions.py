@@ -512,12 +512,16 @@ class _WindowExpr(ColumnExpr):
         args: List[Any],
         partition_by: List[str],
         order_by: List[Any],  # (name, ascending) pairs
+        frame: Any = None,  # (kind, start, end); None = dialect default
     ):
         super().__init__()
         self._func = func.upper()
         self._args = [_to_col(a) for a in args]
         self._partition_by = list(partition_by)
         self._order_by = list(order_by)
+        # frame: kind ∈ {"rows","range"}; bounds are "unb_prec"/"unb_foll"/
+        # "current"/("prec", n)/("foll", n)
+        self._frame = frame
 
     @property
     def func(self) -> str:
@@ -534,6 +538,10 @@ class _WindowExpr(ColumnExpr):
     @property
     def order_by(self) -> List[Any]:
         return self._order_by
+
+    @property
+    def frame(self) -> Any:
+        return self._frame
 
     @property
     def children(self) -> List[ColumnExpr]:
@@ -558,4 +566,10 @@ class _WindowExpr(ColumnExpr):
         return s if self.as_name == "" else f"{s} AS {self.as_name}"
 
     def _uuid_keys(self) -> List[Any]:
-        return ["window", self._func, self._partition_by, repr(self._order_by)]
+        return [
+            "window",
+            self._func,
+            self._partition_by,
+            repr(self._order_by),
+            repr(self._frame),
+        ]

@@ -108,9 +108,28 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
         keys = (
             [ordered[c] for c in expr.partition_by] if grouped is not None else None
         )
+        frame = getattr(expr, "frame", None)
         if len(order_names) > 0:
-            # running aggregate over a ROWS frame up to the current row
-            res = _running_agg(v, keys, func)
+            # SQL default frame with ORDER BY: RANGE UNBOUNDED PRECEDING ..
+            # CURRENT ROW (peer rows share the running value)
+            if frame is None:
+                frame = ("range", "unb_prec", "current")
+            kind, start, end = frame
+            if start == "unb_prec" and end == "unb_foll":
+                res = _whole_partition_agg(v, keys, func, ordered)
+            elif kind == "rows" and start == "unb_prec" and end == "current":
+                res = _running_agg(v, keys, func)
+            elif kind == "range" and start == "unb_prec" and end == "current":
+                run = _running_agg(v, keys, func)
+                # broadcast each peer group's LAST running value (positional)
+                pk = (keys or []) + [ordered[c] for c in order_names]
+                res = run.groupby(pk, dropna=False).transform(
+                    lambda x: x.iloc[-1]
+                )
+            else:
+                res = _bounded_frame_agg(
+                    ordered, v, keys, order_names, asc, func, frame
+                )
         elif keys is not None:
             if func == "FIRST":
                 res = v.groupby(keys, dropna=False).transform(lambda x: x.iloc[0])
@@ -132,6 +151,138 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
         raise FugueSQLSyntaxError(f"unsupported window function {func}")
     # restore the original row order
     return res.reindex(work.index)
+
+
+def _whole_partition_agg(
+    v: pd.Series, keys: Any, func: str, ordered: pd.DataFrame
+) -> pd.Series:
+    """UNBOUNDED PRECEDING .. UNBOUNDED FOLLOWING — the whole partition."""
+    if keys is not None:
+        g = v.groupby(keys, dropna=False)
+        if func == "FIRST":
+            return g.transform(lambda x: x.iloc[0])
+        if func == "LAST":
+            return g.transform(lambda x: x.iloc[-1])
+        return g.transform(_WINDOW_AGGS[func])
+    if func == "FIRST":
+        agg = v.iloc[0] if len(v) > 0 else None
+    elif func == "LAST":
+        agg = v.iloc[-1] if len(v) > 0 else None
+    elif func == "COUNT":
+        agg = v.notna().sum()
+    else:
+        agg = getattr(v, _WINDOW_AGGS[func])()
+    return pd.Series([agg] * len(v), index=v.index)
+
+
+def _bound_offsets(start: Any, end: Any) -> Any:
+    """Normalize bounds to (lo_off, hi_off) where None = unbounded; offsets
+    are signed relative positions/values (preceding negative)."""
+
+    def off(b: Any, is_start: bool) -> Any:
+        if b == "unb_prec":
+            return None if is_start else 0  # degenerate, validated upstream
+        if b == "unb_foll":
+            return None
+        if b == "current":
+            return 0
+        tag, n = b
+        return -n if tag == "prec" else n
+
+    return off(start, True), off(end, False)
+
+
+def _bounded_frame_agg(
+    ordered: pd.DataFrame,
+    v: pd.Series,
+    keys: Any,
+    order_names: List[str],
+    asc: List[bool],
+    func: str,
+    frame: Any,
+) -> pd.Series:
+    """Explicit ROWS/RANGE frames with numeric bounds.
+
+    ROWS offsets are row positions; RANGE offsets are order-key value
+    distances (single numeric ORDER BY key required). Per partition the
+    window [lo, hi) per row comes from positions / ``searchsorted`` over
+    the ordered keys; aggregates skip NULLs (SQL semantics).
+    """
+    if func in ("FIRST", "LAST"):
+        raise FugueSQLSyntaxError(
+            f"{func} does not support explicit window frames"
+        )
+    kind, start, end = frame
+    lo_off, hi_off = _bound_offsets(start, end)
+    if start == "unb_prec":
+        lo_off = None
+    if kind == "range" and (lo_off not in (None, 0) or hi_off not in (None, 0)):
+        if len(order_names) != 1:
+            raise FugueSQLSyntaxError(
+                "RANGE with offsets requires exactly one ORDER BY key"
+            )
+
+    out = np.full(len(v), np.nan, dtype=np.float64)
+    vals = v.to_numpy(dtype=np.float64, na_value=np.nan)
+    if keys is not None:
+        # positional locations per partition, in sorted (frame) order
+        group_iter = [
+            np.sort(np.asarray(g))
+            for g in ordered.groupby(
+                [k for k in keys], dropna=False, sort=False
+            ).indices.values()
+        ]
+    else:
+        group_iter = [np.arange(len(ordered))]
+    for gpos in group_iter:
+        n = len(gpos)
+        gv = vals[gpos]
+        if kind == "rows":
+            lo = (
+                np.zeros(n, dtype=np.int64)
+                if lo_off is None
+                else np.clip(np.arange(n) + lo_off, 0, n)
+            )
+            hi = (
+                np.full(n, n, dtype=np.int64)
+                if hi_off is None
+                else np.clip(np.arange(n) + hi_off + 1, 0, n)
+            )
+        else:
+            okey = ordered[order_names[0]].to_numpy(dtype=np.float64)[gpos]
+            sign = 1.0 if asc[0] else -1.0
+            k = sign * okey  # ascending view
+            lo = (
+                np.zeros(n, dtype=np.int64)
+                if lo_off is None
+                else np.searchsorted(k, k + lo_off, side="left")
+            )
+            hi = (
+                np.full(n, n, dtype=np.int64)
+                if hi_off is None
+                else np.searchsorted(k, k + hi_off, side="right")
+            )
+        for i in range(n):
+            w = gv[lo[i] : hi[i]]
+            w = w[~np.isnan(w)]
+            if func == "COUNT":
+                out[gpos[i]] = len(w)
+            elif len(w) == 0:
+                out[gpos[i]] = np.nan
+            elif func == "SUM":
+                out[gpos[i]] = w.sum()
+            elif func == "AVG":
+                out[gpos[i]] = w.mean()
+            elif func == "MIN":
+                out[gpos[i]] = w.min()
+            elif func == "MAX":
+                out[gpos[i]] = w.max()
+            else:  # pragma: no cover
+                raise FugueSQLSyntaxError(f"unsupported frame aggregate {func}")
+    res = pd.Series(out, index=ordered.index)  # positional over `ordered`
+    if func == "COUNT":
+        res = res.fillna(0).astype("int64")
+    return res
 
 
 def _running_agg(v: pd.Series, keys: Any, func: str) -> pd.Series:

@@ -217,7 +217,24 @@ class RunSQLSelect(Processor):
     def process(self, dfs: DataFrames) -> DataFrame:
         statement = self.params.get_or_throw("statement", StructuredRawSQL)
         engine = self.execution_engine
-        sql_engine = engine.sql_engine
+        spec = self.params.get_or_none("sql_engine", object)
+        if spec is None:
+            sql_engine = engine.sql_engine
+        else:
+            # engine-specific select (FugueSQL CONNECT): a registered SQL
+            # engine, a SQLEngine class, or an execution-engine name whose
+            # SQL facet runs this statement
+            from ...execution.factory import (
+                make_execution_engine,
+                make_sql_engine,
+            )
+
+            kw = dict(self.params.get("sql_engine_params", dict()))
+            try:
+                sql_engine = make_sql_engine(spec, engine, **kw)
+            except Exception:
+                other = make_execution_engine(spec, conf=engine.conf, **kw)
+                sql_engine = other.sql_engine
         return sql_engine.select(dfs, statement)
 
 

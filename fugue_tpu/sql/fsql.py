@@ -356,6 +356,8 @@ class FugueSQLCompiler:
             return None
         if p.at_kw("SELECT"):
             return self._stmt_select(p, sql)
+        if p.at_kw("CONNECT"):
+            return self._stmt_connect(p, sql)
         if p.at_kw("TAKE"):
             return self._stmt_take(p)
         if p.at_kw("SAMPLE"):
@@ -610,7 +612,53 @@ class FugueSQLCompiler:
             title = t.value
         self._wf.show(*dfs, n=n, with_count=with_count, title=title)
 
-    def _stmt_select(self, p: _StatementParser, sql: str) -> WorkflowDataFrame:
+    def _stmt_connect(self, p: _StatementParser, sql: str) -> WorkflowDataFrame:
+        """``CONNECT <engine> [PARAMS k=v,...] SELECT ...`` — run ONE select
+        on a specific SQL engine (the reference's engine-specific query,
+        ``fugue/sql/_visitors.py:728-760``)."""
+        p.expect_kw("CONNECT")
+        parts: List[str] = [p.next().value]
+        while p.peek().value == "." or (
+            p.peek().kind == "IDENT"
+            and not p.at_kw("SELECT")
+            and not p.at_kw("PARAMS")
+        ):
+            parts.append(p.next().value)
+        engine = "".join(parts)
+        params: Dict[str, Any] = {}
+        if p.eat_kw("PARAMS"):
+            while True:
+                k = p.next().value
+                t = p.peek()
+                if t.kind == "OP" and t.value == "=":
+                    p.next()
+                elif t.kind == "PUNCT" and t.value == ":":
+                    p.next()
+                else:
+                    raise FugueSQLSyntaxError("PARAMS expects k=v pairs")
+                v = p.next()
+                if v.kind == "NUMBER":
+                    params[k] = float(v.value) if "." in v.value or "e" in v.value.lower() else int(v.value)
+                elif v.kind == "STRING":
+                    params[k] = v.value
+                elif v.upper in ("TRUE", "FALSE"):
+                    params[k] = v.upper == "TRUE"
+                else:
+                    params[k] = v.value
+                if not (p.peek().kind == "PUNCT" and p.peek().value == ","):
+                    break
+                p.next()
+        if not p.at_kw("SELECT"):
+            raise FugueSQLSyntaxError("CONNECT must be followed by SELECT")
+        return self._stmt_select(p, sql, sql_engine=engine, sql_engine_params=params)
+
+    def _stmt_select(
+        self,
+        p: _StatementParser,
+        sql: str,
+        sql_engine: Any = None,
+        sql_engine_params: Optional[Dict[str, Any]] = None,
+    ) -> WorkflowDataFrame:
         text = p.text_until(
             "PERSIST", "BROADCAST", "CHECKPOINT", "DETERMINISTIC", "WEAK",
             "STRONG", "YIELD",
@@ -646,9 +694,15 @@ class FugueSQLCompiler:
             text2 = _inject_from(text)
             return self._wf.select(
                 *_interleave(text2, {"_0": prev}),
+                sql_engine=sql_engine,
+                sql_engine_params=sql_engine_params,
             )
         mapping = {n: self._resolve_df(n) for n in names}
-        return self._wf.select(*_interleave(text, mapping))
+        return self._wf.select(
+            *_interleave(text, mapping),
+            sql_engine=sql_engine,
+            sql_engine_params=sql_engine_params,
+        )
 
     def _stmt_take(self, p: _StatementParser) -> WorkflowDataFrame:
         p.expect_kw("TAKE")

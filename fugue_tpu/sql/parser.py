@@ -771,8 +771,37 @@ class SQLParser:
                 order_by.append((name, asc))
                 if not self.eat_punct(","):
                     break
+        frame = None
+        if self.at_kw("ROWS") or self.at_kw("RANGE"):
+            kind = self.next().value.lower()
+            if self.eat_kw("BETWEEN"):
+                start = self._parse_frame_bound()
+                self.expect_kw("AND")
+                end = self._parse_frame_bound()
+            else:
+                start = self._parse_frame_bound()
+                end = "current"
+            frame = (kind, start, end)
         self.expect_punct(")")
-        return _WindowExpr(func, args, partition_by, order_by)
+        return _WindowExpr(func, args, partition_by, order_by, frame=frame)
+
+    def _parse_frame_bound(self) -> Any:
+        if self.eat_kw("UNBOUNDED"):
+            if self.eat_kw("PRECEDING"):
+                return "unb_prec"
+            self.expect_kw("FOLLOWING")
+            return "unb_foll"
+        if self.eat_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return "current"
+        t = self.next()
+        if t.kind != "NUMBER":
+            raise FugueSQLSyntaxError(f"invalid frame bound {t.value!r}")
+        n = int(float(t.value))
+        if self.eat_kw("PRECEDING"):
+            return ("prec", n)
+        self.expect_kw("FOLLOWING")
+        return ("foll", n)
 
     def _make_func(self, name: str, args: List[ColumnExpr], distinct: bool) -> ColumnExpr:
         if name in _AGG_FUNCS:

@@ -724,9 +724,17 @@ class FugueWorkflow:
             input_names=names,
         )
 
-    def select(self, *statements: Any, sql_engine: Any = None, dialect: str = "spark") -> WorkflowDataFrame:
+    def select(
+        self,
+        *statements: Any,
+        sql_engine: Any = None,
+        sql_engine_params: Any = None,
+        dialect: str = "spark",
+    ) -> WorkflowDataFrame:
         """Raw SQL select over workflow frames; pieces may be strings or
-        WorkflowDataFrames (reference ``workflow.py`` raw-sql path)."""
+        WorkflowDataFrames (reference ``workflow.py`` raw-sql path).
+        ``sql_engine`` runs this one select on a specific SQL engine (name,
+        class, or an execution-engine name whose SQL facet is used)."""
         parts: List[Any] = []
         inputs: List[WorkflowDataFrame] = []
         names: List[str] = []
@@ -741,10 +749,14 @@ class FugueWorkflow:
             else:
                 raise FugueWorkflowCompileError(f"invalid select statement piece {s}")
         statement = StructuredRawSQL(parts, dialect=dialect)
+        params: Dict[str, Any] = dict(statement=statement)
+        if sql_engine is not None:
+            params["sql_engine"] = sql_engine
+            params["sql_engine_params"] = dict(sql_engine_params or {})
         return self.add_process_task(
             bp.RunSQLSelect(),
             inputs,
-            params=dict(statement=statement),
+            params=params,
             input_names=names if len(names) > 0 else None,
         )
 

@@ -463,3 +463,89 @@ class TestTokenizerParity:
             assert [(t.kind, t.value, t.pos) for t in py] == [
                 (t.kind, t.value, t.pos) for t in nat
             ], sql
+
+
+class TestWindowFrames:
+    """Explicit ROWS/RANGE frames + the SQL default RANGE-with-peers."""
+
+    def test_default_range_includes_peers(self):
+        # duplicate order keys: peers share the running value (SQL default
+        # frame is RANGE UNBOUNDED PRECEDING .. CURRENT ROW)
+        t = pd.DataFrame({"k": [1, 1, 1], "o": [1, 2, 2], "v": [1.0, 2.0, 4.0]})
+        r = fugue_sql(
+            "SELECT o, SUM(v) OVER (PARTITION BY k ORDER BY o) AS s FROM t"
+        )
+        assert r["s"].tolist() == [1.0, 7.0, 7.0]  # peers at o=2 both see 7
+
+    def test_rows_frame_excludes_peers(self):
+        t = pd.DataFrame({"k": [1, 1, 1], "o": [1, 2, 2], "v": [1.0, 2.0, 4.0]})
+        r = fugue_sql(
+            "SELECT o, SUM(v) OVER (PARTITION BY k ORDER BY o "
+            "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS s FROM t"
+        )
+        assert r["s"].tolist() == [1.0, 3.0, 7.0]
+
+    def test_rows_sliding_window(self):
+        t = pd.DataFrame({"o": [1, 2, 3, 4, 5], "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+        r = fugue_sql(
+            "SELECT o, SUM(v) OVER (ORDER BY o "
+            "ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS s FROM t ORDER BY o"
+        )
+        exp = t["v"].rolling(3, min_periods=1, center=True).sum()
+        assert r["s"].tolist() == exp.tolist()
+        r2 = fugue_sql(
+            "SELECT o, AVG(v) OVER (ORDER BY o ROWS 2 PRECEDING) AS m "
+            "FROM t ORDER BY o"
+        )
+        exp2 = t["v"].rolling(3, min_periods=1).mean()
+        assert r2["m"].tolist() == exp2.tolist()
+
+    def test_range_value_window(self):
+        # RANGE offsets are VALUE distances over the order key, not rows
+        t = pd.DataFrame({"o": [1, 2, 4, 7, 8], "v": [1.0, 1.0, 1.0, 1.0, 1.0]})
+        r = fugue_sql(
+            "SELECT o, COUNT(v) OVER (ORDER BY o "
+            "RANGE BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS n FROM t ORDER BY o"
+        )
+        # windows: o=1→{1,2}, o=2→{1,2}, o=4→{4}, o=7→{7,8}, o=8→{7,8}
+        assert r["n"].tolist() == [2, 2, 1, 2, 2]
+
+    def test_frames_with_nulls_and_min_max(self):
+        t = pd.DataFrame(
+            {"o": [1, 2, 3, 4], "v": [3.0, None, 1.0, 2.0]}
+        )
+        r = fugue_sql(
+            "SELECT o, MIN(v) OVER (ORDER BY o ROWS 1 PRECEDING) AS lo, "
+            "MAX(v) OVER (ORDER BY o ROWS 1 PRECEDING) AS hi FROM t ORDER BY o"
+        )
+        assert r["lo"].tolist() == [3.0, 3.0, 1.0, 1.0]
+        assert r["hi"].tolist() == [3.0, 3.0, 1.0, 2.0]
+
+
+class TestConnectStatement:
+    """FugueSQL CONNECT: one statement runs on a different engine."""
+
+    def test_connect_engine_switch(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            """
+            a = CONNECT jax SELECT k, SUM(v) AS s FROM t GROUP BY k
+            SELECT k, s + 1 AS s1 FROM a ORDER BY k
+            """
+        )
+        assert r["s1"].tolist() == [4.0, 4.0]
+
+    def test_connect_registered_sql_engine(self):
+        t = pd.DataFrame({"a": [3, 1, 2]})
+        r = fugue_sql("CONNECT local SELECT a FROM t ORDER BY a")
+        assert r["a"].tolist() == [1, 2, 3]
+
+    def test_connect_unknown_engine_raises(self):
+        t = pd.DataFrame({"a": [1]})
+        with pytest.raises(Exception):
+            fugue_sql("CONNECT no_such_engine SELECT a FROM t")
+
+    def test_connect_requires_select(self):
+        t = pd.DataFrame({"a": [1]})
+        with pytest.raises(FugueSQLSyntaxError):
+            fugue_sql("CONNECT jax PRINT FROM t")
