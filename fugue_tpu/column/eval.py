@@ -6,7 +6,7 @@ running qpd — ``fugue/execution/execution_engine.py:736-939``). Here the IR
 is evaluated directly on pandas; the TPU engine has a parallel jnp evaluator.
 """
 
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import pandas as pd
@@ -309,10 +309,59 @@ def eval_select(
         cols_order = [c.output_name for c in sc.all_cols]
         res = pd.DataFrame(out_rows, columns=cols_order) if len(out_rows) > 0 else pd.DataFrame(columns=cols_order)
     if having is not None:
-        res = eval_filter(res, having)
+        res = _eval_having_filter(res, sc, having)
     if sc.is_distinct:
         res = res.drop_duplicates().reset_index(drop=True)
     return res
+
+
+def _eval_having_filter(
+    res: pd.DataFrame, sc: SelectColumns, having: ColumnExpr
+) -> pd.DataFrame:
+    """HAVING over the aggregated frame: aggregate subexpressions that
+    structurally match a SELECT aggregate (ignoring alias/cast) read that
+    computed output column; everything else evaluates normally."""
+    from .functions import is_agg
+
+    agg_map: Dict[str, str] = {}
+    for c in sc.all_cols:
+        if is_agg(c):
+            agg_map[c.alias("").cast(None).__uuid__()] = c.output_name
+
+    def ev(e: ColumnExpr) -> Any:
+        if not is_agg(e):
+            return evaluate(res, e)
+        if isinstance(e, _FuncExpr) and e.is_agg:
+            key = e.alias("").cast(None).__uuid__()
+            if key in agg_map:
+                v = res[agg_map[key]]
+                return _cast_series(v, e.as_type) if e.as_type is not None else v
+            raise FugueSQLError(
+                f"HAVING aggregate {e!r} does not appear in the SELECT list"
+            )
+        if isinstance(e, _BinaryOpExpr):
+            l, r = ev(e.left), ev(e.right)
+            ops = {
+                "+": lambda: l + r, "-": lambda: l - r, "*": lambda: l * r,
+                "/": lambda: l / r, "<": lambda: l < r, "<=": lambda: l <= r,
+                ">": lambda: l > r, ">=": lambda: l >= r, "==": lambda: l == r,
+                "!=": lambda: l != r,
+                "&": lambda: _as_bool(l) & _as_bool(r),
+                "|": lambda: _as_bool(l) | _as_bool(r),
+            }
+            return ops[e.op]()
+        if isinstance(e, _UnaryOpExpr):
+            v = ev(e.col)
+            if e.op == "~":
+                return ~_as_bool(v)
+            if e.op == "-":
+                return -v
+        raise NotImplementedError(f"unsupported HAVING expression {e!r}")
+
+    mask = _as_bool(ev(having))
+    if not isinstance(mask, pd.Series):
+        return res if mask else res.head(0)
+    return res[mask].reset_index(drop=True)
 
 
 def _is_na(v: Any) -> bool:

@@ -432,18 +432,12 @@ class ExecutionEngine(FugueEngineBase):
             FugueInvalidOperation("all assignments must have output names"),
         )
         existing = df.schema.names
-        new_cols: List[ColumnExpr] = []
         replaced = {c.output_name: c for c in columns}
         sel: List[ColumnExpr] = []
         for name in existing:
-            if name in replaced:
-                c = replaced.pop(name)
-                if c.as_type is None:
-                    tp = df.schema[name].type
-                    c = c.cast(tp) if not _is_plain_col(c, name) else c
-                sel.append(c)
-            else:
-                sel.append(col(name))
+            # replaced columns take the NEW expression's type (reference
+            # ``:868``: assigning a constant may change the column type)
+            sel.append(replaced.pop(name) if name in replaced else col(name))
         sel.extend(replaced.values())
         return self.select(df, SelectColumns(*sel))
 

@@ -1207,9 +1207,14 @@ class JaxExecutionEngine(ExecutionEngine):
                 if _plan_device_agg(jdf, spec.partition_by, aggs) is not None:
                     res = self.aggregate(jdf, spec, aggs)
                     if having is not None:
-                        # the aggregate result is O(groups): host filter
+                        # the aggregate result is O(groups): host filter;
+                        # aggregate subexpressions read their computed
+                        # output columns (same contract as the oracle)
                         res = self._back(
-                            self._host_engine.filter(self._host(res), having)
+                            self._host_engine.filter(
+                                self._host(res),
+                                _rewrite_having_aggs(having, aggs),
+                            )
                         )
                     # restore declared projection order
                     order = [c.output_name for c in sc.all_cols]
@@ -1384,6 +1389,32 @@ class JaxExecutionEngine(ExecutionEngine):
             out[spec["name"]] = spec["fn"](merged)
         out_schema = plan["schema"]
         return self.to_df(PandasDataFrame(out, out_schema))
+
+
+def _rewrite_having_aggs(having: ColumnExpr, aggs: List[ColumnExpr]) -> ColumnExpr:
+    """Replace aggregate subtrees in HAVING that structurally match a SELECT
+    aggregate (ignoring alias/cast) with a reference to its output column."""
+    from ..column import col as _col
+    from ..column.expressions import _BinaryOpExpr, _FuncExpr, _UnaryOpExpr
+
+    agg_map = {c.alias("").cast(None).__uuid__(): c.output_name for c in aggs}
+
+    def rw(e: ColumnExpr) -> ColumnExpr:
+        if isinstance(e, _FuncExpr) and e.is_agg:
+            key = e.alias("").cast(None).__uuid__()
+            if key in agg_map:
+                out: ColumnExpr = _col(agg_map[key])
+                return out.cast(e.as_type) if e.as_type is not None else out
+            raise FugueInvalidOperation(
+                f"HAVING aggregate {e!r} does not appear in the SELECT list"
+            )
+        if isinstance(e, _BinaryOpExpr):
+            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
+        if isinstance(e, _UnaryOpExpr):
+            return _UnaryOpExpr(e.op, rw(e.col))
+        return e
+
+    return rw(having)
 
 
 def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:

@@ -530,6 +530,256 @@ class BuiltInTests:
             out_transform(pd.DataFrame({"a": [1, 2]}), f, engine=self.engine)
             assert hits == [2]
 
+        # -- parity additions (reference builtin_suite analogs) --------------
+        def test_workflows(self):
+            # multiple DAGs compute independently on one engine
+            a = FugueWorkflow()
+            a.df([[0]], "a:long").yield_dataframe_as("x", as_local=True)
+            b = FugueWorkflow()
+            b.df([[1]], "a:long").yield_dataframe_as("x", as_local=True)
+            ra = a.run(self.engine)
+            rb = b.run(self.engine)
+            assert ra.yields["x"].result.as_array() == [[0]]
+            assert rb.yields["x"].result.as_array() == [[1]]
+
+        def test_datetime_in_workflow(self):
+            import datetime
+
+            # schema: a:date,b:datetime
+            def t1(df: pd.DataFrame) -> pd.DataFrame:
+                df["b"] = "2020-01-02"
+                df["b"] = pd.to_datetime(df["b"])
+                return df
+
+            class T2(Transformer):
+                def get_output_schema(self, df):
+                    return df.schema
+
+                def transform(self, df):
+                    return PandasDataFrame(df.as_pandas())
+
+            dag = FugueWorkflow()
+            a = dag.df([["2020-01-01"]], "a:date").transform(t1)
+            b = dag.df(
+                [[datetime.date(2020, 1, 1), datetime.datetime(2020, 1, 2)]],
+                "a:date,b:datetime",
+            )
+            b.assert_eq(a)
+            c = dag.df(
+                [["2020-01-01", "2020-01-01 00:00:00"]], "a:date,b:datetime"
+            )
+            c.transform(T2).assert_eq(c)
+            c.partition(by=["a"]).transform(T2).assert_eq(c)
+            dag.run(self.engine)
+
+        def test_any_column_name(self):
+            import fugue_tpu.api as fa
+            from fugue_tpu.column import col
+
+            f_parquet = os.path.join(self.tmpdir, "odd.parquet")
+
+            # schema: *,`c *`:long
+            def tr(df: pd.DataFrame) -> pd.DataFrame:
+                return df.assign(**{"c *": 2})
+
+            with fa.engine_context(self.engine):
+                df1 = pd.DataFrame([[0, 1], [2, 3]], columns=["a b", " "])
+                df2 = pd.DataFrame([[0, 10], [20, 3]], columns=["a b", "d"])
+                r = fa.inner_join(df1, df2, as_fugue=True)
+                assert r.as_array() == [[0, 1, 10]]
+                assert str(r.schema) == "`a b`:long,` `:long,d:long"
+                r = fa.transform(r, tr, as_fugue=True)
+                assert r.as_array() == [[0, 1, 10, 2]]
+                r = fa.select(
+                    r,
+                    col("a b").alias("a b "),
+                    col(" ").alias("x y"),
+                    col("d"),
+                    col("c *"),
+                    as_fugue=True,
+                )
+                assert str(r.schema) == "`a b `:long,`x y`:long,d:long,`c *`:long"
+                r = fa.rename(r, {"a b ": "a b"}, as_fugue=True)
+                fa.save(r, f_parquet)
+                back = fa.load(
+                    f_parquet, columns=["x y", "d", "c *"], as_fugue=True
+                )
+                assert back.as_array() == [[1, 10, 2]]
+
+        def test_out_cotransform(self):
+            from fugue_tpu import (
+                CoTransformer,
+                OutputCoTransformer,
+                cotransformer,
+            )
+
+            hits: List[str] = []
+
+            def t1(df: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+                hits.append("t1")
+                return df
+
+            def t2(dfs: DataFrames) -> None:
+                hits.append("t2")
+
+            @cotransformer("a:double,b:long")
+            def t4(df: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+                hits.append("t4")
+                return df
+
+            class T6(CoTransformer):
+                def get_output_schema(self, dfs):
+                    return dfs[0].schema
+
+                def transform(self, dfs):
+                    hits.append("T6")
+                    return dfs[0]
+
+            class T7(OutputCoTransformer):
+                def process(self, dfs):
+                    hits.append("T7")
+
+            def t8(df: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+                hits.append("t8")
+                raise NotImplementedError
+
+            dag = FugueWorkflow()
+            a0 = dag.df([[1.0, 2], [3.0, 4]], "a:double,b:long")
+            a1 = dag.df([[1.0, 2], [3.0, 4]], "aa:double,b:long")
+            a = a0.zip(a1)
+            a.out_transform(t1)
+            a.out_transform(t2)
+            a.out_transform(t4)
+            a.out_transform(T6)
+            a.out_transform(T7)
+            a.out_transform(t8, ignore_errors=[NotImplementedError])
+            dag.run(self.engine)
+            assert len(hits) >= 6
+            for name in ["t1", "t2", "t4", "T6", "T7", "t8"]:
+                assert name in hits
+
+        def test_df_select(self):
+            from fugue_tpu.column import col, functions as ff, lit
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, 10], [2, 20], [3, 30]], "x:long,y:long")
+            a.select("*").assert_eq(a)
+            b = dag.df(
+                [[1, 10, 11, "x"], [2, 20, 22, "x"], [3, 30, 33, "x"]],
+                "x:long,y:long,c:long,d:str",
+            )
+            a.select(
+                "*", (col("x") + col("y")).cast("int64").alias("c"), lit("x", "d")
+            ).assert_eq(b)
+            # distinct
+            c = dag.df([[1, 10], [2, 20], [1, 10]], "x:long,y:long")
+            d = dag.df([[1, 10], [2, 20]], "x:long,y:long")
+            c.select("*", distinct=True).assert_eq(d)
+            # aggregation + where/having
+            e = dag.df([[1, 10], [1, 20], [3, 35], [3, 40]], "x:long,y:long")
+            g = dag.df([[3, 35]], "x:long,z:long")
+            e.select(
+                "x",
+                ff.sum(col("y")).alias("z").cast("int64"),
+                where=col("y") < 40,
+                having=ff.sum(col("y")) > 30,
+            ).assert_eq(g)
+            dag.run(self.engine)
+
+        def test_df_filter(self):
+            from fugue_tpu.column import col
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, 10], [2, 20], [3, 30]], "x:long,y:long")
+            b = dag.df([[2, 20]], "x:long,y:long")
+            a.filter((col("y") > 15) & (col("y") < 25)).assert_eq(b)
+            dag.run(self.engine)
+
+        def test_df_assign(self):
+            from fugue_tpu.column import col, lit
+
+            dag = FugueWorkflow()
+            a = dag.df([[1, 10], [2, 20], [3, 30]], "x:long,y:long")
+            b = dag.df([[1, "x"], [2, "x"], [3, "x"]], "x:long,y:str")
+            a.assign(y="x").assert_eq(b)
+            c = dag.df([[1, 10], [2, 20], [3, 30]], "x:long,y:long")
+            d = dag.df(
+                [[1, "x", 11.0], [2, "x", 21.0], [3, "x", 31.0]],
+                "x:long,y:str,z:double",
+            )
+            c.assign(lit("x").alias("y"), z=(col("y") + 1).cast(float)).assert_eq(d)
+            dag.run(self.engine)
+
+        def test_col_ops(self):
+            dag = FugueWorkflow()
+            a = dag.df([[1, 10, "x"]], "a:long,b:long,c:str")
+            a.rename({"a": "aa"}).assert_eq(
+                dag.df([[1, 10, "x"]], "aa:long,b:long,c:str")
+            )
+            a.drop(["c"]).assert_eq(dag.df([[1, 10]], "a:long,b:long"))
+            a.drop(["c", "nope"], if_exists=True).assert_eq(
+                dag.df([[1, 10]], "a:long,b:long")
+            )
+            a[["b", "c"]].assert_eq(dag.df([[10, "x"]], "b:long,c:str"))
+            a.alter_columns("b:str").assert_eq(
+                dag.df([[1, "10", "x"]], "a:long,b:str,c:str")
+            )
+            dag.run(self.engine)
+
+        def test_extension_registry(self):
+            from fugue_tpu.plugins import (
+                parse_creator,
+                parse_outputter,
+                parse_processor,
+                parse_transformer,
+            )
+
+            @parse_creator.candidate(
+                lambda obj, **kw: isinstance(obj, str) and obj == "_reg_creator"
+            )
+            def _pc(obj: str):
+                def _make() -> pd.DataFrame:
+                    return pd.DataFrame({"a": [7]})
+
+                return _make
+
+            dag = FugueWorkflow()
+            dag.create("_reg_creator", params=dict()).assert_eq(
+                dag.df([[7]], "a:long")
+            )
+            dag.run(self.engine)
+
+        def test_deterministic_checkpoint_complex_dag(self):
+            self.engine.conf["fugue.workflow.checkpoint.path"] = os.path.join(
+                self.tmpdir, "ckx"
+            )
+            calls: List[str] = []
+
+            def src_a() -> pd.DataFrame:
+                calls.append("a")
+                return pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+
+            def src_b() -> pd.DataFrame:
+                calls.append("b")
+                return pd.DataFrame({"k": [1, 2], "w": [10.0, 20.0]})
+
+            def build() -> FugueWorkflow:
+                dag = FugueWorkflow()
+                a = dag.create(src_a).deterministic_checkpoint()
+                b = dag.create(src_b).deterministic_checkpoint()
+                j = a.inner_join(b)
+                j.deterministic_checkpoint().yield_dataframe_as(
+                    "res", as_local=True
+                )
+                return dag
+
+            r1 = build().run(self.engine).yields["res"].result.as_array()
+            n1 = len(calls)
+            r2 = build().run(self.engine).yields["res"].result.as_array()
+            assert sorted(r1) == sorted(r2)
+            # every creator resumed from its checkpoint on the second run
+            assert len(calls) == n1
+
 
 def _string_ref_transformer(df: pd.DataFrame) -> pd.DataFrame:
     return df

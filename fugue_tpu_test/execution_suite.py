@@ -364,6 +364,140 @@ class ExecutionEngineTests:
                 e.save_df(df, path, mode="error")
             e.save_df(df, path, mode="overwrite")
 
+        # -- io matrix (reference execution_suite :1018-1271) ----------------
+        def test_save_single_and_load_parquet(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            # overwrite a folder with a single file
+            b = self.df([[6, 1], [2, 7]], "c:int,a:long")
+            e.save_df(b, path, format_hint="parquet", force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(path, format_hint="parquet", columns=["a", "c"])
+            assert _df_eq(c, [[1, 6], [7, 2]], "a:long,c:int", throw=True)
+            # overwrite the single file again
+            b2 = self.df([[60, 1], [20, 7]], "c:int,a:long")
+            e.save_df(b2, path, format_hint="parquet", mode="overwrite")
+            c = e.load_df(path, format_hint="parquet", columns=["a", "c"])
+            assert _df_eq(c, [[1, 60], [7, 20]], "a:long,c:int", throw=True)
+
+        def test_load_parquet_folder(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            e.save_df(self.df([[6, 1]], "c:int,a:long"), os.path.join(path, "a.parquet"))
+            e.save_df(
+                self.df([[2, 7], [4, 8]], "c:int,a:long"),
+                os.path.join(path, "b.parquet"),
+            )
+            open(os.path.join(path, "_SUCCESS"), "w").close()
+            c = e.load_df(path, format_hint="parquet", columns=["a", "c"])
+            assert _df_eq(
+                c, [[1, 6], [7, 2], [8, 4]], "a:long,c:int", throw=True
+            )
+
+        def test_load_parquet_files(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            f1, f2 = os.path.join(path, "a.parquet"), os.path.join(path, "b.parquet")
+            e.save_df(self.df([[6, 1]], "c:int,a:long"), f1)
+            e.save_df(self.df([[2, 7], [4, 8]], "c:int,a:long"), f2)
+            c = e.load_df([f1, f2], format_hint="parquet", columns=["a", "c"])
+            assert _df_eq(
+                c, [[1, 6], [7, 2], [8, 4]], "a:long,c:int", throw=True
+            )
+
+        def test_save_single_and_load_csv(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            b = self.df([[6.1, 1.1], [2.1, 7.1]], "c:double,a:double")
+            e.save_df(b, path, format_hint="csv", header=True, force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(
+                path,
+                format_hint="csv",
+                header=True,
+                infer_schema=True,
+                columns=["a", "c"],
+            )
+            assert _df_eq(
+                c, [[1.1, 6.1], [7.1, 2.1]], "a:double,c:double", throw=True
+            )
+
+        def test_save_single_and_load_csv_no_header(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "nh.csv")
+            b = self.df([[6.1, 1.1], [2.1, 7.1]], "c:double,a:double")
+            e.save_df(b, path, format_hint="csv", header=False)
+            c = e.load_df(
+                path, format_hint="csv", header=False, columns="c:double,a:double"
+            )
+            assert _df_eq(
+                c, [[6.1, 1.1], [2.1, 7.1]], "c:double,a:double", throw=True
+            )
+
+        def test_load_csv_folder(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            e.save_df(
+                self.df([[6.1, 1.1]], "c:double,a:double"),
+                os.path.join(path, "a.csv"),
+                format_hint="csv",
+                header=True,
+            )
+            e.save_df(
+                self.df([[2.1, 7.1], [4.1, 8.1]], "c:double,a:double"),
+                os.path.join(path, "b.csv"),
+                format_hint="csv",
+                header=True,
+            )
+            open(os.path.join(path, "_SUCCESS"), "w").close()
+            c = e.load_df(
+                path,
+                format_hint="csv",
+                header=True,
+                infer_schema=True,
+                columns=["a", "c"],
+            )
+            assert _df_eq(
+                c,
+                [[1.1, 6.1], [7.1, 2.1], [8.1, 4.1]],
+                "a:double,c:double",
+                throw=True,
+            )
+
+        def test_save_single_and_load_json(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            b = self.df([[6, 1], [2, 7]], "c:long,a:long")
+            e.save_df(b, path, format_hint="json", force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(path, format_hint="json", columns=["a", "c"])
+            assert _df_eq(c, [[1, 6], [7, 2]], "a:long,c:long", throw=True)
+
+        def test_load_json_folder(self):
+            e = self.engine
+            path = os.path.join(self.tmpdir, "a", "b")
+            os.makedirs(path, exist_ok=True)
+            e.save_df(
+                self.df([[6, 1], [3, 4]], "c:long,a:long"),
+                os.path.join(path, "a.json"),
+                format_hint="json",
+            )
+            e.save_df(
+                self.df([[2, 7], [4, 8]], "c:long,a:long"),
+                os.path.join(path, "b.json"),
+                format_hint="json",
+            )
+            open(os.path.join(path, "_SUCCESS"), "w").close()
+            c = e.load_df(path, format_hint="json", columns=["a", "c"])
+            assert _df_eq(
+                c, [[1, 6], [7, 2], [4, 3], [8, 4]], "a:long,c:long", throw=True
+            )
+
         # -- persist/broadcast/repartition ----------------------------------
         def test_persist_broadcast(self):
             e = self.engine
