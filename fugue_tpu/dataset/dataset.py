@@ -74,6 +74,11 @@ class Dataset(ABC):
     ) -> None:
         get_dataset_display(self).show(n=n, with_count=with_count, title=title)
 
+    def _repr_html_(self) -> str:
+        """Rich rendering hook (notebooks) via the display plugin chain
+        (reference ``fugue/dataset/dataset.py`` repr_html)."""
+        return get_dataset_display(self).repr_html()
+
     def __uuid__(self) -> str:
         # intentionally object-identity based: a raw in-memory dataset is NOT
         # cross-run deterministic, so workflow nodes rooted on one never

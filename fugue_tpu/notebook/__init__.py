@@ -1,1 +1,18 @@
-from .env import NotebookSetup, setup
+from .env import NotebookSetup, _load_ipython_extension, setup
+
+
+def _jupyter_nbextension_paths():  # pragma: no cover - jupyter hook
+    """Classic-notebook extension registration (reference
+    ``fugue_notebook/__init__.py``)."""
+    return [
+        dict(
+            section="notebook",
+            src="nbextension",
+            dest="fugue_tpu",
+            require="fugue_tpu/main",
+        )
+    ]
+
+
+def load_ipython_extension(ip):  # pragma: no cover - ipython hook
+    _load_ipython_extension(ip)

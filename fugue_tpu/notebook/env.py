@@ -1,11 +1,14 @@
-"""Notebook integration: the ``%%fsql`` cell magic.
+"""Notebook integration: the ``%%fsql`` cell magic + HTML display chain.
 
-Parity with the reference (`fugue_notebook/env.py:53-66`): running a
+Parity with the reference (`fugue_notebook/env.py:53-130`): running a
 ``%%fsql [engine]`` cell compiles+runs FugueSQL and injects yielded
-dataframes into the notebook namespace. Gated on IPython availability.
+dataframes into the notebook namespace; inside IPython, ``df.show()`` and
+the rich-repr hook render DataFrames as HTML tables with the schema
+footer. Gated on IPython availability.
 """
 
-from typing import Any, Optional
+import html as _html
+from typing import Any, List, Optional
 
 
 def _setup_magic() -> bool:
@@ -39,12 +42,105 @@ def _setup_magic() -> bool:
     return True
 
 
+def _setup_display() -> bool:
+    """Register the Jupyter HTML renderer on the display plugin chain
+    (reference ``fugue_notebook/env.py:91-126``)."""
+    try:
+        from IPython import get_ipython
+        from IPython.display import HTML, display
+    except ImportError:
+        return False
+    if get_ipython() is None:
+        return False
+
+    from ..dataframe import DataFrame
+    from ..dataframe.dataframe import DataFrameDisplay
+    from ..dataset.dataset import Dataset, get_dataset_display
+
+    class JupyterDataFrameDisplay(DataFrameDisplay):
+        def show(
+            self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+        ) -> None:
+            components: List[Any] = []
+            if title is not None:
+                components.append(HTML(f"<h3>{_html.escape(title)}</h3>"))
+            components.append(HTML(self._df_html(n)))
+            if with_count:
+                components.append(
+                    HTML(f"<strong>total count: {self.df.count()}</strong>")
+                )
+            display(*components)
+
+        def repr_html(self) -> str:
+            return self._df_html(10)
+
+        def _df_html(self, n: int) -> str:
+            pdf = self.df.head(n).as_pandas()
+            body = pdf._repr_html_()
+            schema = type(self.df).__name__ + ": " + str(self.df.schema)
+            return body + '\n<font size="-1">' + _html.escape(schema) + "</font>"
+
+    @get_dataset_display.candidate(
+        lambda ds: get_ipython() is not None and isinstance(ds, DataFrame),
+        priority=3.0,
+    )
+    def _jupyter_display(ds: Dataset) -> DataFrameDisplay:
+        return JupyterDataFrameDisplay(ds)
+
+    return True
+
+
+_HIGHLIGHT_JS = r"""
+require(["codemirror/lib/codemirror"], function (CodeMirror) {
+  CodeMirror.defineMode("fsql", function (config) {
+    return CodeMirror.getMode(config, "text/x-sql");
+  });
+  CodeMirror.modeInfo.push({name: "Fugue SQL", mime: "text/x-fsql", mode: "fsql"});
+  var magic = /^%%fsql/;
+  function hl(cell) {
+    if (cell.get_text !== undefined && magic.test(cell.get_text())) {
+      cell.code_mirror.setOption("mode", "fsql");
+    }
+  }
+  if (window.Jupyter !== undefined) {
+    Jupyter.notebook.get_cells().forEach(hl);
+    Jupyter.notebook.events.on("create.Cell", function (_, d) { hl(d.cell); });
+  }
+});
+"""
+
+
+def _load_ipython_extension(ip: Any) -> None:
+    """``%load_ext fugue_tpu.notebook`` entrypoint-compatible hook."""
+    _setup_magic()
+    _setup_display()
+
+
 class NotebookSetup:
-    """Call ``setup()`` in a notebook to enable ``%%fsql``."""
+    """Call ``setup()`` in a notebook to enable ``%%fsql`` + HTML display."""
 
     def setup(self) -> bool:
-        return _setup_magic()
+        ok = _setup_magic()
+        _setup_display()
+        return ok
+
+    def register_execution_engines(self) -> None:  # reference-parity hook
+        pass
+
+    @property
+    def highlight_js(self) -> str:
+        """The codemirror highlight snippet the nbextension injects
+        (reference ``fugue_notebook/nbextension/main.js``)."""
+        return _HIGHLIGHT_JS
 
 
-def setup(**kwargs: Any) -> bool:
-    return NotebookSetup().setup()
+def setup(run_js: bool = False, **kwargs: Any) -> bool:
+    res = NotebookSetup().setup()
+    if res and run_js:
+        try:
+            from IPython.display import Javascript, display
+
+            display(Javascript(_HIGHLIGHT_JS))
+        except ImportError:  # pragma: no cover
+            pass
+    return res

@@ -1,0 +1,29 @@
+// fugue_tpu nbextension: register %%fsql cells as SQL-highlighted
+// (parity with the reference's fugue_notebook/nbextension/main.js)
+define(["codemirror/lib/codemirror", "base/js/namespace"], function (
+  CodeMirror,
+  Jupyter
+) {
+  "use strict";
+  function load() {
+    CodeMirror.defineMode("fsql", function (config) {
+      return CodeMirror.getMode(config, "text/x-sql");
+    });
+    CodeMirror.modeInfo.push({
+      name: "Fugue SQL",
+      mime: "text/x-fsql",
+      mode: "fsql",
+    });
+    var magic = /^%%fsql/;
+    function hl(cell) {
+      if (cell.get_text !== undefined && magic.test(cell.get_text())) {
+        cell.code_mirror.setOption("mode", "fsql");
+      }
+    }
+    Jupyter.notebook.get_cells().forEach(hl);
+    Jupyter.notebook.events.on("create.Cell", function (_, d) {
+      hl(d.cell);
+    });
+  }
+  return { load_ipython_extension: load };
+});

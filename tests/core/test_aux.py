@@ -226,3 +226,39 @@ class TestAutoPersist:
         # the shared node got a weak checkpoint applied
         assert isinstance(a._task.checkpoint, WeakCheckpoint)
         assert len(calls) == 1
+
+
+class TestNotebookIntegration:
+    """%%fsql magic + the Jupyter HTML display chain.
+
+    Runs in a subprocess: starting IPython in-process would permanently
+    register the Jupyter display candidate and change how every later
+    test's .show() renders.
+    """
+
+    def test_magic_display_and_highlight(self):
+        import subprocess
+        import sys
+
+        pytest.importorskip("IPython")
+        code = """
+from IPython.testing.globalipapp import start_ipython
+ip = start_ipython()
+import fugue_tpu.notebook as nb
+assert nb.setup()
+import pandas as pd
+ip.user_ns["src"] = pd.DataFrame({"a": [1, 2, 3]})
+cell = chr(10).join(["SELECT a FROM src WHERE a > 1", "YIELD DATAFRAME AS res"])
+ip.run_cell_magic("fsql", "", cell)
+assert ip.user_ns["res"].result.as_array() == [[2], [3]]
+from fugue_tpu.dataframe import ArrayDataFrame
+h = ArrayDataFrame([[1, "x"]], "a:long,b:str")._repr_html_()
+assert "<" in h and "a:long,b:str" in h
+from fugue_tpu.notebook import NotebookSetup
+assert "fsql" in NotebookSetup().highlight_js
+print("NB_OK")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=240
+        )
+        assert proc.returncode == 0 and b"NB_OK" in proc.stdout, proc.stderr
