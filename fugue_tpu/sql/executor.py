@@ -11,7 +11,26 @@ from typing import Any, Dict, List, Optional
 import pandas as pd
 
 from ..column import SelectColumns, col as _col
-from ..column.expressions import _LitColumnExpr, _NamedColumnExpr, _WindowExpr
+from ..column.expressions import (
+    ColumnExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _WindowExpr,
+)
+
+
+def _referenced_names(expr: "ColumnExpr") -> List[str]:
+    """All column names referenced anywhere in the expression tree."""
+    names: List[str] = []
+
+    def walk(e: "ColumnExpr") -> None:
+        if isinstance(e, _NamedColumnExpr):
+            names.append(e.name)
+        for c in e.children:
+            walk(c)
+
+    walk(expr)
+    return names
 from ..column.functions import is_agg
 from ..dataframe import ArrayDataFrame, DataFrame, PandasDataFrame
 from ..exceptions import FugueSQLRuntimeError, FugueSQLSyntaxError
@@ -55,7 +74,19 @@ class SQLExecutor:
         if isinstance(node, JoinNode):
             left = self._exec(node.left)
             right = self._exec(node.right)
-            return e.join(left, right, how=node.how, on=node.on or None)
+            if node.condition is None:
+                return e.join(left, right, how=node.how, on=node.on or None)
+            # non-equi ON: equi-join (or cross product when no equi keys)
+            # then filter the residual predicate over the joined output
+            if node.how not in ("inner", "cross"):
+                raise NotImplementedError(
+                    "non-equi join conditions are supported for INNER joins only"
+                )
+            if len(node.on) > 0:
+                res = e.join(left, right, how="inner", on=node.on)
+            else:
+                res = e.join(left, right, how="cross")
+            return e.filter(res, node.condition)
         if isinstance(node, SetOpNode):
             left = self._exec(node.left)
             right = self._exec(node.right)
@@ -154,31 +185,106 @@ class SQLExecutor:
             *[c.infer_alias() for c in node.projections], arg_distinct=node.distinct
         )
         if len(node.group_by) > 0:
-            # validate GROUP BY matches the non-agg projections (the implicit
-            # grouping the IR derives); anything fancier isn't supported yet
-            gb_names = set()
+            gb_names: List[str] = []
             for g in node.group_by:
                 if not isinstance(g, _NamedColumnExpr):
                     raise NotImplementedError(
                         "GROUP BY supports plain column references only"
                     )
-                gb_names.add(g.name)
-            proj_keys = {
-                c.output_name
-                for c in cols.replace_wildcard(child.schema).all_cols
-                if not is_agg(c)
-            }
+                gb_names.append(g.name)
+            expanded = cols.replace_wildcard(child.schema).all_cols
             keys_in_proj_source = {
                 c.name
-                for c in cols.replace_wildcard(child.schema).all_cols
+                for c in expanded
                 if isinstance(c, _NamedColumnExpr) and not is_agg(c)
             }
-            if not (gb_names == proj_keys or gb_names == keys_in_proj_source):
-                raise NotImplementedError(
-                    f"GROUP BY {sorted(gb_names)} must match the non-aggregate "
-                    f"select columns {sorted(proj_keys)}"
-                )
+            proj_keys = {c.output_name for c in expanded if not is_agg(c)}
+            if not (
+                set(gb_names) == proj_keys
+                or set(gb_names) == keys_in_proj_source
+            ):
+                # GROUP BY decoupled from the projection: aggregate by the
+                # GROUP BY keys, then project/filter over the O(groups) result
+                return self._exec_decoupled_groupby(node, child, gb_names)
         return e.select(child, cols, where=node.where, having=node.having)
+
+    def _exec_decoupled_groupby(
+        self, node: SelectNode, child: DataFrame, gb_names: List[str]
+    ) -> DataFrame:
+        """``SELECT <exprs over keys + aggs> ... GROUP BY k1,...`` where the
+        key set differs from the plain projection columns (keys may be
+        dropped, transformed, or a superset). Two phases: an engine
+        aggregate by the GROUP BY keys, then a host-side projection over
+        the aggregated frame with aggregate subtrees reading their
+        computed columns."""
+        from ..collections.partition import PartitionSpec
+        from ..column.expressions import (
+            _BinaryOpExpr,
+            _FuncExpr,
+            _UnaryOpExpr,
+        )
+
+        e = self._engine
+        if node.where is not None:
+            child = e.filter(child, node.where)
+        agg_map: Dict[str, str] = {}
+        agg_list: List[ColumnExpr] = []
+
+        def extract(expr: ColumnExpr) -> ColumnExpr:
+            if isinstance(expr, _FuncExpr) and expr.is_agg:
+                bare = expr.alias("").cast(None)
+                key = bare.__uuid__()
+                if key not in agg_map:
+                    name = f"__agg_{len(agg_map)}__"
+                    agg_map[key] = name
+                    agg_list.append(bare.alias(name))
+                ref: ColumnExpr = _col(agg_map[key])
+                if expr.as_type is not None:
+                    ref = ref.cast(expr.as_type)
+                if expr.as_name != "":
+                    ref = ref.alias(expr.as_name)
+                return ref
+            if isinstance(expr, _BinaryOpExpr):
+                res: ColumnExpr = _BinaryOpExpr(
+                    expr.op, extract(expr.left), extract(expr.right)
+                )
+            elif isinstance(expr, _UnaryOpExpr):
+                res = _UnaryOpExpr(expr.op, extract(expr.col))
+            elif isinstance(expr, _FuncExpr) and not expr.is_agg:
+                res = _FuncExpr(
+                    expr.func,
+                    *[extract(a) for a in expr.args],
+                    arg_distinct=expr.is_distinct,
+                )
+            else:
+                names = _referenced_names(expr)
+                bad = [n for n in names if n not in gb_names]
+                if len(bad) > 0:
+                    raise FugueSQLSyntaxError(
+                        f"column(s) {bad} must appear in GROUP BY or inside "
+                        "an aggregate function"
+                    )
+                return expr
+            if expr.as_name != "":
+                res = res.alias(expr.as_name)
+            if expr.as_type is not None:
+                res = res.cast(expr.as_type)
+            return res
+
+        finals = [extract(c.infer_alias()) for c in node.projections]
+        having = extract(node.having) if node.having is not None else None
+        if len(agg_list) > 0:
+            grouped = e.aggregate(child, PartitionSpec(by=gb_names), agg_list)
+        else:  # pure grouping (key superset, no aggregates) = distinct keys
+            grouped = e.select(
+                child,
+                SelectColumns(*[_col(k) for k in gb_names], arg_distinct=True),
+            )
+        if having is not None:
+            grouped = e.filter(grouped, having)
+        return e.select(
+            grouped, SelectColumns(*finals, arg_distinct=node.distinct)
+        )
 
     def _exec_windowed_select(self, node: SelectNode, child: DataFrame) -> DataFrame:
         """SQL evaluation order: WHERE → window → projection → DISTINCT."""

@@ -207,6 +207,8 @@ class JoinNode(PlanNode):
     right: PlanNode
     how: str
     on: List[str] = field(default_factory=list)
+    # residual (non-equi) ON predicate, applied over the joined output
+    condition: Optional[ColumnExpr] = None
 
 
 @dataclass
@@ -371,8 +373,9 @@ class SQLParser:
                     break
                 right = self._parse_source()
                 on: List[str] = []
+                residual: Optional[ColumnExpr] = None
                 if self.eat_kw("ON"):
-                    on = self._parse_on_keys()
+                    on, residual = self._parse_on_condition()
                 elif self.eat_kw("USING"):
                     self.expect_punct("(")
                     while True:
@@ -380,7 +383,7 @@ class SQLParser:
                         if not self.eat_punct(","):
                             break
                     self.expect_punct(")")
-                child = JoinNode(child, right, how, on)
+                child = JoinNode(child, right, how, on, residual)
         where = None
         if self.eat_kw("WHERE"):
             where = self._parse_expr()
@@ -455,25 +458,37 @@ class SQLParser:
         t = self.peek()
         return t.kind == "IDENT" and t.upper in _KEYWORD_STOP
 
-    def _parse_on_keys(self) -> List[str]:
+    def _parse_on_condition(self) -> Any:
+        """Parse a general ON predicate and split it into equi-join keys
+        (``a.k = b.k`` on a shared name) and a residual (non-equi)
+        condition evaluated over the joined output."""
+        from ..column.expressions import _BinaryOpExpr, _NamedColumnExpr
+
+        cond = self._parse_expr()
+        conjuncts: List[ColumnExpr] = []
+
+        def split(e: ColumnExpr) -> None:
+            if isinstance(e, _BinaryOpExpr) and e.op == "&":
+                split(e.left)
+                split(e.right)
+            else:
+                conjuncts.append(e)
+
+        split(cond)
         keys: List[str] = []
-        while True:
-            l = self._parse_qualified_name()
-            t = self.next()
-            if not (t.kind == "OP" and t.value in ("=", "==")):
-                raise FugueSQLSyntaxError(
-                    f"only equi-join conditions are supported, got {t.value!r}"
-                )
-            r = self._parse_qualified_name()
-            if l != r:
-                raise FugueSQLSyntaxError(
-                    f"join keys must share a column name ({l} vs {r}); "
-                    "rename columns before joining (fugue convention)"
-                )
-            keys.append(l)
-            if not self.eat_kw("AND"):
-                break
-        return keys
+        residual: Optional[ColumnExpr] = None
+        for c in conjuncts:
+            if (
+                isinstance(c, _BinaryOpExpr)
+                and c.op == "=="
+                and isinstance(c.left, _NamedColumnExpr)
+                and isinstance(c.right, _NamedColumnExpr)
+                and c.left.name == c.right.name  # qualifiers already stripped
+            ):
+                keys.append(c.left.name)
+            else:
+                residual = c if residual is None else (residual & c)
+        return keys, residual
 
     def _parse_name(self) -> str:
         t = self.next()

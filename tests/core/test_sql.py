@@ -549,3 +549,79 @@ class TestConnectStatement:
         t = pd.DataFrame({"a": [1]})
         with pytest.raises(FugueSQLSyntaxError):
             fugue_sql("CONNECT jax PRINT FROM t")
+
+
+class TestGroupByDecoupled:
+    """GROUP BY no longer has to match the projection."""
+
+    def test_groupby_key_not_projected(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql("SELECT SUM(v) AS s FROM t GROUP BY k ORDER BY s")
+        assert r["s"].tolist() == [3.0, 3.0]
+
+    def test_groupby_transformed_key(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT k + 100 AS kk, SUM(v) AS s FROM t GROUP BY k ORDER BY kk"
+        )
+        assert r["kk"].tolist() == [101, 102]
+        assert r["s"].tolist() == [3.0, 3.0]
+
+    def test_groupby_superset_of_projection(self):
+        t = pd.DataFrame(
+            {"k": [1, 1, 2], "k2": [1, 2, 3], "v": [1.0, 2.0, 3.0]}
+        )
+        r = fugue_sql("SELECT k, SUM(v) AS s FROM t GROUP BY k, k2 ORDER BY s")
+        assert r["k"].tolist() == [1, 1, 2]
+        assert r["s"].tolist() == [1.0, 2.0, 3.0]
+
+    def test_groupby_no_aggs_pure_grouping(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "k2": [5, 5, 6]})
+        r = fugue_sql("SELECT k FROM t GROUP BY k, k2 ORDER BY k")
+        assert r["k"].tolist() == [1, 2]
+
+    def test_expression_over_aggregates(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT SUM(v) / COUNT(v) AS m FROM t GROUP BY k ORDER BY m"
+        )
+        assert r["m"].tolist() == [1.5, 3.0]
+
+    def test_having_with_decoupled_groupby(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT SUM(v) AS s FROM t GROUP BY k HAVING COUNT(v) > 1"
+        )
+        assert r["s"].tolist() == [3.0]
+
+    def test_ungrouped_column_raises(self):
+        t = pd.DataFrame({"k": [1], "v": [1.0]})
+        with pytest.raises(Exception, match="GROUP BY"):
+            fugue_sql("SELECT v, SUM(v) AS s FROM t GROUP BY k")
+
+
+class TestNonEquiJoins:
+    def test_theta_join_inner(self):
+        lo = pd.DataFrame({"a": [1, 5, 9]})
+        hi = pd.DataFrame({"b": [4, 6]})
+        r = fugue_sql(
+            "SELECT a, b FROM lo JOIN hi ON lo.a < hi.b ORDER BY a, b"
+        )
+        assert r.values.tolist() == [[1, 4], [1, 6], [5, 6]]
+
+    def test_equi_plus_residual(self):
+        t1 = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 5.0, 2.0]})
+        t2 = pd.DataFrame({"k": [1, 2], "w": [3.0, 1.0]})
+        r = fugue_sql(
+            "SELECT k, v, w FROM t1 INNER JOIN t2 ON t1.k = t2.k AND v > w "
+            "ORDER BY k, v"
+        )
+        assert r.values.tolist() == [[1, 5.0, 3.0], [2, 2.0, 1.0]]
+
+    def test_non_equi_outer_raises(self):
+        t1 = pd.DataFrame({"k": [1], "v": [1.0]})
+        t2 = pd.DataFrame({"k": [1], "w": [2.0]})
+        with pytest.raises(Exception):
+            fugue_sql(
+                "SELECT * FROM t1 LEFT JOIN t2 ON t1.k = t2.k AND v > w"
+            )
