@@ -1059,8 +1059,17 @@ class JaxExecutionEngine(ExecutionEngine):
         )
 
     def take(self, df, n, presort, na_position="last", partition_spec=None) -> DataFrame:
-        """Global top-n by a single device column runs on device: per-shard
-        ``lax.top_k`` then an O(shards·n) host merge."""
+        """Global top-n by any number of device sort keys: per-shard
+        lexicographic ``lax.sort`` takes each shard's first k rows, then an
+        O(shards·n) host merge picks the global n.
+
+        Exact in all cases the gate admits: each shard contributes its
+        lexicographically-first min(k, valid) rows, so the merged pool is
+        always a superset of the true global top-n — multi-key presorts,
+        full-range int64 keys (no float64 scoring) and NaN tails included
+        (XLA sorts NaN after all numbers, matching ``na_position="last"``;
+        DESC negates floats / bit-inverts ints, both NaN/order preserving).
+        """
         from ..collections.partition import parse_presort_exp
 
         jdf = self.to_df(df)
@@ -1070,12 +1079,12 @@ class JaxExecutionEngine(ExecutionEngine):
         no_keys = partition_spec is None or len(partition_spec.partition_by) == 0
         if (
             no_keys
-            and len(sorts) == 1
+            and len(sorts) > 0
             and na_position == "last"
             and isinstance(jdf, JaxDataFrame)
             and jdf.host_table is None
             and not jdf.has_encoded  # code/epoch order ≠ value order semantics
-            and list(sorts.keys())[0] in jdf.device_cols
+            and all(c in jdf.device_cols for c in sorts)
             and n <= 4096
         ):
             import jax
@@ -1083,37 +1092,43 @@ class JaxExecutionEngine(ExecutionEngine):
             import numpy as np_
             from jax.sharding import PartitionSpec as JP
 
-            sort_col, asc = next(iter(sorts.items()))
-            k = min(n, next(iter(jdf.device_cols.values())).shape[0] // num_row_shards(self._mesh))
-            # the kernel scores in float64: int keys beyond 2^53 would
-            # collapse — verify the range with the cached min/max probe
-            fits_float = True
-            import jax.numpy as _jnp
-
-            if _jnp.issubdtype(jdf.device_cols[sort_col].dtype, _jnp.integer):
-                from ..ops.segment import _get_compiled_minmax
-
-                lo_a, hi_a = _get_compiled_minmax(self._mesh)(
-                    jdf.device_cols[sort_col], jdf.device_valid_mask()
-                )
-                import jax as _jax
-
-                lo, hi = int(_jax.device_get(lo_a)[0]), int(_jax.device_get(hi_a)[0])
-                fits_float = max(abs(lo), abs(hi)) < (1 << 53)
-            if k > 0 and fits_float:
+            sort_items = list(sorts.items())
+            k = min(
+                n,
+                next(iter(jdf.device_cols.values())).shape[0]
+                // num_row_shards(self._mesh),
+            )
+            if k > 0:
                 mesh = jdf.mesh  # bind locally: the closure must not pin jdf
-                cache_key = ("take", sort_col, asc, k, mesh, tuple(jdf.schema.names))
+                cache_key = (
+                    "take",
+                    tuple(sort_items),
+                    k,
+                    mesh,
+                    tuple(jdf.schema.names),
+                )
                 if cache_key not in self._jit_cache:
 
                     def compute(cols: Dict[str, Any], valid: Any):
                         def shard_fn(c: Dict[str, Any], v: Any):
-                            s = c[sort_col].astype(jnp.float64)
-                            # NaN sorts last (SQL default): exclude from top_k
-                            ok = v & ~jnp.isnan(s)
-                            score = jnp.where(ok, s if not asc else -s, -jnp.inf)
-                            _, idx = jax.lax.top_k(score, k)
-                            out = {name: arr[idx] for name, arr in c.items()}
-                            out["__take_valid__"] = v[idx] & ok[idx]
+                            ops: List[Any] = [jnp.logical_not(v)]  # valid first
+                            for name, asc in sort_items:
+                                key = c[name]
+                                if not asc:
+                                    if jnp.issubdtype(key.dtype, jnp.floating):
+                                        key = -key  # NaN stays NaN → still last
+                                    elif key.dtype == jnp.bool_:
+                                        key = jnp.logical_not(key)
+                                    else:
+                                        key = ~key  # monotone reversal, no overflow
+                                ops.append(key)
+                            iota = jax.lax.iota(jnp.int32, v.shape[0])
+                            sorted_ops = jax.lax.sort(
+                                tuple(ops) + (iota,), num_keys=len(ops)
+                            )
+                            perm = sorted_ops[-1][:k]
+                            out = {name: arr[perm] for name, arr in c.items()}
+                            out["__take_valid__"] = v[perm]
                             return out
 
                         return jax.shard_map(
@@ -1133,15 +1148,16 @@ class JaxExecutionEngine(ExecutionEngine):
                 }
                 valid = host.pop("__take_valid__")
                 pdf = pd.DataFrame({k2: v2[valid] for k2, v2 in host.items()})
-                pdf = pdf.sort_values(sort_col, ascending=asc).head(n)
-                # NaN rows were excluded from top_k; if they are needed to
-                # fill the result, fall back to the host for exactness
-                if len(pdf) >= n or len(pdf) >= jdf.count():
-                    return self.to_df(
-                        PandasDataFrame(
-                            pdf[jdf.schema.names].reset_index(drop=True), jdf.schema
-                        )
+                pdf = pdf.sort_values(
+                    [c for c, _ in sort_items],
+                    ascending=[a for _, a in sort_items],
+                    na_position="last",
+                ).head(n)
+                return self.to_df(
+                    PandasDataFrame(
+                        pdf[jdf.schema.names].reset_index(drop=True), jdf.schema
                     )
+                )
         return self._back(
             self._host_engine.take(
                 self._host(df), n, presort, na_position=na_position, partition_spec=partition_spec

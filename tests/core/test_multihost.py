@@ -1,0 +1,85 @@
+"""Multi-host runtime: a REAL two-process jax.distributed run on CPU.
+
+Two worker processes coordinate through jax's distributed service, build
+one mesh spanning both processes' devices, and run a cross-host psum —
+the same initialization path a TPU pod uses (SURVEY §5.8).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+sys.path.insert(0, {repo!r})
+from fugue_tpu.parallel.distributed import (
+    initialize_distributed, is_multihost, process_info,
+)
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=pid
+)
+# idempotency: a second call must be a no-op, not an error
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=pid
+)
+info = process_info()
+assert info["process_count"] == 2, info
+assert info["global_device_count"] == 4, info
+assert info["local_device_count"] == 2, info
+assert is_multihost()
+import numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from fugue_tpu.parallel.mesh import ROW_AXIS, build_mesh
+mesh = build_mesh()  # spans BOTH processes' devices
+assert mesh.shape[ROW_AXIS] == 4
+local = np.arange(pid * 8, (pid + 1) * 8, dtype=np.float64)
+x = jax.make_array_from_process_local_data(NamedSharding(mesh, P(ROW_AXIS)), local)
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == float(sum(range(16))), float(total)
+print("MH_OK", pid, flush=True)
+"""
+
+
+def test_two_process_distributed_mesh(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    worker = os.path.join(str(tmp_path), "worker.py")
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    with open(worker, "w") as f:
+        f.write(_WORKER.format(repo=repo))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0 and f"MH_OK {i}".encode() in out, err.decode()[-2000:]

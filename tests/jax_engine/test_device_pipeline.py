@@ -209,3 +209,48 @@ class TestDeviceSampleTake:
         flt = engine.filter(engine.to_df(pdf), col("v") > 0.5)
         s = engine.sample(flt, frac=0.5, seed=3)
         assert s.count() <= flt.count()
+
+
+class TestDeviceTake:
+    """Sort-based device take: multi-key, int64 full range, NaN tails."""
+
+    @pytest.fixture(scope="class")
+    def eng(self):
+        from fugue_tpu.jax import JaxExecutionEngine
+
+        e = JaxExecutionEngine()
+        yield e
+        e.stop()
+
+    def test_multi_key_presort(self, eng):
+        pdf = pd.DataFrame(
+            {"a": [1, 1, 2, 2, 1], "b": [9.0, 1.0, 5.0, 0.5, 3.0]}
+        )
+        res = eng.take(eng.to_df(pdf), 3, presort="a,b desc")
+        assert res.as_array() == [[1, 9.0], [1, 3.0], [1, 1.0]]
+
+    def test_large_int64_keys(self, eng):
+        big = 1 << 60
+        pdf = pd.DataFrame({"a": [big + 3, big + 1, big + 2, -big]})
+        res = eng.take(eng.to_df(pdf), 2, presort="a desc")
+        assert res.as_array() == [[big + 3], [big + 2]]
+
+    def test_nan_fills_tail(self, eng):
+        import pyarrow as pa
+
+        # NaN as device value (arrow keeps it): top-3 of 2 numbers + NaNs
+        tbl = pa.table(
+            {"a": pa.array([2.0, float("nan"), 1.0, float("nan")], pa.float64())}
+        )
+        res = eng.take(eng.to_df(tbl), 3, presort="a")
+        vals = [r[0] for r in res.as_array()]
+        assert vals[0] == 1.0 and vals[1] == 2.0
+        assert len(vals) == 3 and (vals[2] is None or vals[2] != vals[2])
+
+    def test_take_after_filter_skewed_mask(self, eng):
+        from fugue_tpu.column import col
+
+        pdf = pd.DataFrame({"a": np.arange(1000, dtype=np.int64)})
+        f = eng.filter(eng.to_df(pdf), col("a") < 10)  # only low shards valid
+        res = eng.take(f, 8, presort="a desc")
+        assert [r[0] for r in res.as_array()] == list(range(9, 1, -1))
