@@ -495,6 +495,102 @@ class JaxExecutionEngine(ExecutionEngine):
                 return res
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
 
+    def _prepare_join_keys(
+        self, j1: JaxDataFrame, j2: JaxDataFrame, keys: List[str]
+    ) -> Optional[Any]:
+        """Align the two frames' key representations for hashing/equality.
+
+        Returns (left_key_arrs: Dict[mangled→arr], right_key_arrs: List) or
+        None on fallback. Dictionary keys remap the right side's codes into
+        the left's code space (host-side unification of the small
+        dictionaries; NULLs get −1 left / −2 right so they never match);
+        nullable numeric keys become float64 NaN views on both sides;
+        epoch datetimes compare directly when the arrow types agree.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _nullview(arr: Any, mask: Optional[Any]) -> Any:
+            cache_key = ("nullview", self._mesh)
+            if cache_key not in self._jit_cache:
+                self._jit_cache[cache_key] = jax.jit(
+                    lambda a, m: jnp.where(m, jnp.nan, a.astype(jnp.float64))
+                )
+            if mask is None:
+                return arr.astype(jnp.float64)
+            return self._jit_cache[cache_key](arr, mask)
+
+        left_keys: Dict[str, Any] = {}
+        right_keys: List[Any] = []
+        for i, k in enumerate(keys):
+            lenc, renc = j1.encodings.get(k), j2.encodings.get(k)
+            lm, rm = j1.null_masks.get(k), j2.null_masks.get(k)
+            la, ra = j1.device_cols[k], j2.device_cols[k]
+            if lenc is None and renc is None:
+                if lm is None and rm is None:
+                    lk, rk = la, ra
+                elif np.dtype(la.dtype).kind == "f" or (
+                    np.dtype(la.dtype).itemsize < 8
+                    and np.dtype(ra.dtype).itemsize < 8
+                ):
+                    lk, rk = _nullview(la, lm), _nullview(ra, rm)
+                else:
+                    return None  # 64-bit ints with NULL keys lose exactness
+            elif (
+                lenc is not None
+                and renc is not None
+                and lenc["kind"] == "dict"
+                and renc["kind"] == "dict"
+            ):
+                lk = la
+                rk = self._remap_dict_codes(lenc, renc, ra)
+            elif (
+                lenc is not None
+                and renc is not None
+                and lenc["kind"] == "datetime"
+                and renc["kind"] == "datetime"
+                and lenc["type"] == renc["type"]
+            ):
+                if lm is not None or rm is not None:
+                    return None  # masked epochs: 64-bit NULL-key problem
+                lk, rk = la, ra
+            else:
+                return None
+            left_keys[f"__key{i}__"] = lk
+            right_keys.append(rk)
+        return left_keys, right_keys
+
+    def _remap_dict_codes(self, lenc: dict, renc: dict, right_codes: Any) -> Any:
+        """Map right-side dictionary codes into the left's code space.
+
+        Right values absent from the left dictionary get out-of-range codes
+        (≥ len(left dict)) so they never match; NULL codes map −1 → −2 so
+        NULL never equals NULL (SQL semantics)."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = pa.compute.index_in(
+            renc["dictionary"], value_set=lenc["dictionary"]
+        )
+        n_left = len(lenc["dictionary"])
+        mapped = idx.to_numpy(zero_copy_only=False)
+        missing = np.isnan(mapped)
+        mapped = np.where(
+            missing, n_left + np.arange(len(mapped)), mapped
+        ).astype(np.int32)
+        table = jnp.asarray(mapped)
+
+        cache_key = ("dictremap", self._mesh)
+        if cache_key not in self._jit_cache:
+            self._jit_cache[cache_key] = jax.jit(
+                lambda codes, t: jnp.where(
+                    codes < 0,
+                    jnp.int32(-2),
+                    t[jnp.clip(codes, 0, t.shape[0] - 1)],
+                )
+            )
+        return self._jit_cache[cache_key](right_codes, table)
+
     def _join_device(self, df1, df2, kernel_how: str, on) -> Optional[DataFrame]:
         """Try the device hash join; None → host fallback."""
         from ..dataframe.utils import get_join_schemas
@@ -516,11 +612,17 @@ class JaxExecutionEngine(ExecutionEngine):
             return None
         keys = key_schema.names
         # cheap schema pre-checks BEFORE any device conversion
-        numeric = all(
-            pa.types.is_integer(t) or pa.types.is_floating(t) or pa.types.is_boolean(t)
+        supported = all(
+            pa.types.is_integer(t)
+            or pa.types.is_floating(t)
+            or pa.types.is_boolean(t)
+            or pa.types.is_string(t)
+            or pa.types.is_large_string(t)
+            or pa.types.is_timestamp(t)
+            or pa.types.is_date(t)
             for t in key_schema.types
         )
-        if len(keys) == 0 or not numeric:
+        if len(keys) == 0 or not supported:
             return None
         j1, j2 = self.to_df(df1), self.to_df(df2)
         if not (
@@ -528,61 +630,125 @@ class JaxExecutionEngine(ExecutionEngine):
             and isinstance(j2, JaxDataFrame)
             and j2.host_table is None
             and len(j2.device_cols) == len(j2.schema)
-            and not j2.has_encoded  # value gather assumes plain semantics
             and all(k in j1.device_cols for k in keys)
-            # encoded/masked join keys (dict codes don't align across
-            # frames; masked NULL keys must never match) go host
-            and all(
-                k not in j1.encodings and k not in j1.null_masks for k in keys
-            )
         ):
             return None
+        prepared = self._prepare_join_keys(j1, j2, keys)
+        if prepared is None:
+            return None
+        left_key_arrs, right_key_arrs = prepared
         value_names = [
             n for n in j2.schema.names if n not in keys and n in out_schema
         ]
+        # value entries: (out_name, array, left_outer miss fill); masked
+        # columns ship their mask as an extra gathered array (miss = True)
+        import math
+
         import jax
 
+        right_entries: List[Any] = []
+        out_value_encodings: Dict[str, Any] = {}
+        gen_mask_names: List[str] = []  # plain non-floats: mask = ~match
+        for v in value_names:
+            arr = j2.device_cols[v]
+            enc = j2.encodings.get(v)
+            if enc is not None and enc["kind"] == "dict":
+                right_entries.append((v, arr, -1))
+                out_value_encodings[v] = enc
+            elif np.issubdtype(np.dtype(arr.dtype), np.floating):
+                right_entries.append((v, arr, math.nan))
+            else:
+                right_entries.append((v, arr, 0))
+                if enc is not None:
+                    out_value_encodings[v] = enc
+                if kernel_how == "left_outer" and v not in j2.null_masks:
+                    gen_mask_names.append(v)
+            if v in j2.null_masks:
+                right_entries.append(
+                    (f"__mask__{v}", j2.null_masks[v], True)
+                )
         n_right = next(iter(j2.device_cols.values())).shape[0]
         encodings: Dict[str, Any] = {}
         null_masks: Dict[str, Any] = {}
         if n_right <= MAX_BROADCAST_ROWS:
             strategy = "broadcast"
             rep = replicated_sharding(self._mesh)
-            right_cols = {
-                n: jax.device_put(a, rep) for n, a in j2.device_cols.items()
-            }
+            right_entries = [
+                (n, jax.device_put(a, rep), f) for n, a, f in right_entries
+            ]
+            right_key_arrs = [
+                jax.device_put(a, rep) for a in right_key_arrs
+            ]
             right_valid = jax.device_put(j2.device_valid_mask(), rep)
-            left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
+            left_cols = dict(j1.device_cols)
+            left_cols.update(left_key_arrs)
+            left_valid = j1.device_valid_mask()
             host_tbl = j1.host_table  # rows stay in place → stays aligned
             nan_cols = j1._nan_cols
             encodings = dict(j1.encodings)  # non-key left cols ride along
             null_masks = dict(j1.null_masks)
         else:
             strategy = "shuffle"
-            if j1.host_table is not None or j1.has_encoded:
-                # rows move; host columns / per-column masks can't follow yet
-                return None
-            right_cols, right_valid = dict(j2.device_cols), j2.device_valid_mask()
-            left_cols, left_valid = dict(j1.device_cols), j1.device_valid_mask()
+            if j1.host_table is not None:
+                return None  # rows move; host columns can't follow
+            left_cols = dict(j1.device_cols)
+            # left null masks travel with their rows through the exchange
+            for c, m in j1.null_masks.items():
+                left_cols[f"__lmask__{c}"] = m
+            left_cols.update(left_key_arrs)
+            left_valid = j1.device_valid_mask()
+            right_valid = j2.device_valid_mask()
             host_tbl = None
             nan_cols = None
+            encodings = dict(j1.encodings)
         res = device_hash_join(
             self._mesh,
             kernel_how,
             left_cols,
             left_valid,
-            right_cols,
+            list(left_key_arrs.keys()),
+            right_key_arrs,
             right_valid,
-            keys,
-            value_names,
+            right_entries,
             strategy=strategy,
         )
         if res is None:
             return None
-        new_cols, match = res
-        if kernel_how == "left_outer" and nan_cols is not None:
-            # gathered right values may be NaN-filled on misses
-            nan_cols = set(nan_cols) | set(value_names)
+        new_cols, new_valid, match = res
+        # reassemble: pop probe keys, split off mask arrays
+        for mk in left_key_arrs:
+            new_cols.pop(mk, None)
+        if strategy == "shuffle":
+            for c in list(j1.null_masks):
+                m = new_cols.pop(f"__lmask__{c}", None)
+                if m is not None:
+                    null_masks[c] = m
+        for v in value_names:
+            m = new_cols.pop(f"__mask__{v}", None)
+            if m is not None:
+                null_masks[v] = m
+        if kernel_how == "left_outer":
+            if nan_cols is not None:
+                # gathered float values may be NaN-filled on misses
+                nan_cols = set(nan_cols) | {
+                    v
+                    for v in value_names
+                    if np.issubdtype(
+                        np.dtype(j2.device_cols[v].dtype), np.floating
+                    )
+                }
+            if len(gen_mask_names) > 0:
+                import jax.numpy as jnp
+
+                cache_key = ("notmask", self._mesh)
+                if cache_key not in self._jit_cache:
+                    import jax as _jax
+
+                    self._jit_cache[cache_key] = _jax.jit(jnp.logical_not)
+                miss = self._jit_cache[cache_key](match)
+                for v in gen_mask_names:
+                    null_masks[v] = miss
+        encodings.update(out_value_encodings)
         return JaxDataFrame(
             mesh=self._mesh,
             _internal=dict(
@@ -591,10 +757,16 @@ class JaxExecutionEngine(ExecutionEngine):
                 },
                 host_tbl=host_tbl,
                 row_count=-1,
-                valid_mask=match,
+                valid_mask=new_valid,
                 nan_cols=nan_cols,
-                encodings=encodings,
-                null_masks=null_masks,
+                encodings={
+                    k: v
+                    for k, v in encodings.items()
+                    if k in out_schema
+                },
+                null_masks={
+                    k: v for k, v in null_masks.items() if k in out_schema
+                },
                 schema=out_schema,
             ),
         )

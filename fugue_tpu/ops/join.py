@@ -53,8 +53,15 @@ def _probe_body(
     r_nvalid: Any,
     rk_cols: Tuple[Any, ...],
     r_values: Tuple[Any, ...],
+    fills: Tuple[Any, ...] = (),
 ):
-    """Shared probe: fact hashes against the hash-sorted right side."""
+    """Shared probe: fact hashes against the hash-sorted right side.
+
+    ``fills`` (static, one per value array) are the left_outer miss values:
+    NaN for floats, −1 for dictionary codes, True for null masks, 0 for
+    plain ints whose misses get a generated null mask from the returned
+    match flags.
+    """
     fh, fkv = _key_hash_and_valid(jnp, list(fk_cols), f_valid)
     idx = jnp.searchsorted(rk_sorted_hash, fh)
     idx_c = jnp.clip(idx, 0, rk_sorted_hash.shape[0] - 1)
@@ -70,8 +77,9 @@ def _probe_body(
     elif how == "left_outer":
         new_valid = f_valid
         gathered = tuple(
-            jnp.where(eq, rv[src], jnp.nan).astype(rv.dtype) for rv in r_values
-        )
+            jnp.where(eq, rv[src], jnp.asarray(fill, dtype=rv.dtype))
+            for rv, fill in zip(r_values, fills)
+        ) + (eq,)  # match flags: the engine derives generated null masks
     elif how == "semi":
         new_valid = f_valid & eq
         gathered = ()
@@ -131,13 +139,19 @@ def _get_compiled_right_prep(mesh: Any, n_keys: int, dtypes: Any, local: bool):
 
 
 def _get_compiled_probe(
-    mesh: Any, how: str, n_keys: int, n_values: int, dtypes: Any, local: bool
+    mesh: Any,
+    how: str,
+    n_keys: int,
+    n_values: int,
+    dtypes: Any,
+    local: bool,
+    fills: Tuple[Any, ...] = (),
 ):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    key = ("probe", mesh, how, n_keys, n_values, dtypes, local)
+    key = ("probe", mesh, how, n_keys, n_values, dtypes, local, fills)
     if key not in _JOIN_CACHE:
 
         def probe(*args: Any):
@@ -151,12 +165,14 @@ def _get_compiled_probe(
                 rk_ = rest[n_keys : 2 * n_keys]
                 rv_ = rest[2 * n_keys :]
                 return _probe_body(
-                    jnp, how, fk_, fv_, sh_, od_, nv_[0], rk_, rv_
+                    jnp, how, fk_, fv_, sh_, od_, nv_[0], rk_, rv_, fills
                 )
 
             row = P(ROW_AXIS)
             right = row if local else P()
-            n_out = 1 + (n_values if how in ("inner", "left_outer") else 0)
+            n_out = 1 + (
+                (n_values + 1) if how == "left_outer" else (n_values if how == "inner" else 0)
+            )
             return jax.shard_map(
                 shard_fn,
                 mesh=mesh,
@@ -175,71 +191,96 @@ def device_hash_join(
     how: str,
     left_cols: Dict[str, Any],
     left_valid: Any,
-    right_cols: Dict[str, Any],
+    left_key_names: List[str],
+    right_keys: List[Any],
     right_valid: Any,
-    key_names: List[str],
-    value_names: List[str],
+    right_values: List[Tuple[str, Any, Any]],
     strategy: str = "broadcast",
-) -> Optional[Tuple[Dict[str, Any], Any]]:
-    """Join ``left`` with ``right`` on ``key_names``; gather ``value_names``
-    from the right. Returns (new_device_cols, new_valid) or None on host
-    fallback (non-unique right keys, or a ``left_outer`` whose right value
-    columns cannot represent NULL on device).
+) -> Optional[Tuple[Dict[str, Any], Any, Optional[Any]]]:
+    """Join the left payload against prepared right-side arrays.
 
-    ``strategy="broadcast"`` expects the right side replicated to every
-    device; ``strategy="shuffle"`` expects both sides row-sharded and
-    co-partitions them by key hash with the all-to-all exchange first.
+    - ``left_cols`` is the FULL left payload (columns, null masks, prepared
+      probe keys — any row-aligned arrays); ``left_key_names`` picks the
+      probe keys out of it;
+    - ``right_keys`` are the prepared right key arrays (dictionary codes
+      remapped, masked keys as NaN float views — the caller aligns
+      representations across frames);
+    - ``right_values`` entries are ``(out_name, array, miss_fill)`` — the
+      fill is the left_outer NULL for that array's representation (NaN /
+      −1 code / True mask / 0 plain).
+
+    Returns ``(new_cols, new_valid, match)`` where ``match`` (left_outer
+    only) flags rows that found a partner — the caller derives generated
+    null masks for plain columns from it. None → host fallback (non-unique
+    right keys / hash collision).
+
+    ``strategy="broadcast"`` expects the right arrays replicated;
+    ``"shuffle"`` expects both sides row-sharded and co-partitions them by
+    key hash with the all-to-all exchange first.
     """
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    if how == "left_outer" and any(
-        not jnp.issubdtype(right_cols[v].dtype, jnp.floating)
-        for v in value_names
-    ):
-        return None  # NaN is the only device NULL; int/bool misses can't fill
     shuffle = strategy == "shuffle"
+    n_keys = len(left_key_names)
     if shuffle:
         from .shuffle import compute_dest, exchange_rows
 
         # co-partition both sides by the same key hash
         l_dest = compute_dest(
-            mesh, "hash", [left_cols[k] for k in key_names], left_valid
+            mesh, "hash", [left_cols[k] for k in left_key_names], left_valid
         )
-        r_dest = compute_dest(
-            mesh, "hash", [right_cols[k] for k in key_names], right_valid
-        )
+        r_dest = compute_dest(mesh, "hash", list(right_keys), right_valid)
         left_cols, left_valid, _ = exchange_rows(
             mesh, dict(left_cols), left_valid, l_dest
         )
-        right_cols, right_valid, _ = exchange_rows(
-            mesh, dict(right_cols), right_valid, r_dest
+        r_payload = {f"__k{i}__": a for i, a in enumerate(right_keys)}
+        r_payload.update({f"__v__{n}": a for n, a, _ in right_values})
+        r_payload, right_valid, _ = exchange_rows(
+            mesh, r_payload, right_valid, r_dest
         )
-    kdt = tuple(str(right_cols[k].dtype) for k in key_names)
-    prep = _get_compiled_right_prep(mesh, len(key_names), kdt, local=shuffle)
-    s_h, order, nv, dup = prep(right_valid, *[right_cols[k] for k in key_names])
+        right_keys = [r_payload[f"__k{i}__"] for i in range(n_keys)]
+        right_values = [
+            (n, r_payload[f"__v__{n}"], f) for n, _, f in right_values
+        ]
+    kdt = tuple(str(a.dtype) for a in right_keys)
+    prep = _get_compiled_right_prep(mesh, n_keys, kdt, local=shuffle)
+    s_h, order, nv, dup = prep(right_valid, *right_keys)
     if bool(np.asarray(jax.device_get(dup)).any()):
         return None  # duplicate keys (or hash collision) → host join
-    vdt = tuple(str(right_cols[v].dtype) for v in value_names)
+    vdt = tuple(str(a.dtype) for _, a, _ in right_values)
+    fills = (
+        tuple(f for _, _, f in right_values) if how == "left_outer" else ()
+    )
     probe = _get_compiled_probe(
-        mesh, how, len(key_names), len(value_names), (kdt, vdt), local=shuffle
+        mesh,
+        how,
+        n_keys,
+        len(right_values),
+        (kdt, vdt),
+        local=shuffle,
+        fills=fills,
     )
     outs = probe(
         left_valid,
         s_h,
         order,
         nv,
-        *[left_cols[k] for k in key_names],
-        *[right_cols[k] for k in key_names],
-        *[right_cols[v] for v in value_names],
+        *[left_cols[k] for k in left_key_names],
+        *right_keys,
+        *[a for _, a, _ in right_values],
     )
     new_valid = outs[0]
+    match = None
     new_cols = dict(left_cols)
-    if how in ("inner", "left_outer"):
-        for name, arr in zip(value_names, outs[1:]):
+    if how == "inner":
+        for (name, _, _), arr in zip(right_values, outs[1:]):
             new_cols[name] = arr
-    return new_cols, new_valid
+    elif how == "left_outer":
+        for (name, _, _), arr in zip(right_values, outs[1:-1]):
+            new_cols[name] = arr
+        match = outs[-1]
+    return new_cols, new_valid, match
 
 
 def device_broadcast_inner_join(
@@ -251,14 +292,22 @@ def device_broadcast_inner_join(
     dim_valid: Any,
 ) -> Any:
     """Back-compat single-key INNER wrapper over :func:`device_hash_join`."""
-    value_names = [n for n in dim_cols if n != key_name]
-    return device_hash_join(
+    import math
+
+    values = [
+        (n, a, math.nan) for n, a in dim_cols.items() if n != key_name
+    ]
+    res = device_hash_join(
         mesh,
         "inner",
         fact_cols,
         fact_valid,
-        dim_cols,
-        dim_valid,
         [key_name],
-        value_names,
+        [dim_cols[key_name]],
+        dim_valid,
+        values,
     )
+    if res is None:
+        return None
+    new_cols, new_valid, _ = res
+    return new_cols, new_valid

@@ -66,9 +66,11 @@ def test_left_outer_float_values(engine, oracle, fact, dim):
     assert isinstance(got, JaxDataFrame)
 
 
-def test_left_outer_int_values_falls_back(engine, oracle, fact):
+def test_left_outer_int_values(engine, oracle, fact):
     dim_int = pd.DataFrame({"k": np.arange(40), "w": np.arange(40)})
-    _check(engine, oracle, fact, dim_int, "left_outer")  # host path, correct
+    got = _check(engine, oracle, fact, dim_int, "left_outer")
+    # stays on device: int misses carry a generated null mask
+    assert isinstance(got, JaxDataFrame) and "w" in got.null_masks
 
 
 def test_semi_anti(engine, oracle, fact, dim):
@@ -142,3 +144,88 @@ def test_shuffle_strategy(engine, oracle, monkeypatch):
 def test_right_and_full_outer_on_host(engine, oracle, fact, dim):
     _check(engine, oracle, fact, dim, "right_outer")
     _check(engine, oracle, fact, dim, "full_outer")
+
+
+class TestEncodedJoins:
+    """String keys (dictionary unification), encoded/nullable value columns,
+    and left_outer NULL-fill for every representation."""
+
+    def test_string_key_inner_join(self, engine, oracle):
+        left = pd.DataFrame(
+            {
+                "s": ["apple", "pear", "fig", "apple", None],
+                "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+            }
+        )
+        right = pd.DataFrame(
+            {"s": ["apple", "fig", "kiwi", None], "w": [0.1, 0.3, 0.9, 0.7]}
+        )
+        got = _check(engine, oracle, left, right, "inner")
+        assert isinstance(got, JaxDataFrame) and got.host_table is None
+
+    def test_string_key_all_types(self, engine, oracle):
+        rng = np.random.default_rng(4)
+        words = ["a", "bb", "ccc", "dddd", "e f", None]
+        left = pd.DataFrame(
+            {
+                "s": rng.choice(words[:5], 300).tolist(),
+                "v": rng.random(300),
+            }
+        )
+        right = pd.DataFrame({"s": ["bb", "dddd", "zz"], "w": [1.0, 2.0, 3.0]})
+        for how in ["inner", "left_outer", "semi", "anti"]:
+            _check(engine, oracle, left, right, how)
+
+    def test_left_outer_int_values_on_device(self, engine, oracle):
+        left = pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+        right = pd.DataFrame({"k": [1, 3], "w": [10, 30]})  # int values
+        got = _check(engine, oracle, left, right, "left_outer")
+        # now stays on device: misses carry a generated null mask
+        assert isinstance(got, JaxDataFrame) and "w" in got.null_masks
+
+    def test_string_value_columns(self, engine, oracle):
+        left = pd.DataFrame({"k": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]})
+        right = pd.DataFrame({"k": [1, 3], "name": ["one", "three"]})
+        got = _check(engine, oracle, left, right, "inner")
+        assert isinstance(got, JaxDataFrame)
+        got2 = _check(engine, oracle, left, right, "left_outer")
+        assert isinstance(got2, JaxDataFrame)
+        assert got2.encodings.get("name", {}).get("kind") == "dict"
+
+    def test_nullable_value_columns(self, engine, oracle):
+        left = pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+        right = pd.DataFrame(
+            {"k": [1, 2], "w": pd.array([10, None], dtype="Int32")}
+        )
+        for how in ["inner", "left_outer"]:
+            got = _check(engine, oracle, left, right, how)
+            assert isinstance(got, JaxDataFrame) and "w" in got.null_masks
+
+    def test_nullable_int_key(self, engine, oracle):
+        left = pd.DataFrame(
+            {
+                "k": pd.array([1, None, 3, 4], dtype="Int32"),
+                "v": [1.0, 2.0, 3.0, 4.0],
+            }
+        )
+        right = pd.DataFrame(
+            {"k": pd.array([1, 4, None], dtype="Int32"), "w": [0.1, 0.4, 0.9]}
+        )
+        # NULL keys never match (SQL), even NULL vs NULL
+        for how in ["inner", "left_outer", "semi", "anti"]:
+            _check(engine, oracle, left, right, how)
+
+    def test_datetime_key(self, engine, oracle):
+        d = pd.to_datetime
+        left = pd.DataFrame(
+            {
+                "t": d(["2020-01-01", "2020-02-01", "2020-03-01"]),
+                "v": [1.0, 2.0, 3.0],
+            }
+        )
+        right = pd.DataFrame(
+            {"t": d(["2020-02-01", "2020-04-01"]), "w": [0.2, 0.4]}
+        )
+        for how in ["inner", "left_outer", "semi", "anti"]:
+            got = _check(engine, oracle, left, right, how)
+            assert isinstance(got, JaxDataFrame)
