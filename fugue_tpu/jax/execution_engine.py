@@ -799,23 +799,43 @@ class JaxExecutionEngine(ExecutionEngine):
             ]
         if how.lower() != "cross" and len(keys) > 0:
             jdfs = [self.to_df(d) for d in dfs.values()]
+
+            def _key_ok(j: JaxDataFrame, k: str) -> bool:
+                if k not in j.device_cols:
+                    return False
+                enc = j.encodings.get(k)
+                if enc is not None and enc["kind"] == "dict":
+                    return True  # co-located via code remapping below
+                # NULL/NaN keys don't group across frames on the host side
+                # (NaN/NaT break the key-tuple lookup) → blob protocol
+                return (
+                    enc is None
+                    and k not in j.null_masks
+                    and not j.maybe_nan(k)
+                )
+
             device_ok = all(
                 isinstance(j, JaxDataFrame)
                 and j.host_table is None
                 and len(j.device_cols) == len(j.schema)
-                and all(
-                    k in j.device_cols
-                    and k not in j.encodings  # codes differ across frames
-                    and k not in j.null_masks  # NULL keys → host grouping
-                    and not j.maybe_nan(k)
-                    for k in keys
-                )
+                and all(_key_ok(j, k) for k in keys)
                 for j in jdfs
             )
             if device_ok:
+                # union dictionary per string key: every frame's codes remap
+                # into ONE shared space so equal values co-locate even when
+                # absent from other frames' dictionaries
+                union_dicts: Dict[str, Any] = {}
+                for k in keys:
+                    dicts = [
+                        j.encodings[k]["dictionary"]
+                        for j in jdfs
+                        if j.encodings.get(k, {}).get("kind") == "dict"
+                    ]
+                    if len(dicts) > 0:
+                        union_dicts[k] = pa.concat_arrays(dicts).unique()
                 co = [
-                    self.repartition(j, _PSpec(algo="hash", by=keys))
-                    for j in jdfs
+                    self._zip_repartition(j, union_dicts, keys) for j in jdfs
                 ]
                 return ZippedJaxDataFrame(
                     frames=co,  # type: ignore[arg-type]
@@ -832,6 +852,69 @@ class JaxExecutionEngine(ExecutionEngine):
             partition_spec=partition_spec,
             temp_path=temp_path,
             to_file_threshold=to_file_threshold,
+        )
+
+    def _zip_repartition(
+        self, j: JaxDataFrame, union_dicts: Dict[str, Any], keys: List[str]
+    ) -> JaxDataFrame:
+        """Hash-repartition a zip input so equal key VALUES co-locate across
+        frames: dictionary keys hash via codes remapped into the shared
+        union-dictionary space (NULL codes stay −1, so every frame's NULL
+        rows share a shard and form one comap group)."""
+        from ..collections.partition import PartitionSpec as _PSpec
+        from ..ops.shuffle import compute_dest, exchange_rows
+
+        dict_keys = [k for k in keys if k in union_dicts]
+        if len(dict_keys) == 0:
+            return self.repartition(j, _PSpec(algo="hash", by=keys))  # type: ignore[return-value]
+        import jax
+        import jax.numpy as jnp
+
+        key_arrs = []
+        for k in keys:
+            arr = j.device_cols[k]
+            if k in dict_keys:
+                mapped = np.asarray(
+                    pa.compute.index_in(
+                        j.encodings[k]["dictionary"], value_set=union_dicts[k]
+                    ).to_numpy(zero_copy_only=False)
+                )
+                if mapped.size == 0:  # no dictionary entries → all NULL rows
+                    mapped = np.asarray([-1])
+                table = jnp.asarray(mapped.astype(np.int32))
+                ck = ("zipremap", self._mesh)
+                if ck not in self._jit_cache:
+                    self._jit_cache[ck] = jax.jit(
+                        lambda c, t: jnp.where(
+                            c < 0,
+                            jnp.int32(-1),  # NULLs co-locate across frames
+                            t[jnp.clip(c, 0, t.shape[0] - 1)],
+                        )
+                    )
+                arr = self._jit_cache[ck](arr, table)
+            key_arrs.append(arr)
+        valid = j.device_valid_mask()
+        dest = compute_dest(self._mesh, "hash", key_arrs, valid)
+        payload = dict(j.device_cols)
+        for c, m in j.null_masks.items():
+            payload[f"__mask__{c}"] = m
+        new_payload, new_valid, _ = exchange_rows(
+            self._mesh, payload, valid, dest
+        )
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols={c: new_payload[c] for c in j.device_cols},
+                host_tbl=None,
+                row_count=j.count(),
+                valid_mask=new_valid,
+                nan_cols=j._nan_cols,
+                encodings=dict(j.encodings),
+                null_masks={
+                    c: new_payload[f"__mask__{c}"] for c in j.null_masks
+                },
+                schema=j.schema,
+            ),
         )
 
     def comap(

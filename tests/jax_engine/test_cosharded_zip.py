@@ -102,14 +102,21 @@ def test_comap_outer_semantics(engine):
         assert got == sorted(expected), how
 
 
-def test_zip_string_keys_falls_back_to_blob_protocol(engine):
-    a = pd.DataFrame({"s": ["x", "y"], "v": [1.0, 2.0]})
-    b = pd.DataFrame({"s": ["y", "z"], "w": [3.0, 4.0]})
+def test_zip_nanable_float_keys_fall_back_to_blob_protocol(engine):
+    import pyarrow as pa
+
+    # NaN float keys can't group across frames host-side → blob protocol
+    a = pa.table(
+        {
+            "k": pa.array([1.0, float("nan")], pa.float64()),
+            "v": pa.array([1.0, 2.0], pa.float64()),
+        }
+    )
+    b = pd.DataFrame({"k": [1.0, 2.0], "w": [3.0, 4.0]})
     z = engine.zip(
         DataFrames([engine.to_df(a), engine.to_df(b)]),
-        partition_spec=PartitionSpec(by=["s"]),
+        partition_spec=PartitionSpec(by=["k"]),
     )
-    # dict codes don't align across frames → host blob protocol
     assert not isinstance(z, ZippedJaxDataFrame)
     assert z.metadata["serialized"] is True
 
@@ -125,3 +132,43 @@ def test_zipped_frame_materializes_for_non_comap_use(engine):
     tbl = z.as_arrow()  # blob fallback materialization
     assert tbl.num_rows == 4  # 2 keys × 2 frames
     assert z.count() == 4
+
+
+def test_zip_string_keys_on_device(engine, monkeypatch):
+    """String zip keys co-locate via a union dictionary — no blob path."""
+    a = pd.DataFrame(
+        {"s": ["x", "y", "z", None, "x"], "v": [1.0, 2.0, 3.0, 4.0, 5.0]}
+    )
+    b = pd.DataFrame({"s": ["y", "w", None, "x"], "w": [20.0, 40.0, 60.0, 10.0]})
+
+    def stats(df1: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame(
+            {
+                "s": [df1["s"].iloc[0] if len(df1) else df2["s"].iloc[0]],
+                "n1": [len(df1)],
+                "n2": [len(df2)],
+            }
+        )
+
+    def _no_blobs(*args, **kw):
+        raise AssertionError("blob serialization used for string zip keys")
+
+    monkeypatch.setattr(engine, "_serialize_by_partition", _no_blobs)
+    from fugue_tpu.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    dag.df(a).zip(dag.df(b), how="full_outer", partition=dict(by=["s"])).transform(
+        stats, schema="s:str,n1:int,n2:int"
+    ).yield_dataframe_as("r", as_local=True)
+    res = dag.run(engine).yields["r"].result.as_pandas()
+    got = {
+        (None if pd.isna(r["s"]) else r["s"]): (r["n1"], r["n2"])
+        for _, r in res.iterrows()
+    }
+    assert got == {
+        "x": (2, 1),
+        "y": (1, 1),
+        "z": (1, 0),
+        "w": (0, 1),
+        None: (1, 1),
+    }
