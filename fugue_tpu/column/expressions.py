@@ -188,9 +188,14 @@ class _NamedColumnExpr(ColumnExpr):
 
 class _LitColumnExpr(ColumnExpr):
     def __init__(self, value: Any):
+        import datetime as _dt
+
         super().__init__()
         assert_or_throw(
-            value is None or isinstance(value, (int, float, bool, str, bytes)),
+            value is None
+            or isinstance(
+                value, (int, float, bool, str, bytes, _dt.date, _dt.datetime)
+            ),
             lambda: NotImplementedError(f"unsupported literal {value!r}"),
         )
         self._value = value
@@ -200,6 +205,8 @@ class _LitColumnExpr(ColumnExpr):
         return self._value
 
     def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        import datetime as _dt
+
         if self.as_type is not None:
             return self.as_type
         if self._value is None:
@@ -212,6 +219,10 @@ class _LitColumnExpr(ColumnExpr):
             return pa.float64()
         if isinstance(self._value, str):
             return pa.string()
+        if isinstance(self._value, _dt.datetime):
+            return pa.timestamp("us")
+        if isinstance(self._value, _dt.date):
+            return pa.date32()
         return pa.binary()
 
     def __repr__(self) -> str:

@@ -309,20 +309,90 @@ def plan_dict_lookups(
     return tables if plan(expr) else None
 
 
+def _epoch_of(value: Any, tp: Any) -> Optional[int]:
+    """Convert a datetime-like literal to the epoch int of the column's
+    arrow storage (timestamp unit / date32 days). None = not convertible."""
+    import datetime as _dt
+
+    import pandas as pd
+
+    try:
+        ts = pd.Timestamp(value)
+    except Exception:
+        return None
+    if pa.types.is_date32(tp):
+        return (ts - pd.Timestamp("1970-01-01")).days
+    if pa.types.is_timestamp(tp):
+        ns = ts.value  # nanoseconds since epoch
+        div = {"s": 10**9, "ms": 10**6, "us": 10**3, "ns": 1}[tp.unit]
+        return ns // div
+    return None
+
+
+def _rewrite_datetime_literals(
+    expr: ColumnExpr, encodings: Dict[str, dict]
+) -> Any:
+    """Rewrite comparisons between epoch-encoded datetime columns and
+    datetime-like literals into integer comparisons. Returns
+    (rewritten_expr, names of datetime columns now usable as plain ints),
+    or (expr, empty set) when nothing applies."""
+    import datetime as _dt
+
+    allowed: set = set()
+
+    def is_dt_col(e: ColumnExpr) -> bool:
+        return (
+            isinstance(e, _NamedColumnExpr)
+            and encodings.get(e.name, {}).get("kind") == "datetime"
+        )
+
+    def rw(e: ColumnExpr) -> ColumnExpr:
+        if isinstance(e, _BinaryOpExpr):
+            if e.op in ("<", "<=", ">", ">=", "==", "!="):
+                l, r = e.left, e.right
+                for a, b, flip in ((l, r, False), (r, l, True)):
+                    if is_dt_col(a) and isinstance(b, _LitColumnExpr):
+                        if not isinstance(
+                            b.value, (str, _dt.date, _dt.datetime)
+                        ):
+                            continue
+                        epoch = _epoch_of(b.value, encodings[a.name]["type"])
+                        if epoch is None:
+                            continue
+                        allowed.add(a.name)
+                        lit_e = _LitColumnExpr(epoch)
+                        return (
+                            _BinaryOpExpr(e.op, lit_e, a)
+                            if flip
+                            else _BinaryOpExpr(e.op, a, lit_e)
+                        )
+            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
+        if isinstance(e, _UnaryOpExpr):
+            if e.op in ("IS_NULL", "NOT_NULL") and is_dt_col(e.col):
+                allowed.add(e.col.name)
+                return e
+            return _UnaryOpExpr(e.op, rw(e.col))
+        return e
+
+    return rw(expr), allowed
+
+
 def device_predicate_plan(
     expr: ColumnExpr, device_cols: Any, encodings: Dict[str, dict]
 ) -> Optional[Dict[str, Any]]:
     """Gate + plan for three-valued device evaluation of a predicate.
 
-    Returns the dict-lookup tables (possibly empty) when the expression can
-    run on device with :func:`evaluate_jnp_3v`, else None. Dict-encoded
+    Returns ``(dict_lookup_tables, rewritten_expr)`` when the expression
+    can run on device with :func:`evaluate_jnp_3v`, else None. Dict-encoded
     columns are allowed only inside host-reducible subtrees; datetime
-    encodings are not supported in predicates yet (host fallback).
+    columns are allowed where a literal comparison rewrote to epoch ints
+    or under IS_NULL/NOT_NULL.
     """
     from .functions import is_agg
 
     if is_agg(expr):
         return None
+    expr, dt_allowed = _rewrite_datetime_literals(expr, encodings)
     tables = plan_dict_lookups(expr, encodings)
     if tables is None:
         return None
@@ -340,9 +410,14 @@ def device_predicate_plan(
             if e.wildcard or e.name not in device_cols:
                 return False
             if e.name in encodings:
-                # dict codes: only the null flag is usable; epoch datetimes
-                # have no literal comparison support yet
-                return under_null and encodings[e.name]["kind"] == "dict"
+                kind = encodings[e.name]["kind"]
+                if kind == "dict":
+                    return under_null  # only the null flag is usable
+                if kind == "datetime":
+                    # usable where a literal comparison rewrote to epoch
+                    # ints, or under IS_NULL/NOT_NULL
+                    return under_null or e.name in dt_allowed
+                return False
             return True
         if isinstance(e, _LitColumnExpr):
             return e.value is not None and isinstance(e.value, (int, float, bool))
@@ -362,7 +437,7 @@ def device_predicate_plan(
             )
         return False
 
-    return tables if ok(expr) else None
+    return (tables, expr) if ok(expr) else None
 
 
 def can_evaluate_on_device(

@@ -409,19 +409,20 @@ class JaxExecutionEngine(ExecutionEngine):
             and len(jdf.device_cols) > 0
             and jdf.host_table is None
         ):
-            tables = device_predicate_plan(
+            plan = device_predicate_plan(
                 condition, jdf.device_cols, jdf.encodings
             )
-            if tables is not None:
+            if plan is not None:
                 import jax
 
+                tables, cond = plan  # datetime literals rewritten to epochs
                 uuids = tuple(sorted(tables.keys()))
                 names = {u: tables[u][0] for u in uuids}
                 code_cols = frozenset(
                     c for c, e in jdf.encodings.items() if e["kind"] == "dict"
                 )
                 cache_key = (
-                    "filter3v", condition.__uuid__(), jdf.mesh, uuids, code_cols
+                    "filter3v", cond.__uuid__(), jdf.mesh, uuids, code_cols
                 )
                 if cache_key not in self._jit_cache:
 
@@ -437,7 +438,7 @@ class JaxExecutionEngine(ExecutionEngine):
 
                         dt = {u: (names[u], t) for u, t in zip(uuids, tarrs)}
                         v, nl = evaluate_jnp_3v(
-                            cols, masks, dt, condition, code_cols
+                            cols, masks, dt, cond, code_cols
                         )
                         return (
                             valid

@@ -240,3 +240,35 @@ class TestShuffleWithEncodings:
         got = res.as_pandas().sort_values(["k", "s", "a"]).reset_index(drop=True)
         exp = pdf.sort_values(["k", "s", "a"]).reset_index(drop=True)
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+class TestDatetimePredicates:
+    def test_datetime_filter_on_device(self, engine, oracle):
+        import datetime
+
+        pdf = pd.DataFrame(
+            {
+                "t": pd.to_datetime(
+                    ["2020-01-01", "2020-06-15", None, "2021-02-02"]
+                ),
+                "v": [1.0, 2.0, 3.0, 4.0],
+            }
+        )
+        jdf = engine.to_df(pdf)
+        assert "t" in jdf.device_cols
+        got = engine.filter(jdf, col("t") > "2020-03-01")
+        assert isinstance(got, JaxDataFrame)  # device path
+        assert got.as_pandas()["v"].tolist() == [2.0, 4.0]
+        # datetime.date literal + compound predicate; NULL dropped
+        cond = (col("t") >= datetime.date(2020, 1, 1)) & (
+            col("t") < datetime.datetime(2021, 1, 1)
+        )
+        got2 = engine.filter(jdf, cond)
+        assert got2.as_pandas()["v"].tolist() == [1.0, 2.0]
+        # oracle agreement incl. IS_NULL
+        got3 = engine.filter(jdf, col("t").is_null())
+        assert got3.as_pandas()["v"].tolist() == [3.0]
+        exp = oracle.filter(
+            oracle.to_df(pdf), col("t") > "2020-03-01"
+        ).as_pandas()
+        assert got.as_pandas()["v"].tolist() == exp["v"].tolist()
