@@ -315,53 +315,64 @@ def eval_select(
     return res
 
 
-def _eval_having_filter(
-    res: pd.DataFrame, sc: SelectColumns, having: ColumnExpr
-) -> pd.DataFrame:
-    """HAVING over the aggregated frame: aggregate subexpressions that
-    structurally match a SELECT aggregate (ignoring alias/cast) read that
-    computed output column; everything else evaluates normally."""
+def rewrite_having_aggs(
+    having: ColumnExpr, agg_cols: List[ColumnExpr]
+) -> ColumnExpr:
+    """Replace aggregate subtrees in HAVING that structurally match a SELECT
+    aggregate (ignoring alias/cast) with references to its output column —
+    the rewritten predicate evaluates over the aggregated frame with the
+    plain evaluator. Shared by the oracle and the device engine."""
+    from .expressions import col as _named_col
     from .functions import is_agg
 
     agg_map: Dict[str, str] = {}
-    for c in sc.all_cols:
+    for c in agg_cols:
         if is_agg(c):
             agg_map[c.alias("").cast(None).__uuid__()] = c.output_name
 
-    def ev(e: ColumnExpr) -> Any:
-        if not is_agg(e):
-            return evaluate(res, e)
+    def rw(e: ColumnExpr) -> ColumnExpr:
         if isinstance(e, _FuncExpr) and e.is_agg:
             key = e.alias("").cast(None).__uuid__()
-            if key in agg_map:
-                v = res[agg_map[key]]
-                return _cast_series(v, e.as_type) if e.as_type is not None else v
-            raise FugueSQLError(
-                f"HAVING aggregate {e!r} does not appear in the SELECT list"
-            )
+            if key not in agg_map:
+                raise FugueSQLError(
+                    f"HAVING aggregate {e!r} does not appear in the SELECT list"
+                )
+            out: ColumnExpr = _named_col(agg_map[key])
+            if e.as_type is not None:
+                out = out.cast(e.as_type)
+            return out
+        if not is_agg(e):
+            return e
         if isinstance(e, _BinaryOpExpr):
-            l, r = ev(e.left), ev(e.right)
-            ops = {
-                "+": lambda: l + r, "-": lambda: l - r, "*": lambda: l * r,
-                "/": lambda: l / r, "<": lambda: l < r, "<=": lambda: l <= r,
-                ">": lambda: l > r, ">=": lambda: l >= r, "==": lambda: l == r,
-                "!=": lambda: l != r,
-                "&": lambda: _as_bool(l) & _as_bool(r),
-                "|": lambda: _as_bool(l) | _as_bool(r),
-            }
-            return ops[e.op]()
+            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
         if isinstance(e, _UnaryOpExpr):
-            v = ev(e.col)
-            if e.op == "~":
-                return ~_as_bool(v)
-            if e.op == "-":
-                return -v
+            return _UnaryOpExpr(e.op, rw(e.col))
+        if isinstance(e, _FuncExpr):
+            return _FuncExpr(
+                e.func, *[rw(a) for a in e.args], arg_distinct=e.is_distinct
+            )
+        if isinstance(e, _InExpr):
+            return _InExpr(rw(e.col), e.values, e.positive)
+        if isinstance(e, _LikeExpr):
+            return _LikeExpr(rw(e.col), e.pattern, e.positive)
+        if isinstance(e, _CaseWhenExpr):
+            return _CaseWhenExpr(
+                [(rw(c), rw(v)) for c, v in e.cases], rw(e.default)
+            )
         raise NotImplementedError(f"unsupported HAVING expression {e!r}")
 
-    mask = _as_bool(ev(having))
-    if not isinstance(mask, pd.Series):
-        return res if mask else res.head(0)
-    return res[mask].reset_index(drop=True)
+    return rw(having)
+
+
+def _eval_having_filter(
+    res: pd.DataFrame, sc: SelectColumns, having: ColumnExpr
+) -> pd.DataFrame:
+    """HAVING over the aggregated frame: rewrite aggregate subtrees to read
+    their computed output columns, then filter normally."""
+    from .functions import is_agg
+
+    aggs = [c for c in sc.all_cols if is_agg(c)]
+    return eval_filter(res, rewrite_having_aggs(having, aggs))
 
 
 def _is_na(v: Any) -> bool:

@@ -110,14 +110,6 @@ def _eval(cols: Dict[str, Any], expr: ColumnExpr) -> Any:
 # TPU-native way to run string filters without device strings.
 
 
-class _DictLookup:
-    """Marks a subtree to be computed as dictionary-table lookup."""
-
-    def __init__(self, col_name: str, expr: ColumnExpr):
-        self.col_name = col_name
-        self.expr = expr
-
-
 def _contains_null_ops(expr: ColumnExpr) -> bool:
     """Whether the subtree consumes NULL flags (IS_NULL/NOT_NULL/COALESCE) —
     such subtrees must NOT evaluate over the dictionary (which has no

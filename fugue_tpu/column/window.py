@@ -177,19 +177,18 @@ def _whole_partition_agg(
 
 def _bound_offsets(start: Any, end: Any) -> Any:
     """Normalize bounds to (lo_off, hi_off) where None = unbounded; offsets
-    are signed relative positions/values (preceding negative)."""
+    are signed relative positions/values (preceding negative). The parser
+    rejects UNBOUNDED FOLLOWING starts / UNBOUNDED PRECEDING ends."""
 
-    def off(b: Any, is_start: bool) -> Any:
-        if b == "unb_prec":
-            return None if is_start else 0  # degenerate, validated upstream
-        if b == "unb_foll":
+    def off(b: Any) -> Any:
+        if b in ("unb_prec", "unb_foll"):
             return None
         if b == "current":
             return 0
         tag, n = b
         return -n if tag == "prec" else n
 
-    return off(start, True), off(end, False)
+    return off(start), off(end)
 
 
 def _bounded_frame_agg(
@@ -216,14 +215,25 @@ def _bounded_frame_agg(
     lo_off, hi_off = _bound_offsets(start, end)
     if start == "unb_prec":
         lo_off = None
-    if kind == "range" and (lo_off not in (None, 0) or hi_off not in (None, 0)):
-        if len(order_names) != 1:
-            raise FugueSQLSyntaxError(
-                "RANGE with offsets requires exactly one ORDER BY key"
-            )
+    range_offsets = kind == "range" and (
+        lo_off not in (None, 0) or hi_off not in (None, 0)
+    )
+    if range_offsets and len(order_names) != 1:
+        raise FugueSQLSyntaxError(
+            "RANGE with offsets requires exactly one ORDER BY key"
+        )
 
     out = np.full(len(v), np.nan, dtype=np.float64)
     vals = v.to_numpy(dtype=np.float64, na_value=np.nan)
+    # peer-group ids over ALL order keys (dtype-agnostic): RANGE bounds at
+    # CURRENT ROW include the whole peer group, not just equal first keys
+    peer_changed = np.ones(len(ordered), dtype=bool)
+    if kind == "range" and len(ordered) > 0:
+        okeys = ordered[order_names]
+        eq_prev = (okeys.eq(okeys.shift()) | (okeys.isna() & okeys.shift().isna())).all(
+            axis=1
+        )
+        peer_changed = ~eq_prev.to_numpy()
     if keys is not None:
         # positional locations per partition, in sorted (frame) order
         group_iter = [
@@ -248,7 +258,7 @@ def _bounded_frame_agg(
                 if hi_off is None
                 else np.clip(np.arange(n) + hi_off + 1, 0, n)
             )
-        else:
+        elif range_offsets:
             okey = ordered[order_names[0]].to_numpy(dtype=np.float64)[gpos]
             sign = 1.0 if asc[0] else -1.0
             k = sign * okey  # ascending view
@@ -261,6 +271,24 @@ def _bounded_frame_agg(
                 np.full(n, n, dtype=np.int64)
                 if hi_off is None
                 else np.searchsorted(k, k + hi_off, side="right")
+            )
+        else:
+            # RANGE with CURRENT ROW bounds: peer-group boundaries (the
+            # first row of the partition always starts a peer group)
+            changed = peer_changed[gpos].copy()
+            changed[0] = True
+            gid = np.cumsum(changed) - 1
+            starts = np.flatnonzero(changed)
+            ends = np.append(starts[1:], n)
+            lo = (
+                np.zeros(n, dtype=np.int64)
+                if lo_off is None
+                else starts[gid]  # CURRENT ROW → first peer
+            )
+            hi = (
+                np.full(n, n, dtype=np.int64)
+                if hi_off is None
+                else ends[gid]  # CURRENT ROW → last peer
             )
         for i in range(n):
             w = gv[lo[i] : hi[i]]

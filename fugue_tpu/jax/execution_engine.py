@@ -392,7 +392,7 @@ class JaxExecutionEngine(ExecutionEngine):
         return jdf
 
     # ---- relational ops ----------------------------------------------------
-    def filter(self, df: DataFrame, condition: Any) -> DataFrame:
+    def filter(self, df: DataFrame, condition: Any, _plan: Any = None) -> DataFrame:
         """Device filter: the condition becomes a validity mask — no rows
         move, downstream device ops and host conversion honor the mask.
 
@@ -400,6 +400,7 @@ class JaxExecutionEngine(ExecutionEngine):
         is NULL are dropped): NaN floats and per-column null masks are
         NULLs, and predicates on dictionary-encoded string columns evaluate
         host-side over the dictionary into a lookup table gathered by code.
+        ``_plan`` lets ``select`` reuse its already-computed predicate plan.
         """
         from ..column.jax_eval import device_predicate_plan
 
@@ -409,8 +410,12 @@ class JaxExecutionEngine(ExecutionEngine):
             and len(jdf.device_cols) > 0
             and jdf.host_table is None
         ):
-            plan = device_predicate_plan(
-                condition, jdf.device_cols, jdf.encodings
+            plan = (
+                _plan
+                if _plan is not None
+                else device_predicate_plan(
+                    condition, jdf.device_cols, jdf.encodings
+                )
             )
             if plan is not None:
                 import jax
@@ -1455,11 +1460,13 @@ class JaxExecutionEngine(ExecutionEngine):
             where is not None
             and len(jdf.device_cols) > 0
             and jdf.host_table is None
-            and device_predicate_plan(where, jdf.device_cols, jdf.encodings)
-            is not None
         ):
-            jdf = self.filter(jdf, where)  # type: ignore
-            where = None
+            where_plan = device_predicate_plan(
+                where, jdf.device_cols, jdf.encodings
+            )
+            if where_plan is not None:
+                jdf = self.filter(jdf, where, _plan=where_plan)  # type: ignore
+                where = None
         # grouped aggregation lowers to the device groupby
         if where is None and sc.has_agg and not sc.is_distinct:
             from ..collections.partition import PartitionSpec as _PSpec
@@ -1482,10 +1489,12 @@ class JaxExecutionEngine(ExecutionEngine):
                         # the aggregate result is O(groups): host filter;
                         # aggregate subexpressions read their computed
                         # output columns (same contract as the oracle)
+                        from ..column.eval import rewrite_having_aggs
+
                         res = self._back(
                             self._host_engine.filter(
                                 self._host(res),
-                                _rewrite_having_aggs(having, aggs),
+                                rewrite_having_aggs(having, aggs),
                             )
                         )
                     # restore declared projection order
@@ -1661,32 +1670,6 @@ class JaxExecutionEngine(ExecutionEngine):
             out[spec["name"]] = spec["fn"](merged)
         out_schema = plan["schema"]
         return self.to_df(PandasDataFrame(out, out_schema))
-
-
-def _rewrite_having_aggs(having: ColumnExpr, aggs: List[ColumnExpr]) -> ColumnExpr:
-    """Replace aggregate subtrees in HAVING that structurally match a SELECT
-    aggregate (ignoring alias/cast) with a reference to its output column."""
-    from ..column import col as _col
-    from ..column.expressions import _BinaryOpExpr, _FuncExpr, _UnaryOpExpr
-
-    agg_map = {c.alias("").cast(None).__uuid__(): c.output_name for c in aggs}
-
-    def rw(e: ColumnExpr) -> ColumnExpr:
-        if isinstance(e, _FuncExpr) and e.is_agg:
-            key = e.alias("").cast(None).__uuid__()
-            if key in agg_map:
-                out: ColumnExpr = _col(agg_map[key])
-                return out.cast(e.as_type) if e.as_type is not None else out
-            raise FugueInvalidOperation(
-                f"HAVING aggregate {e!r} does not appear in the SELECT list"
-            )
-        if isinstance(e, _BinaryOpExpr):
-            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
-        if isinstance(e, _UnaryOpExpr):
-            return _UnaryOpExpr(e.op, rw(e.col))
-        return e
-
-    return rw(having)
 
 
 def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:

@@ -627,27 +627,7 @@ class FugueSQLCompiler:
         engine = "".join(parts)
         params: Dict[str, Any] = {}
         if p.eat_kw("PARAMS"):
-            while True:
-                k = p.next().value
-                t = p.peek()
-                if t.kind == "OP" and t.value == "=":
-                    p.next()
-                elif t.kind == "PUNCT" and t.value == ":":
-                    p.next()
-                else:
-                    raise FugueSQLSyntaxError("PARAMS expects k=v pairs")
-                v = p.next()
-                if v.kind == "NUMBER":
-                    params[k] = float(v.value) if "." in v.value or "e" in v.value.lower() else int(v.value)
-                elif v.kind == "STRING":
-                    params[k] = v.value
-                elif v.upper in ("TRUE", "FALSE"):
-                    params[k] = v.upper == "TRUE"
-                else:
-                    params[k] = v.value
-                if not (p.peek().kind == "PUNCT" and p.peek().value == ","):
-                    break
-                p.next()
+            params = p.parse_params()
         if not p.at_kw("SELECT"):
             raise FugueSQLSyntaxError("CONNECT must be followed by SELECT")
         return self._stmt_select(p, sql, sql_engine=engine, sql_engine_params=params)

@@ -796,6 +796,12 @@ class SQLParser:
             else:
                 start = self._parse_frame_bound()
                 end = "current"
+            if start == "unb_foll" or end == "unb_prec":
+                raise FugueSQLSyntaxError(
+                    "invalid window frame: the start bound cannot be "
+                    "UNBOUNDED FOLLOWING and the end bound cannot be "
+                    "UNBOUNDED PRECEDING"
+                )
             frame = (kind, start, end)
         self.expect_punct(")")
         return _WindowExpr(func, args, partition_by, order_by, frame=frame)

@@ -625,3 +625,48 @@ class TestNonEquiJoins:
             fugue_sql(
                 "SELECT * FROM t1 LEFT JOIN t2 ON t1.k = t2.k AND v > w"
             )
+
+
+class TestWindowFrameEdges:
+    def test_range_current_row_bounds_use_all_order_keys(self):
+        # peers = equal on ALL order keys, not just the first
+        t = pd.DataFrame(
+            {"a": [1, 1, 1], "b": [1, 2, 2], "v": [1.0, 2.0, 4.0]}
+        )
+        r = fugue_sql(
+            "SELECT b, SUM(v) OVER (ORDER BY a, b "
+            "RANGE BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s "
+            "FROM t ORDER BY b, s"
+        )
+        # row (a=1,b=1): frame starts at its peer group → 7.0
+        # rows (a=1,b=2): their peer group starts after b=1 → 6.0
+        assert r["s"].tolist() == [7.0, 6.0, 6.0]
+
+    def test_range_current_row_with_string_order_key(self):
+        t = pd.DataFrame({"s": ["x", "x", "y"], "v": [1.0, 2.0, 3.0]})
+        r = fugue_sql(
+            "SELECT s, SUM(v) OVER (ORDER BY s "
+            "RANGE BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS c "
+            "FROM t ORDER BY s, c"
+        )
+        assert r["c"].tolist() == [6.0, 6.0, 3.0]
+
+    def test_invalid_frame_bound_order_raises(self):
+        t = pd.DataFrame({"a": [1.0]})
+        with pytest.raises(FugueSQLSyntaxError):
+            fugue_sql(
+                "SELECT SUM(a) OVER (ORDER BY a "
+                "ROWS BETWEEN UNBOUNDED FOLLOWING AND CURRENT ROW) AS s FROM t"
+            )
+        with pytest.raises(FugueSQLSyntaxError):
+            fugue_sql(
+                "SELECT SUM(a) OVER (ORDER BY a "
+                "ROWS BETWEEN CURRENT ROW AND UNBOUNDED PRECEDING) AS s FROM t"
+            )
+
+    def test_having_with_in_over_aggregate(self):
+        t = pd.DataFrame({"k": [1, 1, 2], "v": [1, 2, 3]})
+        r = fugue_sql(
+            "SELECT k, COUNT(v) AS n FROM t GROUP BY k HAVING COUNT(v) IN (2)"
+        )
+        assert r.values.tolist() == [[1, 2]]
