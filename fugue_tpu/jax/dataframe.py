@@ -120,11 +120,25 @@ def encode_arrow_for_device(tbl: pa.Table, encode: bool = True) -> Any:
             codes = np.asarray(
                 d.indices.fill_null(-1).to_numpy(zero_copy_only=False)
             ).astype(np.int32)
+            # SORT the dictionary so code order == lexicographic order:
+            # MIN/MAX aggregates and presorts on the codes are then exact
+            dictionary = d.dictionary.cast(t)
+            if len(dictionary) > 1:
+                order = np.asarray(
+                    pa.compute.sort_indices(dictionary).to_numpy(
+                        zero_copy_only=False
+                    )
+                )
+                dictionary = dictionary.take(pa.array(order))
+                inverse = np.empty(len(order), dtype=np.int32)
+                inverse[order] = np.arange(len(order), dtype=np.int32)
+                codes = np.where(codes >= 0, inverse[np.clip(codes, 0, None)], -1).astype(np.int32)
             device_cols[f.name] = codes
             meta["encodings"][f.name] = {
                 "kind": "dict",
-                "dictionary": d.dictionary.cast(t),
+                "dictionary": dictionary,
                 "type": t,
+                "sorted": True,
             }
             continue
         if encode and (pa.types.is_timestamp(t) or pa.types.is_date(t)):

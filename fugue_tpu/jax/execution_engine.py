@@ -1338,14 +1338,25 @@ class JaxExecutionEngine(ExecutionEngine):
             partition_spec.presort if partition_spec is not None else {}
         )
         no_keys = partition_spec is None or len(partition_spec.partition_by) == 0
+
+        def _sortable(c: str) -> bool:
+            if c not in jdf.device_cols:
+                return False
+            enc = jdf.encodings.get(c)
+            if enc is None:
+                return True
+            # sorted-dict codes and epoch ints order like their values
+            return enc["kind"] == "datetime" or (
+                enc["kind"] == "dict" and enc.get("sorted", False)
+            )
+
         if (
             no_keys
             and len(sorts) > 0
             and na_position == "last"
             and isinstance(jdf, JaxDataFrame)
             and jdf.host_table is None
-            and not jdf.has_encoded  # code/epoch order ≠ value order semantics
-            and all(c in jdf.device_cols for c in sorts)
+            and all(_sortable(c) for c in sorts)
             and n <= 4096
         ):
             import jax
@@ -1354,6 +1365,12 @@ class JaxExecutionEngine(ExecutionEngine):
             from jax.sharding import PartitionSpec as JP
 
             sort_items = list(sorts.items())
+            dict_sort_cols = frozenset(
+                c
+                for c in sorts
+                if jdf.encodings.get(c, {}).get("kind") == "dict"
+            )
+            masked = frozenset(jdf.null_masks)
             k = min(
                 n,
                 next(iter(jdf.device_cols.values())).shape[0]
@@ -1367,48 +1384,77 @@ class JaxExecutionEngine(ExecutionEngine):
                     k,
                     mesh,
                     tuple(jdf.schema.names),
+                    dict_sort_cols,
+                    masked,
                 )
                 if cache_key not in self._jit_cache:
 
-                    def compute(cols: Dict[str, Any], valid: Any):
-                        def shard_fn(c: Dict[str, Any], v: Any):
+                    def compute(
+                        cols: Dict[str, Any], masks: Dict[str, Any], valid: Any
+                    ):
+                        def shard_fn(
+                            c: Dict[str, Any], m: Dict[str, Any], v: Any
+                        ):
+                            # per-key (isnull, transformed-key) pairs: NULLs
+                            # sort last within ties of earlier keys, exactly
+                            # pandas na_position="last", for any asc/desc mix
                             ops: List[Any] = [jnp.logical_not(v)]  # valid first
                             for name, asc in sort_items:
                                 key = c[name]
+                                isnull = jnp.zeros(key.shape, dtype=bool)
+                                if name in m:
+                                    isnull = isnull | m[name]
+                                if jnp.issubdtype(key.dtype, jnp.floating):
+                                    isnull = isnull | jnp.isnan(key)
+                                if name in dict_sort_cols:
+                                    isnull = isnull | (key < 0)
                                 if not asc:
                                     if jnp.issubdtype(key.dtype, jnp.floating):
-                                        key = -key  # NaN stays NaN → still last
+                                        key = jnp.where(isnull, key, -key)
                                     elif key.dtype == jnp.bool_:
                                         key = jnp.logical_not(key)
                                     else:
-                                        key = ~key  # monotone reversal, no overflow
-                                ops.append(key)
+                                        key = ~key  # monotone reversal
+                                ops.extend([isnull, key])
                             iota = jax.lax.iota(jnp.int32, v.shape[0])
                             sorted_ops = jax.lax.sort(
                                 tuple(ops) + (iota,), num_keys=len(ops)
                             )
                             perm = sorted_ops[-1][:k]
                             out = {name: arr[perm] for name, arr in c.items()}
+                            for name, arr in m.items():
+                                out[f"__mask__{name}"] = arr[perm]
                             out["__take_valid__"] = v[perm]
                             return out
 
                         return jax.shard_map(
                             shard_fn,
                             mesh=mesh,
-                            in_specs=(JP(ROW_AXIS), JP(ROW_AXIS)),
+                            in_specs=(JP(ROW_AXIS), JP(ROW_AXIS), JP(ROW_AXIS)),
                             out_specs=JP(ROW_AXIS),
-                        )(cols, valid)
+                        )(cols, masks, valid)
 
                     self._jit_cache[cache_key] = jax.jit(compute)
                 outs = self._jit_cache[cache_key](
-                    dict(jdf.device_cols), jdf.device_valid_mask()
+                    dict(jdf.device_cols),
+                    dict(jdf.null_masks),
+                    jdf.device_valid_mask(),
                 )
                 host = {
                     name: np_.asarray(jax.device_get(arr))
                     for name, arr in outs.items()
                 }
                 valid = host.pop("__take_valid__")
+                mask_cols = {
+                    name[len("__mask__"):]: host.pop(name)[valid]
+                    for name in list(host)
+                    if name.startswith("__mask__")
+                }
                 pdf = pd.DataFrame({k2: v2[valid] for k2, v2 in host.items()})
+                for c, m in mask_cols.items():
+                    pdf[c] = pdf[c].mask(m)
+                # decode codes/epochs so host sorting and output use VALUES
+                pdf = self._decode_partial_keys(jdf, pdf, {})
                 pdf = pdf.sort_values(
                     [c for c, _ in sort_items],
                     ascending=[a for _, a in sort_items],
@@ -1627,7 +1673,20 @@ class JaxExecutionEngine(ExecutionEngine):
         value_arrs = {}
         for src in {s for _, _, s in plan["aggs"]}:
             arr = jdf.device_cols[src]
-            if src in plan["masked_srcs"]:
+            if src in plan["dict_srcs"]:
+                # sorted-dict codes → NaN-null float view (−1 code = NULL)
+                cache_key = ("codeview", jdf.mesh)
+                if cache_key not in self._jit_cache:
+                    import jax
+                    import jax.numpy as jnp
+
+                    self._jit_cache[cache_key] = jax.jit(
+                        lambda a: jnp.where(
+                            a < 0, jnp.nan, a.astype(jnp.float64)
+                        )
+                    )
+                arr = self._jit_cache[cache_key](arr)
+            elif src in plan["masked_srcs"]:
                 # nullable int/bool value → float64 view with NaN as NULL
                 # (exact: 64-bit ints with nulls were rejected in the plan)
                 cache_key = ("nullview", jdf.mesh)
@@ -1650,7 +1709,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     name,
                     agg,
                     value_arrs[src],
-                    jdf.maybe_nan(src) or src in plan["masked_srcs"],
+                    jdf.maybe_nan(src)
+                    or src in plan["masked_srcs"]
+                    or src in plan["dict_srcs"],
                 )
                 for name, agg, src in plan["aggs"]
             ],
@@ -1697,6 +1758,7 @@ def _plan_device_agg(
     aggs: List[Any] = []
     post: List[dict] = []
     masked_srcs: set = set()
+    dict_srcs: set = set()
     fields: List[pa.Field] = [jdf.schema[k] for k in keys]
     for c in agg_cols:
         if not isinstance(c, _FuncExpr) or not c.is_agg or c.is_distinct:
@@ -1704,8 +1766,21 @@ def _plan_device_agg(
         if len(c.args) != 1 or not isinstance(c.args[0], _NamedColumnExpr):
             return None
         src = c.args[0].name
-        if src not in jdf.device_cols or src in jdf.encodings:
-            return None  # dict/datetime values don't reduce on device yet
+        func = c.func.upper()
+        if src not in jdf.device_cols:
+            return None
+        enc = jdf.encodings.get(src)
+        if enc is not None:
+            # sorted-dictionary strings: code order == value order, so
+            # MIN/MAX/COUNT reduce over codes (as NaN-null float views) and
+            # the min/max code decodes back to its string
+            if not (
+                enc["kind"] == "dict"
+                and enc.get("sorted")
+                and func in ("MIN", "MAX", "COUNT")
+            ):
+                return None
+            dict_srcs.add(src)
         if src in jdf.null_masks:
             import numpy as np_
 
@@ -1713,12 +1788,25 @@ def _plan_device_agg(
             if dt.kind in ("i", "u") and dt.itemsize >= 8:
                 return None  # 64-bit ints with NULLs lose exactness as f64
             masked_srcs.add(src)
-        func = c.func.upper()
         name = c.output_name
         if name == "":
             return None
         tp = c.infer_type(jdf.schema)
-        if func in ("SUM", "MIN", "MAX"):
+        if src in dict_srcs and func in ("MIN", "MAX"):
+            dictionary = enc["dictionary"]
+
+            def _decode(m: Any, _n: str = name, _d: Any = dictionary) -> Any:
+                codes = m[_n]
+                na = codes.isna()
+                arr = pa.array(
+                    codes.fillna(0).to_numpy().astype(np.int64),
+                    mask=na.to_numpy() if na.any() else None,
+                )
+                return _d.take(arr).to_pandas()
+
+            aggs.append((name, func.lower(), src))
+            post.append({"name": name, "fn": _decode})
+        elif func in ("SUM", "MIN", "MAX"):
             aggs.append((name, func.lower(), src))
             post.append({"name": name, "fn": (lambda m, _n=name: m[_n])})
         elif func == "COUNT":
@@ -1741,6 +1829,7 @@ def _plan_device_agg(
         "post": post,
         "schema": Schema(fields),
         "masked_srcs": masked_srcs,
+        "dict_srcs": dict_srcs,
     }
 
 

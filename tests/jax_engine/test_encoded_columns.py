@@ -272,3 +272,77 @@ class TestDatetimePredicates:
             oracle.to_df(pdf), col("t") > "2020-03-01"
         ).as_pandas()
         assert got.as_pandas()["v"].tolist() == exp["v"].tolist()
+
+
+class TestSortedDictionaryOps:
+    def test_string_min_max_aggregate_on_device(self, engine, oracle):
+        rng = np.random.default_rng(3)
+        pdf = pd.DataFrame(
+            {
+                "k": rng.integers(0, 5, 200),
+                "s": rng.choice(["pear", "apple", "zebra", "fig"], 200).tolist(),
+            }
+        )
+        pdf.loc[rng.integers(0, 200, 20), "s"] = None
+        spec = PartitionSpec(by=["k"])
+        aggs = [
+            f.min(col("s")).alias("lo"),
+            f.max(col("s")).alias("hi"),
+            f.count(col("s")).alias("n"),
+        ]
+        jdf = engine.to_df(pdf)
+        assert "s" in jdf.device_cols  # device path precondition
+        got = (
+            engine.aggregate(jdf, spec, aggs)
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        exp = (
+            oracle.aggregate(oracle.to_df(pdf), spec, aggs)
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_take_with_string_presort(self, engine):
+        pdf = pd.DataFrame(
+            {
+                "s": ["pear", "apple", None, "zebra", "fig"],
+                "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+            }
+        )
+        jdf = engine.to_df(pdf)
+        res = engine.take(jdf, 2, presort="s")
+        assert res.as_array() == [["apple", 2.0], ["fig", 5.0]]
+        res2 = engine.take(jdf, 2, presort="s desc")
+        assert res2.as_array() == [["zebra", 4.0], ["pear", 1.0]]
+        # NULLs fill the tail
+        res3 = engine.take(jdf, 5, presort="s")
+        assert res3.as_array()[-1][0] is None
+
+    def test_take_with_nullable_int_presort(self, engine):
+        pdf = pd.DataFrame(
+            {
+                "a": pd.array([3, None, 1, 2], dtype="Int32"),
+                "v": [1.0, 2.0, 3.0, 4.0],
+            }
+        )
+        res = engine.take(engine.to_df(pdf), 3, presort="a")
+        assert [r[0] for r in res.as_array()] == [1, 2, 3]
+        res2 = engine.take(engine.to_df(pdf), 4, presort="a desc")
+        assert [r[0] for r in res2.as_array()] == [3, 2, 1, None]
+
+    def test_take_with_datetime_presort(self, engine):
+        pdf = pd.DataFrame(
+            {
+                "t": pd.to_datetime(["2021-01-01", "2019-06-01", None, "2020-01-01"]),
+                "v": [1.0, 2.0, 3.0, 4.0],
+            }
+        )
+        res = engine.take(engine.to_df(pdf), 2, presort="t")
+        assert [str(r[0])[:10] for r in res.as_array()] == [
+            "2019-06-01",
+            "2020-01-01",
+        ]
