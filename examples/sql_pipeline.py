@@ -1,0 +1,55 @@
+"""FugueSQL pipeline showing round-2 capabilities:
+
+- mixed-engine scripts (CONNECT runs one statement on another engine),
+- window frames (ROWS/RANGE, SQL-standard RANGE-with-peers default),
+- string/nullable columns staying device-resident on the jax engine.
+
+Run: python examples/sql_pipeline.py   (uses the 8-device CPU mesh when no
+TPU is reachable; same code drives a real TPU mesh unchanged)
+"""
+
+import os
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+try:  # fall back to the virtual CPU mesh when no TPU is attached
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as np
+import pandas as pd
+
+from fugue_tpu.sql import fugue_sql
+
+rng = np.random.default_rng(0)
+orders = pd.DataFrame(
+    {
+        "region": rng.choice(["north", "south", "east", None], 10_000).tolist(),
+        "day": rng.integers(1, 31, 10_000),
+        "amount": rng.random(10_000) * 100,
+    }
+)
+
+result = fugue_sql(
+    """
+    -- groupby with a transformed, unprojected key on the DEVICE engine
+    daily = CONNECT jax SELECT region, day, SUM(amount) AS total
+            FROM orders WHERE region IS NOT NULL GROUP BY region, day
+
+    -- running totals per region: SQL-standard RANGE frame with peers
+    SELECT region, day, total,
+           SUM(total) OVER (PARTITION BY region ORDER BY day) AS running,
+           AVG(total) OVER (PARTITION BY region ORDER BY day
+                            ROWS BETWEEN 6 PRECEDING AND CURRENT ROW) AS avg7d
+    FROM daily
+    ORDER BY region, day
+    """
+)
+
+print(result.head(10).to_string(index=False))
