@@ -1018,17 +1018,123 @@ class JaxExecutionEngine(ExecutionEngine):
         return self.to_df(ArrowDataFrame(tbl))
 
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
-        res = self._back(
+        """Device union: per-shard concatenation of both frames' blocks in
+        one ``shard_map`` (schemas must match; plain/NaN-float columns only
+        — encodings would need dictionary unification of the data itself).
+        ``distinct=True`` runs the device distinct on the result."""
+        j1, j2 = self.to_df(df1), self.to_df(df2)
+        if (
+            isinstance(j1, JaxDataFrame)
+            and isinstance(j2, JaxDataFrame)
+            and j1.schema == j2.schema
+            and j1.host_table is None
+            and j2.host_table is None
+            and not j1.has_encoded
+            and not j2.has_encoded
+            and len(j1.device_cols) > 0
+            and all(
+                j1.device_cols[c].dtype == j2.device_cols[c].dtype
+                for c in j1.schema.names
+            )
+        ):
+            import jax
+            from jax.sharding import PartitionSpec as JP
+
+            mesh = self._mesh
+            cache_key = (
+                "union",
+                mesh,
+                tuple(j1.schema.names),
+                tuple(str(j1.device_cols[c].dtype) for c in j1.schema.names),
+                next(iter(j1.device_cols.values())).shape[0],
+                next(iter(j2.device_cols.values())).shape[0],
+            )
+            if cache_key not in self._jit_cache:
+
+                def compute(c1: Dict[str, Any], v1: Any, c2: Dict[str, Any], v2: Any):
+                    import jax.numpy as jnp
+
+                    def shard_fn(a: Dict[str, Any], va: Any, b: Dict[str, Any], vb: Any):
+                        out = {
+                            n: jnp.concatenate([a[n], b[n]]) for n in a
+                        }
+                        out["__valid__"] = jnp.concatenate([va, vb])
+                        return out
+
+                    return jax.shard_map(
+                        shard_fn,
+                        mesh=mesh,
+                        in_specs=(JP(ROW_AXIS),) * 4,
+                        out_specs=JP(ROW_AXIS),
+                    )(c1, v1, c2, v2)
+
+                self._jit_cache[cache_key] = jax.jit(compute)
+            out = self._jit_cache[cache_key](
+                dict(j1.device_cols),
+                j1.device_valid_mask(),
+                dict(j2.device_cols),
+                j2.device_valid_mask(),
+            )
+            valid = out.pop("__valid__")
+            res: DataFrame = JaxDataFrame(
+                mesh=mesh,
+                _internal=dict(
+                    device_cols=out,
+                    host_tbl=None,
+                    row_count=-1,
+                    valid_mask=valid,
+                    nan_cols=(
+                        None
+                        if j1._nan_cols is None or j2._nan_cols is None
+                        else j1._nan_cols | j2._nan_cols
+                    ),
+                    schema=j1.schema,
+                ),
+            )
+            return self.distinct(res) if distinct else res
+        return self._back(
             self._host_engine.union(self._host(df1), self._host(df2), distinct=distinct)
         )
-        return res
+
+    def _setop_device_ok(self, df: Any) -> bool:
+        """Set-difference semantics treat NULL = NULL; the join kernels
+        treat NULL keys as never-matching — so the device path requires
+        provably NULL-free plain frames."""
+        j = self.to_df(df)
+        return (
+            isinstance(j, JaxDataFrame)
+            and j.host_table is None
+            and not j.has_encoded
+            and j._nan_cols is not None
+            and len(j._nan_cols) == 0
+            and len(j.device_cols) > 0
+        )
 
     def subtract(self, df1, df2, distinct: bool = True) -> DataFrame:
+        """``distinct=True`` lowers to a device ANTI join of the two
+        distinct frames on ALL columns (the deduped right side satisfies
+        the unique-key requirement)."""
+        if distinct and self._setop_device_ok(df1) and self._setop_device_ok(df2):
+            d1, d2 = self.distinct(df1), self.distinct(df2)
+            res = self._join_device(
+                d1, d2, "anti", on=list(self.to_df(df1).schema.names)
+            )
+            if res is not None:
+                return res
         return self._back(
             self._host_engine.subtract(self._host(df1), self._host(df2), distinct=distinct)
         )
 
     def intersect(self, df1, df2, distinct: bool = True) -> DataFrame:
+        """``distinct=True`` lowers to a device SEMI join of the two
+        distinct frames on ALL columns."""
+        if distinct and self._setop_device_ok(df1) and self._setop_device_ok(df2):
+            d1, d2 = self.distinct(df1), self.distinct(df2)
+            res = self._join_device(
+                d1, d2, "semi", on=list(self.to_df(df1).schema.names)
+            )
+            if res is not None:
+                return res
         return self._back(
             self._host_engine.intersect(self._host(df1), self._host(df2), distinct=distinct)
         )
@@ -1036,17 +1142,44 @@ class JaxExecutionEngine(ExecutionEngine):
     def _group_key_cols(self, jdf: JaxDataFrame, names: List[str]) -> Any:
         """(key_cols_for_kernel, mask_col_names) — nullable columns add
         their null mask as an extra key so NULL forms its own group distinct
-        from the fill value."""
+        from the fill value. Maybe-NaN float keys canonicalize to (0, isnan)
+        the same way: NaN != NaN would otherwise split every NULL key into
+        its own group, diverging from the oracle's dropna=False grouping."""
         key_cols: Dict[str, Any] = {}
         mask_names: Dict[str, str] = {}
+
+        def _mangled(c: str) -> str:
+            mn = f"__null__{c}"
+            while mn in jdf.schema:
+                mn = "_" + mn
+            return mn
+
         for c in names:
-            key_cols[c] = jdf.device_cols[c]
+            arr = jdf.device_cols[c]
             if c in jdf.null_masks:
-                mn = f"__null__{c}"
-                while mn in jdf.schema:
-                    mn = "_" + mn
+                key_cols[c] = arr
+                mn = _mangled(c)
                 key_cols[mn] = jdf.null_masks[c]
                 mask_names[c] = mn
+            elif np.issubdtype(np.dtype(arr.dtype), np.floating) and jdf.maybe_nan(c):
+                import jax
+                import jax.numpy as jnp
+
+                ck = ("nankey", self._mesh)
+                if ck not in self._jit_cache:
+                    self._jit_cache[ck] = jax.jit(
+                        lambda a: (
+                            jnp.where(jnp.isnan(a), jnp.zeros_like(a), a),
+                            jnp.isnan(a),
+                        )
+                    )
+                canon, isnan = self._jit_cache[ck](arr)
+                key_cols[c] = canon
+                mn = _mangled(c)
+                key_cols[mn] = isnan
+                mask_names[c] = mn
+            else:
+                key_cols[c] = arr
         return key_cols, mask_names
 
     def _decode_partial_keys(

@@ -254,3 +254,95 @@ class TestDeviceTake:
         f = eng.filter(eng.to_df(pdf), col("a") < 10)  # only low shards valid
         res = eng.take(f, 8, presort="a desc")
         assert [r[0] for r in res.as_array()] == list(range(9, 1, -1))
+
+
+class TestDeviceSetOps:
+    @pytest.fixture(scope="class")
+    def eng(self):
+        from fugue_tpu.jax import JaxExecutionEngine
+
+        e = JaxExecutionEngine()
+        yield e
+        e.stop()
+
+    @pytest.fixture(scope="class")
+    def oracle(self):
+        from fugue_tpu.execution import NativeExecutionEngine
+
+        e = NativeExecutionEngine()
+        yield e
+        e.stop()
+
+    def _cmp(self, eng, oracle, op, a, b, **kw):
+        got = getattr(eng, op)(eng.to_df(a), eng.to_df(b), **kw).as_pandas()
+        exp = getattr(oracle, op)(
+            oracle.to_df(a), oracle.to_df(b), **kw
+        ).as_pandas()
+        cols = list(got.columns)
+        pd.testing.assert_frame_equal(
+            got.sort_values(cols).reset_index(drop=True),
+            exp.sort_values(cols).reset_index(drop=True),
+            check_dtype=False,
+        )
+
+    def test_union_device(self, eng, oracle):
+        rng = np.random.default_rng(0)
+        a = pd.DataFrame({"k": rng.integers(0, 20, 300), "v": rng.integers(0, 3, 300)})
+        b = pd.DataFrame({"k": rng.integers(0, 20, 200), "v": rng.integers(0, 3, 200)})
+        self._cmp(eng, oracle, "union", a, b, distinct=True)
+        self._cmp(eng, oracle, "union", a, b, distinct=False)
+        got = eng.union(eng.to_df(a), eng.to_df(b), distinct=False)
+        assert isinstance(got, JaxDataFrame) and got.count() == 500
+
+    def test_union_after_filter(self, eng, oracle):
+        a = pd.DataFrame({"x": np.arange(100, dtype=np.int64)})
+        b = pd.DataFrame({"x": np.arange(50, 150, dtype=np.int64)})
+        fa_ = eng.filter(eng.to_df(a), col("x") < 30)
+        fb = eng.filter(eng.to_df(b), col("x") >= 120)
+        got = eng.union(fa_, fb, distinct=False).as_pandas()
+        assert sorted(got["x"]) == list(range(30)) + list(range(120, 150))
+
+    def test_subtract_intersect_device(self, eng, oracle):
+        rng = np.random.default_rng(1)
+        a = pd.DataFrame({"k": rng.integers(0, 15, 200), "v": rng.integers(0, 2, 200)})
+        b = pd.DataFrame({"k": rng.integers(0, 15, 150), "v": rng.integers(0, 2, 150)})
+        self._cmp(eng, oracle, "subtract", a, b, distinct=True)
+        self._cmp(eng, oracle, "intersect", a, b, distinct=True)
+        got = eng.subtract(eng.to_df(a), eng.to_df(b))
+        assert isinstance(got, JaxDataFrame) and got.host_table is None
+
+    def test_distinct_nan_keys_group_once(self, eng, oracle):
+        import pyarrow as pa
+
+        tbl = pa.table(
+            {"v": pa.array([1.0, float("nan"), float("nan"), 1.0], pa.float64())}
+        )
+        got = eng.distinct(eng.to_df(tbl)).as_pandas()
+        # oracle semantics: NaN/NULL is one distinct value
+        assert len(got) == 2
+        assert got["v"].isna().sum() == 1
+
+    def test_groupby_nan_float_key(self, eng, oracle):
+        import pyarrow as pa
+
+        from fugue_tpu.collections import PartitionSpec
+        from fugue_tpu.column import functions as ff
+
+        tbl = pa.table(
+            {
+                "k": pa.array([1.0, float("nan"), float("nan")], pa.float64()),
+                "v": pa.array([1.0, 2.0, 3.0], pa.float64()),
+            }
+        )
+        got = (
+            eng.aggregate(
+                eng.to_df(tbl),
+                PartitionSpec(by=["k"]),
+                [ff.sum(col("v")).alias("s")],
+            )
+            .as_pandas()
+            .sort_values("k", na_position="last")
+            .reset_index(drop=True)
+        )
+        assert got["s"].tolist() == [1.0, 5.0]  # one NULL group
+        assert got["k"].isna().tolist() == [False, True]
