@@ -158,6 +158,7 @@ class SQLExecutor:
 
     def _exec_select(self, node: SelectNode) -> DataFrame:
         e = self._engine
+        node = self._substitute_subqueries(node)
         if node.child is None:
             # SELECT <literals> with no FROM → one constant row
             row: List[Any] = []
@@ -207,6 +208,115 @@ class SQLExecutor:
                 # GROUP BY keys, then project/filter over the O(groups) result
                 return self._exec_decoupled_groupby(node, child, gb_names)
         return e.select(child, cols, where=node.where, having=node.having)
+
+    def _substitute_subqueries(self, node: SelectNode) -> SelectNode:
+        """Evaluate uncorrelated subqueries and substitute their results:
+        scalar subqueries become literals, ``IN (SELECT ...)`` becomes a
+        plain IN over the subquery's first column. Correlated references
+        surface as unknown-table/column errors."""
+        import dataclasses
+
+        from ..column.expressions import (
+            _BinaryOpExpr,
+            _CaseWhenExpr,
+            _FuncExpr,
+            _InExpr,
+            _LikeExpr,
+            _LitColumnExpr,
+            _UnaryOpExpr,
+        )
+        from .parser import _SubqueryInExpr, _SubqueryScalarExpr
+
+        found = [False]
+
+        def _run(plan: PlanNode) -> pd.DataFrame:
+            return (
+                SQLExecutor(self._engine, self._dfs)
+                .run(plan)
+                .as_pandas()
+            )
+
+        def sub(e: Any) -> Any:
+            if e is None:
+                return None
+            if isinstance(e, _SubqueryScalarExpr):
+                found[0] = True
+                res = _run(e.plan)
+                if len(res.columns) != 1 or len(res) > 1:
+                    raise FugueSQLRuntimeError(
+                        "scalar subquery must return one column and at most "
+                        f"one row; got {res.shape}"
+                    )
+                v = None if len(res) == 0 else res.iloc[0, 0]
+                v = None if pd.isna(v) else (v.item() if hasattr(v, "item") else v)
+                out: Any = _LitColumnExpr(v)
+            elif isinstance(e, _SubqueryInExpr):
+                found[0] = True
+                res = _run(e.plan)
+                if len(res.columns) != 1:
+                    raise FugueSQLRuntimeError(
+                        "IN subquery must return exactly one column"
+                    )
+                vals = [
+                    x.item() if hasattr(x, "item") else x
+                    for x in res.iloc[:, 0].dropna().tolist()
+                ]
+                out = _InExpr(sub(e.col), vals, e.positive)
+            elif isinstance(e, _BinaryOpExpr):
+                l, r = sub(e.left), sub(e.right)
+                if l is e.left and r is e.right:
+                    return e  # unchanged — keep subclass identity/aliases
+                out = _BinaryOpExpr(e.op, l, r)
+            elif isinstance(e, _UnaryOpExpr):
+                c = sub(e.col)
+                if c is e.col:
+                    return e
+                out = _UnaryOpExpr(e.op, c)
+            elif isinstance(e, _FuncExpr):
+                args = [sub(a) for a in e.args]
+                if all(a is b for a, b in zip(args, e.args)):
+                    return e
+                out = _FuncExpr(
+                    e.func, *args, arg_distinct=e.is_distinct, is_agg=e.is_agg
+                )
+            elif isinstance(e, _InExpr):
+                c = sub(e.col)
+                if c is e.col:
+                    return e
+                out = _InExpr(c, e.values, e.positive)
+            elif isinstance(e, _LikeExpr):
+                c = sub(e.col)
+                if c is e.col:
+                    return e
+                out = _LikeExpr(c, e.pattern, e.positive)
+            elif isinstance(e, _CaseWhenExpr):
+                cases = [(sub(c), sub(v)) for c, v in e.cases]
+                default = sub(e.default)
+                if default is e.default and all(
+                    c is c0 and v is v0
+                    for (c, v), (c0, v0) in zip(cases, e.cases)
+                ):
+                    return e
+                out = _CaseWhenExpr(cases, default)
+            else:
+                return e
+            if e.as_name != "":
+                out = out.alias(e.as_name)
+            if e.as_type is not None:
+                out = out.cast(e.as_type)
+            return out
+
+        new_projections = [sub(c) for c in node.projections]
+        new_where = sub(node.where)
+        new_having = sub(node.having)
+        if not found[0]:
+            return node
+        return dataclasses.replace(
+            node,
+            projections=new_projections,
+            where=new_where,
+            having=new_having,
+        )
 
     def _exec_decoupled_groupby(
         self, node: SelectNode, child: DataFrame, gb_names: List[str]

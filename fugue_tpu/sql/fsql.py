@@ -649,6 +649,15 @@ class FugueSQLCompiler:
         plan = SQLParser(text).parse_full()
         names: List[str] = []
 
+        def walk_expr(e: Any) -> None:
+            # subquery expressions reference tables of their own
+            from .parser import _SubqueryInExpr, _SubqueryScalarExpr
+
+            if isinstance(e, (_SubqueryScalarExpr, _SubqueryInExpr)):
+                walk(e.plan)
+            for c in getattr(e, "children", []):
+                walk_expr(c)
+
         def walk(n: PlanNode) -> None:
             if isinstance(n, ScanNode):
                 if n.name not in names:
@@ -666,6 +675,12 @@ class FugueSQLCompiler:
             elif isinstance(n, SelectNode):
                 if n.child is not None:
                     walk(n.child)
+                for c in n.projections:
+                    walk_expr(c)
+                if n.where is not None:
+                    walk_expr(n.where)
+                if n.having is not None:
+                    walk_expr(n.having)
 
         walk(plan)
         if len(names) == 0:

@@ -211,6 +211,45 @@ class JoinNode(PlanNode):
     condition: Optional[ColumnExpr] = None
 
 
+class _SubqueryScalarExpr(ColumnExpr):
+    """``(SELECT ...)`` used as a scalar value inside an expression.
+
+    The executor evaluates the (uncorrelated) subplan and substitutes the
+    single-cell result as a literal before the outer select runs.
+    """
+
+    def __init__(self, plan: "PlanNode"):
+        super().__init__()
+        self.plan = plan
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["subquery_scalar", repr(self.plan)]
+
+    def __repr__(self) -> str:
+        return f"(SELECT ...{type(self.plan).__name__})"
+
+
+class _SubqueryInExpr(ColumnExpr):
+    """``expr [NOT] IN (SELECT ...)`` — the executor evaluates the subplan
+    and substitutes a plain IN over its first column's values."""
+
+    def __init__(self, expr: Any, plan: "PlanNode", positive: bool = True):
+        super().__init__()
+        self.col = expr
+        self.plan = plan
+        self.positive = positive
+
+    @property
+    def children(self) -> List[ColumnExpr]:
+        return [self.col]
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["subquery_in", self.positive, repr(self.plan)]
+
+    def __repr__(self) -> str:
+        return f"{self.col!r} IN (SELECT ...)"
+
+
 @dataclass
 class SelectNode(PlanNode):
     child: Optional[PlanNode]
@@ -562,6 +601,12 @@ class SQLParser:
                 positive = not self.eat_kw("NOT")
                 self.expect_kw("IN")
                 self.expect_punct("(")
+                if self.at_kw("SELECT"):
+                    plan = self._parse_query_body()
+                    plan = self._maybe_order_limit(plan)
+                    self.expect_punct(")")
+                    left = _SubqueryInExpr(left, plan, positive)
+                    continue
                 values: List[Any] = []
                 while True:
                     values.append(self._parse_literal_value())
@@ -658,6 +703,11 @@ class SQLParser:
             return lit(v)
         if t.kind == "PUNCT" and t.value == "(":
             self.next()
+            if self.at_kw("SELECT"):  # scalar subquery
+                plan = self._parse_query_body()
+                plan = self._maybe_order_limit(plan)
+                self.expect_punct(")")
+                return _SubqueryScalarExpr(plan)
             e = self._parse_expr()
             self.expect_punct(")")
             return e

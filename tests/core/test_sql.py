@@ -670,3 +670,39 @@ class TestWindowFrameEdges:
             "SELECT k, COUNT(v) AS n FROM t GROUP BY k HAVING COUNT(v) IN (2)"
         )
         assert r.values.tolist() == [[1, 2]]
+
+
+class TestSubqueries:
+    def test_scalar_subquery_in_where(self):
+        t = pd.DataFrame({"k": [1, 2, 3, 4], "v": [10.0, 20.0, 30.0, 40.0]})
+        r = fugue_sql(
+            "SELECT k FROM t WHERE v > (SELECT AVG(v) FROM t) ORDER BY k"
+        )
+        assert r["k"].tolist() == [3, 4]
+
+    def test_scalar_subquery_in_projection(self):
+        t = pd.DataFrame({"v": [1.0, 2.0, 3.0]})
+        r = fugue_sql("SELECT v, (SELECT MAX(v) FROM t) AS mx FROM t")
+        assert r["mx"].tolist() == [3.0, 3.0, 3.0]
+
+    def test_scalar_subquery_no_from(self):
+        t = pd.DataFrame({"v": [5.0, 7.0]})
+        r = fugue_sql("SELECT (SELECT SUM(v) FROM t) AS s", as_fugue=True)
+        assert r.as_array() == [[12.0]]
+
+    def test_in_subquery(self):
+        t = pd.DataFrame({"k": [1, 2, 3, 4]})
+        good = pd.DataFrame({"k": [2, 4, 9]})
+        r = fugue_sql(
+            "SELECT k FROM t WHERE k IN (SELECT k FROM good) ORDER BY k"
+        )
+        assert r["k"].tolist() == [2, 4]
+        r2 = fugue_sql(
+            "SELECT k FROM t WHERE k NOT IN (SELECT k FROM good) ORDER BY k"
+        )
+        assert r2["k"].tolist() == [1, 3]
+
+    def test_scalar_subquery_multirow_raises(self):
+        t = pd.DataFrame({"v": [1.0, 2.0]})
+        with pytest.raises(Exception, match="one row|one column"):
+            fugue_sql("SELECT (SELECT v FROM t) AS s")
