@@ -1019,41 +1019,103 @@ class JaxExecutionEngine(ExecutionEngine):
 
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
         """Device union: per-shard concatenation of both frames' blocks in
-        one ``shard_map`` (schemas must match; plain/NaN-float columns only
-        — encodings would need dictionary unification of the data itself).
-        ``distinct=True`` runs the device distinct on the result."""
+        one ``shard_map``. Dictionary columns unify into one (re-sorted)
+        union dictionary with both sides' codes remapped; null masks
+        concatenate with their columns; epoch datetimes concatenate when
+        the arrow types agree. ``distinct=True`` runs the device distinct
+        on the result."""
         j1, j2 = self.to_df(df1), self.to_df(df2)
-        if (
+        compatible = (
             isinstance(j1, JaxDataFrame)
             and isinstance(j2, JaxDataFrame)
             and j1.schema == j2.schema
             and j1.host_table is None
             and j2.host_table is None
-            and not j1.has_encoded
-            and not j2.has_encoded
             and len(j1.device_cols) > 0
             and all(
                 j1.device_cols[c].dtype == j2.device_cols[c].dtype
                 for c in j1.schema.names
             )
-        ):
+            # per-column encodings must agree in KIND (schema equality
+            # already forces matching arrow types, incl. timestamp units)
+            and all(
+                j1.encodings.get(c, {}).get("kind")
+                == j2.encodings.get(c, {}).get("kind")
+                for c in j1.schema.names
+            )
+        )
+        if compatible:
             import jax
+            import jax.numpy as jnp
             from jax.sharding import PartitionSpec as JP
 
             mesh = self._mesh
+            # unify dictionary columns: sorted union dictionary + remapped
+            # codes on both sides (NULL code −1 is preserved by the remap)
+            cols1, cols2 = dict(j1.device_cols), dict(j2.device_cols)
+            encodings: Dict[str, Any] = {}
+            for c in j1.schema.names:
+                enc1, enc2 = j1.encodings.get(c), j2.encodings.get(c)
+                if enc1 is None:
+                    continue
+                if enc1["kind"] == "datetime":
+                    encodings[c] = enc1
+                    continue
+                union_dict = pa.concat_arrays(
+                    [enc1["dictionary"], enc2["dictionary"]]
+                ).unique()
+                order = np.asarray(
+                    pa.compute.sort_indices(union_dict).to_numpy(
+                        zero_copy_only=False
+                    )
+                )
+                union_dict = union_dict.take(pa.array(order))
+                ck = ("zipremap", mesh)
+                if ck not in self._jit_cache:
+                    self._jit_cache[ck] = jax.jit(
+                        lambda cd, t: jnp.where(
+                            cd < 0,
+                            jnp.int32(-1),
+                            t[jnp.clip(cd, 0, t.shape[0] - 1)],
+                        )
+                    )
+                for cols, enc in ((cols1, enc1), (cols2, enc2)):
+                    mapped = np.asarray(
+                        pa.compute.index_in(
+                            enc["dictionary"], value_set=union_dict
+                        ).to_numpy(zero_copy_only=False)
+                    )
+                    if mapped.size == 0:
+                        mapped = np.asarray([-1])
+                    cols[c] = self._jit_cache[ck](
+                        cols[c], jnp.asarray(mapped.astype(np.int32))
+                    )
+                encodings[c] = {
+                    "kind": "dict",
+                    "dictionary": union_dict,
+                    "type": enc1["type"],
+                    "sorted": True,
+                }
+            # null masks travel with their columns through the concat
+            for c, m in j1.null_masks.items():
+                cols1[f"__mask__{c}"] = m
+                cols2[f"__mask__{c}"] = j2.null_masks[c]
+            for c, m in j2.null_masks.items():
+                if f"__mask__{c}" not in cols1:
+                    cols1[f"__mask__{c}"] = self._false_mask_like(j1)
+                    cols2[f"__mask__{c}"] = m
+            mask_names = [n for n in cols1 if n.startswith("__mask__")]
             cache_key = (
                 "union",
                 mesh,
-                tuple(j1.schema.names),
-                tuple(str(j1.device_cols[c].dtype) for c in j1.schema.names),
-                next(iter(j1.device_cols.values())).shape[0],
-                next(iter(j2.device_cols.values())).shape[0],
+                tuple(sorted(cols1)),
+                tuple(str(cols1[c].dtype) for c in sorted(cols1)),
+                next(iter(cols1.values())).shape[0],
+                next(iter(cols2.values())).shape[0],
             )
             if cache_key not in self._jit_cache:
 
                 def compute(c1: Dict[str, Any], v1: Any, c2: Dict[str, Any], v2: Any):
-                    import jax.numpy as jnp
-
                     def shard_fn(a: Dict[str, Any], va: Any, b: Dict[str, Any], vb: Any):
                         out = {
                             n: jnp.concatenate([a[n], b[n]]) for n in a
@@ -1070,12 +1132,15 @@ class JaxExecutionEngine(ExecutionEngine):
 
                 self._jit_cache[cache_key] = jax.jit(compute)
             out = self._jit_cache[cache_key](
-                dict(j1.device_cols),
+                cols1,
                 j1.device_valid_mask(),
-                dict(j2.device_cols),
+                cols2,
                 j2.device_valid_mask(),
             )
             valid = out.pop("__valid__")
+            null_masks = {
+                n[len("__mask__"):]: out.pop(n) for n in mask_names
+            }
             res: DataFrame = JaxDataFrame(
                 mesh=mesh,
                 _internal=dict(
@@ -1088,6 +1153,8 @@ class JaxExecutionEngine(ExecutionEngine):
                         if j1._nan_cols is None or j2._nan_cols is None
                         else j1._nan_cols | j2._nan_cols
                     ),
+                    encodings=encodings,
+                    null_masks=null_masks,
                     schema=j1.schema,
                 ),
             )
@@ -1095,6 +1162,19 @@ class JaxExecutionEngine(ExecutionEngine):
         return self._back(
             self._host_engine.union(self._host(df1), self._host(df2), distinct=distinct)
         )
+
+    def _false_mask_like(self, jdf: JaxDataFrame) -> Any:
+        """An all-False device bool array row-aligned with the frame."""
+        import jax
+        import jax.numpy as jnp
+
+        ck = ("falsemask", self._mesh)
+        if ck not in self._jit_cache:
+            self._jit_cache[ck] = jax.jit(
+                lambda t: jnp.zeros(t.shape[0], dtype=bool),
+                out_shardings=row_sharding(self._mesh),
+            )
+        return self._jit_cache[ck](next(iter(jdf.device_cols.values())))
 
     def _setop_device_ok(self, df: Any) -> bool:
         """Set-difference semantics treat NULL = NULL; the join kernels

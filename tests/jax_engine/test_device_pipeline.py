@@ -346,3 +346,63 @@ class TestDeviceSetOps:
         )
         assert got["s"].tolist() == [1.0, 5.0]  # one NULL group
         assert got["k"].isna().tolist() == [False, True]
+
+
+class TestEncodedUnion:
+    @pytest.fixture(scope="class")
+    def eng(self):
+        from fugue_tpu.jax import JaxExecutionEngine
+
+        e = JaxExecutionEngine()
+        yield e
+        e.stop()
+
+    @pytest.fixture(scope="class")
+    def oracle(self):
+        from fugue_tpu.execution import NativeExecutionEngine
+
+        e = NativeExecutionEngine()
+        yield e
+        e.stop()
+
+    def test_union_string_columns_on_device(self, eng, oracle):
+        a = pd.DataFrame({"s": ["x", "y", None], "v": [1.0, 2.0, 3.0]})
+        b = pd.DataFrame({"s": ["y", "z", None], "v": [2.0, 4.0, 3.0]})
+        got = eng.union(eng.to_df(a), eng.to_df(b), distinct=True)
+        assert isinstance(got, JaxDataFrame) and got.host_table is None
+        g = got.as_pandas()
+        e = oracle.union(
+            oracle.to_df(a), oracle.to_df(b), distinct=True
+        ).as_pandas()
+        key = lambda d: d.sort_values(  # noqa: E731
+            ["s", "v"], na_position="last"
+        ).reset_index(drop=True)
+        pd.testing.assert_frame_equal(key(g), key(e), check_dtype=False)
+        # union dictionary is sorted → downstream string sorts still work
+        assert got.encodings["s"].get("sorted") is True
+        res = eng.take(got, 2, presort="s")
+        assert [r[0] for r in res.as_array()] == ["x", "y"]
+
+    def test_union_nullable_and_datetime(self, eng, oracle):
+        a = pd.DataFrame(
+            {
+                "n": pd.array([1, None], dtype="Int32"),
+                "t": pd.to_datetime(["2020-01-01", "2020-02-01"]),
+            }
+        )
+        b = pd.DataFrame(
+            {
+                "n": pd.array([None, 3], dtype="Int32"),
+                "t": pd.to_datetime(["2020-02-01", None]),
+            }
+        )
+        got = eng.union(eng.to_df(a), eng.to_df(b), distinct=False)
+        assert isinstance(got, JaxDataFrame)
+        g = got.as_pandas()
+        e = oracle.union(
+            oracle.to_df(a), oracle.to_df(b), distinct=False
+        ).as_pandas()
+        key = lambda d: d.sort_values(  # noqa: E731
+            ["n", "t"], na_position="last"
+        ).reset_index(drop=True)
+        pd.testing.assert_frame_equal(key(g), key(e), check_dtype=False)
