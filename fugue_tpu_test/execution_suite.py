@@ -220,6 +220,42 @@ class ExecutionEngineTests:
                 throw=True,
             )
 
+        def test_set_ops_with_nulls(self):
+            # set-op semantics treat NULL = NULL (unlike join matching)
+            df1 = self.df(
+                [[1, "x"], [None, "y"], [None, "y"], [2, None]],
+                "a:double,b:str",
+            )
+            df2 = self.df([[None, "y"], [2, None]], "a:double,b:str")
+            assert _df_eq(
+                self.engine.union(df1, df2),
+                [[1, "x"], [None, "y"], [2, None]],
+                "a:double,b:str",
+                throw=True,
+            )
+            assert _df_eq(
+                self.engine.subtract(df1, df2),
+                [[1, "x"]],
+                "a:double,b:str",
+                throw=True,
+            )
+            assert _df_eq(
+                self.engine.intersect(df1, df2),
+                [[None, "y"], [2, None]],
+                "a:double,b:str",
+                throw=True,
+            )
+
+        def test_subtract_intersect(self):
+            df1 = self.df([[1], [2], [2], [3]], "a:long")
+            df2 = self.df([[2], [4]], "a:long")
+            assert _df_eq(
+                self.engine.subtract(df1, df2), [[1], [3]], "a:long", throw=True
+            )
+            assert _df_eq(
+                self.engine.intersect(df1, df2), [[2]], "a:long", throw=True
+            )
+
         def test_subtract(self):
             df1 = self.df([[1], [2], [2]], "a:long")
             df2 = self.df([[2]], "a:long")
