@@ -1096,14 +1096,15 @@ class JaxExecutionEngine(ExecutionEngine):
                     "type": enc1["type"],
                     "sorted": True,
                 }
-            # null masks travel with their columns through the concat
-            for c, m in j1.null_masks.items():
-                cols1[f"__mask__{c}"] = m
-                cols2[f"__mask__{c}"] = j2.null_masks[c]
-            for c, m in j2.null_masks.items():
-                if f"__mask__{c}" not in cols1:
-                    cols1[f"__mask__{c}"] = self._false_mask_like(j1)
-                    cols2[f"__mask__{c}"] = m
+            # null masks travel with their columns through the concat; a
+            # side without a mask for the column contributes all-False
+            for c in set(j1.null_masks) | set(j2.null_masks):
+                cols1[f"__mask__{c}"] = j1.null_masks.get(
+                    c, self._false_mask_like(j1)
+                )
+                cols2[f"__mask__{c}"] = j2.null_masks.get(
+                    c, self._false_mask_like(j2)
+                )
             mask_names = [n for n in cols1 if n.startswith("__mask__")]
             cache_key = (
                 "union",

@@ -406,3 +406,27 @@ class TestEncodedUnion:
             ["n", "t"], na_position="last"
         ).reset_index(drop=True)
         pd.testing.assert_frame_equal(key(g), key(e), check_dtype=False)
+
+
+def test_union_one_sided_null_mask():
+    """Union when only one side carries a null mask for a column."""
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    eng = JaxExecutionEngine()
+    oracle = NativeExecutionEngine()
+    try:
+        a = pd.DataFrame({"n": pd.array([1, None, 2], dtype="Int32")})
+        b = pd.DataFrame({"n": pd.array([3, 4], dtype="Int32")})  # no nulls
+        for d1, d2 in [(a, b), (b, a)]:
+            got = eng.union(eng.to_df(d1), eng.to_df(d2), distinct=False)
+            assert isinstance(got, JaxDataFrame)
+            g = got.as_pandas()["n"]
+            e = oracle.union(
+                oracle.to_df(d1), oracle.to_df(d2), distinct=False
+            ).as_pandas()["n"]
+            assert sorted(g.dropna()) == sorted(e.dropna())
+            assert g.isna().sum() == e.isna().sum() == 1
+    finally:
+        eng.stop()
+        oracle.stop()
