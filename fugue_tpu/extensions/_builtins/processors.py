@@ -229,12 +229,22 @@ class RunSQLSelect(Processor):
                 make_sql_engine,
             )
 
+            from ...exceptions import FuguePluginsRegistrationError
+
             kw = dict(self.params.get("sql_engine_params", dict()))
             try:
                 sql_engine = make_sql_engine(spec, engine, **kw)
-            except Exception:
+            except FuguePluginsRegistrationError:
+                # not a registered SQL engine — treat the spec as an
+                # execution-engine name and run on its SQL facet; the
+                # temporary engine stops once the result is detached
                 other = make_execution_engine(spec, conf=engine.conf, **kw)
-                sql_engine = other.sql_engine
+                try:
+                    res = other.sql_engine.select(dfs, statement)
+                    return engine.to_df(res.as_local_bounded())
+                finally:
+                    if other is not engine and not other.in_context:
+                        other.stop()
         return sql_engine.select(dfs, statement)
 
 

@@ -56,6 +56,16 @@ from ..schema import Schema
 from .dataframe import JaxDataFrame, _DEVICE_DTYPES
 
 
+def _safe_prefix(base: str, *name_sets: Any) -> str:
+    """Internal payload-column prefix guaranteed not to shadow a user column
+    (a user column may literally be named ``__mask__x``): prepend ``_`` until
+    no provided name starts with the prefix."""
+    p = base
+    while any(any(str(n).startswith(p) for n in ns) for ns in name_sets):
+        p = "_" + p
+    return p
+
+
 class JaxMapEngine(MapEngine):
     @property
     def is_distributed(self) -> bool:
@@ -335,15 +345,16 @@ class JaxExecutionEngine(ExecutionEngine):
             valid,
         )
         # null masks are row-aligned — they travel with their columns
+        mp = _safe_prefix("__mask__", jdf.schema.names)
         payload = dict(jdf.device_cols)
         for c, m in jdf.null_masks.items():
-            payload[f"__mask__{c}"] = m
+            payload[f"{mp}{c}"] = m
         new_payload, new_valid, _ = exchange_rows(
             self._mesh, payload, valid, dest
         )
         new_cols = {c: new_payload[c] for c in jdf.device_cols}
         new_masks = {
-            c: new_payload[f"__mask__{c}"] for c in jdf.null_masks
+            c: new_payload[f"{mp}{c}"] for c in jdf.null_masks
         }
         return JaxDataFrame(
             mesh=self._mesh,
@@ -365,12 +376,16 @@ class JaxExecutionEngine(ExecutionEngine):
         jdf = self.to_df(df)
         rep = replicated_sharding(self._mesh)
         cols = {k: jax.device_put(v, rep) for k, v in jdf.device_cols.items()}
+        # a filtered frame carries an explicit hole-y valid mask; it must
+        # travel with the rows or broadcasting silently re-validates them
+        vm = jdf.valid_mask
         return JaxDataFrame(
             mesh=self._mesh,
             _internal=dict(
                 device_cols=cols,
                 host_tbl=jdf.host_table,
                 row_count=jdf.count(),
+                valid_mask=None if vm is None else jax.device_put(vm, rep),
                 nan_cols=jdf._nan_cols,
                 encodings=dict(jdf.encodings),
                 null_masks={
@@ -526,6 +541,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 return arr.astype(jnp.float64)
             return self._jit_cache[cache_key](arr, mask)
 
+        kp = _safe_prefix("__key", j1.schema.names)
         left_keys: Dict[str, Any] = {}
         right_keys: List[Any] = []
         for i, k in enumerate(keys):
@@ -562,7 +578,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 lk, rk = la, ra
             else:
                 return None
-            left_keys[f"__key{i}__"] = lk
+            left_keys[f"{kp}{i}__"] = lk
             right_keys.append(rk)
         return left_keys, right_keys
 
@@ -652,6 +668,8 @@ class JaxExecutionEngine(ExecutionEngine):
 
         import jax
 
+        mp = _safe_prefix("__mask__", j1.schema.names, j2.schema.names)
+        lmp = _safe_prefix("__lmask__", j1.schema.names)
         right_entries: List[Any] = []
         out_value_encodings: Dict[str, Any] = {}
         gen_mask_names: List[str] = []  # plain non-floats: mask = ~match
@@ -671,7 +689,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     gen_mask_names.append(v)
             if v in j2.null_masks:
                 right_entries.append(
-                    (f"__mask__{v}", j2.null_masks[v], True)
+                    (f"{mp}{v}", j2.null_masks[v], True)
                 )
         n_right = next(iter(j2.device_cols.values())).shape[0]
         encodings: Dict[str, Any] = {}
@@ -700,7 +718,7 @@ class JaxExecutionEngine(ExecutionEngine):
             left_cols = dict(j1.device_cols)
             # left null masks travel with their rows through the exchange
             for c, m in j1.null_masks.items():
-                left_cols[f"__lmask__{c}"] = m
+                left_cols[f"{lmp}{c}"] = m
             left_cols.update(left_key_arrs)
             left_valid = j1.device_valid_mask()
             right_valid = j2.device_valid_mask()
@@ -726,11 +744,11 @@ class JaxExecutionEngine(ExecutionEngine):
             new_cols.pop(mk, None)
         if strategy == "shuffle":
             for c in list(j1.null_masks):
-                m = new_cols.pop(f"__lmask__{c}", None)
+                m = new_cols.pop(f"{lmp}{c}", None)
                 if m is not None:
                     null_masks[c] = m
         for v in value_names:
-            m = new_cols.pop(f"__mask__{v}", None)
+            m = new_cols.pop(f"{mp}{v}", None)
             if m is not None:
                 null_masks[v] = m
         if kernel_how == "left_outer":
@@ -851,6 +869,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     keys=keys,
                     schemas=[j.schema for j in jdfs],
                     mesh=self._mesh,
+                    presort=dict(spec.presort),
                 )
         return super().zip(
             dfs,
@@ -901,9 +920,10 @@ class JaxExecutionEngine(ExecutionEngine):
             key_arrs.append(arr)
         valid = j.device_valid_mask()
         dest = compute_dest(self._mesh, "hash", key_arrs, valid)
+        mp = _safe_prefix("__mask__", j.schema.names)
         payload = dict(j.device_cols)
         for c, m in j.null_masks.items():
-            payload[f"__mask__{c}"] = m
+            payload[f"{mp}{c}"] = m
         new_payload, new_valid, _ = exchange_rows(
             self._mesh, payload, valid, dest
         )
@@ -917,7 +937,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 nan_cols=j._nan_cols,
                 encodings=dict(j.encodings),
                 null_masks={
-                    c: new_payload[f"__mask__{c}"] for c in j.null_masks
+                    c: new_payload[f"{mp}{c}"] for c in j.null_masks
                 },
                 schema=j.schema,
             ),
@@ -969,7 +989,27 @@ class JaxExecutionEngine(ExecutionEngine):
                     {n: ArrayDataFrame([], s) for n, s in zip(names, schemas)}
                 ),
             )
+        presort = dict(getattr(df, "_zip_presort", {}) or {})
+        # the comap-time spec's presort (e.g. from the cotransformer's own
+        # partition settings) overrides the zip-time one, matching the host
+        # blob protocol where serialization uses the effective spec
+        if len(spec.presort) > 0:
+            presort = dict(spec.presort)
         frames_pd = [f.as_pandas() for f in df.zip_frames]
+        if len(presort) > 0:
+            # na_position="first" matches the host blob protocol's partition
+            # presort (PandasMapEngine) so NULL rows order identically
+            frames_pd = [
+                p.sort_values(
+                    by=[c for c in presort if c in p.columns],
+                    ascending=[v for c, v in presort.items() if c in p.columns],
+                    kind="mergesort",
+                    na_position="first",
+                )
+                if len(p) > 0 and any(c in p.columns for c in presort)
+                else p
+                for p in frames_pd
+            ]
         grouped: List[Dict[Any, pd.DataFrame]] = []
         key_order: List[Any] = []
         seen: set = set()
@@ -1098,14 +1138,16 @@ class JaxExecutionEngine(ExecutionEngine):
                 }
             # null masks travel with their columns through the concat; a
             # side without a mask for the column contributes all-False
+            mp = _safe_prefix("__mask__", j1.schema.names)
+            vp = _safe_prefix("__valid__", cols1.keys())
             for c in set(j1.null_masks) | set(j2.null_masks):
-                cols1[f"__mask__{c}"] = j1.null_masks.get(
+                cols1[f"{mp}{c}"] = j1.null_masks.get(
                     c, self._false_mask_like(j1)
                 )
-                cols2[f"__mask__{c}"] = j2.null_masks.get(
+                cols2[f"{mp}{c}"] = j2.null_masks.get(
                     c, self._false_mask_like(j2)
                 )
-            mask_names = [n for n in cols1 if n.startswith("__mask__")]
+            mask_names = [n for n in cols1 if n.startswith(mp)]
             cache_key = (
                 "union",
                 mesh,
@@ -1121,7 +1163,7 @@ class JaxExecutionEngine(ExecutionEngine):
                         out = {
                             n: jnp.concatenate([a[n], b[n]]) for n in a
                         }
-                        out["__valid__"] = jnp.concatenate([va, vb])
+                        out[vp] = jnp.concatenate([va, vb])
                         return out
 
                     return jax.shard_map(
@@ -1138,9 +1180,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 cols2,
                 j2.device_valid_mask(),
             )
-            valid = out.pop("__valid__")
+            valid = out.pop(vp)
             null_masks = {
-                n[len("__mask__"):]: out.pop(n) for n in mask_names
+                n[len(mp):]: out.pop(n) for n in mask_names
             }
             res: DataFrame = JaxDataFrame(
                 mesh=mesh,
@@ -1592,6 +1634,8 @@ class JaxExecutionEngine(ExecutionEngine):
             )
             if k > 0:
                 mesh = jdf.mesh  # bind locally: the closure must not pin jdf
+                mp = _safe_prefix("__mask__", jdf.schema.names)
+                tvp = _safe_prefix("__take_valid__", jdf.schema.names)
                 cache_key = (
                     "take",
                     tuple(sort_items),
@@ -1637,8 +1681,8 @@ class JaxExecutionEngine(ExecutionEngine):
                             perm = sorted_ops[-1][:k]
                             out = {name: arr[perm] for name, arr in c.items()}
                             for name, arr in m.items():
-                                out[f"__mask__{name}"] = arr[perm]
-                            out["__take_valid__"] = v[perm]
+                                out[f"{mp}{name}"] = arr[perm]
+                            out[tvp] = v[perm]
                             return out
 
                         return jax.shard_map(
@@ -1658,11 +1702,11 @@ class JaxExecutionEngine(ExecutionEngine):
                     name: np_.asarray(jax.device_get(arr))
                     for name, arr in outs.items()
                 }
-                valid = host.pop("__take_valid__")
+                valid = host.pop(tvp)
                 mask_cols = {
-                    name[len("__mask__"):]: host.pop(name)[valid]
+                    name[len(mp):]: host.pop(name)[valid]
                     for name in list(host)
-                    if name.startswith("__mask__")
+                    if name.startswith(mp)
                 }
                 pdf = pd.DataFrame({k2: v2[valid] for k2, v2 in host.items()})
                 for c, m in mask_cols.items():

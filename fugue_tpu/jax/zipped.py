@@ -39,6 +39,7 @@ class ZippedJaxDataFrame(JaxDataFrame):
         keys: List[str],
         schemas: List[Schema],
         mesh: Any,
+        presort: Optional[Dict[str, bool]] = None,
     ):
         key_schema = schemas[0].extract(keys)
         blob_fields = ",".join(
@@ -65,6 +66,10 @@ class ZippedJaxDataFrame(JaxDataFrame):
         self._zip_how = how
         self._zip_keys = keys
         self._zip_schemas = schemas
+        # zip-time presort: the host blob protocol sorts each partition
+        # before serializing, so cotransformers see ordered rows — the
+        # device path must replay that ordering per key group in comap
+        self._zip_presort: Dict[str, bool] = dict(presort or {})
         self._mat: Optional[LocalBoundedDataFrame] = None
         self.reset_metadata(
             {
@@ -105,7 +110,9 @@ class ZippedJaxDataFrame(JaxDataFrame):
             res = e.zip(
                 dfs,
                 how=self._zip_how,
-                partition_spec=PartitionSpec(by=self._zip_keys)
+                partition_spec=PartitionSpec(
+                    by=self._zip_keys, presort=self._zip_presort
+                )
                 if len(self._zip_keys) > 0
                 else None,
             )

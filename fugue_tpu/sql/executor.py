@@ -257,11 +257,24 @@ class SQLExecutor:
                     raise FugueSQLRuntimeError(
                         "IN subquery must return exactly one column"
                     )
+                col_res = res.iloc[:, 0]
+                has_null = bool(col_res.isna().any())
                 vals = [
                     x.item() if hasattr(x, "item") else x
-                    for x in res.iloc[:, 0].dropna().tolist()
+                    for x in col_res.dropna().tolist()
                 ]
-                out = _InExpr(sub(e.col), vals, e.positive)
+                if has_null:
+                    # SQL three-valued logic: a NULL in the IN-set means a
+                    # non-matching row compares NULL, never TRUE/FALSE —
+                    #   x IN (..., NULL)     → TRUE on match, else NULL
+                    #   x NOT IN (..., NULL) → FALSE on match, else NULL
+                    match = _InExpr(sub(e.col), vals, True)
+                    out = _CaseWhenExpr(
+                        [(match, _LitColumnExpr(e.positive))],
+                        _LitColumnExpr(None),
+                    )
+                else:
+                    out = _InExpr(sub(e.col), vals, e.positive)
             elif isinstance(e, _BinaryOpExpr):
                 l, r = sub(e.left), sub(e.right)
                 if l is e.left and r is e.right:

@@ -1,0 +1,174 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md):
+
+1. device zip/comap honors partition_spec.presort
+2. broadcast() preserves an explicit valid mask (filtered frames)
+3. NOT IN (SELECT ...) follows SQL three-valued logic when the subquery
+   result contains NULLs
+4. internal payload names (__mask__*, __key*, ...) never shadow user columns
+5. CONNECT engine fallback surfaces real errors and stops the temp engine
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.dataframe import DataFrames, PandasDataFrame
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.dataframe import JaxDataFrame
+from fugue_tpu.jax.zipped import ZippedJaxDataFrame
+
+
+def _pd(res):
+    return res.to_pandas() if hasattr(res, "to_pandas") else res
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+def test_comap_presort_device_path(engine):
+    # values arrive deliberately unsorted within each key
+    a = pd.DataFrame(
+        {"k": [1, 1, 1, 2, 2], "v": [3.0, 1.0, 2.0, 9.0, 5.0]}
+    )
+    b = pd.DataFrame({"k": [1, 2], "w": [10.0, 20.0]})
+    z = engine.zip(
+        DataFrames([engine.to_df(a), engine.to_df(b)]),
+        partition_spec=PartitionSpec(by=["k"], presort="v desc"),
+    )
+    assert isinstance(z, ZippedJaxDataFrame)  # device path, not blobs
+    seen = {}
+
+    def first_v(cursor, dfs):
+        d1 = dfs[0].as_pandas()
+        k = int(d1["k"].iloc[0])
+        seen[k] = d1["v"].tolist()
+        return PandasDataFrame(
+            pd.DataFrame({"k": [k], "first_v": [d1["v"].iloc[0]]}),
+            "k:long,first_v:double",
+        )
+
+    res = engine.comap(z, first_v, "k:long,first_v:double").as_pandas()
+    assert seen[1] == [3.0, 2.0, 1.0]  # presort applied inside each group
+    assert seen[2] == [9.0, 5.0]
+    assert dict(zip(res["k"], res["first_v"])) == {1: 3.0, 2: 9.0}
+
+
+def test_broadcast_preserves_filter_mask(engine):
+    df = engine.to_df(pd.DataFrame({"a": [1, 2, 3, 4, 5, 6, 7, 8]}))
+    from fugue_tpu.column import col, lit
+
+    flt = engine.filter(df, col("a") > lit(4))
+    assert isinstance(flt, JaxDataFrame)
+    assert flt.valid_mask is not None  # hole-y mask, not tail padding
+    b = engine.broadcast(flt)
+    assert sorted(b.as_pandas()["a"].tolist()) == [5, 6, 7, 8]
+    assert b.count() == 4
+
+
+def test_not_in_subquery_with_nulls(engine):
+    left = pd.DataFrame({"a": [1, 2, 3]})
+    right = pd.DataFrame({"b": [2.0, None]})
+    for eng in [NativeExecutionEngine(), engine]:
+        res = fa.fugue_sql(
+            """
+            SELECT * FROM df WHERE a NOT IN (SELECT b FROM other)
+            """,
+            df=left,
+            other=right,
+            engine=eng,
+            as_local=True,
+        )
+        # NULL in the set -> NOT IN is never TRUE
+        assert len(_pd(res)) == 0, f"{type(eng).__name__}: {res}"
+        res2 = fa.fugue_sql(
+            "SELECT * FROM df WHERE a IN (SELECT b FROM other)",
+            df=left,
+            other=right,
+            engine=eng,
+            as_local=True,
+        )
+        assert _pd(res2)["a"].tolist() == [2]
+
+
+def test_reserved_payload_name_collision(engine):
+    # a user column literally named __mask__x next to a nullable column x
+    pdf = pd.DataFrame(
+        {
+            "x": pd.array([1, None, 3, 4], dtype="Int64"),
+            "__mask__x": [10, 20, 30, 40],
+        }
+    )
+    jdf = engine.to_df(PandasDataFrame(pdf, "x:long,__mask__x:long"))
+    out = engine.repartition(
+        jdf, PartitionSpec(algo="hash", by=["__mask__x"], num=4)
+    ).as_pandas()
+    out = out.sort_values("__mask__x").reset_index(drop=True)
+    assert out["__mask__x"].tolist() == [10, 20, 30, 40]
+    assert out["x"].isna().tolist() == [False, True, False, False]
+
+    # union with the same adversarial name
+    u = engine.union(jdf, jdf, distinct=False).as_pandas()
+    assert len(u) == 8
+    assert u["x"].isna().sum() == 2
+
+    # take with presort on the nullable column
+    t = engine.take(jdf, 2, presort="x asc").as_pandas()
+    assert t["x"].tolist()[0] == 1
+
+
+def test_join_key_name_collision(engine):
+    left = pd.DataFrame({"__key0__": [1, 2, 3], "k": [1, 2, 3]})
+    right = pd.DataFrame({"k": [2, 3], "w": [20.0, 30.0]})
+    res = (
+        engine.join(engine.to_df(left), engine.to_df(right), how="inner", on=["k"])
+        .as_pandas()
+        .sort_values("k")
+    )
+    assert res["__key0__"].tolist() == [2, 3]
+    assert res["w"].tolist() == [20.0, 30.0]
+
+
+def test_connect_bad_engine_raises(engine):
+    from fugue_tpu.exceptions import FuguePluginsRegistrationError
+
+    with pytest.raises(Exception) as ei:
+        fa.fugue_sql(
+            """
+            CONNECT not_a_real_engine SELECT * FROM df
+            """,
+            df=pd.DataFrame({"a": [1]}),
+            engine=engine,
+            as_local=True,
+        )
+    # the real registration error surfaces, not a masked fallback failure
+    assert "not_a_real_engine" in str(ei.value)
+
+
+def test_connect_fallback_engine_stops(engine):
+    import fugue_tpu.execution.factory as factory
+
+    stopped = []
+
+    class _TrackEngine(NativeExecutionEngine):
+        def stop_engine(self) -> None:
+            stopped.append(True)
+            super().stop_engine()
+
+    factory.register_execution_engine(
+        "tracknative", lambda conf, **kw: _TrackEngine(conf)
+    )
+    res = fa.fugue_sql(
+        "CONNECT tracknative SELECT a+1 AS b FROM df",
+        df=pd.DataFrame({"a": [1, 2]}),
+        engine=engine,
+        as_local=True,
+    )
+    assert _pd(res)["b"].tolist() == [2, 3]
+    assert len(stopped) == 1
