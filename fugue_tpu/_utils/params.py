@@ -110,6 +110,9 @@ class IndexedOrderedDict(Dict[Any, Any]):
     def _pre_update(self) -> None:
         if self.readonly:
             raise InvalidOperationError("dict is readonly")
+        # mutation counter — lets subclasses cache derived views (e.g.
+        # Schema.pa_schema) and invalidate on any write
+        self._version = getattr(self, "_version", 0) + 1
 
     def __setitem__(self, key: Any, value: Any) -> None:
         self._pre_update()

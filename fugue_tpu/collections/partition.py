@@ -280,16 +280,28 @@ class DatasetPartitionCursor:
     def __init__(self, physical_no: int):
         self._physical_no = physical_no
         self._item: Any = None
+        self._item_factory: Any = None
         self._partition_no = 0
         self._slice_no = 0
 
     def set(self, item: Any, partition_no: int, slice_no: int) -> None:
-        self._item = item() if callable(item) else item
+        # a callable item resolves lazily on first access: most transformers
+        # never read the cursor row, and eager peeking costs a per-partition
+        # row materialization in the map hot loop
+        if callable(item):
+            self._item_factory = item
+            self._item = None
+        else:
+            self._item_factory = None
+            self._item = item
         self._partition_no = partition_no
         self._slice_no = slice_no
 
     @property
     def item(self) -> Any:
+        if self._item is None and self._item_factory is not None:
+            self._item = self._item_factory()
+            self._item_factory = None
         return self._item
 
     @property

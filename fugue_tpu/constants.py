@@ -36,6 +36,11 @@ FUGUE_TPU_CONF_MAX_PARTIAL_ROWS = "fugue.tpu.max_partial_rows"
 # debug: cross-check compiled shard_map transformers against the masked
 # reference on shard 0 (catches UDFs ignoring the __valid__ contract)
 FUGUE_TPU_CONF_VALIDATE_COMPILED = "fugue.tpu.validate_compiled"
+# fork-pool size for the general (host pandas) UDF map path; -1 = auto
+# (the engine's get_current_parallelism), 0/1 = serial
+FUGUE_TPU_CONF_MAP_PARALLELISM = "fugue.tpu.map.parallelism"
+# frames below this row count always map serially (pool setup ~100ms)
+FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS = "fugue.tpu.map.parallel_min_rows"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

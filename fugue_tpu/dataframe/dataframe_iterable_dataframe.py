@@ -75,9 +75,24 @@ class LocalDataFrameIterableDataFrame(LocalUnboundedDataFrame):
         return self._native.peek().peek_array()
 
     def as_local_bounded(self) -> LocalBoundedDataFrame:
-        tables = [f.as_arrow() for f in self._native if f.count() > 0]
-        if len(tables) == 0:
+        chunks = [f for f in self._native if f.count() > 0]
+        if len(chunks) == 0:
             return ArrowDataFrame(None, self.schema)
+        # all-pandas chunks with identical schemas concat natively — the
+        # per-chunk pandas→arrow conversion is the map loop's single
+        # largest assembly cost
+        if all(
+            isinstance(f, PandasDataFrame) and f.schema == self.schema
+            for f in chunks
+        ):
+            import pandas as pd
+
+            return PandasDataFrame(
+                pd.concat([f.native for f in chunks], ignore_index=True),
+                self.schema,
+                pandas_df_wrapper=True,
+            )
+        tables = [f.as_arrow() for f in chunks]
         target = self.schema.pa_schema
         tables = [t if t.schema == target else t.cast(target) for t in tables]
         return ArrowDataFrame(pa.concat_tables(tables))

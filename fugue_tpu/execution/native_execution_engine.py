@@ -39,20 +39,60 @@ from .execution_engine import ExecutionEngine, MapEngine, SQLEngine
 
 
 class PandasMapEngine(MapEngine):
-    """Sort + groupby-apply map engine (reference ``:81-169``).
+    """Sort + groupby-apply map engine (reference ``:81-169``) with a
+    fork-pool parallel path over logical partitions.
 
     ``parallelism_engine`` supplies CONCURRENCY for partition-number
-    expressions — distributed engines delegating their general map path
-    here pass themselves so num="CONCURRENCY" reflects the real mesh.
+    expressions AND sizes the process pool — distributed engines delegating
+    their general map path here pass themselves so both reflect the real
+    mesh (the reference's cluster engines run transformers concurrently
+    across workers; see ``parallel_map``).
     """
 
     def __init__(self, execution_engine: Any, parallelism_engine: Any = None):
         super().__init__(execution_engine)
         self._parallelism_engine = parallelism_engine or execution_engine
 
+    def _pool_workers(self, map_func: Callable, n_rows: int, n_parts: int) -> int:
+        """Process-pool size for this map call; ≤1 = run serial."""
+        from ..constants import (
+            FUGUE_TPU_CONF_MAP_PARALLELISM,
+            FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS,
+        )
+        from .parallel_map import fork_available, map_func_parallel_safe
+
+        conf = self.execution_engine.conf
+        workers = int(conf.get(FUGUE_TPU_CONF_MAP_PARALLELISM, -1))
+        if workers < 0:
+            # auto: the pool runs HOST-side pandas — cap the mesh-derived
+            # parallelism by the actual host core count (a 1-core host with
+            # an 8-device virtual mesh gains nothing from 8 forked workers)
+            import os
+
+            workers = min(
+                int(self._parallelism_engine.get_current_parallelism()),
+                os.cpu_count() or 1,
+            )
+        min_rows = int(conf.get(FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS, 100_000))
+        if (
+            workers <= 1
+            or n_parts <= 1
+            or n_rows < min_rows
+            or not fork_available()
+            or not map_func_parallel_safe(map_func)
+        ):
+            return 1
+        return workers
+
     @property
     def is_distributed(self) -> bool:
         return False
+
+    @property
+    def map_handles_repartition(self) -> bool:
+        """Logical grouping happens inside map_dataframe — no physical
+        exchange needed before a map (see RunTransformer)."""
+        return True
 
     @property
     def execution_engine_constraint(self) -> type:
@@ -99,10 +139,19 @@ class PandasMapEngine(MapEngine):
             # no keys but an explicit partition count (e.g. per_row =
             # num:ROWCOUNT): split into even contiguous chunks (empty input
             # returned above, so every chunk is non-empty)
-            chunks = np.array_split(np.arange(len(pdf)), min(num, len(pdf)))
+            n_chunks = min(num, len(pdf))
+            bounds = np.linspace(0, len(pdf), n_chunks + 1).astype(np.int64)
+            groups: List[Any] = [
+                slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])
+            ]
+            workers = self._pool_workers(map_func, len(pdf), len(groups))
+            if workers > 1:
+                return self._run_forked(
+                    pdf, schema, groups, map_func, cursor, output_schema, workers
+                )
             results: List[LocalDataFrame] = []
-            for no, idx in enumerate(chunks):
-                sub = pdf.iloc[idx].reset_index(drop=True)
+            for no, sl in enumerate(groups):
+                sub = pdf.iloc[sl].reset_index(drop=True)
                 part = PandasDataFrame(sub, schema, pandas_df_wrapper=True)
                 cursor.set(lambda p=part: p.peek_array(), no, 0)
                 results.append(map_func(cursor, part).as_local_bounded())
@@ -110,25 +159,75 @@ class PandasMapEngine(MapEngine):
                 LocalDataFrameIterableDataFrame(iter(results), output_schema),
                 output_schema,
             )
-        results: List[LocalDataFrame] = []
-        no = [0]
-
-        def _run_group(sub: pd.DataFrame) -> None:
-            part = PandasDataFrame(
-                sub.reset_index(drop=True), schema, pandas_df_wrapper=True
-            )
-            cursor.set(lambda: part.peek_array(), no[0], 0)
-            no[0] += 1
-            res = map_func(cursor, part)
-            results.append(res.as_local_bounded())
-
-        for _, sub in pdf.groupby(keys, dropna=False, sort=False):
-            _run_group(sub)
-        if len(results) == 0:
+        # positional row selections per logical partition, in first-appearance
+        # group order — computed WITHOUT materializing subframes so the
+        # parallel path forks before any per-group copying happens
+        gid = pdf.groupby(keys, dropna=False, sort=False).ngroup().to_numpy()
+        if len(gid) > 0 and gid.min() < 0:  # defensive: shouldn't happen w/ dropna=False
+            gid = np.where(gid < 0, gid.max() + 1, gid)
+        order = np.argsort(gid, kind="stable")
+        counts = np.bincount(gid, minlength=gid.max() + 1 if len(gid) else 0)
+        groups = [
+            a for a in np.split(order, np.cumsum(counts)[:-1]) if len(a) > 0
+        ]
+        if len(groups) == 0:
             return PandasDataFrame(None, output_schema)
+        workers = self._pool_workers(map_func, len(pdf), len(groups))
+        if workers > 1:
+            return self._run_forked(
+                pdf, schema, groups, map_func, cursor, output_schema, workers
+            )
+        results: List[LocalDataFrame] = []
+        for no, idx in enumerate(groups):
+            part = PandasDataFrame(
+                pdf.take(idx).reset_index(drop=True), schema, pandas_df_wrapper=True
+            )
+            cursor.set(lambda p=part: p.peek_array(), no, 0)
+            results.append(map_func(cursor, part).as_local_bounded())
         return _to_output(
             LocalDataFrameIterableDataFrame(iter(results), output_schema), output_schema
         )
+
+    def _run_forked(
+        self,
+        pdf: pd.DataFrame,
+        schema: Schema,
+        groups: List[Any],
+        map_func: Callable,
+        cursor: PartitionCursor,
+        output_schema: Schema,
+        workers: int,
+    ) -> DataFrame:
+        from .parallel_map import run_partitions_forked
+
+        tables = run_partitions_forked(
+            pdf,
+            schema,
+            groups,
+            map_func,
+            cursor,
+            output_schema,
+            workers,
+            wrap_df=_wrap_pandas_part,
+            to_arrow=_result_to_arrow,
+        )
+        tables = [t for t in tables if t.num_rows > 0]
+        if len(tables) == 0:
+            return PandasDataFrame(None, output_schema)
+        import pyarrow as pa
+
+        target = output_schema.pa_schema
+        tables = [t if t.schema == target else t.cast(target) for t in tables]
+        return ArrowDataFrame(pa.concat_tables(tables), output_schema)
+
+
+def _wrap_pandas_part(sub: pd.DataFrame, schema: Schema) -> PandasDataFrame:
+    return PandasDataFrame(sub, schema, pandas_df_wrapper=True)
+
+
+def _result_to_arrow(res: DataFrame, output_schema: Schema) -> Any:
+    local = _to_output(res, output_schema)
+    return local.as_arrow()
 
 
 def _to_output(out: DataFrame, output_schema: Schema) -> LocalBoundedDataFrame:

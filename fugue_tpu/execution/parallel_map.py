@@ -1,0 +1,176 @@
+"""Process-pool execution of per-partition UDFs.
+
+The reference runs transformers concurrently across cluster workers (Spark
+``mapInPandas`` over executors, ``fugue_spark/execution_engine.py:237-330``;
+Dask ``map_partitions``, ``fugue_dask/execution_engine.py:93-183``). The
+TPU-native equivalent for the HOST side of the map path is a fork-based
+process pool over logical partitions: pandas UDFs hold the GIL, so threads
+don't help, while ``fork`` gives every worker copy-on-write access to the
+parent's already-materialized pandas frame — no input serialization at all.
+Only the (usually much smaller) per-partition outputs cross back, as arrow
+tables.
+
+Partitions are split into more chunks than workers (dynamic balancing for
+skewed group sizes), each chunk a contiguous partition range so global
+partition numbering is preserved.
+
+Not engaged when:
+- the platform has no ``fork`` (non-Linux/macOS spawn semantics),
+- the transformer carries a worker→driver RPC callback (the in-process
+  ``NativeRPCServer`` can't cross a process boundary; such transformers run
+  serially, matching the reference's local engine),
+- the frame is below ``fugue.tpu.map.parallel_min_rows`` (pool setup costs
+  ~100ms — tiny frames are faster serial).
+"""
+
+import multiprocessing as mp
+import threading
+import warnings
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+# set in the parent immediately before forking; children inherit the memory
+# image, so the frame and the (arbitrary, unpicklable) UDF need no transport.
+# the lock spans set-state → fork → drain: concurrent map calls (workflow
+# concurrency > 1) must not clobber each other's state mid-fork
+_FORK_STATE: dict = {}
+_FORK_LOCK = threading.Lock()
+
+
+def fork_available() -> bool:
+    try:
+        return "fork" in mp.get_all_start_methods()
+    except Exception:
+        return False
+
+
+def map_func_parallel_safe(map_func: Callable) -> bool:
+    """True when the UDF can run in a forked worker.
+
+    A transformer holding an in-process RPC callback must stay in the
+    driver process: a forked child would invoke its own copy of the handler
+    and the driver would never see the calls.
+    """
+    runner = getattr(map_func, "__self__", None)
+    tf = getattr(runner, "transformer", None)
+    if tf is None:
+        return True
+    return getattr(tf, "_callback", None) is None
+
+
+def split_chunks(sizes: Sequence[int], n_chunks: int) -> List[Any]:
+    """Split partition ids [0..len) into ≤n_chunks contiguous runs balanced
+    by total row count (greedy quantile cuts over the cumulative sizes)."""
+    n = len(sizes)
+    if n == 0:
+        return []
+    n_chunks = max(1, min(n_chunks, n))
+    cum = np.cumsum(np.asarray(sizes, dtype=np.int64))
+    total = int(cum[-1])
+    bounds = [0]
+    for q in range(1, n_chunks):
+        target = total * q // n_chunks
+        pos = int(np.searchsorted(cum, target, side="left")) + 1
+        if pos > bounds[-1] and pos < n:
+            bounds.append(pos)
+    bounds.append(n)
+    return [range(a, b) for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
+
+
+def _run_chunk(part_ids: Any) -> List[bytes]:
+    """Worker body: run the inherited UDF over a contiguous partition range.
+
+    Results serialize as arrow IPC streams — pyarrow tables cross process
+    boundaries far cheaper than pickled pandas frames.
+    """
+    st = _FORK_STATE
+    pdf: pd.DataFrame = st["pdf"]
+    groups: List[Any] = st["groups"]
+    map_func: Callable = st["map_func"]
+    cursor = st["cursor"]
+    schema = st["schema"]
+    output_schema = st["output_schema"]
+    wrap = st["wrap_df"]
+    to_tbl = st["to_arrow"]
+    out: List[bytes] = []
+    for no in part_ids:
+        idx = groups[no]
+        if isinstance(idx, slice):
+            sub = pdf.iloc[idx].reset_index(drop=True)
+        else:
+            sub = pdf.take(idx).reset_index(drop=True)
+        part = wrap(sub, schema)
+        cursor.set(lambda p=part: p.peek_array(), no, 0)
+        res = map_func(cursor, part)
+        tbl = to_tbl(res, output_schema)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        out.append(sink.getvalue().to_pybytes())
+    return out
+
+
+def run_partitions_forked(
+    pdf: pd.DataFrame,
+    schema: Any,
+    groups: List[Any],
+    map_func: Callable,
+    cursor: Any,
+    output_schema: Any,
+    n_workers: int,
+    wrap_df: Callable,
+    to_arrow: Callable,
+) -> List[pa.Table]:
+    """Run ``map_func`` over every logical partition using a fork pool.
+
+    ``groups`` is a list of positional row selections (ndarray or slice),
+    one per logical partition, in partition order. Returns the per-partition
+    arrow tables in the same order.
+    """
+    sizes = [
+        (idx.stop - idx.start) if isinstance(idx, slice) else len(idx)
+        for idx in groups
+    ]
+    chunks = split_chunks(sizes, n_workers * 4)
+    with _FORK_LOCK:
+        _FORK_STATE.clear()
+        _FORK_STATE.update(
+            pdf=pdf,
+            groups=groups,
+            map_func=map_func,
+            cursor=cursor,
+            schema=schema,
+            output_schema=output_schema,
+            wrap_df=wrap_df,
+            to_arrow=to_arrow,
+        )
+        try:
+            import jax
+
+            ctx = mp.get_context("fork")
+            with warnings.catch_warnings():
+                # children never touch JAX (host-only pandas UDFs by the
+                # format-hint gate). On the CPU backend the fork-vs-threads
+                # warning is noise; on an accelerator backend (libtpu holds
+                # runtime threads) keep the warning visible — forking there
+                # is riskier and worth the operator's attention.
+                if jax.default_backend() == "cpu":
+                    warnings.filterwarnings(
+                        "ignore", message=".*fork.*", category=RuntimeWarning
+                    )
+                    warnings.filterwarnings(
+                        "ignore", message=".*fork.*", category=DeprecationWarning
+                    )
+                with ctx.Pool(min(n_workers, len(chunks))) as pool:
+                    chunk_results = pool.map(_run_chunk, chunks, chunksize=1)
+        finally:
+            _FORK_STATE.clear()
+    tables: List[pa.Table] = []
+    for blobs in chunk_results:
+        for blob in blobs:
+            with pa.ipc.open_stream(pa.BufferReader(blob)) as r:
+                tables.append(r.read_all())
+    return tables

@@ -56,7 +56,14 @@ class RunTransformer(Processor):
     ) -> DataFrame:
         engine = self.execution_engine
         spec = self.partition_spec
-        df = engine.repartition(df, spec) if not spec.empty else df
+        # a map engine that groups logically inside map_dataframe (both the
+        # host pandas path and the device segment path) doesn't need a
+        # physical exchange first — mirroring Spark's map engine, which owns
+        # its repartition decisions inside map_dataframe
+        if not spec.empty and not getattr(
+            engine.map_engine, "map_handles_repartition", False
+        ):
+            df = engine.repartition(df, spec)
         validate_input_schema(df.schema, tf.validation_rules)
         schema = Schema(tf.get_output_schema(df))
         tf._output_schema = schema

@@ -198,6 +198,7 @@ class JaxDataFrame(DataFrame):
                 return
             self._device_cols = dict(df._device_cols)
             self._host_tbl = df._host_tbl
+            self._ingest_tbl = getattr(df, "_ingest_tbl", None)
             self._row_count = df._row_count
             self._valid_mask = df._valid_mask
             self._nan_cols = df._nan_cols
@@ -231,6 +232,19 @@ class JaxDataFrame(DataFrame):
 
         self._device_cols = {k: _pad_put(v) for k, v in np_cols.items()}
         self._host_tbl = host_tbl
+        # frames are immutable — the ingestion table stays valid for this
+        # instance's lifetime, so host reads (as_arrow/as_pandas) skip the
+        # device download entirely. EXCEPT when a float column holds literal
+        # NaN values: the device treats NaN as NULL, so the decoded view
+        # (NULL) and the raw ingest table (NaN) would diverge — no cache.
+        cacheable = True
+        for c in meta["nan_cols"]:
+            col = tbl.column(c)
+            literal_nans = pa.compute.sum(pa.compute.is_nan(col)).as_py()
+            if literal_nans:
+                cacheable = False
+                break
+        self._ingest_tbl = tbl if cacheable else None
         self._row_count = n
         # None = tail-padding semantics (rows [0, row_count) valid); a device
         # bool array = explicit per-row validity (result of device filters)
@@ -332,6 +346,9 @@ class JaxDataFrame(DataFrame):
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
         import jax
 
+        src = getattr(self, "_ingest_tbl", None)
+        if src is not None:
+            return src
         mask: Optional[np.ndarray] = None
         if self._valid_mask is not None:
             mask = np.asarray(jax.device_get(self._valid_mask))

@@ -72,6 +72,13 @@ class JaxMapEngine(MapEngine):
         return True
 
     @property
+    def map_handles_repartition(self) -> bool:
+        """Both map paths group internally (host: sort+groupby; compiled:
+        per-shard trace) — a device all-to-all before the map would be paid
+        and then ignored."""
+        return True
+
+    @property
     def execution_engine_constraint(self) -> type:
         return JaxExecutionEngine
 
@@ -95,6 +102,11 @@ class JaxMapEngine(MapEngine):
                 # encoded/masked columns have non-plain semantics the UDF
                 # can't see — host path renders them as real values
                 if isinstance(jdf, JaxDataFrame) and not jdf.has_encoded:
+                    # the compiled path maps shards IN PLACE — an even/rand
+                    # spec still needs its physical exchange first (the
+                    # processor no longer repartitions for this engine)
+                    if not partition_spec.empty:
+                        jdf = engine.repartition(jdf, partition_spec)  # type: ignore[assignment]
                     return self._compiled_map(jdf, raw, output_schema, on_init)
         # general path: host-side partitioned execution, result back on
         # device; CONCURRENCY reflects the mesh, not the host engine

@@ -302,12 +302,32 @@ class Schema(IndexedOrderedDict):
     def types(self) -> List[pa.DataType]:
         return [f.type for f in self.values()]
 
+    def _derived_cache(self, key: str, build: Any) -> Any:
+        """Version-stamped memo for derived views — schema objects are read
+        per logical partition in map loops, so rebuilding these each access
+        is a real hot-loop cost."""
+        v = (getattr(self, "_version", 0), len(self))
+        hit = self.__dict__.get(key)
+        if hit is not None and hit[0] == v:
+            return hit[1]
+        res = build()
+        self.__dict__[key] = (v, res)
+        return res
+
     @property
     def pa_schema(self) -> pa.Schema:
-        return pa.schema(self.fields)
+        return self._derived_cache(
+            "_pa_schema_memo", lambda: pa.schema(self.fields)
+        )
 
     @property
     def pandas_dtype(self) -> Dict[str, Any]:
+        # copy: callers may legitimately mutate the returned mapping
+        return dict(
+            self._derived_cache("_pandas_dtype_memo", self._build_pandas_dtype)
+        )
+
+    def _build_pandas_dtype(self) -> Dict[str, Any]:
         return {
             f.name: pd.api.types.pandas_dtype(f.type.to_pandas_dtype())
             if not pa.types.is_nested(f.type)

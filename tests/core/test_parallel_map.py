@@ -1,0 +1,182 @@
+"""Fork-pool parallel map path (execution/parallel_map.py).
+
+The pool is conf-forced here (this box may have 1 core; the gate normally
+keys off get_current_parallelism and a min-row threshold) — these tests pin
+CORRECTNESS: identical results to the serial path, partition numbering,
+presort, schema enforcement, and the serial fallback for RPC callbacks.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.execution.parallel_map import (
+    map_func_parallel_safe,
+    split_chunks,
+)
+
+PAR_CONF = {
+    "fugue.tpu.map.parallelism": 2,
+    "fugue.tpu.map.parallel_min_rows": 0,
+}
+
+
+def test_split_chunks_balanced():
+    # skewed sizes split into contiguous, row-balanced runs
+    chunks = split_chunks([100, 1, 1, 1, 1, 100], 2)
+    # 102/102 rows — the cut lands mid-list, not at the ends
+    assert [list(c) for c in chunks] == [[0, 1, 2], [3, 4, 5]]
+    assert split_chunks([], 4) == []
+    assert [list(c) for c in split_chunks([5], 4)] == [[0]]
+    # every id appears exactly once, in order
+    chunks = split_chunks(list(np.random.default_rng(0).integers(1, 50, 37)), 8)
+    flat = [i for c in chunks for i in c]
+    assert flat == list(range(37))
+
+
+def _demean(pdf: pd.DataFrame) -> pd.DataFrame:
+    return pdf.assign(d=pdf["v"] - pdf["v"].mean())
+
+
+def test_forked_keyed_map_matches_serial():
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 17, 5000), "v": rng.random(5000)}
+    )
+    serial = fa.transform(
+        df, _demean, schema="k:long,v:double,d:double",
+        partition={"by": ["k"]}, engine="native", as_local=True,
+    )
+    parallel = fa.transform(
+        df, _demean, schema="k:long,v:double,d:double",
+        partition={"by": ["k"]}, engine="native", engine_conf=PAR_CONF,
+        as_local=True,
+    )
+    s = pd.DataFrame(serial).sort_values(["k", "v"]).reset_index(drop=True)
+    p = pd.DataFrame(parallel).sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(s, p)
+
+
+def test_forked_map_presort_and_cursor():
+    df = pd.DataFrame(
+        {"k": [1, 1, 1, 2, 2, 2], "v": [3.0, 1.0, 2.0, 9.0, 7.0, 8.0]}
+    )
+
+    def first_row(pdf: pd.DataFrame) -> pd.DataFrame:
+        return pdf.head(1)
+
+    res = fa.transform(
+        df, first_row, schema="*",
+        partition={"by": ["k"], "presort": "v desc"},
+        engine="native", engine_conf=PAR_CONF, as_local=True,
+    )
+    out = pd.DataFrame(res).sort_values("k")
+    assert out["v"].tolist() == [3.0, 9.0]
+
+
+def test_forked_chunked_map_no_keys():
+    df = pd.DataFrame({"a": range(1000)})
+
+    def tag(pdf: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"n": [len(pdf)]})
+
+    res = fa.transform(
+        df, tag, schema="n:long", partition={"num": 8},
+        engine="native", engine_conf=PAR_CONF, as_local=True,
+    )
+    out = pd.DataFrame(res)
+    assert out["n"].sum() == 1000
+    assert len(out) == 8
+
+
+def test_forked_map_schema_violation_raises():
+    df = pd.DataFrame({"k": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+
+    def bad(pdf: pd.DataFrame) -> pd.DataFrame:
+        return pdf.rename(columns={"v": "w"})
+
+    with pytest.raises(Exception):
+        fa.transform(
+            df, bad, schema="k:long,v:double",
+            partition={"by": ["k"]},
+            engine="native", engine_conf=PAR_CONF, as_local=True,
+        )
+
+
+def test_forked_map_empty_udf_outputs():
+    df = pd.DataFrame({"k": [1, 1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+
+    def keep_big(pdf: pd.DataFrame) -> pd.DataFrame:
+        return pdf[pdf["v"] > 2.5]
+
+    res = fa.transform(
+        df, keep_big, schema="*", partition={"by": ["k"]},
+        engine="native", engine_conf=PAR_CONF, as_local=True,
+    )
+    out = pd.DataFrame(res).sort_values("v")
+    assert out["v"].tolist() == [3.0, 4.0, 5.0]
+
+
+def test_callback_transformer_stays_serial():
+    # an in-process RPC callback can't cross a fork; the gate must detect it
+    class FakeTf:
+        _callback = object()
+
+    class FakeRunner:
+        transformer = FakeTf()
+
+        def run(self, cursor, df):  # pragma: no cover
+            raise AssertionError
+
+    assert not map_func_parallel_safe(FakeRunner().run)
+
+    class NoCbTf:
+        _callback = None
+
+    class NoCbRunner:
+        transformer = NoCbTf()
+
+        def run(self, cursor, df):  # pragma: no cover
+            raise AssertionError
+
+    assert map_func_parallel_safe(NoCbRunner().run)
+    assert map_func_parallel_safe(lambda cursor, df: df)
+
+
+def test_callback_end_to_end_with_parallel_conf():
+    # end-to-end: callbacks still work (serial fallback) under parallel conf
+    collected = []
+
+    def cb(x: str) -> None:
+        collected.append(x)
+
+    def report(pdf: pd.DataFrame, announce: callable) -> pd.DataFrame:
+        announce(f"k={pdf['k'].iloc[0]}")
+        return pdf
+
+    df = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+    fa.out_transform(
+        df, report, partition={"by": ["k"]}, callback=cb,
+        engine="native", engine_conf=PAR_CONF,
+    )
+    assert sorted(collected) == ["k=1", "k=2"]
+
+
+def test_forked_map_on_jax_engine():
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({"k": rng.integers(0, 11, 3000), "v": rng.random(3000)})
+    e = JaxExecutionEngine(conf=PAR_CONF)
+    try:
+        res = fa.transform(
+            df, _demean, schema="k:long,v:double,d:double",
+            partition={"by": ["k"]}, engine=e, as_local=True,
+        )
+        out = pd.DataFrame(res).sort_values(["k", "v"]).reset_index(drop=True)
+        exp = df.assign(d=df["v"] - df.groupby("k")["v"].transform("mean"))
+        exp = exp.sort_values(["k", "v"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(out, exp, check_dtype=False)
+    finally:
+        e.stop()
