@@ -41,6 +41,11 @@ FUGUE_TPU_CONF_VALIDATE_COMPILED = "fugue.tpu.validate_compiled"
 FUGUE_TPU_CONF_MAP_PARALLELISM = "fugue.tpu.map.parallelism"
 # frames below this row count always map serially (pool setup ~100ms)
 FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS = "fugue.tpu.map.parallel_min_rows"
+# max dense segment-id space for the sort-free keyed compiled map plan
+FUGUE_TPU_CONF_DENSE_MAP_RANGE = "fugue.tpu.map.dense_range"
+# keep the ingestion arrow table alive on JaxDataFrames for zero-cost host
+# reads (global conf; ~2x host memory on ingest-heavy pipelines when True)
+FUGUE_TPU_CONF_INGEST_CACHE = "fugue.tpu.ingest_cache"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

@@ -5,7 +5,8 @@ _jax.config.update("jax_enable_x64", True)
 
 from .dataframe import JaxDataFrame
 from .execution_engine import JaxExecutionEngine, JaxMapEngine
+from . import group_ops  # per-group reduction helpers for compiled maps
 from . import params  # registers the Dict[str, jax.Array] annotation
 from . import registry  # registers engine names + inference
 
-__all__ = ["JaxDataFrame", "JaxExecutionEngine", "JaxMapEngine"]
+__all__ = ["JaxDataFrame", "JaxExecutionEngine", "JaxMapEngine", "group_ops"]

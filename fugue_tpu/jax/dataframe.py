@@ -237,13 +237,19 @@ class JaxDataFrame(DataFrame):
         # device download entirely. EXCEPT when a float column holds literal
         # NaN values: the device treats NaN as NULL, so the decoded view
         # (NULL) and the raw ingest table (NaN) would diverge — no cache.
-        cacheable = True
-        for c in meta["nan_cols"]:
-            col = tbl.column(c)
-            literal_nans = pa.compute.sum(pa.compute.is_nan(col)).as_py()
-            if literal_nans:
-                cacheable = False
-                break
+        # The cache pins the host copy for the frame's lifetime (~2x host
+        # memory for ingest-heavy pipelines) — disable it globally with
+        # fugue.tpu.ingest_cache=False when host RAM is the constraint.
+        from ..constants import _FUGUE_GLOBAL_CONF, FUGUE_TPU_CONF_INGEST_CACHE
+
+        cacheable = bool(_FUGUE_GLOBAL_CONF.get(FUGUE_TPU_CONF_INGEST_CACHE, True))
+        if cacheable:
+            for c in meta["nan_cols"]:
+                col = tbl.column(c)
+                literal_nans = pa.compute.sum(pa.compute.is_nan(col)).as_py()
+                if literal_nans:
+                    cacheable = False
+                    break
         self._ingest_tbl = tbl if cacheable else None
         self._row_count = n
         # None = tail-padding semantics (rows [0, row_count) valid); a device

@@ -95,19 +95,38 @@ class JaxMapEngine(MapEngine):
         output_schema = (
             output_schema if isinstance(output_schema, Schema) else Schema(output_schema)
         )
-        if map_func_format_hint == "jax" and len(partition_spec.partition_by) == 0:
+        if map_func_format_hint == "jax":
             raw = _sniff_jax_func(map_func)
             if raw is not None:
                 jdf = engine.to_df(df)
                 # encoded/masked columns have non-plain semantics the UDF
                 # can't see — host path renders them as real values
                 if isinstance(jdf, JaxDataFrame) and not jdf.has_encoded:
-                    # the compiled path maps shards IN PLACE — an even/rand
-                    # spec still needs its physical exchange first (the
-                    # processor no longer repartitions for this engine)
-                    if not partition_spec.empty:
-                        jdf = engine.repartition(jdf, partition_spec)  # type: ignore[assignment]
-                    return self._compiled_map(jdf, raw, output_schema, on_init)
+                    keys = list(partition_spec.partition_by)
+                    if len(keys) == 0:
+                        # the compiled path maps shards IN PLACE — an even/
+                        # rand spec still needs its physical exchange first
+                        # (the processor no longer repartitions for this
+                        # engine)
+                        if not partition_spec.empty:
+                            jdf = engine.repartition(jdf, partition_spec)  # type: ignore[assignment]
+                        return self._compiled_map(jdf, raw, output_schema, on_init)
+                    nan_key = any(
+                        np.issubdtype(
+                            np.dtype(jdf.device_cols[k].dtype), np.floating
+                        )
+                        and jdf.maybe_nan(k)
+                        for k in keys
+                        if k in jdf.device_cols
+                    )
+                    if (
+                        all(k in jdf.device_cols for k in keys)
+                        and not nan_key
+                        and jdf.host_table is None
+                    ):
+                        return self._compiled_keyed_map(
+                            jdf, raw, output_schema, partition_spec, on_init
+                        )
         # general path: host-side partitioned execution, result back on
         # device; CONCURRENCY reflects the mesh, not the host engine
         host_engine = engine._host_engine
@@ -123,6 +142,276 @@ class JaxMapEngine(MapEngine):
             map_func_format_hint=map_func_format_hint,
         )
         return engine.to_df(res)
+
+    def _compiled_keyed_map(
+        self,
+        df: JaxDataFrame,
+        fn: Callable,
+        output_schema: Schema,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable],
+    ) -> DataFrame:
+        """Keyed compiled map: groupby-apply that never leaves the device.
+
+        The device-native answer to the reference's group-map path
+        (``fugue_spark/execution_engine.py:192``): hash-repartition
+        co-locates each key on one shard, ONE ``shard_map`` then sorts the
+        shard by (validity, keys, presort), derives row-aligned contiguous
+        ``__segments__`` ids, and traces the user fn over the sorted
+        columns. The fn computes per-group results with
+        ``jax.ops.segment_sum``-style reductions (``num_segments`` bounded
+        by the static shard size) and returns a row-aligned dict. Padding
+        rows sort to the shard tail, each in its own segment, and stay
+        masked via ``__valid__``.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        engine: JaxExecutionEngine = self.execution_engine  # type: ignore
+        keys = list(partition_spec.partition_by)
+        dense = self._try_dense_keyed_map(
+            df, fn, output_schema, partition_spec, keys, on_init
+        )
+        if dense is not None:
+            return dense
+        jdf: JaxDataFrame = engine.repartition(  # type: ignore[assignment]
+            df, PartitionSpec(partition_spec, algo="hash")
+        )
+        if on_init is not None:
+            on_init(0, jdf)
+        sorts = partition_spec.get_sorts(jdf.schema, with_partition_keys=True)
+        sort_items = tuple(sorts.items())
+        mesh = jdf.mesh
+        cache = engine._jit_cache
+        cache_key = ("kmap", fn, mesh, sort_items, tuple(keys))
+        if cache_key not in cache:
+
+            def compute(cols: Dict[str, Any], valid: Any):
+                def shard_fn(c: Dict[str, Any], v: Any):
+                    # sort keys: valid rows first, then group keys (+presort)
+                    ops: List[Any] = [jnp.logical_not(v)]
+                    for name, asc in sort_items:
+                        key = c[name]
+                        if not asc:
+                            if jnp.issubdtype(key.dtype, jnp.floating):
+                                key = -key
+                            elif key.dtype == jnp.bool_:
+                                key = jnp.logical_not(key)
+                            else:
+                                key = ~key  # monotone reversal
+                        ops.append(key)
+                    names = list(c.keys())
+                    iota = jax.lax.iota(jnp.int32, v.shape[0])
+                    res = jax.lax.sort(
+                        tuple(ops)
+                        + tuple(c[n] for n in names)
+                        + (v, iota),
+                        num_keys=len(ops),
+                    )
+                    payload = res[len(ops):]
+                    sc = dict(zip(names, payload[: len(names)]))
+                    sv = payload[len(names)]
+                    # contiguous segment ids; every padding row becomes its
+                    # own segment so group reductions never mix padding in
+                    change = jnp.logical_not(sv)
+                    for k in keys:
+                        col = sc[k]
+                        diff = jnp.concatenate(
+                            [
+                                jnp.ones((1,), dtype=bool),
+                                col[1:] != col[:-1],
+                            ]
+                        )
+                        change = jnp.logical_or(change, diff)
+                    change = change.at[0].set(True)
+                    seg = jnp.cumsum(change.astype(jnp.int32)) - 1
+                    sc["__segments__"] = seg
+                    sc["__valid__"] = sv
+                    out = fn(sc)
+                    out = {k2: v2 for k2, v2 in out.items() if k2 not in ("__segments__", "__valid__")}
+                    out["__valid__"] = sv
+                    return out
+
+                return jax.shard_map(
+                    shard_fn,
+                    mesh=mesh,
+                    in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
+                    out_specs=P(ROW_AXIS),
+                )(cols, valid)
+
+            cache[cache_key] = jax.jit(compute)
+        out = cache[cache_key](dict(jdf.device_cols), jdf.device_valid_mask())
+        assert_or_throw(
+            isinstance(out, dict),
+            FugueInvalidOperation(
+                "compiled transformer must return Dict[str, jax.Array]"
+            ),
+        )
+        new_valid = out.pop("__valid__")
+        n_in = next(iter(jdf.device_cols.values())).shape[0]
+        missing = [n for n in output_schema.names if n not in out]
+        assert_or_throw(
+            len(missing) == 0,
+            FugueInvalidOperation(
+                f"compiled keyed transformer output missing columns {missing}"
+            ),
+        )
+        same_len = all(v.shape[0] == n_in for v in out.values())
+        assert_or_throw(
+            same_len,
+            FugueInvalidOperation(
+                "compiled keyed transformers must return row-aligned arrays "
+                "(same length as the sorted input shard)"
+            ),
+        )
+        return JaxDataFrame(
+            mesh=mesh,
+            _internal=dict(
+                device_cols={n: out[n] for n in output_schema.names},
+                host_tbl=None,
+                row_count=jdf.count(),
+                valid_mask=new_valid,
+                schema=output_schema,
+            ),
+        )
+
+    def _try_dense_keyed_map(
+        self,
+        jdf: JaxDataFrame,
+        fn: Callable,
+        output_schema: Schema,
+        partition_spec: PartitionSpec,
+        keys: List[str],
+        on_init: Optional[Callable],
+    ) -> Optional[DataFrame]:
+        """Sort-free, exchange-free keyed map (the dense plan).
+
+        Integer keys with a bounded range map to globally-consistent dense
+        segment ids (mixed radix over per-key spans); rows never move, and
+        per-group reductions merge across shards INSIDE the user fn via the
+        ``group_ops`` helpers (``lax.psum`` over the rows axis). This is
+        the fast plan on every backend — sorts are the slow path on TPU,
+        scatter reductions ride the VPU — and it costs zero data movement.
+
+        Returns None when ineligible (presort, non-integer keys, unbounded
+        range) — the caller falls back to the sorted plan.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..constants import FUGUE_TPU_CONF_DENSE_MAP_RANGE
+        from ..ops.segment import _get_compiled_minmax
+        from .group_ops import SEGMENT_SPACE, SEGMENTS, SPANS_SHARDS, VALID
+
+        engine: JaxExecutionEngine = self.execution_engine  # type: ignore
+        if len(partition_spec.presort) > 0:
+            return None  # order inside groups requires the sorted plan
+        if not all(
+            np.issubdtype(np.dtype(jdf.device_cols[k].dtype), np.integer)
+            for k in keys
+        ):
+            return None
+        max_range = int(
+            engine.conf.get(FUGUE_TPU_CONF_DENSE_MAP_RANGE, 1 << 20)
+        )
+        mesh = jdf.mesh
+        valid = jdf.device_valid_mask()
+        mm = _get_compiled_minmax(mesh)
+        bounds: List[int] = []
+        spans: List[int] = []
+        for k in keys:
+            lo, hi = mm(jdf.device_cols[k], valid)
+            lo, hi = int(lo[0]), int(hi[0])
+            if hi < lo:  # empty frame: degenerate single-bucket space
+                lo, hi = 0, 0
+            bounds.append(lo)
+            spans.append(hi - lo + 1)
+        total = 1
+        for s in spans:
+            total *= s
+            if total > max_range:
+                return None
+        buckets = 1 << max(1, (total).bit_length())  # ≥ total+1: padding slot
+        strides: List[int] = []
+        acc = 1
+        for s in reversed(spans):
+            strides.append(acc)
+            acc *= s
+        strides = list(reversed(strides))
+        if on_init is not None:
+            on_init(0, jdf)
+        cache = engine._jit_cache
+        cache_key = ("kmapdense", fn, mesh, buckets, tuple(keys))
+        if cache_key not in cache:
+
+            def compute(cols: Dict[str, Any], v: Any, b: Any, st: Any, space: Any):
+                def shard_fn(c: Dict[str, Any], v_: Any, b_: Any, st_: Any, sp_: Any):
+                    ids = jnp.zeros(v_.shape, dtype=jnp.int64)
+                    for i, k in enumerate(keys):
+                        ids = ids + (c[k].astype(jnp.int64) - b_[i]) * st_[i]
+                    ids = jnp.where(
+                        v_, ids, jnp.int64(sp_.shape[0] - 1)
+                    ).astype(jnp.int32)
+                    sc = dict(c)
+                    sc[SEGMENTS] = ids
+                    sc[VALID] = v_
+                    sc[SEGMENT_SPACE] = sp_
+                    sc[SPANS_SHARDS] = sp_[:1]
+                    out = fn(sc)
+                    return {
+                        k2: v2
+                        for k2, v2 in out.items()
+                        if k2 not in (SEGMENTS, VALID, SEGMENT_SPACE, SPANS_SHARDS)
+                    }
+
+                return jax.shard_map(
+                    shard_fn,
+                    mesh=mesh,
+                    in_specs=(P(ROW_AXIS), P(ROW_AXIS), P(), P(), P()),
+                    out_specs=P(ROW_AXIS),
+                )(cols, v, b, st, space)
+
+            cache[cache_key] = jax.jit(compute)
+        out = cache[cache_key](
+            dict(jdf.device_cols),
+            valid,
+            jnp.asarray(bounds, dtype=jnp.int64),
+            jnp.asarray(strides, dtype=jnp.int64),
+            jnp.zeros((buckets,), dtype=jnp.bool_),
+        )
+        assert_or_throw(
+            isinstance(out, dict),
+            FugueInvalidOperation(
+                "compiled transformer must return Dict[str, jax.Array]"
+            ),
+        )
+        n_in = next(iter(jdf.device_cols.values())).shape[0]
+        missing = [n for n in output_schema.names if n not in out]
+        assert_or_throw(
+            len(missing) == 0,
+            FugueInvalidOperation(
+                f"compiled keyed transformer output missing columns {missing}"
+            ),
+        )
+        assert_or_throw(
+            all(v2.shape[0] == n_in for v2 in out.values()),
+            FugueInvalidOperation(
+                "compiled keyed transformers must return row-aligned arrays"
+            ),
+        )
+        # rows never moved: validity/count carry over unchanged
+        return JaxDataFrame(
+            mesh=mesh,
+            _internal=dict(
+                device_cols={n: out[n] for n in output_schema.names},
+                host_tbl=None,
+                row_count=jdf._row_count,
+                valid_mask=jdf.valid_mask,
+                schema=output_schema,
+            ),
+        )
 
     def _compiled_map(
         self,

@@ -1,0 +1,237 @@
+"""Keyed compiled maps: groupby-apply that never leaves the device.
+
+The device-native answer to the reference's group-map path
+(fugue_spark/execution_engine.py:192). Two physical plans behind ONE UDF
+contract (fugue_tpu.jax.group_ops):
+
+- dense: integer keys, bounded range, no presort — no exchange, no sort;
+  group tables merge across shards inside the fn (psum via group_ops).
+- sorted: hash co-location + shard sort — used for presort / wide ranges.
+"""
+
+from typing import Dict
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.jax import JaxExecutionEngine, group_ops as go
+from fugue_tpu.jax.dataframe import JaxDataFrame
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+def _demean(cols):
+    m = go.mean(cols, cols["v"])
+    return {"k": cols["k"], "v": cols["v"], "d": cols["v"] - go.per_row(cols, m)}
+
+
+def test_keyed_compiled_demean_matches_oracle(engine):
+    import jax
+
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 37, 10_000), "v": rng.random(10_000)}
+    )
+    jdf = engine.to_df(pdf)
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return _demean(cols)
+
+    out = fa.transform(
+        jdf,
+        demean,
+        schema="k:long,v:double,d:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)  # stayed on device
+    got = out.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    exp = pdf.assign(d=pdf["v"] - pdf.groupby("k")["v"].transform("mean"))
+    exp = exp.sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_keyed_compiled_wide_range_sorted_plan(engine):
+    import jax
+
+    # keys spread over a huge range -> dense plan ineligible -> sorted plan
+    rng = np.random.default_rng(6)
+    ks = rng.integers(0, 2**40, 17)
+    pdf = pd.DataFrame(
+        {"k": np.repeat(ks, 100), "v": rng.random(1700)}
+    )
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return _demean(cols)
+
+    out = fa.transform(
+        engine.to_df(pdf),
+        demean,
+        schema="k:long,v:double,d:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)
+    got = out.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    exp = pdf.assign(d=pdf["v"] - pdf.groupby("k")["v"].transform("mean"))
+    exp = exp.sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_keyed_compiled_multi_key_and_presort(engine):
+    import jax
+    import jax.numpy as jnp
+
+    pdf = pd.DataFrame(
+        {
+            "a": [1, 1, 1, 2, 2, 2, 1, 1],
+            "b": [0, 0, 1, 0, 0, 1, 1, 0],
+            "v": [5.0, 3.0, 9.0, 2.0, 8.0, 1.0, 7.0, 4.0],
+        }
+    )
+    jdf = engine.to_df(pdf)
+
+    def gap_to_max(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        # per (a,b) group: distance to the group's max (presort forces the
+        # sorted plan; group_ops stays correct there too)
+        mx = go.segment_max(cols, cols["v"])
+        return {
+            "a": cols["a"],
+            "b": cols["b"],
+            "gap": go.per_row(cols, mx) - cols["v"],
+        }
+
+    out = fa.transform(
+        jdf,
+        gap_to_max,
+        schema="a:long,b:long,gap:double",
+        partition={"by": ["a", "b"], "presort": "v desc"},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)
+    got = out.as_pandas()
+    exp = pdf.assign(
+        gap=pdf.groupby(["a", "b"])["v"].transform("max") - pdf["v"]
+    )
+    m_got = got.sort_values(["a", "b", "gap"]).reset_index(drop=True)
+    m_exp = exp[["a", "b", "gap"]].sort_values(["a", "b", "gap"]).reset_index(
+        drop=True
+    )
+    pd.testing.assert_frame_equal(m_got, m_exp, check_dtype=False)
+
+
+def test_keyed_compiled_multi_key_dense(engine):
+    import jax
+
+    rng = np.random.default_rng(7)
+    pdf = pd.DataFrame(
+        {
+            "a": rng.integers(0, 10, 5000),
+            "b": rng.integers(100, 140, 5000),
+            "v": rng.random(5000),
+        }
+    )
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        m = go.mean(cols, cols["v"])
+        return {
+            "a": cols["a"],
+            "b": cols["b"],
+            "d": cols["v"] - go.per_row(cols, m),
+        }
+
+    out = fa.transform(
+        engine.to_df(pdf),
+        demean,
+        schema="a:long,b:long,d:double",
+        partition={"by": ["a", "b"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    got = out.as_pandas().sort_values(["a", "b", "d"]).reset_index(drop=True)
+    exp = pdf.assign(
+        d=pdf["v"] - pdf.groupby(["a", "b"])["v"].transform("mean")
+    )[["a", "b", "d"]].sort_values(["a", "b", "d"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_keyed_compiled_padding_isolation(engine):
+    import jax
+
+    # 10 rows over 8 shards -> padding rows on most shards; per-group counts
+    # must not include padding
+    pdf = pd.DataFrame({"k": [1] * 5 + [2] * 5, "v": [1.0] * 10})
+    jdf = engine.to_df(pdf)
+
+    def group_count(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        cnt = go.segment_count(cols)
+        return {"k": cols["k"], "n": go.per_row(cols, cnt)}
+
+    out = fa.transform(
+        jdf,
+        group_count,
+        schema="k:long,n:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    got = out.as_pandas()
+    assert len(got) == 10
+    assert got.groupby("k")["n"].first().tolist() == [5.0, 5.0]
+
+
+def test_keyed_compiled_min_sum_helpers(engine):
+    import jax
+
+    pdf = pd.DataFrame(
+        {"k": [1, 1, 2, 2, 2], "v": [4.0, 2.0, 10.0, 30.0, 20.0]}
+    )
+
+    def stats(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        s = go.segment_sum(cols, cols["v"])
+        lo = go.segment_min(cols, cols["v"])
+        return {
+            "k": cols["k"],
+            "s": go.per_row(cols, s),
+            "lo": go.per_row(cols, lo),
+        }
+
+    out = fa.transform(
+        engine.to_df(pdf),
+        stats,
+        schema="k:long,s:double,lo:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    got = out.as_pandas().drop_duplicates("k").sort_values("k")
+    assert got["s"].tolist() == [6.0, 60.0]
+    assert got["lo"].tolist() == [2.0, 10.0]
+
+
+def test_keyed_compiled_falls_back_for_string_keys(engine):
+    import jax
+
+    pdf = pd.DataFrame({"k": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]})
+    jdf = engine.to_df(pdf)
+    # string keys are dictionary-encoded -> compiled gate rejects; the host
+    # path can't feed a Dict[str, jax.Array] UDF, so a clear error beats
+    # silent mis-grouping
+    def f(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:  # pragma: no cover
+        return cols
+
+    with pytest.raises(Exception):
+        fa.transform(
+            jdf, f, schema="k:str,v:double",
+            partition={"by": ["k"]}, engine=engine, as_fugue=True,
+        )
