@@ -172,12 +172,15 @@ class JaxDataFrame(DataFrame):
         schema: Any = None,
         mesh: Any = None,
         _internal: Optional[dict] = None,
+        ingest_cache: Optional[bool] = None,
     ):
         if mesh is None:
             from ..parallel.mesh import build_mesh
 
             mesh = build_mesh()
         self._mesh = mesh
+        # None → fall back to the global conf (engines pass their own conf)
+        self._ingest_cache_opt = ingest_cache
         if _internal is not None:
             self._device_cols = _internal["device_cols"]
             self._host_tbl = _internal["host_tbl"]
@@ -242,7 +245,12 @@ class JaxDataFrame(DataFrame):
         # fugue.tpu.ingest_cache=False when host RAM is the constraint.
         from ..constants import _FUGUE_GLOBAL_CONF, FUGUE_TPU_CONF_INGEST_CACHE
 
-        cacheable = bool(_FUGUE_GLOBAL_CONF.get(FUGUE_TPU_CONF_INGEST_CACHE, True))
+        opt = getattr(self, "_ingest_cache_opt", None)
+        cacheable = (
+            bool(opt)
+            if opt is not None
+            else bool(_FUGUE_GLOBAL_CONF.get(FUGUE_TPU_CONF_INGEST_CACHE, True))
+        )
         if cacheable:
             for c in meta["nan_cols"]:
                 col = tbl.column(c)
@@ -349,6 +357,33 @@ class JaxDataFrame(DataFrame):
         return self._row_count
 
     # -- conversions --------------------------------------------------------
+    def _decode_device_col(
+        self, f: pa.Field, host: np.ndarray, nulls: Optional[np.ndarray]
+    ) -> pa.Array:
+        """Decode a (already row-filtered) host view of a device column back
+        to its arrow form — NaN→NULL, dictionary codes→values, epochs→
+        timestamps."""
+        enc = self._encodings.get(f.name)
+        if enc is None:
+            # device convention: NaN float IS NULL — restore nulls on
+            # the way out (skipped for columns proved NaN-free)
+            if np.issubdtype(host.dtype, np.floating) and (
+                self._nan_cols is None or f.name in self._nan_cols
+            ):
+                nn = np.isnan(host)
+                nulls = nn if nulls is None else (nulls | nn)
+            arr = pa.array(host, mask=nulls)
+        elif enc["kind"] == "dict":
+            # codes → dictionary values; −1 = NULL
+            arr = enc["dictionary"].take(
+                pa.array(host.astype(np.int64), mask=host < 0)
+            )
+        elif enc["kind"] == "datetime":
+            arr = pa.array(host, mask=nulls).cast(enc["type"])
+        else:  # pragma: no cover
+            raise NotImplementedError(enc["kind"])
+        return arr.cast(f.type, safe=False)
+
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
         import jax
 
@@ -369,26 +404,7 @@ class JaxDataFrame(DataFrame):
                     nulls = (
                         nulls[mask] if mask is not None else nulls[: self._row_count]
                     )
-                enc = self._encodings.get(f.name)
-                if enc is None:
-                    # device convention: NaN float IS NULL — restore nulls on
-                    # the way out (skipped for columns proved NaN-free)
-                    if np.issubdtype(host.dtype, np.floating) and (
-                        self._nan_cols is None or f.name in self._nan_cols
-                    ):
-                        nn = np.isnan(host)
-                        nulls = nn if nulls is None else (nulls | nn)
-                    arr = pa.array(host, mask=nulls)
-                elif enc["kind"] == "dict":
-                    # codes → dictionary values; −1 = NULL
-                    arr = enc["dictionary"].take(
-                        pa.array(host.astype(np.int64), mask=host < 0)
-                    )
-                elif enc["kind"] == "datetime":
-                    arr = pa.array(host, mask=nulls).cast(enc["type"])
-                else:  # pragma: no cover
-                    raise NotImplementedError(enc["kind"])
-                arrays.append(arr.cast(f.type, safe=False))
+                arrays.append(self._decode_device_col(f, host, nulls))
             else:
                 assert self._host_tbl is not None
                 col = self._host_tbl.column(f.name)
@@ -398,6 +414,43 @@ class JaxDataFrame(DataFrame):
                     col = col.slice(0, self._row_count)
                 arrays.append(col.combine_chunks())
         return pa.Table.from_arrays(arrays, schema=self.schema.pa_schema)
+
+    @staticmethod
+    def _local_np(arr: Any) -> np.ndarray:
+        """This process's rows of a row-sharded device array, in global
+        index order (multi-host safe: only addressable shards are read)."""
+        shards = sorted(
+            arr.addressable_shards,
+            key=lambda s: (s.index[0].start or 0) if len(s.index) > 0 else 0,
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def as_arrow_local(self) -> pa.Table:
+        """THIS process's valid rows as an arrow table (per-host read for
+        multi-host meshes; on one process it equals ``as_arrow``).
+
+        Requires an all-device frame — host-resident columns are process-
+        replicated and cannot be row-matched to local shards."""
+        import jax
+
+        assert_or_throw(
+            self._host_tbl is None,
+            FugueDataFrameOperationError(
+                "as_arrow_local requires an all-device frame"
+            ),
+        )
+        mask = self._local_np(self.device_valid_mask())
+        arrays: List[pa.Array] = []
+        for f in self.schema.fields:
+            host = self._local_np(self._device_cols[f.name])[mask]
+            nulls: Optional[np.ndarray] = None
+            if f.name in self._null_masks:
+                nulls = self._local_np(self._null_masks[f.name])[mask]
+            arrays.append(self._decode_device_col(f, host, nulls))
+        return pa.Table.from_arrays(arrays, schema=self.schema.pa_schema)
+
+    def as_pandas_local(self) -> pd.DataFrame:
+        return self.as_arrow_local().to_pandas(use_threads=False)
 
     def as_local_bounded(self) -> LocalBoundedDataFrame:
         res = ArrowDataFrame(self.as_arrow())

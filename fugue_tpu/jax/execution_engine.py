@@ -99,10 +99,10 @@ class JaxMapEngine(MapEngine):
             raw = _sniff_jax_func(map_func)
             if raw is not None:
                 jdf = engine.to_df(df)
+                keys = list(partition_spec.partition_by)
                 # encoded/masked columns have non-plain semantics the UDF
                 # can't see — host path renders them as real values
                 if isinstance(jdf, JaxDataFrame) and not jdf.has_encoded:
-                    keys = list(partition_spec.partition_by)
                     if len(keys) == 0:
                         # the compiled path maps shards IN PLACE — an even/
                         # rand spec still needs its physical exchange first
@@ -127,6 +127,17 @@ class JaxMapEngine(MapEngine):
                         return self._compiled_keyed_map(
                             jdf, raw, output_schema, partition_spec, on_init
                         )
+                if len(keys) > 0:
+                    # keyed jax UDFs depend on the reserved __segments__/
+                    # __valid__ contract that only the compiled plans
+                    # provide — a silent host fallback would surface as an
+                    # opaque KeyError deep inside the user fn
+                    raise FugueInvalidOperation(
+                        "compiled keyed map unavailable for partition keys "
+                        f"{keys}: keys must be plain un-encoded device "
+                        "columns (no strings/nullable/maybe-NaN floats). "
+                        "Use a pandas-annotated transformer for these keys."
+                    )
         # general path: host-side partitioned execution, result back on
         # device; CONCURRENCY reflects the mesh, not the host engine
         host_engine = engine._host_engine
@@ -193,20 +204,24 @@ class JaxMapEngine(MapEngine):
                     ops: List[Any] = [jnp.logical_not(v)]
                     for name, asc in sort_items:
                         key = c[name]
-                        if not asc:
-                            if jnp.issubdtype(key.dtype, jnp.floating):
+                        if jnp.issubdtype(key.dtype, jnp.floating):
+                            # NaN is the device NULL — order it FIRST inside
+                            # ties, matching the host protocol's
+                            # na_position="first" (asc or desc alike)
+                            isnan = jnp.isnan(key)
+                            ops.append(jnp.logical_not(isnan))
+                            key = jnp.where(isnan, jnp.zeros((), key.dtype), key)
+                            if not asc:
                                 key = -key
-                            elif key.dtype == jnp.bool_:
+                        elif not asc:
+                            if key.dtype == jnp.bool_:
                                 key = jnp.logical_not(key)
                             else:
                                 key = ~key  # monotone reversal
                         ops.append(key)
                     names = list(c.keys())
-                    iota = jax.lax.iota(jnp.int32, v.shape[0])
                     res = jax.lax.sort(
-                        tuple(ops)
-                        + tuple(c[n] for n in names)
-                        + (v, iota),
+                        tuple(ops) + tuple(c[n] for n in names) + (v,),
                         num_keys=len(ops),
                     )
                     payload = res[len(ops):]
@@ -590,9 +605,14 @@ class JaxExecutionEngine(ExecutionEngine):
                     mesh=self._mesh,
                 )
             return df
+        from ..constants import FUGUE_TPU_CONF_INGEST_CACHE
+
         res = JaxDataFrame(
             df if isinstance(df, DataFrame) else self._host_engine.to_df(df, schema),
             mesh=self._mesh,
+            ingest_cache=self.conf.get_or_none(
+                FUGUE_TPU_CONF_INGEST_CACHE, bool
+            ),
         )
         src_meta = df.metadata if isinstance(df, DataFrame) and df.has_metadata else None
         if src_meta is not None:
@@ -1296,7 +1316,17 @@ class JaxExecutionEngine(ExecutionEngine):
         # blob protocol where serialization uses the effective spec
         if len(spec.presort) > 0:
             presort = dict(spec.presort)
-        frames_pd = [f.as_pandas() for f in df.zip_frames]
+        # multi-host: the zip exchange already placed each key's rows on
+        # exactly one shard, so every process transfers ONLY its local
+        # shards and runs the cotransform for its own keys — the per-host
+        # parallel execution the reference gets from cluster executors
+        from ..parallel.distributed import is_multihost
+
+        multihost = is_multihost()
+        if multihost:
+            frames_pd = [f.as_pandas_local() for f in df.zip_frames]
+        else:
+            frames_pd = [f.as_pandas() for f in df.zip_frames]
         if len(presort) > 0:
             # na_position="first" matches the host blob protocol's partition
             # presort (PandasMapEngine) so NULL rows order identically
@@ -1351,12 +1381,98 @@ class JaxExecutionEngine(ExecutionEngine):
             no += 1
             out = map_func(cursor, dfs_obj)
             results.append(out.as_local_bounded().as_arrow())
+        if multihost:
+            tbl = (
+                pa.concat_tables(
+                    [t.cast(out_schema.pa_schema) for t in results]
+                )
+                if len(results) > 0
+                else out_schema.create_empty_arrow_table()
+            )
+            return self._from_process_local_table(tbl)
         if len(results) == 0:
             return self.to_df(ArrayDataFrame([], out_schema))
         tbl = pa.concat_tables(
             [t.cast(out_schema.pa_schema) for t in results]
         )
         return self.to_df(ArrowDataFrame(tbl))
+
+    def _from_process_local_table(self, tbl: pa.Table) -> JaxDataFrame:
+        """Assemble a global JaxDataFrame from per-process row sets.
+
+        Each process contributes its own rows (counts may differ); per-shard
+        capacity is negotiated with an allgather of the local counts so all
+        processes agree on ONE padded global shape, then the device array is
+        built from process-local data — no host ever sees another host's
+        rows. String/dictionary outputs would need a cross-process
+        dictionary union; they raise until that lands.
+        """
+        import jax
+        from jax.experimental import multihost_utils
+
+        from .dataframe import encode_arrow_for_device
+
+        np_cols, host_tbl, meta = encode_arrow_for_device(tbl, encode=True)
+        assert_or_throw(
+            host_tbl is None and len(meta["encodings"]) == 0,
+            FugueInvalidOperation(
+                "multi-host comap outputs support plain numeric/bool/"
+                "datetime-free columns only (string outputs need a cross-"
+                "process dictionary union)"
+            ),
+        )
+        local_n = tbl.num_rows
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.asarray([local_n]))
+        ).reshape(-1)
+        local_shards = jax.local_device_count()
+        total_shards = num_row_shards(self._mesh)
+        per_shard = max(
+            1, int(-(-int(counts.max()) // local_shards))
+        )  # ceil over the busiest process
+        cap = 1 << (per_shard - 1).bit_length()  # pow2 keeps jit cache small
+        local_rows = local_shards * cap
+        global_rows = total_shards * cap
+        sharding = row_sharding(self._mesh)
+
+        def _pad(arr: np.ndarray, fill: Any) -> np.ndarray:
+            out = np.full(local_rows, fill, dtype=arr.dtype)
+            out[: len(arr)] = arr
+            return out
+
+        cols = {
+            k: jax.make_array_from_process_local_data(
+                sharding, _pad(v, 0), (global_rows,)
+            )
+            for k, v in np_cols.items()
+        }
+        valid = jax.make_array_from_process_local_data(
+            sharding,
+            _pad(np.ones(local_n, dtype=bool), False),
+            (global_rows,),
+        )
+        null_masks = {
+            k: jax.make_array_from_process_local_data(
+                sharding, _pad(v, True), (global_rows,)
+            )
+            for k, v in meta["null_masks"].items()
+        }
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols=cols,
+                host_tbl=None,
+                row_count=int(counts.sum()),
+                valid_mask=valid,
+                # nan_cols derived from LOCAL rows would diverge between
+                # processes (different plan gating → collective deadlock);
+                # None = conservatively maybe-NaN everywhere, identically
+                nan_cols=None,
+                encodings={},
+                null_masks=null_masks,
+                schema=Schema(tbl.schema),
+            ),
+        )
 
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
         """Device union: per-shard concatenation of both frames' blocks in

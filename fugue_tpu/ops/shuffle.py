@@ -144,9 +144,12 @@ def _get_compiled_dest_rand(mesh: Any):
 
 
 def _get_compiled_counts(mesh: Any):
-    """Per-shard destination histogram → host (shards × shards, tiny)."""
+    """Destination-histogram summary → (max_count, total) as REPLICATED
+    scalars: replication keeps the host read addressable from every process
+    on multi-host meshes (a sharded matrix would not be)."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     shards = num_row_shards(mesh)
@@ -154,10 +157,14 @@ def _get_compiled_counts(mesh: Any):
     if cache_key not in _COMPILE_CACHE:
 
         def kernel(dest: Any, valid: Any):
-            return (
+            h = (
                 jnp.zeros(shards, dtype=jnp.int32)
                 .at[dest]
                 .add(valid.astype(jnp.int32))
+            )
+            return (
+                lax.pmax(h.max(), ROW_AXIS)[None],
+                lax.psum(h.sum(), ROW_AXIS)[None],
             )
 
         _COMPILE_CACHE[cache_key] = jax.jit(
@@ -165,7 +172,7 @@ def _get_compiled_counts(mesh: Any):
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
-                out_specs=P(ROW_AXIS),
+                out_specs=(P(), P()),
             )
         )
     return _COMPILE_CACHE[cache_key]
@@ -285,17 +292,12 @@ def exchange_rows(
     """
     import jax
 
-    shards = num_row_shards(mesh)
-    counts = np.asarray(
-        jax.device_get(_get_compiled_counts(mesh)(dest, valid))
-    ).reshape(shards, shards)
-    cap = int(counts.max())
-    if cap == 0:
-        cap = 1
+    mx, total = jax.device_get(_get_compiled_counts(mesh)(dest, valid))
+    cap = max(1, int(mx[0]))
     capacity = 1 << (cap - 1).bit_length()  # pow2 → reuse compiled variants
     dtypes = tuple(str(a.dtype) for a in arrays.values())
     compiled = _get_compiled_exchange(mesh, dtypes, capacity)
     outs = compiled(dest, valid, *arrays.values())
     new_valid = outs[0]
     new_arrays = {k: v for k, v in zip(arrays.keys(), outs[1:])}
-    return new_arrays, new_valid, int(counts.sum())
+    return new_arrays, new_valid, int(total[0])

@@ -83,3 +83,105 @@ def test_two_process_distributed_mesh(tmp_path):
                 p.kill()
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0 and f"MH_OK {i}".encode() in out, err.decode()[-2000:]
+
+
+_COMAP_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+sys.path.insert(0, {repo!r})
+from fugue_tpu.parallel.distributed import initialize_distributed
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=pid
+)
+import numpy as np, pandas as pd
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.dataframe import DataFrames, PandasDataFrame
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.zipped import ZippedJaxDataFrame
+
+e = JaxExecutionEngine()
+rng = np.random.default_rng(3)
+a = pd.DataFrame({{"k": rng.integers(0, 12, 400), "v": rng.random(400)}})
+b = pd.DataFrame({{"k": rng.integers(0, 12, 300), "w": rng.random(300)}})
+z = e.zip(
+    DataFrames([e.to_df(a), e.to_df(b)]),
+    partition_spec=PartitionSpec(by=["k"]),
+)
+assert isinstance(z, ZippedJaxDataFrame), type(z)
+executed = []
+
+def merge(cursor, dfs):
+    d1, d2 = dfs[0].as_pandas(), dfs[1].as_pandas()
+    k = int(d1["k"].iloc[0]) if len(d1) else int(d2["k"].iloc[0])
+    executed.append(k)
+    return PandasDataFrame(
+        pd.DataFrame({{"k": [k], "sv": [d1["v"].sum()], "sw": [d2["w"].sum()]}}),
+        "k:long,sv:double,sw:double",
+    )
+
+res = e.comap(z, merge, "k:long,sv:double,sw:double")
+# per-host execution proof: this process only ran its LOCAL shards' keys
+from jax.experimental import multihost_utils
+mine = np.zeros(12, dtype=np.int64); mine[executed] = 1
+both = np.asarray(multihost_utils.process_allgather(mine))
+assert both.shape[0] == 2
+overlap = (both.sum(axis=0) > 1).sum()
+assert overlap == 0, f"keys executed on both hosts: {{both}}"
+inner = set(a["k"]) & set(b["k"])
+assert set(np.nonzero(both.sum(axis=0))[0].tolist()) == inner
+# global result correctness, checked per host over its local rows
+local = res.as_pandas_local()
+for _, row in local.iterrows():
+    k = int(row["k"])
+    assert np.isclose(row["sv"], a[a["k"] == k]["v"].sum()), k
+    assert np.isclose(row["sw"], b[b["k"] == k]["w"].sum()), k
+assert res.count() == len(inner)
+print("MHC_OK", pid, len(executed), flush=True)
+"""
+
+
+def test_two_process_per_host_comap(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    worker = os.path.join(str(tmp_path), "comap_worker.py")
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    with open(worker, "w") as f:
+        f.write(_COMAP_WORKER.format(repo=repo))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    executed_counts = []
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0 and f"MHC_OK {i}".encode() in out, err.decode()[-3000:]
+        executed_counts.append(
+            int(out.decode().strip().split()[-1])
+        )
+    # both hosts did real work (keys hash-spread over both processes)
+    assert all(c > 0 for c in executed_counts), executed_counts
