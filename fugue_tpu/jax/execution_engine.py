@@ -1451,12 +1451,28 @@ class JaxExecutionEngine(ExecutionEngine):
             _pad(np.ones(local_n, dtype=bool), False),
             (global_rows,),
         )
-        null_masks = {
-            k: jax.make_array_from_process_local_data(
-                sharding, _pad(v, True), (global_rows,)
-            )
-            for k, v in meta["null_masks"].items()
-        }
+        # mask-key sets must be IDENTICAL on every process (divergent frame
+        # structure → divergent jitted programs → collective deadlock):
+        # allgather the local sets and union them, filling absentees with
+        # all-False masks
+        schema_names = [f.name for f in tbl.schema]
+        local_has = np.asarray(
+            [n in meta["null_masks"] for n in schema_names], dtype=np.int32
+        )
+        union_has = (
+            np.asarray(multihost_utils.process_allgather(local_has))
+            .reshape(-1, len(schema_names))
+            .max(axis=0)
+        )
+        null_masks = {}
+        for i, n in enumerate(schema_names):
+            if union_has[i]:
+                m = meta["null_masks"].get(
+                    n, np.zeros(local_n, dtype=bool)
+                )
+                null_masks[n] = jax.make_array_from_process_local_data(
+                    sharding, _pad(m, True), (global_rows,)
+                )
         return JaxDataFrame(
             mesh=self._mesh,
             _internal=dict(

@@ -1,0 +1,506 @@
+"""Device window-function evaluation.
+
+Lowers ``OVER (PARTITION BY ... ORDER BY ...)`` onto the device sort +
+segment machinery (SURVEY §7.8): hash-repartition co-locates each
+partition on one shard, ONE ``shard_map`` sorts the shard by
+(validity, partition keys, order keys) and computes every window column
+with prefix sums / segmented scans — no host materialization (the
+reference runs OVER clauses through backend SQL on the cluster,
+``fugue/execution/execution_engine.py:183-274``; pandas remains the
+fallback for shapes this plan doesn't cover).
+
+Supported here: ROW_NUMBER / RANK / DENSE_RANK / LAG / LEAD (literal
+offset/default) and SUM/AVG/MIN/MAX/COUNT/FIRST/LAST over
+- the whole partition (no ORDER BY, or UNBOUNDED..UNBOUNDED),
+- running ROWS UNBOUNDED PRECEDING..CURRENT ROW,
+- RANGE UNBOUNDED..CURRENT (peer rows share the running value),
+- bounded ROWS frames for SUM/COUNT/AVG (prefix-sum differences).
+
+NULL semantics mirror the host evaluator (``column/window.py``): NaN is
+the device NULL; aggregates skip NULLs; running aggregates are NULL until
+the first non-NULL; FIRST/LAST are positional. Everything else returns
+None → host fallback.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..column.expressions import _LitColumnExpr, _NamedColumnExpr, _WindowExpr
+from ..schema import Schema
+
+_AGGS = {"SUM", "AVG", "MIN", "MAX", "COUNT", "FIRST", "LAST"}
+_RANKS = {"ROW_NUMBER", "RANK", "DENSE_RANK"}
+_NO_LIT = object()
+
+
+def _norm_frame(expr: _WindowExpr) -> Optional[Tuple]:
+    """Normalize an aggregate's frame to a hashable plan tag, or None when
+    the shape needs the host evaluator."""
+    has_order = len(expr.order_by) > 0
+    frame = expr.frame
+    if not has_order:
+        return ("whole",)
+    if frame is None:
+        frame = ("range", "unb_prec", "current")
+    kind, start, end = frame
+    if start == "unb_prec" and end == "unb_foll":
+        return ("whole",)
+    if kind == "rows" and start == "unb_prec" and end == "current":
+        return ("running",)
+    if kind == "range" and start == "unb_prec" and end == "current":
+        return ("peers",)
+    if kind == "rows" and expr.func in ("SUM", "COUNT", "AVG"):
+        def off(b):
+            if b == "current":
+                return 0
+            if isinstance(b, tuple):
+                return -b[1] if b[0] == "prec" else b[1]
+            return None  # unbounded
+        # None offsets mean "to the segment edge" — handled statically
+        return ("rows_bounded", off(start), off(end))
+    return None
+
+
+def _plan_items(
+    jdf: Any, items: List[Tuple[str, _WindowExpr]]
+) -> Optional[Tuple[Tuple, List[str], List[Tuple[str, bool]]]]:
+    """Gate + normalize. Returns (specs, pkeys, order_items) or None."""
+    if len(items) == 0:
+        return None
+    first = items[0][1]
+    pkeys = list(first.partition_by)
+    if len(pkeys) == 0:
+        return None  # a global window spans shards — host fallback
+    # one physical sort serves every spec whose ORDER BY is a PREFIX of the
+    # longest one (peer detection runs per spec on its own keys)
+    order_items: List[Tuple[str, bool]] = []
+    for _, expr in items:
+        oi = [(n, bool(a)) for n, a in expr.order_by]
+        if len(oi) > len(order_items):
+            if order_items != oi[: len(order_items)]:
+                return None
+            order_items = oi
+        elif oi != order_items[: len(oi)]:
+            return None
+    plain = (
+        lambda c: c in jdf.device_cols
+        and c not in jdf.encodings
+        and c not in jdf.null_masks
+    )
+    if not all(plain(k) and not jdf.maybe_nan(k) for k in pkeys):
+        return None
+    if not all(plain(n) for n, _ in order_items):
+        return None
+    specs: List[Tuple] = []
+    for out_name, expr in items:
+        if list(expr.partition_by) != pkeys:
+            return None  # mixed partitions — host fallback
+        func = expr.func
+        n_ord = len(expr.order_by)
+        if func in _RANKS:
+            if func != "ROW_NUMBER" and n_ord == 0:
+                return None
+            specs.append((out_name, func, n_ord))
+            continue
+        if func in ("LAG", "LEAD"):
+            if len(expr.args) < 1 or not isinstance(
+                expr.args[0], _NamedColumnExpr
+            ):
+                return None
+            arg = expr.args[0].name
+            if not plain(arg):
+                return None
+            def lit_value(a: Any) -> Any:
+                if isinstance(a, _LitColumnExpr):
+                    return a.value
+                # "-1.0" parses as unary negation of a literal
+                from ..column.expressions import _UnaryOpExpr
+
+                if (
+                    isinstance(a, _UnaryOpExpr)
+                    and a.op == "-"
+                    and isinstance(a.col, _LitColumnExpr)
+                    and isinstance(a.col.value, (int, float))
+                ):
+                    return -a.col.value
+                return _NO_LIT
+
+            offset, default = 1, None
+            if len(expr.args) > 1:
+                off_v = lit_value(expr.args[1])
+                if off_v is _NO_LIT:
+                    return None
+                offset = int(off_v)
+                if offset < 0:  # negative offsets flip direction — host path
+                    return None
+            if len(expr.args) > 2:
+                default = lit_value(expr.args[2])
+                if default is _NO_LIT:
+                    return None
+                if default is not None and not isinstance(
+                    default, (int, float, bool)
+                ):
+                    return None
+            if default is None and not np.issubdtype(
+                np.dtype(jdf.device_cols[arg].dtype), np.floating
+            ):
+                # NULL fills force a float64 result — the host path keeps
+                # the arg's type; don't let the plan change output schemas
+                return None
+            specs.append((out_name, func, arg, offset, default))
+            continue
+        if func in _AGGS:
+            if len(expr.args) != 1 or not isinstance(
+                expr.args[0], _NamedColumnExpr
+            ):
+                return None
+            arg = expr.args[0].name
+            if not plain(arg):
+                return None
+            if func in ("FIRST", "LAST") and jdf.maybe_nan(arg):
+                return None  # positional semantics vs NaN==NULL ambiguity
+            if func not in ("COUNT", "FIRST", "LAST") and not np.issubdtype(
+                np.dtype(jdf.device_cols[arg].dtype), np.floating
+            ):
+                # int SUM/MIN/MAX/AVG: float64 accumulation would change
+                # the output type (host keeps long) and lose precision
+                # past 2^53 — host fallback
+                return None
+            tag = _norm_frame(expr)
+            if tag is None:
+                return None
+            specs.append((out_name, func, arg, tag, n_ord))
+            continue
+        return None
+    return tuple(specs), pkeys, order_items
+
+
+def plan_device_windows(
+    jdf: Any, items: List[Tuple[str, _WindowExpr]]
+) -> Optional[Tuple]:
+    """Cheap eligibility gate — run BEFORE paying for WHERE filters or
+    repartitions. Returns an opaque plan for :func:`run_device_windows`,
+    or None for host fallback."""
+    from .dataframe import JaxDataFrame
+
+    if not isinstance(jdf, JaxDataFrame) or jdf.host_table is not None:
+        return None
+    if len(jdf.device_cols) != len(jdf.schema):
+        return None
+    if len(jdf.null_masks) > 0:
+        # masked columns would need their masks threaded through the sort;
+        # host fallback until that lands
+        return None
+    return _plan_items(jdf, items)
+
+
+def try_device_windows(
+    engine: Any,
+    jdf: Any,
+    items: List[Tuple[str, _WindowExpr]],
+) -> Optional[Any]:
+    """Gate + run in one step (single-phase callers)."""
+    plan = plan_device_windows(jdf, items)
+    if plan is None:
+        return None
+    return run_device_windows(engine, jdf, plan)
+
+
+def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
+    """Evaluate all window expressions on device; returns a JaxDataFrame of
+    (original columns + one column per item), or None if the frame stopped
+    being device-eligible since planning (e.g. a host-fallback filter)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as JP
+
+    from ..collections.partition import PartitionSpec
+    from ..parallel.mesh import ROW_AXIS
+    from .dataframe import JaxDataFrame
+
+    if (
+        not isinstance(jdf, JaxDataFrame)
+        or jdf.host_table is not None
+        or len(jdf.null_masks) > 0
+    ):
+        return None
+    specs, pkeys, order_items = plan
+    jdf = engine.repartition(jdf, PartitionSpec(algo="hash", by=pkeys))
+    mesh = jdf.mesh
+    cache = engine._jit_cache
+    cache_key = ("window", mesh, specs, tuple(pkeys), tuple(order_items))
+    names_sig = tuple(jdf.schema.names)
+
+    if (cache_key, names_sig) not in cache:
+
+        def compute(cols: Dict[str, Any], valid: Any):
+            def shard_fn(c: Dict[str, Any], v: Any):
+                big = jnp.iinfo(jnp.int32).max
+                ops: List[Any] = [jnp.logical_not(v)]
+                for k in pkeys:
+                    ops.append(c[k])
+                for n, asc in order_items:
+                    key = c[n]
+                    if jnp.issubdtype(key.dtype, jnp.floating):
+                        # host sorts with na_position="last"
+                        isnan = jnp.isnan(key)
+                        ops.append(isnan)
+                        key = jnp.where(isnan, jnp.zeros((), key.dtype), key)
+                        ops.append(-key if not asc else key)
+                    elif not asc:
+                        ops.append(
+                            jnp.logical_not(key)
+                            if key.dtype == jnp.bool_
+                            else ~key
+                        )
+                    else:
+                        ops.append(key)
+                names = list(c.keys())
+                res = jax.lax.sort(
+                    tuple(ops) + tuple(c[n] for n in names) + (v,),
+                    num_keys=len(ops),
+                )
+                payload = res[len(ops):]
+                sc = dict(zip(names, payload[: len(names)]))
+                sv = payload[len(names)]
+                n_rows = sv.shape[0]
+                iota = jax.lax.iota(jnp.int32, n_rows)
+
+                def nan_eq_diff(col: Any) -> Any:
+                    a, b = col[1:], col[:-1]
+                    neq = a != b
+                    if jnp.issubdtype(col.dtype, jnp.floating):
+                        neq = neq & ~(jnp.isnan(a) & jnp.isnan(b))
+                    return jnp.concatenate([jnp.ones((1,), bool), neq])
+
+                seg_change = jnp.logical_not(sv)
+                for k in pkeys:
+                    seg_change = seg_change | nan_eq_diff(sc[k])
+                seg_change = seg_change.at[0].set(True)
+                seg = jnp.cumsum(seg_change.astype(jnp.int32)) - 1
+                seg_start = jax.lax.cummax(
+                    jnp.where(seg_change, iota, jnp.int32(-1))
+                )
+                nxt = jnp.concatenate(
+                    [jnp.where(seg_change, iota, big)[1:], jnp.full((1,), big, jnp.int32)]
+                )
+                seg_end = jnp.minimum(
+                    jnp.flip(jax.lax.cummin(jnp.flip(nxt))) - 1,
+                    jnp.int32(n_rows - 1),
+                )
+                def end_of_run(change: Any) -> Any:
+                    return jnp.minimum(
+                        jnp.flip(
+                            jax.lax.cummin(
+                                jnp.flip(
+                                    jnp.concatenate(
+                                        [
+                                            jnp.where(change, iota, big)[1:],
+                                            jnp.full((1,), big, jnp.int32),
+                                        ]
+                                    )
+                                )
+                            )
+                        )
+                        - 1,
+                        seg_end,
+                    )
+
+                # peer (tied-order-key) machinery per ORDER BY prefix length
+                peer_change_by: Dict[int, Any] = {0: seg_change}
+                pc = seg_change
+                for j, (n, _) in enumerate(order_items):
+                    pc = pc | nan_eq_diff(sc[n])
+                    peer_change_by[j + 1] = pc
+                peer_end_by = {
+                    j: end_of_run(ch) for j, ch in peer_change_by.items()
+                }
+
+                def seg_scan(op, x):
+                    def combine(a, b):
+                        af, av = a
+                        bf, bv = b
+                        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+
+                    _, out = jax.lax.associative_scan(
+                        combine, (seg_change, x)
+                    )
+                    return out
+
+                def prefix_tables(arg: Any):
+                    """(masked values xm, running count n_run, running sum
+                    c_run) with segment resets; NULL-skipping."""
+                    x = sc[arg]
+                    xf = x.astype(jnp.float64)
+                    nn = sv & ~jnp.isnan(xf)
+                    xm = jnp.where(nn, xf, 0.0)
+                    c = jnp.cumsum(xm)
+                    cnt = jnp.cumsum(nn.astype(jnp.float64))
+                    # segment-relative prefixes via the value at seg_start
+                    c0 = c[seg_start] - xm[seg_start]
+                    n0 = cnt[seg_start] - nn[seg_start].astype(jnp.float64)
+                    return xf, nn, xm, c - c0, cnt - n0, c, cnt
+
+                outs: Dict[str, Any] = {}
+                for spec in specs:
+                    out_name, func = spec[0], spec[1]
+                    if func == "ROW_NUMBER":
+                        outs[out_name] = (iota - seg_start + 1).astype(jnp.int64)
+                        continue
+                    if func == "RANK":
+                        pch = peer_change_by[spec[2]]
+                        rank_start = jax.lax.cummax(
+                            jnp.where(pch, iota, jnp.int32(-1))
+                        )
+                        outs[out_name] = (rank_start - seg_start + 1).astype(
+                            jnp.int64
+                        )
+                        continue
+                    if func == "DENSE_RANK":
+                        pcum = jnp.cumsum(
+                            peer_change_by[spec[2]].astype(jnp.int64)
+                        )
+                        outs[out_name] = pcum - pcum[seg_start] + 1
+                        continue
+                    if func in ("LAG", "LEAD"):
+                        _, _, arg, offset, default = spec
+                        x = sc[arg]
+                        shift = offset if func == "LAG" else -offset
+                        idx = iota - shift
+                        ok = (
+                            (idx >= seg_start) & (idx <= seg_end)
+                            if func == "LEAD"
+                            else (idx >= seg_start)
+                        )
+                        val = x[jnp.clip(idx, 0, n_rows - 1)]
+                        if default is None:
+                            valf = val.astype(jnp.float64)
+                            outs[out_name] = jnp.where(ok, valf, jnp.nan)
+                        else:
+                            outs[out_name] = jnp.where(
+                                ok, val, jnp.asarray(default, dtype=x.dtype)
+                            )
+                        continue
+                    # aggregates
+                    _, _, arg, tag, n_ord = spec
+                    xf, nn, xm, c_rel, n_rel, c_abs, n_abs = prefix_tables(arg)
+                    if tag[0] == "whole":
+                        total = c_rel[seg_end]
+                        count = n_rel[seg_end]
+                        if func == "COUNT":
+                            outs[out_name] = count.astype(jnp.int64)
+                        elif func == "SUM":
+                            outs[out_name] = total
+                        elif func == "AVG":
+                            outs[out_name] = total / jnp.where(count > 0, count, jnp.nan)
+                        elif func in ("MIN", "MAX"):
+                            op = jnp.minimum if func == "MIN" else jnp.maximum
+                            fill = jnp.inf if func == "MIN" else -jnp.inf
+                            xs = jnp.where(nn, xf, fill)
+                            run = seg_scan(op, xs)
+                            ext = run[seg_end]
+                            outs[out_name] = jnp.where(
+                                n_rel[seg_end] > 0, ext, jnp.nan
+                            )
+                        elif func == "FIRST":
+                            outs[out_name] = sc[arg][seg_start]
+                        else:  # LAST
+                            outs[out_name] = sc[arg][seg_end]
+                        continue
+                    if tag[0] in ("running", "peers"):
+                        at = peer_end_by[n_ord] if tag[0] == "peers" else iota
+                        count = n_rel[at]
+                        if func == "COUNT":
+                            outs[out_name] = count.astype(jnp.int64)
+                        elif func in ("SUM", "AVG"):
+                            s = c_rel[at]
+                            r = s / count if func == "AVG" else s
+                            outs[out_name] = jnp.where(count > 0, r, jnp.nan)
+                        elif func in ("MIN", "MAX"):
+                            op = jnp.minimum if func == "MIN" else jnp.maximum
+                            fill = jnp.inf if func == "MIN" else -jnp.inf
+                            xs = jnp.where(nn, xf, fill)
+                            run = seg_scan(op, xs)[at]
+                            outs[out_name] = jnp.where(count > 0, run, jnp.nan)
+                        elif func == "FIRST":
+                            outs[out_name] = sc[arg][seg_start]
+                        else:  # LAST: value at the frame end
+                            outs[out_name] = sc[arg][at]
+                        continue
+                    # bounded ROWS frames (SUM/COUNT/AVG only, gated);
+                    # a None offset is unbounded → the segment edge
+                    lo_off, hi_off = tag[1], tag[2]
+                    lo = (
+                        seg_start
+                        if lo_off is None
+                        else jnp.maximum(seg_start, iota + lo_off)
+                    )
+                    hi = (
+                        seg_end
+                        if hi_off is None
+                        else jnp.minimum(seg_end, iota + hi_off)
+                    )
+                    empty = hi < lo
+                    lo_c = jnp.clip(lo, 0, n_rows - 1)
+                    hi_c = jnp.clip(hi, 0, n_rows - 1)
+                    s = c_abs[hi_c] - c_abs[lo_c] + xm[lo_c]
+                    count = n_abs[hi_c] - n_abs[lo_c] + nn[lo_c].astype(jnp.float64)
+                    count = jnp.where(empty, 0.0, count)
+                    s = jnp.where(empty, 0.0, s)
+                    if func == "COUNT":
+                        outs[out_name] = count.astype(jnp.int64)
+                    elif func == "SUM":
+                        outs[out_name] = jnp.where(count > 0, s, jnp.nan)
+                    else:  # AVG
+                        outs[out_name] = jnp.where(
+                            count > 0, s / jnp.where(count > 0, count, 1.0), jnp.nan
+                        )
+                sc_out = dict(sc)
+                sc_out.update(outs)
+                sc_out["__wvalid__"] = sv
+                return sc_out
+
+            return jax.shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(JP(ROW_AXIS), JP(ROW_AXIS)),
+                out_specs=JP(ROW_AXIS),
+            )(cols, valid)
+
+        cache[(cache_key, names_sig)] = jax.jit(compute)
+    out = cache[(cache_key, names_sig)](
+        dict(jdf.device_cols), jdf.device_valid_mask()
+    )
+    new_valid = out.pop("__wvalid__")
+    dtype_to_pa = {
+        "int64": "long",
+        "float64": "double",
+        "bool": "bool",
+        "int32": "int",
+    }
+    import pyarrow as pa
+
+    extra_fields = []
+    for spec in specs:
+        arr = out[spec[0]]
+        tname = dtype_to_pa.get(str(arr.dtype))
+        if tname is None:
+            return None  # unexpected dtype — let the host path handle it
+        extra_fields.append(pa.field(spec[0], Schema(f"x:{tname}").types[0]))
+    work_schema = Schema(list(jdf.schema.fields) + extra_fields)
+    return JaxDataFrame(
+        mesh=mesh,
+        _internal=dict(
+            device_cols={n: out[n] for n in work_schema.names},
+            host_tbl=None,
+            row_count=jdf._row_count,
+            valid_mask=new_valid,
+            nan_cols=None,
+            # encoded columns rode the sort as codes — their encodings
+            # still describe them
+            encodings=dict(jdf.encodings),
+            null_masks={},
+            schema=work_schema,
+        ),
+    )

@@ -409,6 +409,50 @@ class SQLExecutor:
             grouped, SelectColumns(*finals, arg_distinct=node.distinct)
         )
 
+    def _try_device_windowed_select(
+        self, node: "SelectNode", child: DataFrame
+    ) -> Optional[DataFrame]:
+        """Device plan for windowed SELECTs: WHERE as a device filter, all
+        OVER columns in one shard_map (jax/window.py), projection via the
+        engine's column IR — the frame never materializes on the host.
+        Returns None (host fallback) for ineligible engines/shapes."""
+        e = self._engine
+        try:
+            from ..jax.execution_engine import JaxExecutionEngine
+            from ..jax.window import plan_device_windows, run_device_windows
+        except ImportError:  # pragma: no cover
+            return None
+        if not isinstance(e, JaxExecutionEngine):
+            return None
+        items: List[Any] = []
+        projections: List[Any] = []
+        for i, c in enumerate(node.projections):
+            if isinstance(c, _WindowExpr):
+                items.append((f"__w{i}__", c))
+                sub = _col(f"__w{i}__").alias(c.output_name or f"_w{i}")
+                if c.as_type is not None:
+                    sub = sub.cast(c.as_type)
+                projections.append(sub)
+            elif _contains_window(c):
+                return None  # nested windows keep the host error path
+            else:
+                projections.append(c)
+        jdf = e.to_df(child)
+        # gate BEFORE the WHERE filter — an ineligible query shouldn't pay
+        # for device work the host path will redo
+        plan = plan_device_windows(jdf, items)
+        if plan is None:
+            return None
+        if node.where is not None:
+            jdf = e.filter(jdf, node.where)
+        work = run_device_windows(e, jdf, plan)
+        if work is None:
+            return None
+        cols = SelectColumns(
+            *[c.infer_alias() for c in projections], arg_distinct=node.distinct
+        )
+        return e.select(work, cols)
+
     def _exec_windowed_select(self, node: SelectNode, child: DataFrame) -> DataFrame:
         """SQL evaluation order: WHERE → window → projection → DISTINCT."""
         import pyarrow as pa
@@ -422,6 +466,9 @@ class SQLExecutor:
             raise NotImplementedError(
                 "window functions can't be combined with GROUP BY/HAVING yet"
             )
+        device = self._try_device_windowed_select(node, child)
+        if device is not None:
+            return device
         local = e.to_df(child).as_local_bounded()
         pdf = local.as_pandas()
         if node.where is not None:

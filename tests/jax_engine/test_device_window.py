@@ -1,0 +1,305 @@
+"""Device window functions: OVER clauses lowered onto the device sort +
+segment machinery (jax/window.py), oracle-verified against the native
+engine, with the device plan proven used (the host evaluator is poisoned).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    e = NativeExecutionEngine()
+    yield e
+    e.stop()
+
+
+def _pd(res):
+    return res.to_pandas() if hasattr(res, "to_pandas") else res
+
+
+def _run_both(sql, df, engine, oracle, poison=True):
+    # the host evaluator is poisoned ONLY for the jax-engine run: falling
+    # back to pandas there would hide a broken device plan
+    import unittest.mock as mock
+
+    import fugue_tpu.column.window as w
+
+    def boom(*a, **k):  # pragma: no cover
+        raise AssertionError("host window evaluator used on the jax engine")
+
+    if poison:
+        with mock.patch.object(w, "eval_window", boom):
+            got = _pd(fa.fugue_sql(sql, df=df, engine=engine, as_local=True))
+    else:
+        got = _pd(fa.fugue_sql(sql, df=df, engine=engine, as_local=True))
+    exp = _pd(fa.fugue_sql(sql, df=df, engine=oracle, as_local=True))
+    sort_cols = list(exp.columns)
+    g = got.sort_values(sort_cols).reset_index(drop=True)
+    x = exp.sort_values(sort_cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, x, check_dtype=False)
+    return got
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(13)
+    n = 500
+    v = rng.random(n)
+    v[rng.random(n) < 0.15] = np.nan  # NULLs in the aggregate argument
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 9, n),
+            "o": rng.integers(0, 50, n),
+            # r: unique tiebreaker — ROW_NUMBER/LAG over tied order keys is
+            # legitimately nondeterministic, so tests order by (o, r)
+            "r": rng.permutation(n).astype("int64"),
+            "v": v,
+        }
+    )
+
+
+def test_row_number_rank_dense(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, o, v,
+          ROW_NUMBER() OVER (PARTITION BY k ORDER BY o, r) AS rn,
+          RANK() OVER (PARTITION BY k ORDER BY o) AS r,
+          DENSE_RANK() OVER (PARTITION BY k ORDER BY o) AS dr
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_lag_lead(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, o, v,
+          LAG(v) OVER (PARTITION BY k ORDER BY o, r) AS l1,
+          LAG(v, 2, -1.0) OVER (PARTITION BY k ORDER BY o, r) AS l2,
+          LEAD(v) OVER (PARTITION BY k ORDER BY o, r) AS f1,
+          LEAD(o, 1, 999) OVER (PARTITION BY k ORDER BY o, r) AS f2
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_running_aggregates(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, o, v,
+          SUM(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rs,
+          COUNT(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rc,
+          MIN(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rmin,
+          MAX(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rmax,
+          AVG(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS ra
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_range_peers_default_frame(engine, oracle, data):
+    # ORDER BY without an explicit frame = RANGE UNBOUNDED..CURRENT — peer
+    # rows (tied order keys) share the running value
+    _run_both(
+        """
+        SELECT k, o,
+          SUM(v) OVER (PARTITION BY k ORDER BY o) AS s,
+          COUNT(v) OVER (PARTITION BY k ORDER BY o) AS c
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_whole_partition_aggregates(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, v,
+          SUM(v) OVER (PARTITION BY k) AS s,
+          AVG(v) OVER (PARTITION BY k) AS m,
+          MIN(v) OVER (PARTITION BY k) AS lo,
+          MAX(v) OVER (PARTITION BY k) AS hi,
+          COUNT(v) OVER (PARTITION BY k) AS c
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_first_last(engine, oracle, engine_data_nonan):
+    _run_both(
+        """
+        SELECT k, o, w,
+          FIRST(w) OVER (PARTITION BY k ORDER BY o, w) AS fv,
+          LAST(w) OVER (PARTITION BY k ORDER BY o, w
+                        ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS lv
+        FROM df
+        """,
+        engine_data_nonan,
+        engine,
+        oracle,
+    )
+
+
+@pytest.fixture(scope="module")
+def engine_data_nonan():
+    rng = np.random.default_rng(14)
+    n = 300
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 7, n),
+            "o": rng.integers(0, 40, n),
+            "w": rng.random(n),
+        }
+    )
+
+
+def test_bounded_rows_frames(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, o, v,
+          SUM(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS s3,
+          AVG(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS m3,
+          COUNT(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN 2 PRECEDING AND 2 FOLLOWING) AS c5
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_window_after_where(engine, oracle, data):
+    _run_both(
+        """
+        SELECT k, o, ROW_NUMBER() OVER (PARTITION BY k ORDER BY o, r) AS rn
+        FROM df WHERE o > 10
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_desc_order_and_nan_order_keys(engine, oracle):
+    rng = np.random.default_rng(15)
+    n = 200
+    o = rng.random(n)
+    o[rng.random(n) < 0.1] = np.nan  # NULL order keys rank last
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 5, n), "o": o, "v": rng.random(n)}
+    )
+    _run_both(
+        """
+        SELECT k, o,
+          RANK() OVER (PARTITION BY k ORDER BY o DESC) AS r,
+          SUM(v) OVER (PARTITION BY k ORDER BY o DESC) AS s
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_host_fallback_for_global_window(engine, oracle, data):
+    # no PARTITION BY spans shards — host fallback (must still be correct)
+    _run_both(
+        "SELECT o, ROW_NUMBER() OVER (ORDER BY o, r) AS rn FROM df",
+        data,
+        engine,
+        oracle,
+        poison=False,
+    )
+
+
+def test_unbounded_to_following_frame(engine, oracle, data):
+    # UNBOUNDED PRECEDING .. n FOLLOWING (review regression: None offset)
+    _run_both(
+        """
+        SELECT k, o, v,
+          SUM(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND 1 FOLLOWING) AS s,
+          COUNT(v) OVER (PARTITION BY k ORDER BY o, r
+                       ROWS BETWEEN 1 PRECEDING AND UNBOUNDED FOLLOWING) AS c
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+    )
+
+
+def test_negative_lag_offset_host_fallback(engine, oracle, data):
+    # negative offsets flip direction — device plan must decline (review
+    # regression: it used to read past the partition end)
+    _run_both(
+        """
+        SELECT k, o,
+          LAG(v, -1, -99.0) OVER (PARTITION BY k ORDER BY o, r) AS x
+        FROM df
+        """,
+        data,
+        engine,
+        oracle,
+        poison=False,
+    )
+
+
+def test_int_aggregate_schema_fidelity(engine, oracle, data):
+    # SUM over an int column: host keeps long — the device plan declines
+    # rather than emit double (review regression)
+    got = _pd(
+        fa.fugue_sql(
+            "SELECT k, SUM(o) OVER (PARTITION BY k) AS s FROM df",
+            df=data,
+            engine=engine,
+            as_local=True,
+        )
+    )
+    exp = _pd(
+        fa.fugue_sql(
+            "SELECT k, SUM(o) OVER (PARTITION BY k) AS s FROM df",
+            df=data,
+            engine=oracle,
+            as_local=True,
+        )
+    )
+    assert str(got["s"].dtype) == str(exp["s"].dtype)
+    g = got.sort_values(["k", "s"]).reset_index(drop=True)
+    x = exp.sort_values(["k", "s"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, x)
