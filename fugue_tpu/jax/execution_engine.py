@@ -816,12 +816,15 @@ class JaxExecutionEngine(ExecutionEngine):
         return self.to_df(df)
 
     def join(self, df1, df2, how: str, on=None) -> DataFrame:
-        """Hash joins on numeric keys run on device (``ops/join.py``):
-        inner / left_outer / left_semi / left_anti, multi-key, with a
-        broadcast strategy for small right sides and a shuffle
-        (co-partition + shard-local probe) strategy for large×large.
-        Non-unique right keys, non-numeric keys, and right/full_outer /
-        cross go to the host engine."""
+        """Hash joins run on device (``ops/join.py``): inner / left_outer /
+        left_semi / left_anti, multi-key, unique OR duplicate right keys
+        (the 1:N/N:M expansion kernel), with a broadcast strategy for small
+        right sides and a shuffle (co-partition + shard-local probe)
+        strategy for large×large. right_outer mirrors left_outer;
+        full_outer composes left_outer ∪ NULL-extended anti; cross runs
+        through the expansion kernel on a constant key. Host fallback:
+        host-resident frames, keys the preparers can't align, and
+        expansions past the per-shard slot budget."""
         from ..dataframe.utils import parse_join_type
 
         jt = parse_join_type(how)
@@ -835,7 +838,214 @@ class JaxExecutionEngine(ExecutionEngine):
             res = self._join_device(df1, df2, kernel_how, on)
             if res is not None:
                 return res
+        elif jt == "right_outer":
+            # mirrored left_outer, columns re-ordered to the contract schema
+            res = self._join_device(df2, df1, "left_outer", on)
+            if res is not None:
+                from ..dataframe.utils import get_join_schemas
+
+                _, out_schema = get_join_schemas(
+                    self.to_df(df1), self.to_df(df2), how="right_outer", on=on
+                )
+                if list(res.schema.names) != out_schema.names:
+                    res = res[out_schema.names]  # type: ignore[index]
+                return res
+        elif jt == "full_outer":
+            res = self._full_outer_device(df1, df2, on)
+            if res is not None:
+                return res
+        elif jt == "cross":
+            res = self._cross_device(df1, df2)
+            if res is not None:
+                return res
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
+
+    def _full_outer_device(self, df1, df2, on) -> Optional[DataFrame]:
+        """full_outer = left_outer(L,R) ∪ (anti(R,L) with NULL left
+        values) — composed from device verbs, so it inherits all their
+        representations (dictionaries, epochs, masks)."""
+        from ..dataframe.utils import get_join_schemas
+
+        try:
+            _, out_schema = get_join_schemas(
+                self.to_df(df1), self.to_df(df2), how="full_outer", on=on
+            )
+        except Exception:
+            return None
+        left_part = self._join_device(df1, df2, "left_outer", on)
+        if left_part is None:
+            return None
+        right_only = self._join_device(df2, df1, "anti", on)
+        if right_only is None:
+            return None
+        ext = self._null_extend(right_only, out_schema, self.to_df(df1))
+        if ext is None:
+            return None
+        lp = (
+            left_part
+            if list(left_part.schema.names) == out_schema.names
+            else left_part[out_schema.names]  # type: ignore[index]
+        )
+        res = self.union(lp, ext, distinct=False)
+        return res if isinstance(res, JaxDataFrame) else None
+
+    def _null_extend(
+        self, jr: DataFrame, out_schema: Schema, j1: JaxDataFrame
+    ) -> Optional[JaxDataFrame]:
+        """Extend right-only rows to the full join schema: absent (left-
+        side) columns become NULL in each dtype's device representation."""
+        import jax
+
+        jr = self.to_df(jr)
+        if not isinstance(jr, JaxDataFrame) or jr.host_table is not None:
+            return None
+        n = next(iter(jr.device_cols.values())).shape[0]
+        sharding = row_sharding(self._mesh)
+        cols: Dict[str, Any] = {}
+        encodings: Dict[str, Any] = dict(jr.encodings)
+        null_masks: Dict[str, Any] = dict(jr.null_masks)
+        nan_new: set = set()
+        for f in out_schema.fields:
+            name = f.name
+            if name in jr.device_cols:
+                cols[name] = jr.device_cols[name]
+                continue
+            if name not in j1.device_cols:
+                return None  # left column wasn't device-resident
+            enc = j1.encodings.get(name)
+            dt = np.dtype(j1.device_cols[name].dtype)
+            if enc is not None and enc["kind"] == "dict":
+                cols[name] = jax.device_put(
+                    np.full(n, -1, dtype=dt), sharding
+                )
+                encodings[name] = dict(enc)
+            elif enc is not None and enc["kind"] == "datetime":
+                cols[name] = jax.device_put(np.zeros(n, dtype=dt), sharding)
+                encodings[name] = dict(enc)
+                null_masks[name] = jax.device_put(
+                    np.ones(n, dtype=bool), sharding
+                )
+            elif np.issubdtype(dt, np.floating):
+                cols[name] = jax.device_put(
+                    np.full(n, np.nan, dtype=dt), sharding
+                )
+                nan_new.add(name)
+            else:
+                cols[name] = jax.device_put(np.zeros(n, dtype=dt), sharding)
+                null_masks[name] = jax.device_put(
+                    np.ones(n, dtype=bool), sharding
+                )
+        nan_cols = (
+            None if jr._nan_cols is None else set(jr._nan_cols) | nan_new
+        )
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols={name: cols[name] for name in out_schema.names},
+                host_tbl=None,
+                row_count=jr._row_count,
+                valid_mask=jr.valid_mask,
+                nan_cols=nan_cols,
+                encodings=encodings,
+                null_masks=null_masks,
+                schema=out_schema,
+            ),
+        )
+
+    def _cross_device(self, df1, df2) -> Optional[DataFrame]:
+        """Cross join via the expansion kernel over a constant synthetic
+        key (every left row matches every right row)."""
+        import jax
+
+        from ..ops.join import MAX_BROADCAST_ROWS, device_expand_join
+
+        j1, j2 = self.to_df(df1), self.to_df(df2)
+        if not (
+            isinstance(j1, JaxDataFrame)
+            and isinstance(j2, JaxDataFrame)
+            and j1.host_table is None
+            and j2.host_table is None
+            and len(j1.device_cols) > 0
+            and len(j2.device_cols) > 0
+        ):
+            return None
+        n_right = next(iter(j2.device_cols.values())).shape[0]
+        if n_right > MAX_BROADCAST_ROWS:
+            return None
+        if any(c in j1.schema for c in j2.schema.names):
+            return None  # overlapping names — host handles the error
+        mp = _safe_prefix("__mask__", j1.schema.names, j2.schema.names)
+        lmp = _safe_prefix("__lmask__", j1.schema.names)
+        kp = _safe_prefix("__xkey", j1.schema.names, j2.schema.names)
+        rep = replicated_sharding(self._mesh)
+        ones_l = jax.device_put(
+            np.zeros(next(iter(j1.device_cols.values())).shape[0], np.int8),
+            row_sharding(self._mesh),
+        )
+        ones_r = jax.device_put(np.zeros(n_right, np.int8), rep)
+        left_cols = dict(j1.device_cols)
+        for c, m in j1.null_masks.items():
+            left_cols[f"{lmp}{c}"] = m
+        left_cols[f"{kp}0"] = ones_l
+        right_entries: List[Any] = []
+        encodings: Dict[str, Any] = dict(j1.encodings)
+        for v in j2.schema.names:
+            arr = jax.device_put(j2.device_cols[v], rep)
+            right_entries.append((v, arr, 0))
+            enc = j2.encodings.get(v)
+            if enc is not None:
+                encodings[v] = enc
+        for v, m in j2.null_masks.items():
+            right_entries.append(
+                (f"{mp}{v}", jax.device_put(m, rep), True)
+            )
+        res = device_expand_join(
+            self._mesh,
+            "inner",
+            left_cols,
+            j1.device_valid_mask(),
+            [f"{kp}0"],
+            [ones_r],
+            jax.device_put(j2.device_valid_mask(), rep),
+            right_entries,
+            strategy="broadcast",
+        )
+        if res is None:
+            return None
+        new_cols, new_valid, _ = res
+        null_masks: Dict[str, Any] = {}
+        for c in list(j1.null_masks):
+            m = new_cols.pop(f"{lmp}{c}", None)
+            if m is not None:
+                null_masks[c] = m
+        for v in list(j2.null_masks):
+            m = new_cols.pop(f"{mp}{v}", None)
+            if m is not None:
+                null_masks[v] = m
+        new_cols.pop(f"{kp}0", None)
+        out_schema = Schema(
+            list(j1.schema.fields) + list(j2.schema.fields)
+        )
+        nan_cols = (
+            None
+            if j1._nan_cols is None or j2._nan_cols is None
+            else set(j1._nan_cols) | set(j2._nan_cols)
+        )
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols={n: new_cols[n] for n in out_schema.names},
+                host_tbl=None,
+                row_count=-1,
+                valid_mask=new_valid,
+                nan_cols=nan_cols,
+                encodings={
+                    k: v for k, v in encodings.items() if k in out_schema
+                },
+                null_masks=null_masks,
+                schema=out_schema,
+            ),
+        )
 
     def _prepare_join_keys(
         self, j1: JaxDataFrame, j2: JaxDataFrame, keys: List[str]
@@ -1046,6 +1256,27 @@ class JaxExecutionEngine(ExecutionEngine):
             host_tbl = None
             nan_cols = None
             encodings = dict(j1.encodings)
+        if strategy == "shuffle":
+            # ONE exchange, shared by the unique probe and any dup-key
+            # expansion retry (the retry must not repeat the all-to-all)
+            from ..ops.join import copartition_by_keys
+
+            (
+                left_cols,
+                left_valid,
+                right_key_arrs,
+                right_entries,
+                right_valid,
+            ) = copartition_by_keys(
+                self._mesh,
+                left_cols,
+                left_valid,
+                list(left_key_arrs.keys()),
+                right_key_arrs,
+                right_entries,
+                right_valid,
+            )
+            strategy = "local"
         res = device_hash_join(
             self._mesh,
             kernel_how,
@@ -1057,13 +1288,43 @@ class JaxExecutionEngine(ExecutionEngine):
             right_entries,
             strategy=strategy,
         )
+        expanded = False
         if res is None:
-            return None
+            # duplicate right keys: the 1:N/N:M expansion path. semi/anti
+            # keep row alignment (mask-only); inner/left_outer materialize
+            # (left row, match) pairs — rows move, host columns can't follow
+            from ..ops.join import device_expand_join
+
+            if kernel_how in ("inner", "left_outer"):
+                if j1.host_table is not None:
+                    return None
+                if strategy == "broadcast":
+                    # the unique-path broadcast payload omitted the left
+                    # masks (rows didn't move); expansion gathers rows, so
+                    # masks must ride along
+                    for c, m2 in j1.null_masks.items():
+                        left_cols[f"{lmp}{c}"] = m2
+                    host_tbl = None
+                    null_masks = {}
+            res = device_expand_join(
+                self._mesh,
+                kernel_how,
+                left_cols,
+                left_valid,
+                list(left_key_arrs.keys()),
+                right_key_arrs,
+                right_valid,
+                right_entries,
+                strategy=strategy,
+            )
+            if res is None:
+                return None
+            expanded = True
         new_cols, new_valid, match = res
         # reassemble: pop probe keys, split off mask arrays
         for mk in left_key_arrs:
             new_cols.pop(mk, None)
-        if strategy == "shuffle":
+        if strategy == "local" or expanded:
             for c in list(j1.null_masks):
                 m = new_cols.pop(f"{lmp}{c}", None)
                 if m is not None:

@@ -142,11 +142,12 @@ def _plan_items(
                     default, (int, float, bool)
                 ):
                     return None
-            if default is None and not np.issubdtype(
-                np.dtype(jdf.device_cols[arg].dtype), np.floating
-            ):
+            if default is None and np.dtype(
+                jdf.device_cols[arg].dtype
+            ) != np.dtype(np.float64):
                 # NULL fills force a float64 result — the host path keeps
-                # the arg's type; don't let the plan change output schemas
+                # the arg's type (incl. float32); don't let the plan change
+                # output schemas
                 return None
             specs.append((out_name, func, arg, offset, default))
             continue
@@ -160,12 +161,12 @@ def _plan_items(
                 return None
             if func in ("FIRST", "LAST") and jdf.maybe_nan(arg):
                 return None  # positional semantics vs NaN==NULL ambiguity
-            if func not in ("COUNT", "FIRST", "LAST") and not np.issubdtype(
-                np.dtype(jdf.device_cols[arg].dtype), np.floating
-            ):
-                # int SUM/MIN/MAX/AVG: float64 accumulation would change
-                # the output type (host keeps long) and lose precision
-                # past 2^53 — host fallback
+            if func not in ("COUNT", "FIRST", "LAST") and np.dtype(
+                jdf.device_cols[arg].dtype
+            ) != np.dtype(np.float64):
+                # non-float64 SUM/MIN/MAX/AVG: float64 accumulation would
+                # change the output type (host keeps long/float) and lose
+                # int precision past 2^53 — host fallback
                 return None
             tag = _norm_frame(expr)
             if tag is None:
@@ -278,18 +279,13 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                 for k in pkeys:
                     seg_change = seg_change | nan_eq_diff(sc[k])
                 seg_change = seg_change.at[0].set(True)
-                seg = jnp.cumsum(seg_change.astype(jnp.int32)) - 1
                 seg_start = jax.lax.cummax(
                     jnp.where(seg_change, iota, jnp.int32(-1))
                 )
-                nxt = jnp.concatenate(
-                    [jnp.where(seg_change, iota, big)[1:], jnp.full((1,), big, jnp.int32)]
-                )
-                seg_end = jnp.minimum(
-                    jnp.flip(jax.lax.cummin(jnp.flip(nxt))) - 1,
-                    jnp.int32(n_rows - 1),
-                )
-                def end_of_run(change: Any) -> Any:
+
+                def end_of_run(change: Any, cap_at: Any) -> Any:
+                    """Last index of the run each row belongs to (a run
+                    starts wherever ``change`` is True)."""
                     return jnp.minimum(
                         jnp.flip(
                             jax.lax.cummin(
@@ -304,8 +300,10 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                             )
                         )
                         - 1,
-                        seg_end,
+                        cap_at,
                     )
+
+                seg_end = end_of_run(seg_change, jnp.int32(n_rows - 1))
 
                 # peer (tied-order-key) machinery per ORDER BY prefix length
                 peer_change_by: Dict[int, Any] = {0: seg_change}
@@ -314,7 +312,7 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                     pc = pc | nan_eq_diff(sc[n])
                     peer_change_by[j + 1] = pc
                 peer_end_by = {
-                    j: end_of_run(ch) for j, ch in peer_change_by.items()
+                    j: end_of_run(ch, seg_end) for j, ch in peer_change_by.items()
                 }
 
                 def seg_scan(op, x):

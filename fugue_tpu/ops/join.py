@@ -32,6 +32,8 @@ _JOIN_CACHE: Dict[Any, Any] = {}
 
 # right sides larger than this use the shuffle strategy
 MAX_BROADCAST_ROWS = 1 << 20
+# per-shard output-slot budget for the 1:N expansion join
+MAX_EXPAND_ROWS = 1 << 22
 
 
 def _key_hash_and_valid(jnp: Any, key_cols: List[Any], valid: Any):
@@ -186,6 +188,40 @@ def _get_compiled_probe(
     return _JOIN_CACHE[key]
 
 
+def copartition_by_keys(
+    mesh: Any,
+    left_cols: Dict[str, Any],
+    left_valid: Any,
+    left_key_names: List[str],
+    right_keys: List[Any],
+    right_values: List[Tuple[str, Any, Any]],
+    right_valid: Any,
+) -> Tuple[Dict[str, Any], Any, List[Any], List[Tuple[str, Any, Any]], Any]:
+    """Co-partition both join sides by key hash (ONE all-to-all per side);
+    shared by the unique-probe and expansion joins so a dup-key fallback
+    never repeats the exchange."""
+    from .shuffle import compute_dest, exchange_rows
+
+    n_keys = len(left_key_names)
+    l_dest = compute_dest(
+        mesh, "hash", [left_cols[k] for k in left_key_names], left_valid
+    )
+    r_dest = compute_dest(mesh, "hash", list(right_keys), right_valid)
+    left_cols, left_valid, _ = exchange_rows(
+        mesh, dict(left_cols), left_valid, l_dest
+    )
+    r_payload = {f"__k{i}__": a for i, a in enumerate(right_keys)}
+    r_payload.update({f"__v__{n}": a for n, a, _ in right_values})
+    r_payload, right_valid, _ = exchange_rows(
+        mesh, r_payload, right_valid, r_dest
+    )
+    right_keys = [r_payload[f"__k{i}__"] for i in range(n_keys)]
+    right_values = [
+        (n, r_payload[f"__v__{n}"], f) for n, _, f in right_values
+    ]
+    return left_cols, left_valid, right_keys, right_values, right_valid
+
+
 def device_hash_join(
     mesh: Any,
     how: str,
@@ -221,28 +257,16 @@ def device_hash_join(
     import jax
     import numpy as np
 
-    shuffle = strategy == "shuffle"
+    if strategy == "shuffle":
+        left_cols, left_valid, right_keys, right_values, right_valid = (
+            copartition_by_keys(
+                mesh, left_cols, left_valid, left_key_names,
+                right_keys, right_values, right_valid,
+            )
+        )
+        strategy = "local"
+    shuffle = strategy == "local"
     n_keys = len(left_key_names)
-    if shuffle:
-        from .shuffle import compute_dest, exchange_rows
-
-        # co-partition both sides by the same key hash
-        l_dest = compute_dest(
-            mesh, "hash", [left_cols[k] for k in left_key_names], left_valid
-        )
-        r_dest = compute_dest(mesh, "hash", list(right_keys), right_valid)
-        left_cols, left_valid, _ = exchange_rows(
-            mesh, dict(left_cols), left_valid, l_dest
-        )
-        r_payload = {f"__k{i}__": a for i, a in enumerate(right_keys)}
-        r_payload.update({f"__v__{n}": a for n, a, _ in right_values})
-        r_payload, right_valid, _ = exchange_rows(
-            mesh, r_payload, right_valid, r_dest
-        )
-        right_keys = [r_payload[f"__k{i}__"] for i in range(n_keys)]
-        right_values = [
-            (n, r_payload[f"__v__{n}"], f) for n, _, f in right_values
-        ]
     kdt = tuple(str(a.dtype) for a in right_keys)
     prep = _get_compiled_right_prep(mesh, n_keys, kdt, local=shuffle)
     s_h, order, nv, dup = prep(right_valid, *right_keys)
@@ -280,6 +304,227 @@ def device_hash_join(
         for (name, _, _), arr in zip(right_values, outs[1:-1]):
             new_cols[name] = arr
         match = outs[-1]
+    return new_cols, new_valid, match
+
+
+def _get_compiled_expand_count(mesh: Any, n_keys: int, dtypes: Any, local: bool, miss_slot: bool):
+    """Phase A of the 1:N expansion: per-left-row candidate counts (hash-run
+    length in the sorted right side), exclusive offsets, and the replicated
+    per-shard max slot total (→ static output capacity)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    key = ("xcount", mesh, n_keys, dtypes, local, miss_slot)
+    if key not in _JOIN_CACHE:
+
+        def count(f_valid: Any, s_h: Any, nv: Any, *fk: Any):
+            fh, fkv = _key_hash_and_valid(jnp, list(fk), f_valid)
+            lo = jnp.searchsorted(s_h, fh, side="left")
+            hi = jnp.searchsorted(s_h, fh, side="right")
+            hi = jnp.minimum(hi, nv[0])
+            lo = jnp.minimum(lo, hi)
+            cand = jnp.where(f_valid & fkv, hi - lo, 0).astype(jnp.int64)
+            slots = cand + (f_valid.astype(jnp.int64) if miss_slot else 0)
+            off = jnp.cumsum(slots) - slots  # exclusive
+            total = jnp.where(
+                slots.shape[0] > 0, off[-1] + slots[-1], jnp.int64(0)
+            )
+            return cand, lo.astype(jnp.int64), off, lax.pmax(total, ROW_AXIS)[None]
+
+        row = P(ROW_AXIS)
+        right = row if local else P()
+        _JOIN_CACHE[key] = jax.jit(
+            jax.shard_map(
+                count,
+                mesh=mesh,
+                in_specs=(row, right, right) + tuple(row for _ in range(n_keys)),
+                out_specs=(row, row, row, P()),
+            )
+        )
+    return _JOIN_CACHE[key]
+
+
+def _get_compiled_expand(
+    mesh: Any,
+    how: str,
+    cap: int,
+    n_keys: int,
+    n_left: int,
+    n_values: int,
+    dtypes: Any,
+    local: bool,
+    fills: Tuple[Any, ...],
+):
+    """Phase B: materialize one output row per (left row, candidate) pair
+    into a static ``cap``-per-shard buffer; collisions and misses become
+    masked slots, never wrong rows."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    key = ("xpand", mesh, how, cap, n_keys, n_left, n_values, dtypes, local, fills)
+    if key not in _JOIN_CACHE:
+
+        def expand(*args: Any):
+            cand, lo, off, f_valid, order = args[:5]
+            fk = args[5 : 5 + n_keys]
+            lp = args[5 + n_keys : 5 + n_keys + n_left]
+            rk = args[5 + n_keys + n_left : 5 + 2 * n_keys + n_left]
+            rv = args[5 + 2 * n_keys + n_left :]
+            n = f_valid.shape[0]
+            nr = order.shape[0]
+            io = lax.iota(jnp.int64, cap)
+            row = jnp.clip(
+                jnp.searchsorted(off, io, side="right") - 1, 0, n - 1
+            )
+            within = io - off[row]
+            is_cand = within < cand[row]
+            src = order[jnp.clip(lo[row] + within, 0, nr - 1)]
+            eq = is_cand & f_valid[row]
+            for k_, r_ in zip(fk, rk):
+                eq = eq & (r_[src] == k_[row])
+            matched = (
+                jnp.zeros(n, dtype=jnp.int32)
+                .at[row]
+                .max(eq.astype(jnp.int32), mode="drop")
+            ) > 0
+            if how in ("semi", "anti"):
+                mres = matched if how == "semi" else jnp.logical_not(matched)
+                return (f_valid & mres,)
+            total = off[-1] + cand[-1] + (
+                f_valid[-1].astype(jnp.int64) if how == "left_outer" else 0
+            )
+            in_range = io < total
+            if how == "left_outer":
+                miss = (
+                    (within == cand[row])
+                    & f_valid[row]
+                    & jnp.logical_not(matched[row])
+                )
+                valid_out = in_range & (eq | miss)
+            else:
+                valid_out = in_range & eq
+            louts = tuple(a[row] for a in lp)
+            if how == "left_outer":
+                routs = tuple(
+                    jnp.where(eq, a[src], jnp.asarray(f, dtype=a.dtype))
+                    for a, (f,) in zip(rv, fills_z)
+                )
+            else:
+                routs = tuple(a[src] for a in rv)
+            return (valid_out,) + louts + routs + ((eq,) if how == "left_outer" else ())
+
+        fills_z = [(f,) for f in fills] if len(fills) else [(0,)] * n_values
+        row_spec = P(ROW_AXIS)
+        right = row_spec if local else P()
+        n_out = (
+            1
+            if how in ("semi", "anti")
+            else 1 + n_left + n_values + (1 if how == "left_outer" else 0)
+        )
+        _JOIN_CACHE[key] = jax.jit(
+            jax.shard_map(
+                expand,
+                mesh=mesh,
+                in_specs=(row_spec, row_spec, row_spec, row_spec, right)
+                + tuple(row_spec for _ in range(n_keys + n_left))
+                + tuple(right for _ in range(n_keys + n_values)),
+                out_specs=tuple(row_spec for _ in range(n_out)),
+            )
+        )
+    return _JOIN_CACHE[key]
+
+
+def device_expand_join(
+    mesh: Any,
+    how: str,
+    left_cols: Dict[str, Any],
+    left_valid: Any,
+    left_key_names: List[str],
+    right_keys: List[Any],
+    right_valid: Any,
+    right_values: List[Tuple[str, Any, Any]],
+    strategy: str = "broadcast",
+) -> Optional[Tuple[Dict[str, Any], Any, Optional[Any]]]:
+    """1:N / N:M device join — duplicate right keys allowed.
+
+    Same contract as :func:`device_hash_join` but the output is an
+    EXPANDED frame: one row per (left row, matching right row), built in a
+    statically-capacity-negotiated buffer (the only host sync is the tiny
+    replicated slot-total). For ``semi``/``anti`` the left frame keeps its
+    shape and only the validity mask changes.
+
+    The reference handles 1:N joins on every backend via its SQL engines
+    (``fugue_test/execution_suite.py:379-544``); this is the device-native
+    equivalent.
+    """
+    import jax
+    import numpy as np
+
+    if strategy == "shuffle":
+        left_cols, left_valid, right_keys, right_values, right_valid = (
+            copartition_by_keys(
+                mesh, left_cols, left_valid, left_key_names,
+                right_keys, right_values, right_valid,
+            )
+        )
+        strategy = "local"
+    shuffle = strategy == "local"
+    n_keys = len(left_key_names)
+    kdt = tuple(str(a.dtype) for a in right_keys)
+    prep = _get_compiled_right_prep(mesh, n_keys, kdt, local=shuffle)
+    s_h, order, nv, _dup = prep(right_valid, *right_keys)
+    fk_arrs = [left_cols[k] for k in left_key_names]
+    counter = _get_compiled_expand_count(
+        mesh, n_keys, kdt, local=shuffle, miss_slot=(how == "left_outer")
+    )
+    cand, lo, off, max_total = counter(left_valid, s_h, nv, *fk_arrs)
+    mt = int(np.asarray(jax.device_get(max_total))[0])
+    if mt > MAX_EXPAND_ROWS:
+        return None  # output would blow past the per-shard budget → host
+    cap = 1 << (max(1, mt) - 1).bit_length()  # pow2 ≥ mt, ≥ 1
+    left_payload_names = [k for k in left_cols if k not in left_key_names]
+    vdt = tuple(str(a.dtype) for _, a, _ in right_values)
+    ldt = tuple(str(left_cols[k].dtype) for k in left_payload_names)
+    fills = (
+        tuple(f for _, _, f in right_values) if how == "left_outer" else ()
+    )
+    expander = _get_compiled_expand(
+        mesh,
+        how,
+        cap,
+        n_keys,
+        len(left_payload_names),
+        len(right_values),
+        (kdt, ldt, vdt),
+        local=shuffle,
+        fills=fills,
+    )
+    outs = expander(
+        cand,
+        lo,
+        off,
+        left_valid,
+        order,
+        *fk_arrs,
+        *[left_cols[k] for k in left_payload_names],
+        *right_keys,
+        *[a for _, a, _ in right_values],
+    )
+    if how in ("semi", "anti"):
+        return dict(left_cols), outs[0], None
+    new_valid = outs[0]
+    new_cols: Dict[str, Any] = {}
+    lo_i = 1
+    for k, arr in zip(left_payload_names, outs[lo_i : lo_i + len(left_payload_names)]):
+        new_cols[k] = arr
+    vi = lo_i + len(left_payload_names)
+    for (name, _, _), arr in zip(right_values, outs[vi : vi + len(right_values)]):
+        new_cols[name] = arr
+    match = outs[-1] if how == "left_outer" else None
     return new_cols, new_valid, match
 
 

@@ -1,0 +1,160 @@
+"""1:N/N:M device joins (expansion kernel), right/full outer, cross.
+
+The reference runs duplicate-key joins on every backend
+(fugue_test/execution_suite.py:379-544); these are the device-native
+equivalents. The host engine's join is poisoned inside `_device_only` so a
+silent fallback fails the test.
+"""
+
+import contextlib
+import unittest.mock as mock
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.dataframe import JaxDataFrame
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    e = NativeExecutionEngine()
+    yield e
+    e.stop()
+
+
+@contextlib.contextmanager
+def _device_only(engine):
+    def boom(*a, **k):  # pragma: no cover
+        raise AssertionError("host join used")
+
+    with mock.patch.object(engine._host_engine, "join", boom):
+        yield
+
+
+def _chk(engine, oracle, left, right, how, device_only=True):
+    ctx = _device_only(engine) if device_only else contextlib.nullcontext()
+    with ctx:
+        d = engine.join(engine.to_df(left), engine.to_df(right), how=how)
+        if device_only:
+            assert isinstance(d, JaxDataFrame)
+        got = d.as_pandas()
+    exp = oracle.join(oracle.to_df(left), oracle.to_df(right), how=how).as_pandas()
+    sc = list(exp.columns)
+    g = got[sc].sort_values(sc).reset_index(drop=True)
+    x = exp.sort_values(sc).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, x, check_dtype=False)
+    return got
+
+
+def test_duplicate_right_keys_all_types(engine, oracle):
+    left = pd.DataFrame({"k": [1, 2, 3, 4], "a": [10.0, 20.0, 30.0, 40.0]})
+    right = pd.DataFrame(
+        {"k": [1, 1, 2, 2, 2, 9], "b": [1.0, 2.0, 3.0, 4.0, 5.0, 9.0]}
+    )
+    for how in ("inner", "left_outer", "left_semi", "left_anti"):
+        _chk(engine, oracle, left, right, how)
+
+
+def test_n_to_m_duplicates(engine, oracle):
+    left = pd.DataFrame({"k": [1, 1, 1, 2, 2], "a": range(5)})
+    right = pd.DataFrame({"k": [1, 1, 2, 2, 2], "b": range(10, 15)})
+    _chk(engine, oracle, left, right, "inner")
+    _chk(engine, oracle, left, right, "left_outer")
+
+
+def test_random_large_nm(engine, oracle):
+    rng = np.random.default_rng(0)
+    left = pd.DataFrame(
+        {"k": rng.integers(0, 50, 5000), "a": rng.random(5000)}
+    )
+    right = pd.DataFrame(
+        {"k": rng.integers(0, 60, 2000), "b": rng.random(2000)}
+    )
+    got = _chk(engine, oracle, left, right, "inner")
+    assert len(got) > 100_000  # genuinely expanded
+
+
+def test_multi_key_duplicates(engine, oracle):
+    left = pd.DataFrame(
+        {"x": [1, 1, 2, 2], "y": [0, 1, 0, 1], "a": [1.0, 2.0, 3.0, 4.0]}
+    )
+    right = pd.DataFrame(
+        {"x": [1, 1, 2], "y": [0, 0, 1], "b": [9.0, 8.0, 7.0]}
+    )
+    _chk(engine, oracle, left, right, "inner")
+    _chk(engine, oracle, left, right, "left_outer")
+
+
+def test_null_keys_with_duplicates(engine, oracle):
+    # NULL keys never match even when the right side has duplicates
+    left = pd.DataFrame({"k": [1.0, np.nan, 2.0], "a": [1.0, 2.0, 3.0]})
+    right = pd.DataFrame(
+        {"k": [1.0, 1.0, np.nan, np.nan], "b": [5.0, 6.0, 7.0, 8.0]}
+    )
+    _chk(engine, oracle, left, right, "inner")
+    _chk(engine, oracle, left, right, "left_outer")
+    _chk(engine, oracle, left, right, "left_anti")
+
+
+def test_right_outer_device(engine, oracle):
+    left = pd.DataFrame({"k": [1, 2, 3], "a": [1.0, 2.0, 3.0]})
+    right = pd.DataFrame({"k": [2, 2, 4], "b": [5.0, 6.0, 7.0]})
+    _chk(engine, oracle, left, right, "right_outer")
+
+
+def test_full_outer_device(engine, oracle):
+    left = pd.DataFrame({"k": [1, 2], "s": ["a", "b"], "n": [100, 200]})
+    right = pd.DataFrame({"k": [2, 3, 3], "w": [5.0, 6.0, 7.0]})
+    got = _chk(engine, oracle, left, right, "full_outer")
+    # right-only rows carry NULL left values in every representation
+    only3 = got[got["k"] == 3]
+    assert only3["s"].isna().all() and only3["n"].isna().all()
+
+
+def test_full_outer_random(engine, oracle):
+    rng = np.random.default_rng(7)
+    left = pd.DataFrame(
+        {"k": rng.integers(0, 30, 500), "a": rng.random(500)}
+    )
+    right = pd.DataFrame(
+        {"k": rng.integers(10, 40, 400), "b": rng.random(400)}
+    )
+    _chk(engine, oracle, left, right, "full_outer")
+
+
+def test_cross_join_device(engine, oracle):
+    left = pd.DataFrame({"x": [1, 2, 3], "s": ["p", "q", "r"]})
+    right = pd.DataFrame({"y": [10.0, 20.0], "m": [1, 2]})
+    got = _chk(engine, oracle, left, right, "cross")
+    assert len(got) == 6
+
+
+def test_workflow_level_duplicate_join(engine, oracle):
+    import fugue_tpu.api as fa
+
+    left = pd.DataFrame({"k": [1, 2, 3], "a": [1.0, 2.0, 3.0]})
+    right = pd.DataFrame({"k": [1, 1, 2], "b": [5.0, 6.0, 7.0]})
+    with _device_only(engine):
+        res = fa.fugue_sql(
+            """
+            SELECT df.k, a, b FROM df INNER JOIN other ON df.k = other.k
+            """,
+            df=left,
+            other=right,
+            engine=engine,
+            as_local=True,
+        )
+    got = (res.to_pandas() if hasattr(res, "to_pandas") else res).sort_values(
+        ["k", "b"]
+    )
+    assert got["b"].tolist() == [5.0, 6.0, 7.0]
