@@ -156,8 +156,504 @@ class SQLExecutor:
             return self._exec_select(node)
         raise FugueSQLSyntaxError(f"unknown plan node {type(node)}")
 
+    # -- correlated subqueries (decorrelation to joins) ---------------------
+
+    @staticmethod
+    def _conjuncts(expr: Optional[ColumnExpr]) -> List[ColumnExpr]:
+        from ..column.expressions import _BinaryOpExpr
+
+        if expr is None:
+            return []
+        if isinstance(expr, _BinaryOpExpr) and expr.op == "&":
+            return SQLExecutor._conjuncts(expr.left) + SQLExecutor._conjuncts(
+                expr.right
+            )
+        return [expr]
+
+    @staticmethod
+    def _rebuild_and(cs: List[ColumnExpr]) -> Optional[ColumnExpr]:
+        from ..column.expressions import _BinaryOpExpr
+
+        cur: Optional[ColumnExpr] = None
+        for c in cs:
+            cur = c if cur is None else _BinaryOpExpr("&", cur, c)
+        return cur
+
+    def _scan_names(self, plan: Optional[PlanNode]) -> set:
+        """Table names AND aliases visible in a plan's FROM tree."""
+        names: set = set()
+
+        def walk(p: Any) -> None:
+            if isinstance(p, Scan):
+                names.add(p.name)
+                if p.alias:
+                    names.add(p.alias)
+            if isinstance(p, Subquery) and p.alias:
+                names.add(p.alias)
+            for f in getattr(p, "__dataclass_fields__", {}):
+                v = getattr(p, f)
+                if isinstance(v, PlanNode):
+                    walk(v)
+
+        if plan is not None:
+            walk(plan)
+        return names
+
+    def _assert_no_foreign_refs(self, plan: PlanNode) -> None:
+        """Refuse to run a subplan that references tables outside its own
+        FROM tree (a correlated subquery in an unsupported position):
+        qualifiers are stripped from column names at parse time, so running
+        such a plan would silently bind outer refs to same-named inner
+        columns."""
+        own = self._scan_names(plan)
+
+        def walk_expr(e: Any) -> None:
+            if isinstance(e, _NamedColumnExpr):
+                q = getattr(e, "_sql_qualifier", "")
+                if q and q not in own:
+                    raise NotImplementedError(
+                        f"correlated subquery reference {q}.{e.name} is "
+                        "only supported as an equality conjunct of a top-"
+                        "level WHERE EXISTS / scalar subquery"
+                    )
+            for c in getattr(e, "children", []):
+                walk_expr(c)
+
+        def walk(p: Any) -> None:
+            if isinstance(p, SelectNode):
+                for c in p.projections:
+                    walk_expr(c)
+                if p.where is not None:
+                    walk_expr(p.where)
+                if p.having is not None:
+                    walk_expr(p.having)
+            for f in getattr(p, "__dataclass_fields__", {}):
+                v = getattr(p, f)
+                if isinstance(v, PlanNode):
+                    walk(v)
+
+        walk(plan)
+
+    def _exec_memo(self, plan: PlanNode) -> DataFrame:
+        """Execute a subquery's FROM tree once per analysis pass."""
+        memo = getattr(self, "_plan_memo", None)
+        if memo is None:
+            memo = self._plan_memo = {}
+        key = id(plan)
+        if key not in memo:
+            memo[key] = self._exec(plan)
+        return memo[key]
+
+    def _refs_outer(
+        self, expr: ColumnExpr, ischema: Any, outer_names: set, oschema: Any
+    ) -> bool:
+        def walk(c: Any) -> bool:
+            if isinstance(c, _NamedColumnExpr):
+                q = getattr(c, "_sql_qualifier", "")
+                if q and q in outer_names:
+                    return True
+                if not q and c.name not in ischema and c.name in oschema:
+                    return True
+            return any(walk(x) for x in getattr(c, "children", []))
+
+        return walk(expr)
+
+    def _corr_split(self, plan: PlanNode, outer_names: set, oschema: Any):
+        """Analyze a subquery plan for equality correlation against the
+        outer select. Returns (inner_df, pairs[(outer,inner)], residual,
+        plan) for a correlated shape, "uncorrelated", or None (shape this
+        decorrelator doesn't handle → let the generic path error)."""
+        from ..column.expressions import _BinaryOpExpr
+
+        if (
+            not isinstance(plan, SelectNode)
+            or plan.child is None
+            or len(plan.group_by) > 0
+            or plan.having is not None
+            or plan.grouping_sets is not None
+        ):
+            return None
+        inner_names = self._scan_names(plan.child)
+        try:
+            inner_df = self._exec_memo(plan.child)
+        except Exception:
+            return None
+        ischema = inner_df.schema
+        pairs: List[Any] = []
+        residual: List[ColumnExpr] = []
+        for c in self._conjuncts(plan.where):
+            if (
+                isinstance(c, _BinaryOpExpr)
+                and c.op == "=="
+                and isinstance(c.left, _NamedColumnExpr)
+                and isinstance(c.right, _NamedColumnExpr)
+            ):
+                sides = []
+                for cc in (c.left, c.right):
+                    q = getattr(cc, "_sql_qualifier", "")
+                    if q and q in inner_names:
+                        sides.append("i")
+                    elif q and q in outer_names:
+                        sides.append("o")
+                    elif cc.name in ischema:
+                        sides.append("i")
+                    elif cc.name in oschema:
+                        sides.append("o")
+                    else:
+                        sides.append("?")
+                if sides == ["i", "o"]:
+                    pairs.append((c.right.name, c.left.name))
+                    continue
+                if sides == ["o", "i"]:
+                    pairs.append((c.left.name, c.right.name))
+                    continue
+            residual.append(c)
+        for c in residual:
+            if self._refs_outer(c, ischema, outer_names, oschema):
+                return None  # non-equality correlation — unsupported
+        if len(pairs) == 0:
+            return "uncorrelated"
+        return inner_df, pairs, self._rebuild_and(residual), plan
+
+    def _decorrelate(self, node: SelectNode, child: DataFrame):
+        """Rewrite correlated EXISTS / scalar subqueries into joins against
+        ``child``. Returns (node, child), possibly unchanged. Matches the
+        capability the reference gets free from its SQL backends
+        (``fugue_duckdb/execution_engine.py:95-105``)."""
+        import dataclasses
+
+        from ..collections.partition import PartitionSpec
+        from ..column.expressions import _UnaryOpExpr
+        from .parser import _SubqueryExistsExpr, _SubqueryScalarExpr
+
+        e = self._engine
+        outer_names = self._scan_names(node.child)
+        oschema = child.schema
+
+        # --- [NOT] EXISTS as top-level WHERE conjuncts → semi/anti join ----
+        kept: List[ColumnExpr] = []
+        changed = False
+        for c in self._conjuncts(node.where):
+            positive, core = True, c
+            if (
+                isinstance(c, _UnaryOpExpr)
+                and c.op == "~"
+                and isinstance(c.col, _SubqueryExistsExpr)
+            ):
+                positive, core = False, c.col
+            if isinstance(core, _SubqueryExistsExpr):
+                cplan = core.plan
+                # ORDER BY / LIMIT>=1 can't change EXISTS truth per key
+                while isinstance(cplan, SortNode) or (
+                    isinstance(cplan, LimitNode) and cplan.n >= 1
+                ):
+                    cplan = cplan.child
+                info = self._corr_split(cplan, outer_names, oschema)
+                if info is not None and info != "uncorrelated":
+                    inner_df, pairs, residual, _ = info
+                    sub = (
+                        e.filter(inner_df, residual)
+                        if residual is not None
+                        else inner_df
+                    )
+                    sub = e.select(
+                        sub,
+                        SelectColumns(
+                            *[_col(ik).alias(on) for on, ik in pairs],
+                            arg_distinct=True,
+                        ),
+                    )
+                    child = e.join(
+                        child,
+                        sub,
+                        how="left_semi" if positive else "left_anti",
+                        on=[on for on, _ in pairs],
+                    )
+                    changed = True
+                    continue
+                if info is None and self._plan_refs_outer(
+                    core.plan, outer_names, oschema
+                ):
+                    raise NotImplementedError(
+                        "only equality-correlated EXISTS subqueries are "
+                        "supported"
+                    )
+            kept.append(c)
+        if changed:
+            node = dataclasses.replace(node, where=self._rebuild_and(kept))
+
+        # --- correlated scalar subqueries → left join on grouped aggregate -
+        replacements: Dict[int, ColumnExpr] = {}
+        counter = [0]
+
+        def scan_scalar(expr: Any) -> None:
+            nonlocal child
+            if isinstance(expr, _SubqueryScalarExpr) and id(expr) not in replacements:
+                info = self._corr_split(expr.plan, outer_names, oschema)
+                if info is None or info == "uncorrelated":
+                    return  # generic substitution (or its error) handles it
+                inner_df, pairs, residual, plan = info
+                if len(plan.projections) != 1 or not is_agg(plan.projections[0]):
+                    raise NotImplementedError(
+                        "correlated scalar subqueries must select exactly "
+                        "one aggregate"
+                    )
+                tmp = f"__sq{counter[0]}__"
+                counter[0] += 1
+                while tmp in oschema:
+                    tmp = "_" + tmp
+                sub = (
+                    e.filter(inner_df, residual)
+                    if residual is not None
+                    else inner_df
+                )
+                agg = plan.projections[0].infer_alias().alias(tmp)
+                grouped = e.aggregate(
+                    sub, PartitionSpec(by=[ik for _, ik in pairs]), [agg]
+                )
+                renamed = e.select(
+                    grouped,
+                    SelectColumns(
+                        *[_col(ik).alias(on) for on, ik in pairs], _col(tmp)
+                    ),
+                )
+                child = e.join(
+                    child, renamed, how="left_outer", on=[on for on, _ in pairs]
+                )
+                repl: ColumnExpr = _col(tmp)
+                inner_agg = plan.projections[0]
+                if (
+                    getattr(inner_agg, "func", "").upper() == "COUNT"
+                ):
+                    # COUNT over zero matching rows is 0, not NULL — the
+                    # left join produces NULL for unmatched outer rows
+                    from ..column import lit as _lit
+                    from ..column.functions import coalesce as _coalesce
+
+                    repl = _coalesce(repl, _lit(0))
+                replacements[id(expr)] = repl
+            for ch in getattr(expr, "children", []):
+                scan_scalar(ch)
+
+        for p in node.projections:
+            scan_scalar(p)
+        if node.where is not None:
+            scan_scalar(node.where)
+        if replacements:
+            if any(
+                type(p).__name__ == "_AllColumnsExpr" or p.output_name == "*"
+                for p in node.projections
+            ):
+                raise NotImplementedError(
+                    "correlated scalar subqueries with '*' projections are "
+                    "not supported"
+                )
+            node = dataclasses.replace(
+                node,
+                projections=[
+                    self._apply_replacements(p, replacements)
+                    for p in node.projections
+                ],
+                where=(
+                    self._apply_replacements(node.where, replacements)
+                    if node.where is not None
+                    else None
+                ),
+            )
+        return node, child
+
+    def _plan_refs_outer(
+        self, plan: Any, outer_names: set, oschema: Any
+    ) -> bool:
+        """Best-effort: does the subquery reference outer columns at all?"""
+        if not isinstance(plan, SelectNode) or plan.child is None:
+            return False
+        inner_names = self._scan_names(plan.child)
+        try:
+            ischema = self._exec_memo(plan.child).schema
+        except Exception:
+            return False
+        for c in self._conjuncts(plan.where):
+            if self._refs_outer(c, ischema, outer_names - inner_names, oschema):
+                return True
+        return False
+
+    def _apply_replacements(
+        self, expr: ColumnExpr, repl: Dict[int, ColumnExpr]
+    ) -> ColumnExpr:
+        from .parser import _SubqueryScalarExpr
+
+        if isinstance(expr, _SubqueryScalarExpr) and id(expr) in repl:
+            out = repl[id(expr)]
+            if expr.as_name:
+                out = out.alias(expr.as_name)
+            if expr.as_type is not None:
+                out = out.cast(expr.as_type)
+            return out
+        from ..column.expressions import (
+            _BinaryOpExpr,
+            _CaseWhenExpr,
+            _FuncExpr,
+            _InExpr,
+            _LikeExpr,
+            _UnaryOpExpr,
+        )
+
+        if isinstance(expr, _BinaryOpExpr):
+            l = self._apply_replacements(expr.left, repl)
+            r = self._apply_replacements(expr.right, repl)
+            if l is expr.left and r is expr.right:
+                return expr
+            out = _BinaryOpExpr(expr.op, l, r)
+        elif isinstance(expr, _InExpr):
+            c = self._apply_replacements(expr.col, repl)
+            if c is expr.col:
+                return expr
+            out = _InExpr(c, expr.values, expr.positive)
+        elif isinstance(expr, _LikeExpr):
+            c = self._apply_replacements(expr.col, repl)
+            if c is expr.col:
+                return expr
+            out = _LikeExpr(c, expr.pattern, expr.positive)
+        elif isinstance(expr, _UnaryOpExpr):
+            c = self._apply_replacements(expr.col, repl)
+            if c is expr.col:
+                return expr
+            out = _UnaryOpExpr(expr.op, c)
+        elif isinstance(expr, _FuncExpr):
+            args = [self._apply_replacements(a, repl) for a in expr.args]
+            if all(a is b for a, b in zip(args, expr.args)):
+                return expr
+            out = _FuncExpr(
+                expr.func, *args, arg_distinct=expr.is_distinct, is_agg=expr.is_agg
+            )
+        elif isinstance(expr, _CaseWhenExpr):
+            cases = [
+                (
+                    self._apply_replacements(c, repl),
+                    self._apply_replacements(v, repl),
+                )
+                for c, v in expr.cases
+            ]
+            default = (
+                self._apply_replacements(expr.default, repl)
+                if expr.default is not None
+                else None
+            )
+            if default is expr.default and all(
+                c is c0 and v is v0
+                for (c, v), (c0, v0) in zip(cases, expr.cases)
+            ):
+                return expr
+            out = _CaseWhenExpr(cases, default)
+        else:
+            return expr
+        if expr.as_name:
+            out = out.alias(expr.as_name)
+        if expr.as_type is not None:
+            out = out.cast(expr.as_type)
+        return out
+
+    def _decorrelate_safe(self, node: SelectNode, child: DataFrame):
+        """Run decorrelation only when subquery expressions are present."""
+        from .parser import _SubqueryExistsExpr, _SubqueryScalarExpr
+
+        def has_sub(expr: Any) -> bool:
+            if isinstance(expr, (_SubqueryExistsExpr, _SubqueryScalarExpr)):
+                return True
+            return any(has_sub(c) for c in getattr(expr, "children", []))
+
+        exprs = list(node.projections)
+        if node.where is not None:
+            exprs.append(node.where)
+        if not any(has_sub(x) for x in exprs):
+            return node, child
+        return self._decorrelate(node, child)
+
+    def _exec_grouping_sets(self, node: SelectNode, child: DataFrame) -> DataFrame:
+        """ROLLUP/CUBE/GROUPING SETS = union of per-set grouped aggregates,
+        grouped-out key columns NULL (the reference gets these free from
+        its SQL backends)."""
+        import dataclasses
+
+        from ..column import lit as _lit
+
+        e = self._engine
+        all_keys = [
+            g.name for g in node.group_by if isinstance(g, _NamedColumnExpr)
+        ]
+        parts: List[DataFrame] = []
+        for s in node.grouping_sets or []:
+            proj: List[ColumnExpr] = []
+            for c in node.projections:
+                base = c
+                if (
+                    isinstance(base, _NamedColumnExpr)
+                    and not is_agg(base)
+                    and base.name in all_keys
+                    and base.name not in s
+                ):
+                    tp = child.schema[base.name].type
+                    proj.append(
+                        _lit(None).cast(tp).alias(base.output_name or base.name)
+                    )
+                    continue
+                if not is_agg(base) and any(
+                    n in all_keys and n not in s
+                    for n in _referenced_names(base)
+                ):
+                    raise NotImplementedError(
+                        "expressions over grouped-out keys are not supported "
+                        "in GROUPING SETS projections"
+                    )
+                proj.append(base)
+            sub_node = dataclasses.replace(
+                node,
+                projections=proj,
+                group_by=[_col(k) for k in s],
+                grouping_sets=None,
+            )
+            if len(s) == 0:
+                # global aggregate: no grouping keys — project aggregates
+                # (and NULL key stand-ins) over the whole frame
+                where = sub_node.where
+                sub = e.filter(child, where) if where is not None else child
+                parts.append(
+                    e.select(
+                        sub,
+                        SelectColumns(*[p.infer_alias() for p in proj]),
+                        having=sub_node.having,
+                    )
+                )
+                continue
+            parts.append(self._exec_select_on(sub_node, child))
+        res = parts[0]
+        for p in parts[1:]:
+            res = e.union(res, p, distinct=False)
+        return res
+
+    def _exec_select_on(self, node: SelectNode, child: DataFrame) -> DataFrame:
+        """Execute a SelectNode against an ALREADY-materialized child."""
+        import uuid
+
+        tmp = f"__gs_{uuid.uuid4().hex[:8]}__"
+        self._dfs[tmp] = child
+        try:
+            import dataclasses
+
+            return self._exec_select(
+                dataclasses.replace(node, child=Scan(tmp))
+            )
+        finally:
+            self._dfs.pop(tmp, None)
+
     def _exec_select(self, node: SelectNode) -> DataFrame:
         e = self._engine
+        if node.child is not None:
+            pre_child = self._exec(node.child)
+            node, pre_child = self._decorrelate_safe(node, pre_child)
+        else:
+            pre_child = None
         node = self._substitute_subqueries(node)
         if node.child is None:
             # SELECT <literals> with no FROM → one constant row
@@ -177,11 +673,13 @@ class SQLExecutor:
             from ..schema import Schema
 
             return ArrayDataFrame([row], Schema(fields))
-        child = self._exec(node.child)
+        child = pre_child
         # window functions: computed on host after WHERE, before projection
         has_window = any(_contains_window(c) for c in node.projections)
         if has_window:
             return self._exec_windowed_select(node, child)
+        if node.grouping_sets is not None:
+            return self._exec_grouping_sets(node, child)
         cols = SelectColumns(
             *[c.infer_alias() for c in node.projections], arg_distinct=node.distinct
         )
@@ -225,11 +723,20 @@ class SQLExecutor:
             _LitColumnExpr,
             _UnaryOpExpr,
         )
-        from .parser import _SubqueryInExpr, _SubqueryScalarExpr
+        from .parser import (
+            _SubqueryExistsExpr,
+            _SubqueryInExpr,
+            _SubqueryScalarExpr,
+        )
 
         found = [False]
 
         def _run(plan: PlanNode) -> pd.DataFrame:
+            # a subplan referencing tables outside its own FROM is a
+            # correlated subquery in a position the decorrelator doesn't
+            # cover — running it would silently bind outer refs to inner
+            # columns, so refuse loudly instead
+            self._assert_no_foreign_refs(plan)
             return (
                 SQLExecutor(self._engine, self._dfs)
                 .run(plan)
@@ -250,6 +757,26 @@ class SQLExecutor:
                 v = None if len(res) == 0 else res.iloc[0, 0]
                 v = None if pd.isna(v) else (v.item() if hasattr(v, "item") else v)
                 out: Any = _LitColumnExpr(v)
+            elif isinstance(e, _SubqueryExistsExpr):
+                found[0] = True
+                plan = e.plan
+                # ORDER BY never matters to EXISTS; LIMIT n>=1 doesn't
+                # either (LIMIT 0 makes it constant-false)
+                limit0 = False
+                while isinstance(plan, (SortNode, LimitNode)):
+                    if isinstance(plan, LimitNode) and plan.n <= 0:
+                        limit0 = True
+                    plan = plan.child
+                if isinstance(plan, SelectNode):
+                    # the projection is irrelevant to EXISTS (often a bare
+                    # unnamed literal) — count rows, don't shape them
+                    import dataclasses as _dc
+
+                    plan = _dc.replace(
+                        plan, projections=[_col("*")], distinct=False
+                    )
+                exists = (not limit0) and len(_run(plan)) > 0
+                out = _LitColumnExpr(exists == e.positive)
             elif isinstance(e, _SubqueryInExpr):
                 found[0] = True
                 res = _run(e.plan)

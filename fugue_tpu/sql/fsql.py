@@ -651,9 +651,15 @@ class FugueSQLCompiler:
 
         def walk_expr(e: Any) -> None:
             # subquery expressions reference tables of their own
-            from .parser import _SubqueryInExpr, _SubqueryScalarExpr
+            from .parser import (
+                _SubqueryExistsExpr,
+                _SubqueryInExpr,
+                _SubqueryScalarExpr,
+            )
 
-            if isinstance(e, (_SubqueryScalarExpr, _SubqueryInExpr)):
+            if isinstance(
+                e, (_SubqueryScalarExpr, _SubqueryInExpr, _SubqueryExistsExpr)
+            ):
                 walk(e.plan)
             for c in getattr(e, "children", []):
                 walk_expr(c)

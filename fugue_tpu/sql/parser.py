@@ -193,6 +193,7 @@ class PlanNode:
 @dataclass
 class Scan(PlanNode):
     name: str
+    alias: str = ""
 
 
 @dataclass
@@ -229,6 +230,26 @@ class _SubqueryScalarExpr(ColumnExpr):
         return f"(SELECT ...{type(self.plan).__name__})"
 
 
+class _SubqueryExistsExpr(ColumnExpr):
+    """``[NOT] EXISTS (SELECT ...)``.
+
+    Uncorrelated: substituted as a boolean literal. Correlated by equality
+    (``inner.k = outer.k`` conjuncts): decorrelated into a device semi/anti
+    join when the EXISTS is a top-level WHERE conjunct.
+    """
+
+    def __init__(self, plan: "PlanNode", positive: bool = True):
+        super().__init__()
+        self.plan = plan
+        self.positive = positive
+
+    def _uuid_keys(self) -> List[Any]:
+        return ["subquery_exists", self.positive, repr(self.plan)]
+
+    def __repr__(self) -> str:
+        return f"EXISTS (SELECT ...{type(self.plan).__name__})"
+
+
 class _SubqueryInExpr(ColumnExpr):
     """``expr [NOT] IN (SELECT ...)`` — the executor evaluates the subplan
     and substitutes a plain IN over its first column's values."""
@@ -258,6 +279,9 @@ class SelectNode(PlanNode):
     group_by: List[ColumnExpr] = field(default_factory=list)
     having: Optional[ColumnExpr] = None
     distinct: bool = False
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS: each entry is one key subset;
+    # group_by holds the union of all keys
+    grouping_sets: Optional[List[List[str]]] = None
 
 
 @dataclass
@@ -427,20 +451,62 @@ class SQLParser:
         if self.eat_kw("WHERE"):
             where = self._parse_expr()
         group_by: List[ColumnExpr] = []
+        grouping_sets: Optional[List[List[str]]] = None
         if self.at_kw("GROUP"):
             self.next()
             self.expect_kw("BY")
-            while True:
-                group_by.append(self._parse_expr())
-                if not self.eat_punct(","):
-                    break
+            if self.at_kw("ROLLUP") or self.at_kw("CUBE"):
+                kind = self.next().upper
+                keys = self._parse_name_list_parens()
+                if kind == "ROLLUP":
+                    grouping_sets = [keys[:i] for i in range(len(keys), -1, -1)]
+                else:  # CUBE: every subset, preserving key order
+                    grouping_sets = [
+                        [k for j, k in enumerate(keys) if mask & (1 << j)]
+                        for mask in range((1 << len(keys)) - 1, -1, -1)
+                    ]
+                group_by = [col(k) for k in keys]
+            elif self.at_kw("GROUPING") and self.peek(1).upper == "SETS":
+                self.next()
+                self.next()
+                self.expect_punct("(")
+                grouping_sets = []
+                while True:
+                    grouping_sets.append(self._parse_name_list_parens())
+                    if not self.eat_punct(","):
+                        break
+                self.expect_punct(")")
+                seen: List[str] = []
+                for s in grouping_sets:
+                    for k in s:
+                        if k not in seen:
+                            seen.append(k)
+                group_by = [col(k) for k in seen]
+            else:
+                while True:
+                    group_by.append(self._parse_expr())
+                    if not self.eat_punct(","):
+                        break
         having = None
         if self.eat_kw("HAVING"):
             having = self._parse_expr()
         node: PlanNode = SelectNode(
-            child, projections, where, group_by, having, distinct
+            child, projections, where, group_by, having, distinct,
+            grouping_sets=grouping_sets,
         )
         return self._maybe_order_limit(node)
+
+    def _parse_name_list_parens(self) -> List[str]:
+        """``( name, name, ... )`` — also accepts the empty ``()`` set."""
+        self.expect_punct("(")
+        names: List[str] = []
+        if not self.at_punct(")"):
+            while True:
+                names.append(self._parse_qualified_name())
+                if not self.eat_punct(","):
+                    break
+        self.expect_punct(")")
+        return names
 
     def _peek_join_type(self) -> Optional[str]:
         if self.at_kw("JOIN"):
@@ -487,11 +553,12 @@ class SQLParser:
                 alias = self._parse_name()
             return Subquery(inner, alias)
         name = self._parse_name()
+        alias = ""
         if self.eat_kw("AS"):
-            self._parse_name()  # table aliases accepted and ignored
+            alias = self._parse_name()
         elif self.peek().kind in ("IDENT", "QIDENT") and not self._at_clause_kw():
-            self._parse_name()
-        return Scan(name)
+            alias = self._parse_name()
+        return Scan(name, alias)
 
     def _at_clause_kw(self) -> bool:
         t = self.peek()
@@ -724,6 +791,13 @@ class SQLParser:
                 return lit(up == "TRUE")
             if up == "CASE":
                 return self._parse_case()
+            if up == "EXISTS" and self.peek(1).value == "(":
+                self.next()
+                self.expect_punct("(")
+                plan = self._parse_query_body()
+                plan = self._maybe_order_limit(plan)
+                self.expect_punct(")")
+                return _SubqueryExistsExpr(plan, True)
             if up == "CAST":
                 self.next()
                 self.expect_punct("(")
@@ -755,13 +829,20 @@ class SQLParser:
                         )
                     return self._parse_over(up, args)
                 return self._make_func(up, args, distinct)
-            # plain or qualified column ref
+            # plain or qualified column ref — the qualifier is kept as
+            # side-band metadata (correlated-subquery analysis needs it;
+            # everything else sees the bare name)
             self.next()
             name = t.value
+            qual = ""
             while self.at_punct(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
                 self.next()
+                qual = name
                 name = self._parse_name()
-            return col(name)
+            c = col(name)
+            if qual:
+                c._sql_qualifier = qual  # type: ignore[attr-defined]
+            return c
         raise FugueSQLSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
 
     def _parse_type_name(self) -> Any:

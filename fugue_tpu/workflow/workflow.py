@@ -738,11 +738,19 @@ class FugueWorkflow:
         parts: List[Any] = []
         inputs: List[WorkflowDataFrame] = []
         names: List[str] = []
+        seen: Dict[int, str] = {}
         for s in statements:
             if isinstance(s, str):
                 parts.append((False, s))
             elif isinstance(s, WorkflowDataFrame):
+                # the SAME frame referenced multiple times (e.g. a
+                # correlated subquery's qualifier) must keep ONE table
+                # name, or correlation analysis sees unrelated aliases
+                if id(s) in seen:
+                    parts.append((True, seen[id(s)]))
+                    continue
                 name = f"_{len(inputs)}"
+                seen[id(s)] = name
                 parts.append((True, name))
                 inputs.append(s)
                 names.append(name)

@@ -1,0 +1,225 @@
+"""SQL surface closure (round 3): EXISTS / NOT EXISTS, equality-correlated
+subqueries (decorrelated to device joins), GROUPING SETS / ROLLUP / CUBE.
+
+The reference accepts these everywhere because raw SQL goes to DuckDB/Spark
+(fugue_duckdb/execution_engine.py:95-105); here they run on the engine-verb
+executor, identically on the oracle and the jax engine.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+
+
+@pytest.fixture(
+    scope="module", params=["native", "jax"], ids=["oracle", "device"]
+)
+def engine(request):
+    e = (
+        NativeExecutionEngine()
+        if request.param == "native"
+        else JaxExecutionEngine()
+    )
+    yield e
+    e.stop()
+
+
+def _run(sql, eng, **dfs):
+    r = fa.fugue_sql(sql, engine=eng, as_local=True, **dfs)
+    return r.to_pandas() if hasattr(r, "to_pandas") else r
+
+
+@pytest.fixture(scope="module")
+def ab():
+    a = pd.DataFrame({"k": [1, 2, 3, 4], "v": [10.0, 20.0, 30.0, 40.0]})
+    b = pd.DataFrame({"k": [2, 2, 3], "w": [1.0, 2.0, 9.0]})
+    return a, b
+
+
+def test_correlated_exists(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE EXISTS (SELECT 1 FROM b WHERE b.k = a.k)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2, 3]
+
+
+def test_correlated_not_exists(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE NOT EXISTS (SELECT 1 FROM b WHERE b.k = a.k)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [1, 4]
+
+
+def test_correlated_exists_with_residual(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k AND w > 5)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [3]
+
+
+def test_exists_combined_with_other_predicates(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE v < 25 AND EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2]
+
+
+def test_uncorrelated_exists(engine, ab):
+    a, b = ab
+    assert len(_run(
+        "SELECT * FROM a WHERE EXISTS (SELECT 1 FROM b WHERE w > 100)",
+        engine, a=a, b=b,
+    )) == 0
+    assert len(_run(
+        "SELECT * FROM a WHERE EXISTS (SELECT 1 FROM b WHERE w > 5)",
+        engine, a=a, b=b,
+    )) == 4
+
+
+def test_correlated_scalar_in_projection(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT k, v, (SELECT SUM(w) FROM b WHERE b.k = a.k) AS tw FROM a",
+        engine, a=a, b=b,
+    ).sort_values("k")
+    assert r["tw"].fillna(-1).tolist() == [-1.0, 3.0, 9.0, -1.0]
+
+
+def test_correlated_scalar_in_where(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT k FROM a WHERE v > (SELECT SUM(w) FROM b WHERE b.k = a.k)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2, 3]
+
+
+def test_correlated_scalar_min_max(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT k, (SELECT MAX(w) FROM b WHERE b.k = a.k) AS mw FROM a",
+        engine, a=a, b=b,
+    ).sort_values("k")
+    assert r["mw"].fillna(-1).tolist() == [-1.0, 2.0, 9.0, -1.0]
+
+
+def test_rollup(engine):
+    df = pd.DataFrame(
+        {"k": [1, 1, 2, 2, 3], "g": ["a", "a", "b", "b", "b"],
+         "v": [1.0, 2.0, 3.0, 4.0, 5.0]}
+    )
+    r = _run(
+        "SELECT k, g, SUM(v) AS s FROM df GROUP BY ROLLUP(k, g)",
+        engine, df=df,
+    )
+    # 3 (k,g) + 3 (k) + 1 () = 7 rows
+    assert len(r) == 7
+    grand = r[r["k"].isna() & r["g"].isna()]
+    assert len(grand) == 1 and np.isclose(grand["s"].iloc[0], 15.0)
+    konly = r[r["k"].notna() & r["g"].isna()].sort_values("k")
+    assert konly["s"].tolist() == [3.0, 7.0, 5.0]
+
+
+def test_cube(engine):
+    df = pd.DataFrame(
+        {"x": [1, 1, 2], "y": ["a", "b", "b"], "v": [1.0, 2.0, 3.0]}
+    )
+    r = _run(
+        "SELECT x, y, SUM(v) AS s FROM df GROUP BY CUBE(x, y)",
+        engine, df=df,
+    )
+    # (x,y):3 + (x):2 + (y):2 + ():1 = 8
+    assert len(r) == 8
+    yonly = r[r["x"].isna() & r["y"].notna()].sort_values("y")
+    assert yonly["s"].tolist() == [1.0, 5.0]
+
+
+def test_grouping_sets_explicit(engine):
+    df = pd.DataFrame(
+        {"x": [1, 1, 2], "y": ["a", "b", "b"], "v": [1.0, 2.0, 3.0]}
+    )
+    r = _run(
+        "SELECT x, y, SUM(v) AS s FROM df "
+        "GROUP BY GROUPING SETS ((x, y), (x), ())",
+        engine, df=df,
+    )
+    assert len(r) == 6
+    assert np.isclose(r[r["x"].isna()]["s"].iloc[0], 6.0)
+
+
+def test_rollup_with_where_and_having(engine):
+    df = pd.DataFrame(
+        {"k": [1, 1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 100.0]}
+    )
+    r = _run(
+        "SELECT k, SUM(v) AS s FROM df WHERE v < 50 "
+        "GROUP BY ROLLUP(k) HAVING SUM(v) > 2",
+        engine, df=df,
+    )
+    # groups: k=1 s=3, k=2 s=7 (both >2); grand total dropped (HAVING on
+    # the empty set is unsupported -> it would raise; ensure keyed sets ok
+    assert sorted(x for x in r["k"] if not pd.isna(x)) == [1, 2]
+
+
+def test_alias_qualified_correlation(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a AS x WHERE EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = x.k)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2, 3]
+
+
+def test_exists_under_or_raises(engine, ab):
+    # unsupported positions must error loudly, never silently mis-bind
+    a, b = ab
+    with pytest.raises(NotImplementedError):
+        _run(
+            "SELECT * FROM a WHERE v < 15 OR EXISTS "
+            "(SELECT 1 FROM b WHERE b.k = a.k)",
+            engine, a=a, b=b,
+        )
+
+
+def test_correlated_count_zero_not_null(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT k, (SELECT COUNT(*) FROM b WHERE b.k = a.k) AS c FROM a",
+        engine, a=a, b=b,
+    ).sort_values("k")
+    assert r["c"].tolist() == [0, 2, 1, 0]
+
+
+def test_correlated_scalar_inside_in(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT k FROM a WHERE (SELECT SUM(w) FROM b WHERE b.k = a.k) "
+        "IN (3, 9)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2, 3]
+
+
+def test_exists_with_order_and_limit(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k LIMIT 1)",
+        engine, a=a, b=b,
+    )
+    assert sorted(r["k"]) == [2, 3]
