@@ -15,9 +15,11 @@ Protocol (static shapes throughout, SURVEY §7 "mask, don't branch"):
    ``(shards, capacity)`` send buffer, ``all_to_all``s the buffers, and
    returns the received rows + validity mask.
 
-Worst-case skew (every row to one shard) allocates ``shards × capacity``
-per shard — inherent to the result layout, acceptable at mesh sizes where
-this engine runs; a multi-round exchange is the escalation path.
+Skew safety: when a hot destination pushes the block capacity past
+``SINGLE_ROUND_MAX_CAPACITY``, the exchange escalates to MULTIPLE bounded
+rounds (each moving ≤ that many rows per destination) that compact-append
+into output buffers sized by the true max received total — collective
+buffers and outputs stay O(data), never O(shards × hot-key count).
 """
 
 from typing import Any, Dict, List, Optional, Tuple
@@ -162,9 +164,11 @@ def _get_compiled_counts(mesh: Any):
                 .at[dest]
                 .add(valid.astype(jnp.int32))
             )
+            received = lax.psum(h, ROW_AXIS)  # per-dest totals, replicated
             return (
                 lax.pmax(h.max(), ROW_AXIS)[None],
                 lax.psum(h.sum(), ROW_AXIS)[None],
+                received.max()[None],
             )
 
         _COMPILE_CACHE[cache_key] = jax.jit(
@@ -172,7 +176,7 @@ def _get_compiled_counts(mesh: Any):
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
-                out_specs=(P(), P()),
+                out_specs=(P(), P(), P()),
             )
         )
     return _COMPILE_CACHE[cache_key]
@@ -254,6 +258,110 @@ def _get_compiled_exchange(
     return _COMPILE_CACHE[cache_key]
 
 
+def _get_compiled_rank(mesh: Any):
+    """Per-row rank among rows of the SAME destination on this shard —
+    computed once, reused by every round of the multi-round exchange."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("shuffle_rank", mesh)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(dest: Any, valid: Any):
+            n = dest.shape[0]
+            big_dest = jnp.where(valid, dest, shards)
+            iota = lax.iota(jnp.int32, n)
+            sd, perm = lax.sort((big_dest, iota), num_keys=1)
+            starts_tbl = jnp.zeros(shards + 1, dtype=jnp.int32).at[sd].add(1)
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(starts_tbl[:shards])]
+            )
+            pos = iota - starts[jnp.clip(sd, 0, shards - 1)]
+            return jnp.zeros(n, dtype=jnp.int32).at[perm].set(pos)
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
+def _get_compiled_round(
+    mesh: Any, dtypes: Tuple[Any, ...], cap: int, out_cap: int
+):
+    """ONE bounded round of the multi-round exchange: send rows whose
+    within-destination rank falls in this round's window (≤ ``cap`` rows
+    per destination), then compact-append the received rows into the
+    accumulating output buffers. Peak collective buffer = shards × cap,
+    independent of skew."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    shards = num_row_shards(mesh)
+    cache_key = ("xround", mesh, dtypes, cap, out_cap)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(dest: Any, valid: Any, rank: Any, out_len: Any, r: Any, *rest: Any):
+            arrs = rest[: len(dtypes)]
+            bufs = rest[len(dtypes) :]
+            lo = r[0] * cap
+            sel = valid & (rank >= lo) & (rank < lo + cap)
+            flat = jnp.where(
+                sel, dest * cap + (rank - lo), shards * cap
+            )
+            send_valid = (
+                jnp.zeros(shards * cap, dtype=bool)
+                .at[flat]
+                .set(True, mode="drop")
+            )
+            recv_valid = lax.all_to_all(
+                send_valid.reshape(shards, cap),
+                ROW_AXIS,
+                split_axis=0,
+                concat_axis=0,
+            ).reshape(-1)
+            cum = jnp.cumsum(recv_valid.astype(jnp.int32))
+            pos = out_len[0] + cum - 1
+            idx = jnp.where(recv_valid, pos, out_cap)
+            new_bufs = []
+            for a, buf in zip(arrs, bufs):
+                send = (
+                    jnp.zeros(shards * cap, dtype=a.dtype)
+                    .at[flat]
+                    .set(a, mode="drop")
+                )
+                recv = lax.all_to_all(
+                    send.reshape(shards, cap),
+                    ROW_AXIS,
+                    split_axis=0,
+                    concat_axis=0,
+                ).reshape(-1)
+                new_bufs.append(buf.at[idx].set(recv, mode="drop"))
+            new_len = out_len[0] + cum[-1]
+            return (new_len[None],) + tuple(new_bufs)
+
+        row = P(ROW_AXIS)
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(row, row, row, row, P())
+                + tuple(row for _ in range(2 * len(dtypes))),
+                out_specs=tuple(row for _ in range(1 + len(dtypes))),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
 def compute_dest(
     mesh: Any,
     algo: str,
@@ -279,25 +387,101 @@ def compute_dest(
     raise ValueError(f"unknown shuffle algo {algo!r}")
 
 
+# single-round block capacity ceiling: a (shard, dest) pair needing more
+# rows than this escalates to the bounded multi-round exchange, whose peak
+# collective buffer stays shards × this regardless of key skew
+SINGLE_ROUND_MAX_CAPACITY = 1 << 17
+
+
+def _get_compiled_lenmask(mesh: Any, out_cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    cache_key = ("lenmask", mesh, out_cap)
+    if cache_key not in _COMPILE_CACHE:
+
+        def kernel(out_len: Any):
+            return lax.iota(jnp.int32, out_cap) < out_len[0]
+
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS),),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
 def exchange_rows(
     mesh: Any,
     arrays: Dict[str, Any],
     valid: Any,
     dest: Any,
+    round_capacity: Optional[int] = None,
 ) -> Tuple[Dict[str, Any], Any, int]:
     """Move rows to their destination shards.
 
-    Returns (new_arrays, new_valid_mask, received_row_count). The new
-    arrays have padded local length ``shards × capacity`` per shard.
+    Returns (new_arrays, new_valid_mask, received_row_count).
+
+    Small/balanced exchanges run in ONE all-to-all with block capacity =
+    the max per-(shard, dest) count (output local length shards ×
+    capacity). Skewed exchanges — a hot destination pushing the block past
+    ``round_capacity`` — run MULTIPLE bounded rounds: each round moves at
+    most ``round_capacity`` rows per destination and compact-appends into
+    output buffers sized by the TRUE max received total, so neither the
+    collective buffers nor the output inflate with skew.
     """
     import jax
+    import numpy as np_
 
-    mx, total = jax.device_get(_get_compiled_counts(mesh)(dest, valid))
+    mx, total, mr = jax.device_get(_get_compiled_counts(mesh)(dest, valid))
     cap = max(1, int(mx[0]))
     capacity = 1 << (cap - 1).bit_length()  # pow2 → reuse compiled variants
+    limit = (
+        round_capacity if round_capacity is not None else SINGLE_ROUND_MAX_CAPACITY
+    )
     dtypes = tuple(str(a.dtype) for a in arrays.values())
-    compiled = _get_compiled_exchange(mesh, dtypes, capacity)
-    outs = compiled(dest, valid, *arrays.values())
-    new_valid = outs[0]
-    new_arrays = {k: v for k, v in zip(arrays.keys(), outs[1:])}
+    if capacity <= limit:
+        compiled = _get_compiled_exchange(mesh, dtypes, capacity)
+        outs = compiled(dest, valid, *arrays.values())
+        new_valid = outs[0]
+        new_arrays = {k: v for k, v in zip(arrays.keys(), outs[1:])}
+        return new_arrays, new_valid, int(total[0])
+    # ---- multi-round path -------------------------------------------------
+    from ..parallel.mesh import row_sharding
+
+    shards = num_row_shards(mesh)
+    round_cap = 1 << (max(1, limit) - 1).bit_length()
+    rounds = -(-cap // round_cap)  # ceil
+    out_cap = 1 << (max(1, int(mr[0])) - 1).bit_length()
+    sharding = row_sharding(mesh)
+    rank = _get_compiled_rank(mesh)(dest, valid)
+    out_len = jax.device_put(
+        np_.zeros(shards, dtype=np_.int32), sharding
+    )
+    bufs = [
+        jax.device_put(
+            np_.zeros(shards * out_cap, dtype=a.dtype), sharding
+        )
+        for a in arrays.values()
+    ]
+    step = _get_compiled_round(mesh, dtypes, round_cap, out_cap)
+    for r in range(rounds):
+        outs = step(
+            dest,
+            valid,
+            rank,
+            out_len,
+            np_.asarray([r], dtype=np_.int32),
+            *arrays.values(),
+            *bufs,
+        )
+        out_len = outs[0]
+        bufs = list(outs[1:])
+    new_valid = _get_compiled_lenmask(mesh, out_cap)(out_len)
+    new_arrays = {k: v for k, v in zip(arrays.keys(), bufs)}
     return new_arrays, new_valid, int(total[0])

@@ -188,8 +188,13 @@ class SQLExecutor:
                 names.add(p.name)
                 if p.alias:
                     names.add(p.alias)
-            if isinstance(p, Subquery) and p.alias:
-                names.add(p.alias)
+                return
+            if isinstance(p, Subquery):
+                # a derived table HIDES its inner tables; only the alias is
+                # visible to the enclosing scope
+                if p.alias:
+                    names.add(p.alias)
+                return
             for f in getattr(p, "__dataclass_fields__", {}):
                 v = getattr(p, f)
                 if isinstance(v, PlanNode):
@@ -227,6 +232,8 @@ class SQLExecutor:
                     walk_expr(p.where)
                 if p.having is not None:
                     walk_expr(p.having)
+            if isinstance(p, JoinNode) and p.condition is not None:
+                walk_expr(p.condition)
             for f in getattr(p, "__dataclass_fields__", {}):
                 v = getattr(p, f)
                 if isinstance(v, PlanNode):
@@ -582,6 +589,10 @@ class SQLExecutor:
         all_keys = [
             g.name for g in node.group_by if isinstance(g, _NamedColumnExpr)
         ]
+        # WHERE applies identically to every set — filter ONCE, not per set
+        if node.where is not None:
+            child = e.filter(child, node.where)
+            node = dataclasses.replace(node, where=None)
         parts: List[DataFrame] = []
         for s in node.grouping_sets or []:
             proj: List[ColumnExpr] = []
@@ -616,11 +627,9 @@ class SQLExecutor:
             if len(s) == 0:
                 # global aggregate: no grouping keys — project aggregates
                 # (and NULL key stand-ins) over the whole frame
-                where = sub_node.where
-                sub = e.filter(child, where) if where is not None else child
                 parts.append(
                     e.select(
-                        sub,
+                        child,
                         SelectColumns(*[p.infer_alias() for p in proj]),
                         having=sub_node.having,
                     )
@@ -650,7 +659,8 @@ class SQLExecutor:
     def _exec_select(self, node: SelectNode) -> DataFrame:
         e = self._engine
         if node.child is not None:
-            pre_child = self._exec(node.child)
+            # memoized: correlation analysis may already have run this tree
+            pre_child = self._exec_memo(node.child)
             node, pre_child = self._decorrelate_safe(node, pre_child)
         else:
             pre_child = None
@@ -698,12 +708,17 @@ class SQLExecutor:
                 if isinstance(c, _NamedColumnExpr) and not is_agg(c)
             }
             proj_keys = {c.output_name for c in expanded if not is_agg(c)}
-            if not (
+            having_needs_agg = node.having is not None and not any(
+                is_agg(c) for c in expanded
+            )
+            if having_needs_agg or not (
                 set(gb_names) == proj_keys
                 or set(gb_names) == keys_in_proj_source
             ):
                 # GROUP BY decoupled from the projection: aggregate by the
-                # GROUP BY keys, then project/filter over the O(groups) result
+                # GROUP BY keys, then project/filter over the O(groups)
+                # result — also the path for aggregate HAVING over a
+                # key-only projection (eval_select can't see those aggs)
                 return self._exec_decoupled_groupby(node, child, gb_names)
         return e.select(child, cols, where=node.where, having=node.having)
 
@@ -737,11 +752,11 @@ class SQLExecutor:
             # cover — running it would silently bind outer refs to inner
             # columns, so refuse loudly instead
             self._assert_no_foreign_refs(plan)
-            return (
-                SQLExecutor(self._engine, self._dfs)
-                .run(plan)
-                .as_pandas()
-            )
+            ex = SQLExecutor(self._engine, self._dfs)
+            # share FROM-tree materializations with the correlation
+            # analysis (it may already have executed this subquery's child)
+            ex._plan_memo = getattr(self, "_plan_memo", {})
+            return ex.run(plan).as_pandas()
 
         def sub(e: Any) -> Any:
             if e is None:
@@ -767,13 +782,30 @@ class SQLExecutor:
                     if isinstance(plan, LimitNode) and plan.n <= 0:
                         limit0 = True
                     plan = plan.child
-                if isinstance(plan, SelectNode):
+                if (
+                    isinstance(plan, SelectNode)
+                    and plan.child is not None
+                    and len(plan.group_by) == 0
+                    and plan.grouping_sets is None
+                ):
                     # the projection is irrelevant to EXISTS (often a bare
-                    # unnamed literal) — count rows, don't shape them
+                    # unnamed literal) — count rows, don't shape them.
+                    # Grouped / FROM-less subqueries keep their projections
+                    # (a '*' would be invalid there).
                     import dataclasses as _dc
 
                     plan = _dc.replace(
                         plan, projections=[_col("*")], distinct=False
+                    )
+                elif isinstance(plan, SelectNode) and plan.child is None:
+                    import dataclasses as _dc
+
+                    plan = _dc.replace(
+                        plan,
+                        projections=[
+                            p if p.output_name else p.alias(f"_e{i}")
+                            for i, p in enumerate(plan.projections)
+                        ],
                     )
                 exists = (not limit0) and len(_run(plan)) > 0
                 out = _LitColumnExpr(exists == e.positive)

@@ -196,6 +196,23 @@ class Scan(PlanNode):
     alias: str = ""
 
 
+def _source_names(p: "PlanNode") -> set:
+    """Visible table names/aliases of a FROM source (derived tables hide
+    their inner scans — only the alias shows)."""
+    out: set = set()
+    if isinstance(p, Scan):
+        out.add(p.name)
+        if p.alias:
+            out.add(p.alias)
+    elif isinstance(p, Subquery):
+        if p.alias:
+            out.add(p.alias)
+    elif isinstance(p, JoinNode):
+        out |= _source_names(p.left)
+        out |= _source_names(p.right)
+    return out
+
+
 @dataclass
 class Subquery(PlanNode):
     child: PlanNode
@@ -438,7 +455,9 @@ class SQLParser:
                 on: List[str] = []
                 residual: Optional[ColumnExpr] = None
                 if self.eat_kw("ON"):
-                    on, residual = self._parse_on_condition()
+                    on, residual = self._parse_on_condition(
+                        _source_names(child) | _source_names(right)
+                    )
                 elif self.eat_kw("USING"):
                     self.expect_punct("(")
                     while True:
@@ -564,10 +583,16 @@ class SQLParser:
         t = self.peek()
         return t.kind == "IDENT" and t.upper in _KEYWORD_STOP
 
-    def _parse_on_condition(self) -> Any:
+    def _parse_on_condition(self, local_names: Any = None) -> Any:
         """Parse a general ON predicate and split it into equi-join keys
         (``a.k = b.k`` on a shared name) and a residual (non-equi)
-        condition evaluated over the joined output."""
+        condition evaluated over the joined output.
+
+        ``local_names``: table names/aliases of the two joined sources —
+        a qualifier outside this set is a correlated outer reference the
+        join can't bind, and silently treating it as an equi key would
+        join the wrong columns; refuse loudly instead.
+        """
         from ..column.expressions import _BinaryOpExpr, _NamedColumnExpr
 
         cond = self._parse_expr()
@@ -581,6 +606,11 @@ class SQLParser:
                 conjuncts.append(e)
 
         split(cond)
+
+        def _foreign(c: _NamedColumnExpr) -> bool:
+            q = getattr(c, "_sql_qualifier", "")
+            return bool(q) and local_names is not None and q not in local_names
+
         keys: List[str] = []
         residual: Optional[ColumnExpr] = None
         for c in conjuncts:
@@ -589,11 +619,16 @@ class SQLParser:
                 and c.op == "=="
                 and isinstance(c.left, _NamedColumnExpr)
                 and isinstance(c.right, _NamedColumnExpr)
-                and c.left.name == c.right.name  # qualifiers already stripped
             ):
-                keys.append(c.left.name)
-            else:
-                residual = c if residual is None else (residual & c)
+                if _foreign(c.left) or _foreign(c.right):
+                    raise FugueSQLSyntaxError(
+                        "JOIN ON references a table outside the join "
+                        "(correlated ON conditions are not supported)"
+                    )
+                if c.left.name == c.right.name:  # qualifiers stripped
+                    keys.append(c.left.name)
+                    continue
+            residual = c if residual is None else (residual & c)
         return keys, residual
 
     def _parse_name(self) -> str:

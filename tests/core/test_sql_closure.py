@@ -223,3 +223,53 @@ def test_exists_with_order_and_limit(engine, ab):
         engine, a=a, b=b,
     )
     assert sorted(r["k"]) == [2, 3]
+
+
+def test_join_on_correlation_refused(engine, ab):
+    from fugue_tpu.exceptions import FugueSQLSyntaxError
+
+    a, b = ab
+    c = pd.DataFrame({"j": [2, 3], "z": [1.0, 2.0]})
+    with pytest.raises((NotImplementedError, FugueSQLSyntaxError)):
+        _run(
+            "SELECT * FROM a WHERE EXISTS "
+            "(SELECT 1 FROM b JOIN c ON c.j = a.k)",
+            engine, a=a, b=b, c=c,
+        )
+
+
+def test_exists_with_group_by_having(engine, ab):
+    a, b = ab
+    r = _run(
+        "SELECT * FROM a WHERE EXISTS "
+        "(SELECT k FROM b GROUP BY k HAVING SUM(w) > 1)",
+        engine, a=a, b=b,
+    )
+    assert len(r) == 4
+
+
+def test_exists_without_from(engine, ab):
+    a, _ = ab
+    assert len(_run(
+        "SELECT * FROM a WHERE EXISTS (SELECT 1)", engine, a=a
+    )) == 4
+
+
+def test_derived_table_hides_inner_scope(engine, ab):
+    from fugue_tpu.exceptions import FugueSQLSyntaxError
+
+    a, b = ab
+    with pytest.raises((NotImplementedError, FugueSQLSyntaxError)):
+        _run(
+            "SELECT * FROM (SELECT k FROM a) t WHERE EXISTS "
+            "(SELECT 1 FROM b WHERE b.k = a.k)",
+            engine, a=a, b=b,
+        )
+
+
+def test_grouped_key_projection_with_agg_having(engine, ab):
+    _, b = ab
+    r = _run(
+        "SELECT k FROM b GROUP BY k HAVING SUM(w) > 1", engine, b=b
+    )
+    assert sorted(r["k"]) == [2, 3]
