@@ -2602,6 +2602,40 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    def _virtual_agg_array(self, jdf: JaxDataFrame, tag: str, src: str) -> Any:
+        """Materialize a derived aggregation input for a null-masked 64-bit
+        int column — exactness-preserving views the float64 NaN view can't
+        give (SURVEY §7 hard parts; STATUS r2 known gap):
+
+        - ``hi``/``lo``: NULL→0 value split into 32-bit halves, so
+          SUM = Σhi·2³² + Σlo stays exact at any magnitude;
+        - ``minfill``/``maxfill``: NULLs become the dtype extreme (the
+          identity for min/max), nullability recovered from a count;
+        - ``nullview``: float64 NaN view (counting only — value-lossy).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cache_key = ("vagg", tag, self._mesh)
+        if cache_key not in self._jit_cache:
+
+            def build(a: Any, m: Any, _tag: str = tag):
+                if _tag == "notnull":
+                    return jnp.logical_not(m).astype(jnp.int64)
+                filled = jnp.where(m, jnp.zeros((), a.dtype), a)
+                if _tag == "hi":
+                    return filled >> 32
+                if _tag == "lo":
+                    return filled & jnp.asarray(0xFFFFFFFF, dtype=a.dtype)
+                ii = jnp.iinfo(a.dtype)
+                fill = ii.max if _tag == "minfill" else ii.min
+                return jnp.where(m, jnp.asarray(fill, dtype=a.dtype), a)
+
+            self._jit_cache[cache_key] = jax.jit(build)
+        return self._jit_cache[cache_key](
+            jdf.device_cols[src], jdf.null_masks[src]
+        )
+
     def aggregate(
         self,
         df: DataFrame,
@@ -2624,6 +2658,11 @@ class JaxExecutionEngine(ExecutionEngine):
         key_cols, mask_names = self._group_key_cols(jdf, keys)
         value_arrs = {}
         for src in {s for _, _, s in plan["aggs"]}:
+            if src in plan["virtual"]:
+                value_arrs[src] = self._virtual_agg_array(
+                    jdf, *plan["virtual"][src]
+                )
+                continue
             arr = jdf.device_cols[src]
             if src in plan["dict_srcs"]:
                 # sorted-dict codes → NaN-null float view (−1 code = NULL)
@@ -2661,9 +2700,15 @@ class JaxExecutionEngine(ExecutionEngine):
                     name,
                     agg,
                     value_arrs[src],
-                    jdf.maybe_nan(src)
-                    or src in plan["masked_srcs"]
-                    or src in plan["dict_srcs"],
+                    (
+                        plan["virtual"][src][0] == "nullview"
+                        if src in plan["virtual"]
+                        else (
+                            jdf.maybe_nan(src)
+                            or src in plan["masked_srcs"]
+                            or src in plan["dict_srcs"]
+                        )
+                    ),
                 )
                 for name, agg, src in plan["aggs"]
             ],
@@ -2709,6 +2754,7 @@ def _plan_device_agg(
         return None
     aggs: List[Any] = []
     post: List[dict] = []
+    virtual: Dict[str, Any] = {}  # vname -> (tag, real src)
     masked_srcs: set = set()
     dict_srcs: set = set()
     fields: List[pa.Field] = [jdf.schema[k] for k in keys]
@@ -2733,17 +2779,92 @@ def _plan_device_agg(
             ):
                 return None
             dict_srcs.add(src)
+        big_int_masked = False
         if src in jdf.null_masks:
             import numpy as np_
 
             dt = np_.dtype(jdf.device_cols[src].dtype)
-            if dt.kind in ("i", "u") and dt.itemsize >= 8:
-                return None  # 64-bit ints with NULLs lose exactness as f64
-            masked_srcs.add(src)
+            if dt.kind == "u" and dt.itemsize >= 8:
+                # uint64 > 2^63 has no faithful pandas/post-processing
+                # representation here — host engine computes it exactly
+                return None
+            if dt.kind == "i" and dt.itemsize >= 8:
+                # int64 with NULLs: the float64 NaN view loses exactness
+                # past 2^53 — SUM/AVG split into hi/lo 32-bit halves
+                # (exact), MIN/MAX fill NULLs with dtype extremes, counts
+                # come from the null mask
+                big_int_masked = True
+            else:
+                masked_srcs.add(src)
         name = c.output_name
         if name == "":
             return None
         tp = c.infer_type(jdf.schema)
+        if big_int_masked:
+            if func not in ("SUM", "AVG", "MIN", "MAX", "COUNT"):
+                return None
+            nn = f"{name}__nn"
+            if func in ("SUM", "AVG"):
+                virtual[f"{src}__hi__"] = ("hi", src)
+                virtual[f"{src}__lo__"] = ("lo", src)
+                aggs.append((f"{name}__hi", "sum", f"{src}__hi__"))
+                aggs.append((f"{name}__lo", "sum", f"{src}__lo__"))
+                virtual[f"{src}__nn__"] = ("notnull", src)
+                aggs.append((nn, "sum", f"{src}__nn__"))
+                if func == "SUM":
+                    post.append(
+                        {
+                            "name": name,
+                            # exact int64 reassembly; SUM over an all-NULL
+                            # group is NULL (SQL; the host's float64 NaN
+                            # coerces to the same)
+                            "fn": (
+                                lambda m, _n=name: (
+                                    m[f"{_n}__hi"].astype("int64") * (1 << 32)
+                                    + m[f"{_n}__lo"].astype("int64")
+                                )
+                                .astype("Int64")
+                                .where(m[f"{_n}__nn"] > 0)
+                            ),
+                        }
+                    )
+                else:  # AVG
+                    post.append(
+                        {
+                            "name": name,
+                            "fn": (
+                                lambda m, _n=name: (
+                                    m[f"{_n}__hi"].astype("float64") * (1 << 32)
+                                    + m[f"{_n}__lo"].astype("float64")
+                                )
+                                / m[f"{_n}__nn"].where(m[f"{_n}__nn"] > 0)
+                            ),
+                        }
+                    )
+            elif func in ("MIN", "MAX"):
+                tag = "minfill" if func == "MIN" else "maxfill"
+                virtual[f"{src}__{tag}__"] = (tag, src)
+                aggs.append((name, func.lower(), f"{src}__{tag}__"))
+                virtual[f"{src}__nn__"] = ("notnull", src)
+                aggs.append((nn, "sum", f"{src}__nn__"))
+                post.append(
+                    {
+                        "name": name,
+                        # Int64 extension keeps <NA> exact (a float NaN
+                        # detour would corrupt values past 2^53)
+                        "fn": (
+                            lambda m, _n=name: m[_n]
+                            .astype("Int64")
+                            .where(m[f"{_n}__nn"] > 0)
+                        ),
+                    }
+                )
+            else:  # COUNT
+                virtual[f"{src}__nn__"] = ("notnull", src)
+                aggs.append((name, "sum", f"{src}__nn__"))
+                post.append({"name": name, "fn": (lambda m, _n=name: m[_n])})
+            fields.append(pa.field(name, tp if tp is not None else pa.float64()))
+            continue
         if src in dict_srcs and func in ("MIN", "MAX"):
             dictionary = enc["dictionary"]
 
@@ -2782,6 +2903,7 @@ def _plan_device_agg(
         "schema": Schema(fields),
         "masked_srcs": masked_srcs,
         "dict_srcs": dict_srcs,
+        "virtual": virtual,
     }
 
 
