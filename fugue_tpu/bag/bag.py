@@ -7,7 +7,7 @@ DataFrame; engines may optionally support ``map_bag``.
 from abc import abstractmethod
 from typing import Any, Iterable, List
 
-from ..dataset.dataset import Dataset
+from ..dataset.dataset import Dataset, DatasetDisplay, get_dataset_display
 from ..exceptions import FugueDatasetEmptyError
 
 
@@ -46,3 +46,26 @@ class LocalBoundedBag(LocalBag):
 
     def as_local(self) -> LocalBag:
         return self
+
+
+class BagDisplay(DatasetDisplay):
+    """Plain-text renderer for bags (reference registers an equivalent so
+    ``Bag.show()`` works out of the box)."""
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Any = None
+    ) -> None:
+        b = self._ds
+        if title:
+            print(title)
+        head: List[Any] = b.as_local().head(n).as_array()  # type: ignore[attr-defined]
+        print(f"Bag({len(head)} shown)")
+        for item in head:
+            print(f"  {item!r}")
+        if with_count:
+            print(f"Total count: {b.count()}")
+
+
+@get_dataset_display.candidate(lambda ds: isinstance(ds, Bag), priority=0.1)
+def _default_bag_display(ds: Dataset) -> DatasetDisplay:
+    return BagDisplay(ds)

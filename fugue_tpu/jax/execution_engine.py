@@ -2610,8 +2610,7 @@ class JaxExecutionEngine(ExecutionEngine):
         - ``hi``/``lo``: NULL→0 value split into 32-bit halves, so
           SUM = Σhi·2³² + Σlo stays exact at any magnitude;
         - ``minfill``/``maxfill``: NULLs become the dtype extreme (the
-          identity for min/max), nullability recovered from a count;
-        - ``nullview``: float64 NaN view (counting only — value-lossy).
+          identity for min/max), nullability recovered from the mask count.
         """
         import jax
         import jax.numpy as jnp
@@ -2701,7 +2700,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     agg,
                     value_arrs[src],
                     (
-                        plan["virtual"][src][0] == "nullview"
+                        # virtual arrays (hi/lo/notnull/min-max fills) are
+                        # pre-filled plain ints — never NaN-aware
+                        False
                         if src in plan["virtual"]
                         else (
                             jdf.maybe_nan(src)

@@ -37,3 +37,46 @@ class BagTests:
             h = b.head(3)
             assert h.as_array() == [0, 1, 2]
             assert h.is_bounded
+
+        def test_head_edges(self):
+            b = self.bag([1, 2])
+            assert b.head(0).as_array() == []
+            assert b.head(10).as_array() == [1, 2]
+
+        def test_special_values(self):
+            data = [None, float("nan"), "", 0, False, b"\x00"]
+            b = self.bag(list(data))
+            arr = b.as_array()
+            assert len(arr) == 6
+            assert arr[0] is None and arr[2] == "" and arr[3] == 0
+
+        def test_mixed_object_types(self):
+            data = [dict(a=1), [1, 2], ("t", 1), {3, 4}]
+            b = self.bag(list(data))
+            arr = b.as_array()
+            assert dict(a=1) in arr and [1, 2] in arr
+
+        def test_as_local_identity(self):
+            b = self.bag([1, 2, 3])
+            lb = b.as_local()
+            assert lb.is_local
+            assert lb.as_array() == [1, 2, 3]
+
+        def test_num_partitions_and_metadata(self):
+            b = self.bag([1])
+            assert b.num_partitions >= 1
+            assert not b.has_metadata
+            b.reset_metadata({"k": "v"})
+            assert b.metadata["k"] == "v"
+            b.reset_metadata(None)
+            assert not b.has_metadata
+
+        def test_show(self):
+            self.bag([1, "x", None]).show()
+            self.bag([]).show()
+
+        def test_large_bag(self):
+            n = 10_000
+            b = self.bag(list(range(n)))
+            assert b.count() == n
+            assert b.head(5).as_array() == [0, 1, 2, 3, 4]

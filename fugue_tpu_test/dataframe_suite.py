@@ -162,3 +162,60 @@ class DataFrameTests:
             with pytest.raises(Exception):
                 r = df.alter_columns("x:[long]")
                 r.as_array()
+
+        def test_as_array_special_values(self):
+            # NaN / None / NaT mixtures survive typed extraction: type-safe
+            # extraction renders float NaN as NULL (None)
+            rows = self.df(
+                [[1.0, None], [float("nan"), "x"]], "a:double,b:str"
+            ).as_array(type_safe=True)
+            assert rows[0] == [1.0, None]
+            assert rows[1][1] == "x" and (
+                rows[1][0] is None or rows[1][0] != rows[1][0]
+            )
+            rows = self.df(
+                [[1.0, None], [None, "x"]], "a:double,b:str"
+            ).as_array(type_safe=True)
+            assert rows[0] == [1.0, None]
+            assert rows[1][1] == "x" and rows[1][0] is None
+            ts = datetime(2021, 5, 6, 7, 8)
+            rows = self.df([[ts], [None]], "t:datetime").as_array(
+                type_safe=True
+            )
+            assert rows[0][0] == ts and rows[1][0] is None
+
+        def test_as_dict_iterable_specials(self):
+            rows = list(
+                self.df(
+                    [[1, None], [None, "b"]], "x:long,y:str"
+                ).as_dict_iterable()
+            )
+            assert rows == [dict(x=1, y=None), dict(x=None, y="b")]
+
+        def test_rename_invalid(self):
+            df = self.df([[1]], "x:long")
+            with pytest.raises(Exception):
+                df.rename({"nonexistent": "y"})
+
+        def test_get_column_names(self):
+            from fugue_tpu.dataframe.api import get_column_names
+
+            df = self.df([[1, "a", 2.0]], "x:long,y:str,z:double")
+            assert get_column_names(df) == ["x", "y", "z"]
+
+        def test_rename_any_names(self):
+            df = self.df([[1, "a"]], "x:long,y:str")
+            r = df.rename({"x": "a b", "y": "c.d"})
+            assert r.schema.names == ["a b", "c.d"]
+            assert r.as_array() == [[1, "a"]]
+
+        def test_deep_nested_types(self):
+            # structs of lists and lists of structs round-trip
+            df = self.df(
+                [[dict(a=[1, 2], b="x")]], "c:{a:[long],b:str}"
+            )
+            row = df.as_array(type_safe=True)[0]
+            assert row[0] == dict(a=[1, 2], b="x")
+            df2 = self.df([[[dict(a=1), dict(a=2)]]], "c:[{a:long}]")
+            row2 = df2.as_array(type_safe=True)[0]
+            assert row2[0] == [dict(a=1), dict(a=2)]

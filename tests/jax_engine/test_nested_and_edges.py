@@ -1,0 +1,183 @@
+"""Nested list/struct columns on the jax engine (host-resident columns
+riding device frames) and the empty/edge-partition matrix (VERDICT r2 #7:
+static-shape XLA makes empty partitions the hard case — mask, don't
+branch)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff, lit
+from fugue_tpu.dataframe import ArrowDataFrame
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.dataframe import JaxDataFrame
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = JaxExecutionEngine()
+    yield e
+    e.stop()
+
+
+# ---- nested types on the device engine ------------------------------------
+
+
+def _nested_tbl():
+    return pa.table(
+        {
+            "k": pa.array([1, 2, 3], type=pa.int64()),
+            "tags": pa.array([[1, 2], [], [3]], type=pa.list_(pa.int64())),
+            "info": pa.array(
+                [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}, {"a": 3, "b": "z"}],
+                type=pa.struct([("a", pa.int64()), ("b", pa.string())]),
+            ),
+        }
+    )
+
+
+def test_nested_ingestion_roundtrip(engine):
+    jdf = engine.to_df(ArrowDataFrame(_nested_tbl()))
+    assert isinstance(jdf, JaxDataFrame)
+    assert "k" in jdf.device_cols  # numeric col on device
+    assert jdf.host_table is not None  # nested cols stay host-resident
+    out = jdf.as_arrow()
+    assert out.column("tags").to_pylist() == [[1, 2], [], [3]]
+    assert out.column("info").to_pylist()[0] == {"a": 1, "b": "x"}
+
+
+def test_nested_filter_keeps_alignment(engine):
+    jdf = engine.to_df(ArrowDataFrame(_nested_tbl()))
+    flt = engine.filter(jdf, col("k") > lit(1))
+    got = flt.as_arrow()
+    assert got.column("k").to_pylist() == [2, 3]
+    assert got.column("tags").to_pylist() == [[], [3]]
+    assert got.column("info").to_pylist()[-1]["b"] == "z"
+
+
+def test_nested_select_and_take(engine):
+    jdf = engine.to_df(ArrowDataFrame(_nested_tbl()))
+    sub = jdf[["k", "tags"]]
+    assert sub.schema.names == ["k", "tags"]
+    assert sub.as_arrow().column("tags").to_pylist() == [[1, 2], [], [3]]
+    t = engine.take(jdf, 2, presort="k desc")
+    got = t.as_pandas()
+    assert got["k"].tolist() == [3, 2]
+    assert got["tags"].tolist()[0] == [3]
+
+
+def test_nested_transform_passthrough(engine):
+    jdf = engine.to_df(ArrowDataFrame(_nested_tbl()))
+
+    def first_tag(pdf: pd.DataFrame) -> pd.DataFrame:
+        return pdf.assign(
+            first=[t[0] if len(t) else -1 for t in pdf["tags"]]
+        )[["k", "first"]]
+
+    res = fa.transform(
+        jdf,
+        first_tag,
+        schema="k:long,first:long",
+        engine=engine,
+        as_local=True,
+    )
+    if hasattr(res, "as_pandas"):
+        got = res.as_pandas()
+    elif hasattr(res, "to_pandas"):
+        got = res.to_pandas()
+    else:
+        got = res
+    assert sorted(got["first"]) == [-1, 1, 3]
+
+
+def test_nested_parquet_roundtrip(engine, tmp_path):
+    jdf = engine.to_df(ArrowDataFrame(_nested_tbl()))
+    path = str(tmp_path / "nested.parquet")
+    engine.save_df(jdf, path)
+    back = engine.load_df(path)
+    assert back.as_arrow().column("tags").to_pylist() == [[1, 2], [], [3]]
+
+
+# ---- empty / edge partition matrix ----------------------------------------
+
+
+def test_fully_filtered_frame_ops(engine):
+    jdf = engine.to_df(pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+    empty = engine.filter(jdf, col("v") > lit(100.0))
+    assert empty.count() == 0
+    agg = engine.aggregate(
+        empty, PartitionSpec(by=["k"]), [ff.sum(col("v")).alias("s")]
+    )
+    assert agg.count() == 0
+    d = engine.distinct(empty)
+    assert d.count() == 0
+    t = engine.take(empty, 5, presort="v")
+    assert t.count() == 0
+
+
+def test_empty_one_side_joins(engine):
+    left = engine.to_df(pd.DataFrame({"k": [1, 2], "a": [1.0, 2.0]}))
+    empty = engine.filter(
+        engine.to_df(pd.DataFrame({"k": [9], "b": [9.0]})),
+        col("k") < lit(0),
+    )
+    inner = engine.join(left, empty, how="inner", on=["k"])
+    assert inner.count() == 0
+    lo = engine.join(left, empty, how="left_outer", on=["k"])
+    got = lo.as_pandas().sort_values("k")
+    assert got["k"].tolist() == [1, 2]
+    assert got["b"].isna().all()
+    anti = engine.join(left, empty, how="left_anti", on=["k"])
+    assert anti.count() == 2
+
+
+def test_single_row_on_eight_shards(engine):
+    # 1 valid row, 7+ all-padding shards: every op must mask, not branch
+    jdf = engine.to_df(pd.DataFrame({"k": [5], "v": [1.5]}))
+    rep = engine.repartition(jdf, PartitionSpec(algo="hash", by=["k"]))
+    assert rep.as_pandas()["v"].tolist() == [1.5]
+    agg = engine.aggregate(
+        jdf, PartitionSpec(by=["k"]), [ff.avg(col("v")).alias("m")]
+    ).as_pandas()
+    assert agg["m"].tolist() == [1.5]
+    u = engine.union(jdf, jdf, distinct=True)
+    assert u.count() == 1
+
+
+def test_skewed_valid_rows_window_and_group(engine):
+    # filter empties most shards; window + groupby still exact
+    rng = np.random.default_rng(4)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 5, 800), "v": rng.random(800)}
+    )
+    r = fa.fugue_sql(
+        """
+        SELECT k, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v) AS rn
+        FROM df WHERE v < 0.05
+        """,
+        df=pdf,
+        engine=engine,
+        as_local=True,
+    )
+    got = (r.to_pandas() if hasattr(r, "to_pandas") else r)
+    sub = pdf[pdf["v"] < 0.05]
+    assert len(got) == len(sub)
+    assert got.groupby("k")["rn"].max().sum() == len(sub)
+
+
+def test_empty_frame_through_workflow(engine):
+    pdf = pd.DataFrame({"k": pd.array([], dtype="int64"), "v": pd.array([], dtype="float64")})
+
+    def noop(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    res = fa.transform(
+        pdf, noop, schema="*", partition={"by": ["k"]}, engine=engine,
+        as_local=True,
+    )
+    got = (res.to_pandas() if hasattr(res, "to_pandas") else res)
+    assert len(got) == 0
+    assert list(got.columns) == ["k", "v"]
