@@ -1,4 +1,9 @@
-.PHONY: test bench dryrun native
+.PHONY: install test bench dryrun native
+
+# editable install so examples/notebooks import fugue_tpu without PYTHONPATH
+# (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
+install:
+	pip install -e . --no-deps --no-build-isolation
 
 test:
 	python -m pytest tests/ -q
