@@ -65,7 +65,12 @@ def eval_window(pdf: pd.DataFrame, expr: _WindowExpr) -> pd.Series:
         else:
             prev = okeys.shift()
             pos = pd.Series(np.arange(len(ordered)), index=ordered.index)
-        equal_prev = (okeys.eq(prev) | (okeys.isna() & prev.isna())).all(axis=1)
+        # fillna(False): eq() over nullable extension dtypes yields pd.NA
+        # for value-vs-NULL comparisons, and NA would pass .all() as True
+        equal_prev = (
+            okeys.eq(prev).fillna(False).astype(bool)
+            | (okeys.isna() & prev.isna())
+        ).all(axis=1)
         changed = ~equal_prev | (pos == 0)
         if func == "DENSE_RANK":
             res = (
@@ -230,9 +235,12 @@ def _bounded_frame_agg(
     peer_changed = np.ones(len(ordered), dtype=bool)
     if kind == "range" and len(ordered) > 0:
         okeys = ordered[order_names]
-        eq_prev = (okeys.eq(okeys.shift()) | (okeys.isna() & okeys.shift().isna())).all(
-            axis=1
-        )
+        # fillna(False): eq() over nullable extension dtypes yields pd.NA
+        # for value-vs-NULL comparisons, and NA would pass .all() as True
+        eq_prev = (
+            okeys.eq(okeys.shift()).fillna(False).astype(bool)
+            | (okeys.isna() & okeys.shift().isna())
+        ).all(axis=1)
         peer_changed = ~eq_prev.to_numpy()
     if keys is not None:
         # positional locations per partition, in sorted (frame) order

@@ -34,6 +34,13 @@ _RANKS = {"ROW_NUMBER", "RANK", "DENSE_RANK"}
 _NO_LIT = object()
 
 
+def _safe_mask_prefix(names: Any) -> str:
+    """Sort-payload mask-column prefix that can't shadow a user column."""
+    from .execution_engine import _safe_prefix
+
+    return _safe_prefix("__wmask__", names)
+
+
 def _norm_frame(expr: _WindowExpr) -> Optional[Tuple]:
     """Normalize an aggregate's frame to a hashable plan tag, or None when
     the shape needs the host evaluator."""
@@ -88,9 +95,36 @@ def _plan_items(
         and c not in jdf.encodings
         and c not in jdf.null_masks
     )
-    if not all(plain(k) and not jdf.maybe_nan(k) for k in pkeys):
+
+    def groupable(c: str) -> bool:
+        """Usable as a partition/order key: plain, or a SORTED dictionary
+        (codes group exactly and code order == lexicographic order; -1 is
+        the NULL code, flagged separately in the sort)."""
+        if plain(c):
+            return True
+        enc = jdf.encodings.get(c)
+        return (
+            c in jdf.device_cols
+            and c not in jdf.null_masks
+            and enc is not None
+            and enc.get("kind") == "dict"
+            and bool(enc.get("sorted"))
+        )
+
+    def orderable(c: str) -> bool:
+        """Order keys additionally admit null-masked (nullable int/bool)
+        columns — the mask rides the sort and flags NULL-last ordering."""
+        if groupable(c):
+            return True
+        return (
+            c in jdf.device_cols
+            and c in jdf.null_masks
+            and c not in jdf.encodings
+        )
+
+    if not all(groupable(k) and not jdf.maybe_nan(k) for k in pkeys):
         return None
-    if not all(plain(n) for n, _ in order_items):
+    if not all(orderable(n) for n, _ in order_items):
         return None
     specs: List[Tuple] = []
     for out_name, expr in items:
@@ -157,16 +191,27 @@ def _plan_items(
             ):
                 return None
             arg = expr.args[0].name
-            if not plain(arg):
+            masked_arg = (
+                arg in jdf.device_cols
+                and arg in jdf.null_masks
+                and arg not in jdf.encodings
+            )
+            if not plain(arg) and not masked_arg:
                 return None
-            if func in ("FIRST", "LAST") and jdf.maybe_nan(arg):
-                return None  # positional semantics vs NaN==NULL ambiguity
-            if func not in ("COUNT", "FIRST", "LAST") and np.dtype(
-                jdf.device_cols[arg].dtype
-            ) != np.dtype(np.float64):
+            if func in ("FIRST", "LAST") and (
+                masked_arg or jdf.maybe_nan(arg)
+            ):
+                return None  # positional semantics vs NULL ambiguity
+            if (
+                func not in ("COUNT", "FIRST", "LAST")
+                and not masked_arg
+                and np.dtype(jdf.device_cols[arg].dtype)
+                != np.dtype(np.float64)
+            ):
                 # non-float64 SUM/MIN/MAX/AVG: float64 accumulation would
                 # change the output type (host keeps long/float) and lose
-                # int precision past 2^53 — host fallback
+                # int precision past 2^53 — host fallback. Masked args are
+                # exempt: the host oracle itself holds them as float64.
                 return None
             tag = _norm_frame(expr)
             if tag is None:
@@ -188,10 +233,6 @@ def plan_device_windows(
     if not isinstance(jdf, JaxDataFrame) or jdf.host_table is not None:
         return None
     if len(jdf.device_cols) != len(jdf.schema):
-        return None
-    if len(jdf.null_masks) > 0:
-        # masked columns would need their masks threaded through the sort;
-        # host fallback until that lands
         return None
     return _plan_items(jdf, items)
 
@@ -220,17 +261,29 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
     from ..parallel.mesh import ROW_AXIS
     from .dataframe import JaxDataFrame
 
-    if (
-        not isinstance(jdf, JaxDataFrame)
-        or jdf.host_table is not None
-        or len(jdf.null_masks) > 0
-    ):
+    if not isinstance(jdf, JaxDataFrame) or jdf.host_table is not None:
         return None
     specs, pkeys, order_items = plan
     jdf = engine.repartition(jdf, PartitionSpec(algo="hash", by=pkeys))
     mesh = jdf.mesh
     cache = engine._jit_cache
-    cache_key = ("window", mesh, specs, tuple(pkeys), tuple(order_items))
+    # null masks ride the sort as extra payload columns (mangled names) so
+    # masked order keys / aggregate args keep NULL semantics
+    mask_prefix = _safe_mask_prefix(jdf.schema.names)
+    masked_cols = frozenset(jdf.null_masks)
+    dict_cols = frozenset(
+        c for c, enc in jdf.encodings.items() if enc.get("kind") == "dict"
+    )
+    # only ORDER-key dict membership shapes the compiled kernel (pkeys
+    # compare as plain codes; payload-only encodings just ride the sort) —
+    # keying on it alone keeps jit reuse across frames
+    dict_order_cols = frozenset(
+        n for n, _ in order_items if n in dict_cols
+    )
+    cache_key = (
+        "window", mesh, specs, tuple(pkeys), tuple(order_items),
+        dict_order_cols, masked_cols,
+    )
     names_sig = tuple(jdf.schema.names)
 
     if (cache_key, names_sig) not in cache:
@@ -243,12 +296,30 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                     ops.append(c[k])
                 for n, asc in order_items:
                     key = c[n]
-                    if jnp.issubdtype(key.dtype, jnp.floating):
+                    if n in masked_cols:
+                        # nullable int/bool: the mask flags NULL-last order
+                        isnull = c[f"{mask_prefix}{n}"]
+                        ops.append(isnull)
+                        key = jnp.where(isnull, jnp.zeros((), key.dtype), key)
+                        if not asc:
+                            key = (
+                                jnp.logical_not(key)
+                                if key.dtype == jnp.bool_
+                                else ~key
+                            )
+                        ops.append(key)
+                    elif jnp.issubdtype(key.dtype, jnp.floating):
                         # host sorts with na_position="last"
                         isnan = jnp.isnan(key)
                         ops.append(isnan)
                         key = jnp.where(isnan, jnp.zeros((), key.dtype), key)
                         ops.append(-key if not asc else key)
+                    elif n in dict_cols:
+                        # sorted-dictionary codes: code order == lex order;
+                        # -1 is NULL → order it LAST like the host
+                        isnull = key < 0
+                        ops.append(isnull)
+                        ops.append(~key if not asc else key)
                     elif not asc:
                         ops.append(
                             jnp.logical_not(key)
@@ -268,16 +339,28 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                 n_rows = sv.shape[0]
                 iota = jax.lax.iota(jnp.int32, n_rows)
 
-                def nan_eq_diff(col: Any) -> Any:
+                def nan_eq_diff(col: Any, mask: Any = None) -> Any:
                     a, b = col[1:], col[:-1]
                     neq = a != b
                     if jnp.issubdtype(col.dtype, jnp.floating):
                         neq = neq & ~(jnp.isnan(a) & jnp.isnan(b))
+                    if mask is not None:
+                        # NULLs compare equal to each other, never to values
+                        ma, mb = mask[1:], mask[:-1]
+                        neq = (neq & ~(ma & mb)) | (ma != mb)
                     return jnp.concatenate([jnp.ones((1,), bool), neq])
+
+                def key_diff(n: str) -> Any:
+                    m = (
+                        sc[f"{mask_prefix}{n}"]
+                        if n in masked_cols
+                        else None
+                    )
+                    return nan_eq_diff(sc[n], m)
 
                 seg_change = jnp.logical_not(sv)
                 for k in pkeys:
-                    seg_change = seg_change | nan_eq_diff(sc[k])
+                    seg_change = seg_change | key_diff(k)
                 seg_change = seg_change.at[0].set(True)
                 seg_start = jax.lax.cummax(
                     jnp.where(seg_change, iota, jnp.int32(-1))
@@ -309,7 +392,7 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                 peer_change_by: Dict[int, Any] = {0: seg_change}
                 pc = seg_change
                 for j, (n, _) in enumerate(order_items):
-                    pc = pc | nan_eq_diff(sc[n])
+                    pc = pc | key_diff(n)
                     peer_change_by[j + 1] = pc
                 peer_end_by = {
                     j: end_of_run(ch, seg_end) for j, ch in peer_change_by.items()
@@ -332,6 +415,8 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                     x = sc[arg]
                     xf = x.astype(jnp.float64)
                     nn = sv & ~jnp.isnan(xf)
+                    if arg in masked_cols:
+                        nn = nn & jnp.logical_not(sc[f"{mask_prefix}{arg}"])
                     xm = jnp.where(nn, xf, 0.0)
                     c = jnp.cumsum(xm)
                     cnt = jnp.cumsum(nn.astype(jnp.float64))
@@ -467,10 +552,14 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
             )(cols, valid)
 
         cache[(cache_key, names_sig)] = jax.jit(compute)
-    out = cache[(cache_key, names_sig)](
-        dict(jdf.device_cols), jdf.device_valid_mask()
-    )
+    payload = dict(jdf.device_cols)
+    for c_, m_ in jdf.null_masks.items():
+        payload[f"{mask_prefix}{c_}"] = m_
+    out = cache[(cache_key, names_sig)](payload, jdf.device_valid_mask())
     new_valid = out.pop("__wvalid__")
+    out_masks = {
+        c_: out.pop(f"{mask_prefix}{c_}") for c_ in jdf.null_masks
+    }
     dtype_to_pa = {
         "int64": "long",
         "float64": "double",
@@ -498,7 +587,8 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
             # encoded columns rode the sort as codes — their encodings
             # still describe them
             encodings=dict(jdf.encodings),
-            null_masks={},
+            # sorted alongside their columns — still row-aligned
+            null_masks=out_masks,
             schema=work_schema,
         ),
     )

@@ -303,3 +303,166 @@ def test_int_aggregate_schema_fidelity(engine, oracle, data):
     g = got.sort_values(["k", "s"]).reset_index(drop=True)
     x = exp.sort_values(["k", "s"]).reset_index(drop=True)
     pd.testing.assert_frame_equal(g, x)
+
+
+def test_string_partition_keys_device(engine, oracle):
+    rng = np.random.default_rng(21)
+    n = 300
+    df = pd.DataFrame(
+        {
+            "g": rng.choice(["alpha", "beta", "gamma", "delta"], n),
+            "o": rng.permutation(n).astype("int64"),
+            "v": rng.random(n),
+        }
+    )
+    _run_both(
+        """
+        SELECT g, o,
+          ROW_NUMBER() OVER (PARTITION BY g ORDER BY o) AS rn,
+          SUM(v) OVER (PARTITION BY g ORDER BY o) AS rs
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_string_order_keys_with_nulls_device(engine, oracle):
+    rng = np.random.default_rng(22)
+    n = 200
+    s = rng.choice(["a", "bb", "ccc", None], n, p=[0.3, 0.3, 0.3, 0.1])
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 5, n),
+            "s": pd.array(s, dtype="str"),
+            "v": rng.random(n),
+        }
+    )
+    _run_both(
+        """
+        SELECT k, s,
+          RANK() OVER (PARTITION BY k ORDER BY s) AS r,
+          DENSE_RANK() OVER (PARTITION BY k ORDER BY s) AS dr
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_string_order_desc_device(engine, oracle):
+    rng = np.random.default_rng(23)
+    n = 150
+    s = rng.choice(["a", "bb", "ccc", None], n, p=[0.3, 0.3, 0.3, 0.1])
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 4, n), "s": pd.array(s, dtype="str"),
+         "v": rng.random(n)}
+    )
+    _run_both(
+        """
+        SELECT k, s,
+          DENSE_RANK() OVER (PARTITION BY k ORDER BY s DESC) AS dr
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_nullable_int_order_key_device(engine, oracle):
+    rng = np.random.default_rng(24)
+    n = 200
+    o = pd.array(
+        np.where(rng.random(n) < 0.15, None, rng.integers(0, 40, n)),
+        dtype="Int64",
+    )
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 5, n), "o": o, "v": rng.random(n)}
+    )
+    _run_both(
+        """
+        SELECT k, o,
+          RANK() OVER (PARTITION BY k ORDER BY o) AS r,
+          SUM(v) OVER (PARTITION BY k ORDER BY o) AS s
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_nullable_int_aggregate_arg_device(engine, oracle):
+    rng = np.random.default_rng(25)
+    n = 150
+    m = pd.array(
+        np.where(rng.random(n) < 0.25, None, rng.integers(0, 100, n)),
+        dtype="Int64",
+    )
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 4, n), "o": rng.permutation(n), "m": m}
+    )
+    _run_both(
+        """
+        SELECT k, o,
+          SUM(m) OVER (PARTITION BY k ORDER BY o) AS rs,
+          COUNT(m) OVER (PARTITION BY k ORDER BY o) AS rc,
+          AVG(m) OVER (PARTITION BY k) AS a
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_nullable_int_order_desc_device(engine, oracle):
+    rng = np.random.default_rng(26)
+    n = 160
+    o = pd.array(
+        np.where(rng.random(n) < 0.2, None, rng.integers(0, 30, n)),
+        dtype="Int64",
+    )
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 4, n), "o": o, "v": rng.random(n)}
+    )
+    _run_both(
+        """
+        SELECT k, o,
+          DENSE_RANK() OVER (PARTITION BY k ORDER BY o DESC) AS dr,
+          SUM(v) OVER (PARTITION BY k ORDER BY o DESC) AS s
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+
+
+def test_range_current_row_nullable_order_key(engine, oracle):
+    # host-evaluator regression: pd.NA through .all() in bounded RANGE peers
+    df = pd.DataFrame(
+        {
+            "k": [1, 1, 1, 1],
+            "o": pd.array([1, 1, None, 2], dtype="Int64"),
+            "v": [50.0, 51.0, 100.0, 1.0],
+        }
+    )
+    r = _run_both(
+        """
+        SELECT k, o, v,
+          SUM(v) OVER (PARTITION BY k ORDER BY o
+                       RANGE BETWEEN CURRENT ROW AND CURRENT ROW) AS s
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+        poison=False,
+    )
+    got = r.sort_values("v")
+    assert got[got["v"] == 100.0]["s"].iloc[0] == 100.0  # NULL is its own peer
+    assert got[got["v"] == 1.0]["s"].iloc[0] == 1.0
