@@ -111,16 +111,18 @@ def _plan_items(
             and bool(enc.get("sorted"))
         )
 
-    def orderable(c: str) -> bool:
-        """Order keys additionally admit null-masked (nullable int/bool)
-        columns — the mask rides the sort and flags NULL-last ordering."""
-        if groupable(c):
-            return True
+    def masked(c: str) -> bool:
+        """A null-masked plain device column (nullable int/bool)."""
         return (
             c in jdf.device_cols
             and c in jdf.null_masks
             and c not in jdf.encodings
         )
+
+    def orderable(c: str) -> bool:
+        """Order keys additionally admit null-masked (nullable int/bool)
+        columns — the mask rides the sort and flags NULL-last ordering."""
+        return groupable(c) or masked(c)
 
     if not all(groupable(k) and not jdf.maybe_nan(k) for k in pkeys):
         return None
@@ -191,11 +193,7 @@ def _plan_items(
             ):
                 return None
             arg = expr.args[0].name
-            masked_arg = (
-                arg in jdf.device_cols
-                and arg in jdf.null_masks
-                and arg not in jdf.encodings
-            )
+            masked_arg = masked(arg)
             if not plain(arg) and not masked_arg:
                 return None
             if func in ("FIRST", "LAST") and (
@@ -216,7 +214,19 @@ def _plan_items(
             tag = _norm_frame(expr)
             if tag is None:
                 return None
-            specs.append((out_name, func, arg, tag, n_ord))
+            out_cast = None
+            if masked_arg and func in ("SUM", "MIN", "MAX"):
+                # the host declares the ARG's type for these (long/bool);
+                # the device computes float64 — mark for conversion back
+                # (values ≤2^53 exact; the host passes through float64 too)
+                import pyarrow as _pa
+
+                tp = expr.infer_type(jdf.schema)
+                if tp is not None and _pa.types.is_integer(tp):
+                    out_cast = "int64"
+                elif tp is not None and _pa.types.is_boolean(tp):
+                    out_cast = "bool"
+            specs.append((out_name, func, arg, tag, n_ord, out_cast))
             continue
         return None
     return tuple(specs), pkeys, order_items
@@ -466,7 +476,7 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                             )
                         continue
                     # aggregates
-                    _, _, arg, tag, n_ord = spec
+                    _, _, arg, tag, n_ord = spec[:5]
                     xf, nn, xm, c_rel, n_rel, c_abs, n_abs = prefix_tables(arg)
                     if tag[0] == "whole":
                         total = c_rel[seg_end]
@@ -571,6 +581,29 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
     extra_fields = []
     for spec in specs:
         arr = out[spec[0]]
+        out_cast = spec[5] if len(spec) >= 6 else None
+        if out_cast is not None:
+            # masked-arg SUM/MIN/MAX computed in float64 with NaN=NULL —
+            # restore the declared integer/bool type + a null mask, exactly
+            # like the host's own float64 round trip
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            ck = ("wcast", out_cast, mesh)
+            if ck not in cache:
+
+                def _conv(a: Any, _t: str = out_cast):
+                    m = _jnp.isnan(a)
+                    vals = _jnp.where(m, 0.0, a).astype(
+                        _jnp.int64 if _t == "int64" else _jnp.bool_
+                    )
+                    return vals, m
+
+                cache[ck] = _jax.jit(_conv)
+            vals, m = cache[ck](arr)
+            out[spec[0]] = vals
+            out_masks[spec[0]] = m
+            arr = vals
         tname = dtype_to_pa.get(str(arr.dtype))
         if tname is None:
             return None  # unexpected dtype — let the host path handle it

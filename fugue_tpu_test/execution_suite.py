@@ -602,3 +602,98 @@ class ExecutionEngineTests:
 
             res = e.comap(z, cm, "k:long,a:long,b:long,c:long")
             assert res.as_array() == [[1, 1, 2, 1]]
+        # -- round-3 coverage: duplicate-key joins, outer/cross, SQL surface -
+        def test_join_duplicate_keys(self):
+            e = self.engine
+            left = self.df([[1, 10.0], [2, 20.0], [3, 30.0]], "x:long,a:double")
+            right = self.df(
+                [[1, 1.0], [1, 2.0], [2, 3.0], [9, 9.0]], "x:long,b:double"
+            )
+            res = e.join(left, right, how="inner", on=["x"])
+            assert _df_eq(
+                res,
+                [[1, 10.0, 1.0], [1, 10.0, 2.0], [2, 20.0, 3.0]],
+                "x:long,a:double,b:double",
+                throw=True,
+            )
+            lo = e.join(left, right, how="left_outer", on=["x"])
+            assert lo.count() == 4
+            semi = e.join(left, right, how="left_semi", on=["x"])
+            assert sorted(r[0] for r in semi.as_array()) == [1, 2]
+            anti = e.join(left, right, how="left_anti", on=["x"])
+            assert sorted(r[0] for r in anti.as_array()) == [3]
+
+        def test_right_and_full_outer_join(self):
+            e = self.engine
+            left = self.df([[1, 1.0], [2, 2.0]], "x:long,a:double")
+            right = self.df([[2, 20.0], [3, 30.0]], "x:long,b:double")
+            ro = e.join(left, right, how="right_outer", on=["x"])
+            rows = sorted(ro.as_array(type_safe=True))
+            assert rows == [[2, 2.0, 20.0], [3, None, 30.0]]
+            fo = e.join(left, right, how="full_outer", on=["x"])
+            rows = sorted(
+                fo.as_array(type_safe=True), key=lambda r: (r[0] is None, r)
+            )
+            assert len(rows) == 3
+            assert [1, 1.0, None] in rows and [3, None, 30.0] in rows
+
+        def test_cross_join(self):
+            e = self.engine
+            a = self.df([[1], [2]], "x:long")
+            b = self.df([["p"], ["q"], ["r"]], "y:str")
+            res = e.join(a, b, how="cross")
+            assert res.count() == 6
+            assert sorted(res.as_array()) == sorted(
+                [[i, s] for i in (1, 2) for s in ("p", "q", "r")]
+            )
+
+        def test_sql_grouping_sets(self):
+            e = self.engine
+            from fugue_tpu.collections.sql import StructuredRawSQL
+
+            df = self.df(
+                [[1, "a", 1.0], [1, "b", 2.0], [2, "b", 3.0]],
+                "x:long,y:str,v:double",
+            )
+            res = e.sql_engine.select(
+                DataFrames(t=df),
+                StructuredRawSQL.from_expr(
+                    "SELECT x, y, SUM(v) AS s FROM <tmpdf:t> GROUP BY ROLLUP(x, y)"
+                ),
+            )
+            rows = res.as_array(type_safe=True)
+            assert len(rows) == 3 + 2 + 1
+            assert [None, None, 6.0] in rows
+
+        def test_sql_correlated_exists(self):
+            e = self.engine
+            from fugue_tpu.collections.sql import StructuredRawSQL
+
+            a = self.df([[1], [2], [3]], "x:long")
+            b = self.df([[2], [2], [3]], "x:long")
+            res = e.sql_engine.select(
+                DataFrames(a=a, b=b),
+                StructuredRawSQL.from_expr(
+                    "SELECT * FROM <tmpdf:a> WHERE EXISTS "
+                    "(SELECT 1 FROM <tmpdf:b> WHERE <tmpdf:b>.x = <tmpdf:a>.x)"
+                ),
+            )
+            assert sorted(r[0] for r in res.as_array()) == [2, 3]
+
+        def test_sql_window_over_strings(self):
+            e = self.engine
+            from fugue_tpu.collections.sql import StructuredRawSQL
+
+            df = self.df(
+                [["a", 3.0], ["a", 1.0], ["b", 2.0]], "g:str,v:double"
+            )
+            res = e.sql_engine.select(
+                DataFrames(t=df),
+                StructuredRawSQL.from_expr(
+                    "SELECT g, ROW_NUMBER() OVER "
+                    "(PARTITION BY g ORDER BY v) AS rn FROM <tmpdf:t>"
+                ),
+            )
+            rows = sorted(res.as_array())
+            assert rows == [["a", 1], ["a", 2], ["b", 1]]
+
