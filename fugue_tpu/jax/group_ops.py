@@ -49,11 +49,14 @@ def num_segments(cols: Dict[str, Any]) -> int:
 
 def _merge(cols: Dict[str, Any], table: Any, kind: str) -> Any:
     if SPANS_SHARDS in cols:
-        from jax import lax
-
+        from ..ops import collectives
         from ..parallel.mesh import ROW_AXIS
 
-        op = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}[kind]
+        op = {
+            "sum": collectives.psum,
+            "min": collectives.pmin,
+            "max": collectives.pmax,
+        }[kind]
         table = op(table, ROW_AXIS)
     return table
 

@@ -1,0 +1,102 @@
+"""Axis-size-aware collective wrappers.
+
+Every cross-shard collective in the device kernels goes through these
+instead of raw ``lax`` so that:
+
+1. On a 1-device mesh (the single-chip TPU tunnel) every reduce equals
+   ``psum`` (sum over one element is also the min, the max, and the
+   identity) — and Sum all-reduce is the ONLY collective the axon TPU
+   platform's AOT compiler lowers (observed live: ``lax.pmin`` fails to
+   compile with "Supported lowering only of Sum all reduce"). Rewriting
+   to ``psum`` at size 1 both compiles on the real chip and keeps
+   ``shard_map``'s replication typing intact (plain identity would leave
+   the value "varying" and trip the out_specs VMA check). The axis size
+   is static inside ``shard_map`` (``lax.axis_size``), so the branch
+   disappears at trace time.
+2. On a multi-device mesh whose platform still only lowers Sum
+   all-reduces, setting ``FUGUE_TPU_SUM_ONLY_COLLECTIVES=1`` rewrites
+   min/max/gather/all-to-all in terms of ``psum`` over one-hot buffers
+   (n× the bandwidth — correct everywhere, tested on the CPU mesh).
+   Default off; the CPU mesh and standard TPU runtimes lower the native
+   collectives fine.
+
+The reference delegates all of this to its backends' transports (Spark
+shuffle / Dask comm / Ray object store — SURVEY §5.8); here the XLA
+collectives ARE the transport, so platform quirks surface in-tree.
+"""
+
+import os
+from typing import Any
+
+__all__ = ["psum", "pmin", "pmax", "all_gather", "all_to_all"]
+
+
+def _sum_only() -> bool:
+    return os.environ.get("FUGUE_TPU_SUM_ONLY_COLLECTIVES", "") == "1"
+
+
+def _gather_via_psum(x: Any, axis: str) -> Any:
+    """``all_gather`` built from the one collective every platform lowers:
+    each shard psums a one-hot-indexed buffer holding its own block."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    buf = jnp.zeros((n,) + x.shape, x.dtype).at[i].set(x)
+    # psum upcasts bool to int32 — restore the caller's dtype (the buffers
+    # are one-hot, so the cast is lossless)
+    return lax.psum(buf, axis).astype(x.dtype)
+
+
+def psum(x: Any, axis: str) -> Any:
+    from jax import lax
+
+    return lax.psum(x, axis)
+
+
+def pmin(x: Any, axis: str) -> Any:
+    from jax import lax
+
+    if lax.axis_size(axis) == 1:
+        return lax.psum(x, axis).astype(x.dtype)
+    if _sum_only():
+        return _gather_via_psum(x, axis).min(axis=0)
+    return lax.pmin(x, axis)
+
+
+def pmax(x: Any, axis: str) -> Any:
+    from jax import lax
+
+    if lax.axis_size(axis) == 1:
+        return lax.psum(x, axis).astype(x.dtype)
+    if _sum_only():
+        return _gather_via_psum(x, axis).max(axis=0)
+    return lax.pmax(x, axis)
+
+
+def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
+    import jax.numpy as jnp
+    from jax import lax
+
+    if lax.axis_size(axis) == 1:
+        g = lax.psum(x, axis).astype(x.dtype)
+        return g if tiled else g[None]
+    if _sum_only():
+        g = _gather_via_psum(x, axis)
+        return jnp.concatenate(list(g), axis=0) if tiled else g
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def all_to_all(x: Any, axis: str, split_axis: int, concat_axis: int) -> Any:
+    """Shard i's ``x[j]`` block lands on shard j (split/concat over the
+    leading axis — the only shape the shuffle kernels use)."""
+    from jax import lax
+
+    assert split_axis == 0 and concat_axis == 0
+    if lax.axis_size(axis) == 1:
+        return x
+    if _sum_only():
+        # g[src, dest, ...] replicated via psum; my receive row is g[:, i]
+        return _gather_via_psum(x, axis)[:, lax.axis_index(axis)]
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis)

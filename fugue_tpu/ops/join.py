@@ -26,6 +26,7 @@ both sides' match sets on device.
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..parallel.mesh import ROW_AXIS, num_row_shards
+from . import collectives
 from .shuffle import _hash_cols
 
 _JOIN_CACHE: Dict[Any, Any] = {}
@@ -331,7 +332,7 @@ def _get_compiled_expand_count(mesh: Any, n_keys: int, dtypes: Any, local: bool,
             total = jnp.where(
                 slots.shape[0] > 0, off[-1] + slots[-1], jnp.int64(0)
             )
-            return cand, lo.astype(jnp.int64), off, lax.pmax(total, ROW_AXIS)[None]
+            return cand, lo.astype(jnp.int64), off, collectives.pmax(total, ROW_AXIS)[None]
 
         row = P(ROW_AXIS)
         right = row if local else P()

@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import collectives
+
 _COMPILE_CACHE: Dict[Any, Any] = {}
 
 
@@ -305,8 +307,8 @@ def _get_compiled_minmax(mesh: Any):
                 big = jnp.where(v_, k_, jnp.iinfo(k_.dtype).max)
                 small = jnp.where(v_, k_, jnp.iinfo(k_.dtype).min)
                 return (
-                    jax.lax.pmin(big.min(), ROW_AXIS)[None],
-                    jax.lax.pmax(small.max(), ROW_AXIS)[None],
+                    collectives.pmin(big.min(), ROW_AXIS)[None],
+                    collectives.pmax(small.max(), ROW_AXIS)[None],
                 )
 
             return jax.shard_map(
@@ -345,7 +347,7 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
             values = rest[:num_vals]
             valid = rest[num_vals]
             idx = jnp.where(valid, (k - kmin).astype(jnp.int32), buckets - 1)
-            present = lax.psum(
+            present = collectives.psum(
                 jnp.zeros(buckets, dtype=jnp.int64).at[idx].add(
                     valid.astype(jnp.int64)
                 ),
@@ -369,9 +371,9 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
                 ),
                 count_all=present,
                 merge_ops={
-                    "sum": lambda t: lax.psum(t, ROW_AXIS),
-                    "min": lambda t: lax.pmin(t, ROW_AXIS),
-                    "max": lambda t: lax.pmax(t, ROW_AXIS),
+                    "sum": lambda t: collectives.psum(t, ROW_AXIS),
+                    "min": lambda t: collectives.pmin(t, ROW_AXIS),
+                    "max": lambda t: collectives.pmax(t, ROW_AXIS),
                 },
             )
             return (present,) + tuple(outs)

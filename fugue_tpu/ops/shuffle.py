@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..parallel.mesh import ROW_AXIS, num_row_shards
+from . import collectives
 
 _COMPILE_CACHE: Dict[Any, Any] = {}
 
@@ -99,7 +100,7 @@ def _get_compiled_dest_even(mesh: Any):
 
         def kernel(valid: Any):
             local = jnp.cumsum(valid.astype(jnp.int64)) - 1  # local rank
-            counts = jax.lax.all_gather(valid.sum(dtype=jnp.int64), ROW_AXIS)
+            counts = collectives.all_gather(valid.sum(dtype=jnp.int64), ROW_AXIS)
             me = jax.lax.axis_index(ROW_AXIS)
             offset = jnp.where(
                 jax.lax.iota(jnp.int64, shards) < me, counts, 0
@@ -164,10 +165,10 @@ def _get_compiled_counts(mesh: Any):
                 .at[dest]
                 .add(valid.astype(jnp.int32))
             )
-            received = lax.psum(h, ROW_AXIS)  # per-dest totals, replicated
+            received = collectives.psum(h, ROW_AXIS)  # per-dest totals, replicated
             return (
-                lax.pmax(h.max(), ROW_AXIS)[None],
-                lax.psum(h.sum(), ROW_AXIS)[None],
+                collectives.pmax(h.max(), ROW_AXIS)[None],
+                collectives.psum(h.sum(), ROW_AXIS)[None],
                 received.max()[None],
             )
 
@@ -221,7 +222,7 @@ def _get_compiled_exchange(
                 .at[flat]
                 .set(True, mode="drop")
             )
-            recv_valid = lax.all_to_all(
+            recv_valid = collectives.all_to_all(
                 send_valid.reshape(shards, capacity),
                 ROW_AXIS,
                 split_axis=0,
@@ -236,7 +237,7 @@ def _get_compiled_exchange(
                     .set(sa, mode="drop")
                 )
                 outs.append(
-                    lax.all_to_all(
+                    collectives.all_to_all(
                         send.reshape(shards, capacity),
                         ROW_AXIS,
                         split_axis=0,
@@ -323,7 +324,7 @@ def _get_compiled_round(
                 .at[flat]
                 .set(True, mode="drop")
             )
-            recv_valid = lax.all_to_all(
+            recv_valid = collectives.all_to_all(
                 send_valid.reshape(shards, cap),
                 ROW_AXIS,
                 split_axis=0,
@@ -339,7 +340,7 @@ def _get_compiled_round(
                     .at[flat]
                     .set(a, mode="drop")
                 )
-                recv = lax.all_to_all(
+                recv = collectives.all_to_all(
                     send.reshape(shards, cap),
                     ROW_AXIS,
                     split_axis=0,

@@ -1,0 +1,84 @@
+"""Collective wrappers (``ops/collectives.py``).
+
+The single-chip TPU tunnel's compiler lowers ONLY Sum all-reduces, so the
+kernels route every cross-shard collective through axis-size-aware
+wrappers: at size 1 everything becomes ``psum``; on multi-device meshes
+whose platform has the same restriction, ``FUGUE_TPU_SUM_ONLY_COLLECTIVES=1``
+emulates min/max/gather/all-to-all via one-hot ``psum``. The emulation is
+correctness-tested here on the 8-device CPU mesh in a SUBPROCESS — the
+flag is read at trace time and compiled kernels are cached per-process,
+so flipping it inside this process would test nothing.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DRIVER = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from typing import Dict
+import numpy as np
+import pandas as pd
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.jax import JaxExecutionEngine, group_ops as go
+
+eng = JaxExecutionEngine()
+rng = np.random.default_rng(0)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 20000), "v": rng.random(20000)})
+jdf = eng.to_df(pdf)
+
+res = eng.aggregate(jdf, PartitionSpec(by=["k"]),
+    [ff.sum(col("v")).alias("s"), ff.min(col("v")).alias("lo"),
+     ff.max(col("v")).alias("hi")]).as_pandas().sort_values("k")
+exp = pdf.groupby("k").agg(
+    s=("v", "sum"), lo=("v", "min"), hi=("v", "max")).reset_index()
+assert np.allclose(res[["s", "lo", "hi"]], exp[["s", "lo", "hi"]])
+
+other = pd.DataFrame({"k": np.arange(50), "w": np.arange(50) * 2.0})
+j = eng.join(jdf, eng.to_df(other), how="inner", on=["k"]).as_pandas()
+ej = pdf.merge(other, on="k")
+assert len(j) == len(ej) and abs(j["w"].sum() - ej["w"].sum()) < 1e-6
+
+rp = eng.repartition(jdf, PartitionSpec(algo="even", num=8)).as_pandas()
+assert sorted(rp["v"].round(9)) == sorted(pdf["v"].round(9))
+
+def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    m = go.mean(cols, cols["v"])
+    return {"k": cols["k"], "v": cols["v"] - go.per_row(cols, m)}
+
+out = fa.transform(jdf, demean, schema="k:long,v:double",
+                   partition=PartitionSpec(by=["k"]), engine=eng)
+g = out.as_pandas().groupby("k")["v"].mean().abs().max()
+assert g < 1e-12, g
+print("COLLECTIVES_OK")
+"""
+
+
+def _run(extra_env):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(extra_env)
+    env["PYTHONPATH"] = _REPO
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER],
+        capture_output=True,
+        timeout=600,
+        env=env,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "COLLECTIVES_OK" in proc.stdout
+
+
+def test_sum_only_emulation_mode():
+    """One-hot psum emulation gives identical results to native collectives
+    across aggregate/join/repartition/keyed-map (incl. bool-dtype exchange
+    masks, which psum upcasts to int32 — must be cast back)."""
+    _run({"FUGUE_TPU_SUM_ONLY_COLLECTIVES": "1"})
