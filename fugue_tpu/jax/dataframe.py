@@ -19,7 +19,7 @@ The TPU-native distributed frame (SURVEY §7.1 "ShardedJaxDataFrame"):
   device ops and sliced off on conversion back to arrow.
 """
 
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -325,6 +325,36 @@ class JaxDataFrame(DataFrame):
 
         template = next(iter(self._device_cols.values()))
         return _get_compiled_mask(self._mesh)(template, _np.int64(self._row_count))
+
+    def key_range(self, name: str) -> "Tuple[int, int]":
+        """Cached ``(min, max)`` of integer device column ``name`` over
+        valid rows — the probe behind dense-plan eligibility. Frames are
+        immutable, so the probe runs at most once per (frame, column); on a
+        remote-chip tunnel every device→host fetch is a full network
+        roundtrip, and repeated aggregates over a persisted frame were
+        paying it on every call. With no valid rows the kernel's fill
+        values come back — ``(iinfo(dtype).max, iinfo(dtype).min)`` —
+        so emptiness is detected as ``hi < lo``, never by sentinel."""
+        cache = getattr(self, "_key_range_cache", None)
+        if cache is None:
+            cache = self._key_range_cache = {}
+        if name not in cache:
+            import jax
+            import numpy as _np
+
+            from ..ops.segment import _get_compiled_minmax
+
+            lo_a, hi_a = _get_compiled_minmax(self._mesh)(
+                self._device_cols[name], self.device_valid_mask()
+            )
+            # overlap the two fetches: one tunnel roundtrip, not two
+            lo_a.copy_to_host_async()
+            hi_a.copy_to_host_async()
+            cache[name] = (
+                int(_np.asarray(jax.device_get(lo_a))[0]),
+                int(_np.asarray(jax.device_get(hi_a))[0]),
+            )
+        return cache[name]
 
     @property
     def native(self) -> "JaxDataFrame":

@@ -429,7 +429,11 @@ def _dense_groupby_partials(
     agg_sig, arrays = _dedupe_cols(agg_cols)
     compiled = _get_compiled_dense(mesh, buckets, agg_sig)
     outs = compiled(key_arr, np_.int64(kmin), *arrays, valid)
-    # outputs are cross-shard merged + replicated: ONE table comes to host
+    # outputs are cross-shard merged + replicated: ONE table comes to host.
+    # Start every copy before reading any — on a remote-chip tunnel the
+    # roundtrips overlap instead of serializing.
+    for o in outs:
+        o.copy_to_host_async()
     host = [np_.asarray(jax.device_get(o)) for o in outs]
     present = host[0]
     # the overflow bucket (buckets-1) may mix padding rows; presence counts
@@ -452,6 +456,7 @@ def device_groupby_partials(
     agg_cols: List[Tuple[Any, ...]],
     valid_mask: Any,
     max_partial_rows: Optional[int] = None,
+    range_hint: Optional[Tuple[int, int]] = None,
 ) -> "Any":
     """Run the device phase; return a host pandas frame of per-shard-group
     partials. Strategy: single int key with a small range → dense scatter-add
@@ -461,6 +466,9 @@ def device_groupby_partials(
     ``agg_cols`` entries are ``(name, agg, arr)`` or ``(name, agg, arr,
     nullable)`` — ``nullable=False`` marks a float column proved NaN-free,
     which skips the NaN-as-NULL masking work in the kernels.
+    ``range_hint`` is the caller's cached (min, max) of the single key
+    column (``JaxDataFrame.key_range``) — it skips the device probe AND its
+    device→host roundtrip.
     """
     import jax
     import numpy as np_
@@ -475,9 +483,14 @@ def device_groupby_partials(
 
         karr = key_cols[key_names[0]]
         if jnp.issubdtype(karr.dtype, jnp.integer):
-            kmin_a, kmax_a = _get_compiled_minmax(mesh)(karr, valid0)
-            kmin = int(np_.asarray(jax.device_get(kmin_a))[0])
-            kmax = int(np_.asarray(jax.device_get(kmax_a))[0])
+            if range_hint is not None:
+                kmin, kmax = range_hint
+            else:
+                kmin_a, kmax_a = _get_compiled_minmax(mesh)(karr, valid0)
+                kmin_a.copy_to_host_async()
+                kmax_a.copy_to_host_async()
+                kmin = int(np_.asarray(jax.device_get(kmin_a))[0])
+                kmax = int(np_.asarray(jax.device_get(kmax_a))[0])
             rng = kmax - kmin + 1
             if 0 < rng <= _DENSE_MAX_RANGE:
                 # pow2 bucket count bounds the number of compiled variants;
@@ -509,6 +522,8 @@ def device_groupby_partials(
     local_n = outs[1].shape[0] // shards
     k = min(k, local_n)
     sliced = _get_compiled_slicer(mesh, len(outs) - 1, k)(*outs[1:])
+    for a in sliced:
+        a.copy_to_host_async()
     host = [np_.asarray(jax.device_get(a)).reshape(shards, k) for a in sliced]
     # keep only the first nsegs[s] rows of each shard block
     take = np_.arange(k)[None, :] < nsegs[:, None]
