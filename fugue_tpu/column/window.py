@@ -230,18 +230,7 @@ def _bounded_frame_agg(
 
     out = np.full(len(v), np.nan, dtype=np.float64)
     vals = v.to_numpy(dtype=np.float64, na_value=np.nan)
-    # peer-group ids over ALL order keys (dtype-agnostic): RANGE bounds at
-    # CURRENT ROW include the whole peer group, not just equal first keys
-    peer_changed = np.ones(len(ordered), dtype=bool)
-    if kind == "range" and len(ordered) > 0:
-        okeys = ordered[order_names]
-        # fillna(False): eq() over nullable extension dtypes yields pd.NA
-        # for value-vs-NULL comparisons, and NA would pass .all() as True
-        eq_prev = (
-            okeys.eq(okeys.shift()).fillna(False).astype(bool)
-            | (okeys.isna() & okeys.shift().isna())
-        ).all(axis=1)
-        peer_changed = ~eq_prev.to_numpy()
+    okeys = ordered[order_names] if kind == "range" else None
     if keys is not None:
         # positional locations per partition, in sorted (frame) order
         group_iter = [
@@ -281,9 +270,19 @@ def _bounded_frame_agg(
                 else np.searchsorted(k, k + hi_off, side="right")
             )
         else:
-            # RANGE with CURRENT ROW bounds: peer-group boundaries (the
-            # first row of the partition always starts a peer group)
-            changed = peer_changed[gpos].copy()
+            # RANGE with CURRENT ROW bounds: peer-group (tied order keys)
+            # boundaries computed WITHIN the partition — the global sort
+            # interleaves partitions, so row-to-previous-row comparison
+            # there would merge peers whose global neighbors happen to tie.
+            # fillna(False): eq() over nullable extension dtypes yields
+            # pd.NA for value-vs-NULL comparisons, and NA would pass
+            # .all() as True
+            gk = okeys.iloc[gpos]
+            eq_prev = (
+                gk.eq(gk.shift()).fillna(False).astype(bool)
+                | (gk.isna() & gk.shift().isna())
+            ).all(axis=1)
+            changed = (~eq_prev).to_numpy().copy()
             changed[0] = True
             gid = np.cumsum(changed) - 1
             starts = np.flatnonzero(changed)

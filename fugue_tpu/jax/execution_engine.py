@@ -317,7 +317,6 @@ class JaxMapEngine(MapEngine):
         from jax.sharding import PartitionSpec as P
 
         from ..constants import FUGUE_TPU_CONF_DENSE_MAP_RANGE
-        from ..ops.segment import _get_compiled_minmax
         from .group_ops import SEGMENT_SPACE, SEGMENTS, SPANS_SHARDS, VALID
 
         engine: JaxExecutionEngine = self.execution_engine  # type: ignore
@@ -333,12 +332,10 @@ class JaxMapEngine(MapEngine):
         )
         mesh = jdf.mesh
         valid = jdf.device_valid_mask()
-        mm = _get_compiled_minmax(mesh)
         bounds: List[int] = []
         spans: List[int] = []
         for k in keys:
-            lo, hi = mm(jdf.device_cols[k], valid)
-            lo, hi = int(lo[0]), int(hi[0])
+            lo, hi = jdf.key_range(k)  # cached per frame (one probe ever)
             if hi < lo:  # empty frame: degenerate single-bucket space
                 lo, hi = 0, 0
             bounds.append(lo)
@@ -665,6 +662,25 @@ class JaxExecutionEngine(ExecutionEngine):
             [jdf.device_cols[k] for k in by] if algo == "hash" else [],
             valid,
         )
+        return self._exchange_to(jdf, dest, valid)
+
+    def _repartition_single(self, df: DataFrame) -> "JaxDataFrame":
+        """Move every row to shard 0 — the one-partition physical layout
+        behind global (no PARTITION BY) window evaluation. Fully-device
+        frames only; callers gate on that."""
+        from ..ops.shuffle import compute_dest
+
+        jdf = self.to_df(df)
+        valid = jdf.device_valid_mask()
+        dest = compute_dest(self._mesh, "single", [], valid)
+        return self._exchange_to(jdf, dest, valid)
+
+    def _exchange_to(
+        self, jdf: "JaxDataFrame", dest: Any, valid: Any
+    ) -> "JaxDataFrame":
+        """All-to-all exchange of a device frame to per-row destinations."""
+        from ..ops.shuffle import exchange_rows
+
         # null masks are row-aligned — they travel with their columns
         mp = _safe_prefix("__mask__", jdf.schema.names)
         payload = dict(jdf.device_cols)
@@ -2691,6 +2707,18 @@ class JaxExecutionEngine(ExecutionEngine):
                     )
                 arr = self._jit_cache[cache_key](arr, jdf.null_masks[src])
             value_arrs[src] = arr
+        # single plain-int key: reuse the frame's cached range probe so
+        # repeated aggregates don't re-pay the device→host roundtrip
+        range_hint = None
+        if (
+            len(keys) == 1
+            and len(mask_names) == 0
+            and key_cols.get(keys[0]) is jdf.device_cols.get(keys[0])
+            and np.issubdtype(
+                np.dtype(jdf.device_cols[keys[0]].dtype), np.integer
+            )
+        ):
+            range_hint = jdf.key_range(keys[0])
         partials = device_groupby_partials(
             self._mesh,
             key_cols,
@@ -2714,6 +2742,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 for name, agg, src in plan["aggs"]
             ],
             jdf.device_valid_mask(),
+            range_hint=range_hint,
         )
         merged = merge_partials(
             partials,

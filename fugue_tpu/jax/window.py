@@ -57,16 +57,23 @@ def _norm_frame(expr: _WindowExpr) -> Optional[Tuple]:
         return ("running",)
     if kind == "range" and start == "unb_prec" and end == "current":
         return ("peers",)
-    if kind == "rows" and expr.func in ("SUM", "COUNT", "AVG"):
-        def off(b):
-            if b == "current":
-                return 0
-            if isinstance(b, tuple):
-                return -b[1] if b[0] == "prec" else b[1]
-            return None  # unbounded
-        # None offsets mean "to the segment edge" — handled statically
+    if expr.func not in ("SUM", "COUNT", "AVG", "MIN", "MAX"):
+        return None
+
+    def off(b):
+        if b == "current":
+            return 0
+        if isinstance(b, tuple):
+            return -b[1] if b[0] == "prec" else b[1]
+        return None  # unbounded
+
+    # None offsets mean "to the segment edge" — handled statically
+    if kind == "rows":
         return ("rows_bounded", off(start), off(end))
-    return None
+    # RANGE with value offsets: per-row frame bounds come from a binary
+    # search over the (sorted) single order key — includes offset-0 bounds,
+    # where value equality IS the peer group
+    return ("range_bounded", off(start), off(end))
 
 
 def _plan_items(
@@ -77,8 +84,9 @@ def _plan_items(
         return None
     first = items[0][1]
     pkeys = list(first.partition_by)
-    if len(pkeys) == 0:
-        return None  # a global window spans shards — host fallback
+    # pkeys == [] is the GLOBAL window: run_device_windows routes every row
+    # to one shard (the same single-partition serialization every backend
+    # pays for a global OVER) and the segment machinery sees one segment
     # one physical sort serves every spec whose ORDER BY is a PREFIX of the
     # longest one (peer detection runs per spec on its own keys)
     order_items: List[Tuple[str, bool]] = []
@@ -196,26 +204,55 @@ def _plan_items(
             masked_arg = masked(arg)
             if not plain(arg) and not masked_arg:
                 return None
+            tag = _norm_frame(expr)
+            if tag is None:
+                return None
+            bounded = tag[0] in ("rows_bounded", "range_bounded")
             if func in ("FIRST", "LAST") and (
                 masked_arg or jdf.maybe_nan(arg)
             ):
                 return None  # positional semantics vs NULL ambiguity
             if (
-                func not in ("COUNT", "FIRST", "LAST")
+                not bounded
+                and func not in ("COUNT", "FIRST", "LAST")
                 and not masked_arg
                 and np.dtype(jdf.device_cols[arg].dtype)
                 != np.dtype(np.float64)
             ):
-                # non-float64 SUM/MIN/MAX/AVG: float64 accumulation would
-                # change the output type (host keeps long/float) and lose
-                # int precision past 2^53 — host fallback. Masked args are
-                # exempt: the host oracle itself holds them as float64.
+                # non-float64 SUM/MIN/MAX/AVG over running/whole/peer
+                # frames: float64 accumulation would change the output type
+                # (host keeps long/float) and lose int precision past 2^53
+                # — host fallback. Masked args are exempt, and so are
+                # bounded frames: the host evaluator itself computes those
+                # in float64 and coerces back to the declared type.
                 return None
-            tag = _norm_frame(expr)
-            if tag is None:
-                return None
+            if tag[0] == "range_bounded":
+                # value-offset bounds need ONE plain numeric NaN-free
+                # ORDER BY key (the host evaluator requires exactly one,
+                # and NULL keys make the searched ranges ill-defined)
+                if len(expr.order_by) != 1:
+                    return None
+                okey = expr.order_by[0][0]
+                kd = (
+                    np.dtype(jdf.device_cols[okey].dtype)
+                    if okey in jdf.device_cols
+                    else None
+                )
+                if (
+                    not plain(okey)
+                    or jdf.maybe_nan(okey)
+                    or kd is None
+                    or kd == np.dtype(np.bool_)
+                    or not np.issubdtype(kd, np.number)
+                ):
+                    return None
+                if not all(
+                    o is None or isinstance(o, (int, float))
+                    for o in tag[1:]
+                ):
+                    return None
             out_cast = None
-            if masked_arg and func in ("SUM", "MIN", "MAX"):
+            if (masked_arg or bounded) and func in ("SUM", "MIN", "MAX"):
                 # the host declares the ARG's type for these (long/bool);
                 # the device computes float64 — mark for conversion back
                 # (values ≤2^53 exact; the host passes through float64 too)
@@ -274,7 +311,12 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
     if not isinstance(jdf, JaxDataFrame) or jdf.host_table is not None:
         return None
     specs, pkeys, order_items = plan
-    jdf = engine.repartition(jdf, PartitionSpec(algo="hash", by=pkeys))
+    if len(pkeys) > 0:
+        jdf = engine.repartition(jdf, PartitionSpec(algo="hash", by=pkeys))
+    else:
+        # global window: one partition ⇒ one shard (the serialization any
+        # backend pays for a global OVER; other shards carry padding only)
+        jdf = engine._repartition_single(jdf)
     mesh = jdf.mesh
     cache = engine._jit_cache
     # null masks ride the sort as extra payload columns (mangled names) so
@@ -521,34 +563,126 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                         else:  # LAST: value at the frame end
                             outs[out_name] = sc[arg][at]
                         continue
-                    # bounded ROWS frames (SUM/COUNT/AVG only, gated);
-                    # a None offset is unbounded → the segment edge
+                    # bounded frames: per-row inclusive [lo, hi] indices,
+                    # then prefix-diff (SUM/COUNT/AVG) or sparse-table
+                    # range queries (MIN/MAX). A None offset is unbounded
+                    # → the segment edge.
                     lo_off, hi_off = tag[1], tag[2]
-                    lo = (
-                        seg_start
-                        if lo_off is None
-                        else jnp.maximum(seg_start, iota + lo_off)
-                    )
-                    hi = (
-                        seg_end
-                        if hi_off is None
-                        else jnp.minimum(seg_end, iota + hi_off)
-                    )
+                    if tag[0] == "rows_bounded":
+                        lo = (
+                            seg_start
+                            if lo_off is None
+                            else jnp.maximum(seg_start, iota + lo_off)
+                        )
+                        hi = (
+                            seg_end
+                            if hi_off is None
+                            else jnp.minimum(seg_end, iota + hi_off)
+                        )
+                    else:  # range_bounded: value distances on the order key
+                        okname, oasc = order_items[0]
+                        kv = sc[okname].astype(jnp.float64)
+                        if not oasc:
+                            kv = -kv  # ascending view (host: sign * okey)
+
+                        def bsearch(targets: Any, right: bool) -> Any:
+                            """Per-row binary search of ``targets`` within
+                            each row's own [seg_start, seg_end] span of the
+                            sorted ``kv`` — first index where kv >= target
+                            (or > target when ``right``)."""
+                            def step(_, lh):
+                                lo_, hi_ = lh
+                                ok = lo_ < hi_
+                                mid = (lo_ + hi_) // 2
+                                km = kv[jnp.clip(mid, 0, n_rows - 1)]
+                                go = (km <= targets) if right else (km < targets)
+                                return (
+                                    jnp.where(ok & go, mid + 1, lo_),
+                                    jnp.where(ok & jnp.logical_not(go), mid, hi_),
+                                )
+
+                            lo0, _ = jax.lax.fori_loop(
+                                0,
+                                max(1, int(n_rows).bit_length()),
+                                step,
+                                (seg_start, seg_end + 1),
+                            )
+                            return lo0
+
+                        lo = (
+                            seg_start
+                            if lo_off is None
+                            else bsearch(kv + float(lo_off), right=False)
+                        )
+                        hi = (
+                            seg_end
+                            if hi_off is None
+                            else bsearch(kv + float(hi_off), right=True) - 1
+                        )
                     empty = hi < lo
                     lo_c = jnp.clip(lo, 0, n_rows - 1)
                     hi_c = jnp.clip(hi, 0, n_rows - 1)
-                    s = c_abs[hi_c] - c_abs[lo_c] + xm[lo_c]
                     count = n_abs[hi_c] - n_abs[lo_c] + nn[lo_c].astype(jnp.float64)
                     count = jnp.where(empty, 0.0, count)
-                    s = jnp.where(empty, 0.0, s)
                     if func == "COUNT":
                         outs[out_name] = count.astype(jnp.int64)
-                    elif func == "SUM":
-                        outs[out_name] = jnp.where(count > 0, s, jnp.nan)
-                    else:  # AVG
-                        outs[out_name] = jnp.where(
-                            count > 0, s / jnp.where(count > 0, count, 1.0), jnp.nan
+                    elif func in ("SUM", "AVG"):
+                        s = c_abs[hi_c] - c_abs[lo_c] + xm[lo_c]
+                        s = jnp.where(empty, 0.0, s)
+                        if func == "SUM":
+                            outs[out_name] = jnp.where(count > 0, s, jnp.nan)
+                        else:
+                            outs[out_name] = jnp.where(
+                                count > 0,
+                                s / jnp.where(count > 0, count, 1.0),
+                                jnp.nan,
+                            )
+                    else:  # MIN/MAX: sparse table over NULL-filled values
+                        op = jnp.minimum if func == "MIN" else jnp.maximum
+                        fill = jnp.inf if func == "MIN" else -jnp.inf
+                        xs = jnp.where(nn, xf, fill)
+                        # levels cover the largest possible window length
+                        if (
+                            tag[0] == "rows_bounded"
+                            and lo_off is not None
+                            and hi_off is not None
+                        ):
+                            max_len = min(
+                                int(n_rows), max(1, hi_off - lo_off + 1)
+                            )
+                        else:
+                            max_len = int(n_rows)
+                        lv = max(1, (max_len - 1).bit_length())
+                        tables = [xs]
+                        for j in range(lv):
+                            stp = 1 << j
+                            prev = tables[-1]
+                            tables.append(
+                                op(
+                                    prev,
+                                    jnp.concatenate(
+                                        [
+                                            prev[stp:],
+                                            jnp.full((stp,), fill, prev.dtype),
+                                        ]
+                                    ),
+                                )
+                            )
+                        st = jnp.stack(tables)  # (lv+1, n_rows)
+                        ln = jnp.maximum(hi - lo + 1, 1)
+                        ks = (
+                            ln[:, None]
+                            >= jnp.left_shift(
+                                jnp.int32(1), jnp.arange(1, lv + 1, dtype=jnp.int32)
+                            )[None, :]
+                        ).sum(axis=1)
+                        second = jnp.clip(
+                            hi - jnp.left_shift(jnp.int32(1), ks) + 1,
+                            0,
+                            n_rows - 1,
                         )
+                        res = op(st[ks, lo_c], st[ks, second])
+                        outs[out_name] = jnp.where(count > 0, res, jnp.nan)
                 sc_out = dict(sc)
                 sc_out.update(outs)
                 sc_out["__wvalid__"] = sv

@@ -146,6 +146,24 @@ def _get_compiled_dest_rand(mesh: Any):
     return _COMPILE_CACHE[cache_key]
 
 
+def _get_compiled_dest_single(mesh: Any):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    cache_key = ("dest_single", mesh)
+    if cache_key not in _COMPILE_CACHE:
+        _COMPILE_CACHE[cache_key] = jax.jit(
+            jax.shard_map(
+                lambda template: jnp.zeros(template.shape, jnp.int32),
+                mesh=mesh,
+                in_specs=(P(ROW_AXIS),),
+                out_specs=P(ROW_AXIS),
+            )
+        )
+    return _COMPILE_CACHE[cache_key]
+
+
 def _get_compiled_counts(mesh: Any):
     """Destination-histogram summary → (max_count, total) as REPLICATED
     scalars: replication keeps the host read addressable from every process
@@ -378,6 +396,10 @@ def compute_dest(
         return _get_compiled_dest_hash(mesh, len(key_cols), dtypes)(*key_cols)
     if algo == "even":
         return _get_compiled_dest_even(mesh)(valid)
+    if algo == "single":
+        # every row to shard 0 — the one-partition layout behind global
+        # (no PARTITION BY) window evaluation
+        return _get_compiled_dest_single(mesh)(valid)
     if algo == "rand":
         if seed is None:
             seed = int(np_.random.default_rng().integers(0, 2**31 - 1))
