@@ -101,9 +101,12 @@ class JaxMapEngine(MapEngine):
                 jdf = engine.to_df(df)
                 keys = list(partition_spec.partition_by)
                 # encoded/masked columns have non-plain semantics the UDF
-                # can't see — host path renders them as real values
-                if isinstance(jdf, JaxDataFrame) and not jdf.has_encoded:
-                    if len(keys) == 0:
+                # can't see — host path renders them as real values. The
+                # ONE exception: dictionary-encoded PARTITION keys, whose
+                # codes the UDF only groups by and passes through opaquely
+                # (the engine reattaches the dictionary on output).
+                if isinstance(jdf, JaxDataFrame) and len(keys) == 0:
+                    if not jdf.has_encoded:
                         # the compiled path maps shards IN PLACE — an even/
                         # rand spec still needs its physical exchange first
                         # (the processor no longer repartitions for this
@@ -111,6 +114,19 @@ class JaxMapEngine(MapEngine):
                         if not partition_spec.empty:
                             jdf = engine.repartition(jdf, partition_spec)  # type: ignore[assignment]
                         return self._compiled_map(jdf, raw, output_schema, on_init)
+                elif isinstance(jdf, JaxDataFrame):
+                    dict_keys_only = len(jdf.null_masks) == 0 and all(
+                        e.get("kind") == "dict" and c in keys
+                        for c, e in jdf.encodings.items()
+                    )
+                    # an encoded key that appears in the output must keep
+                    # its declared type — the dictionary is reattached to
+                    # the (passed-through) codes
+                    enc_schema_ok = all(
+                        k not in output_schema
+                        or output_schema[k].type == jdf.schema[k].type
+                        for k in jdf.encodings
+                    )
                     nan_key = any(
                         np.issubdtype(
                             np.dtype(jdf.device_cols[k].dtype), np.floating
@@ -123,6 +139,10 @@ class JaxMapEngine(MapEngine):
                         all(k in jdf.device_cols for k in keys)
                         and not nan_key
                         and jdf.host_table is None
+                        and (
+                            not jdf.has_encoded
+                            or (dict_keys_only and enc_schema_ok)
+                        )
                     ):
                         return self._compiled_keyed_map(
                             jdf, raw, output_schema, partition_spec, on_init
@@ -134,9 +154,12 @@ class JaxMapEngine(MapEngine):
                     # opaque KeyError deep inside the user fn
                     raise FugueInvalidOperation(
                         "compiled keyed map unavailable for partition keys "
-                        f"{keys}: keys must be plain un-encoded device "
-                        "columns (no strings/nullable/maybe-NaN floats). "
-                        "Use a pandas-annotated transformer for these keys."
+                        f"{keys}: keys must be plain or dictionary-encoded "
+                        "device columns (no nullable ints/maybe-NaN "
+                        "floats), non-key columns must be un-encoded, and "
+                        "encoded keys must keep their type in the output "
+                        "schema. Use a pandas-annotated transformer for "
+                        "these shapes."
                     )
         # general path: host-side partitioned execution, result back on
         # device; CONCURRENCY reflects the mesh, not the host engine
@@ -287,6 +310,7 @@ class JaxMapEngine(MapEngine):
                 host_tbl=None,
                 row_count=jdf.count(),
                 valid_mask=new_valid,
+                encodings=self._keyed_out_encodings(jdf, keys, output_schema),
                 schema=output_schema,
             ),
         )
@@ -335,7 +359,13 @@ class JaxMapEngine(MapEngine):
         bounds: List[int] = []
         spans: List[int] = []
         for k in keys:
-            lo, hi = jdf.key_range(k)  # cached per frame (one probe ever)
+            enc = jdf.encodings.get(k)
+            if enc is not None:
+                # dict codes are bounded by construction: [-1, len) where
+                # -1 is the NULL code — static metadata, no device probe
+                lo, hi = -1, len(enc["dictionary"]) - 1
+            else:
+                lo, hi = jdf.key_range(k)  # cached per frame (one probe ever)
             if hi < lo:  # empty frame: degenerate single-bucket space
                 lo, hi = 0, 0
             bounds.append(lo)
@@ -421,9 +451,21 @@ class JaxMapEngine(MapEngine):
                 host_tbl=None,
                 row_count=jdf._row_count,
                 valid_mask=jdf.valid_mask,
+                encodings=self._keyed_out_encodings(jdf, keys, output_schema),
                 schema=output_schema,
             ),
         )
+
+    def _keyed_out_encodings(
+        self, jdf: JaxDataFrame, keys: List[str], output_schema: Schema
+    ) -> Dict[str, Any]:
+        """Dictionary encodings to reattach to encoded partition keys that
+        the UDF passed through (by contract) into the output."""
+        return {
+            k: dict(jdf.encodings[k])
+            for k in keys
+            if k in jdf.encodings and k in output_schema
+        }
 
     def _compiled_map(
         self,

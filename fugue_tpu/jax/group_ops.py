@@ -27,6 +27,11 @@ Example (demean per group)::
         return {"k": cols["k"], "v": cols["v"],
                 "d": cols["v"] - go.per_row(cols, mean)}
 
+String (dictionary-encoded) partition keys are admitted: the UDF sees
+their int32 CODES (-1 = NULL), which group exactly; treat them as opaque
+— pass them through to the output unchanged and the engine reattaches
+the dictionary. Interpreting code values inside the UDF is undefined.
+
 Reference parity: this is the device-native group-map path, replacing the
 reference's per-group pandas apply (``fugue_spark/execution_engine.py:192``).
 """

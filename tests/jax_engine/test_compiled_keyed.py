@@ -219,19 +219,143 @@ def test_keyed_compiled_min_sum_helpers(engine):
     assert got["lo"].tolist() == [2.0, 10.0]
 
 
-def test_keyed_compiled_falls_back_for_string_keys(engine):
+def _str_key_frame(n=6000, nulls=False, seed=11):
+    rng = np.random.default_rng(seed)
+    cities = np.array(["osaka", "lima", "oslo", "pune", "kiel", "bern"])
+    k = cities[rng.integers(0, len(cities), n)].astype(object)
+    if nulls:
+        k[rng.random(n) < 0.1] = None
+    return pd.DataFrame({"k": pd.Series(k, dtype="str"), "v": rng.random(n)})
+
+
+def _expected_demean(pdf):
+    exp = pdf.assign(d=pdf["v"] - pdf.groupby("k", dropna=False)["v"].transform("mean"))
+    return exp.sort_values(["k", "v"]).reset_index(drop=True)
+
+
+def test_keyed_compiled_string_keys_dense(engine):
+    """Dictionary-encoded partition keys run compiled: the UDF groups by
+    the codes (opaque, passed through) and the engine reattaches the
+    dictionary — dense plan (code range is static metadata, no probe)."""
     import jax
 
-    pdf = pd.DataFrame({"k": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]})
+    pdf = _str_key_frame()
     jdf = engine.to_df(pdf)
-    # string keys are dictionary-encoded -> compiled gate rejects; the host
-    # path can't feed a Dict[str, jax.Array] UDF, so a clear error beats
-    # silent mis-grouping
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return _demean(cols)
+
+    out = fa.transform(
+        jdf,
+        demean,
+        schema="k:str,v:double,d:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)  # stayed on device
+    assert out.encodings.get("k", {}).get("kind") == "dict"  # reattached
+    got = out.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, _expected_demean(pdf), check_dtype=False
+    )
+
+
+def test_keyed_compiled_string_keys_sorted_plan_and_nulls(engine):
+    """Presort forces the sorted plan; NULL string keys (-1 code) form
+    their own group, matching the oracle's dropna=False grouping."""
+    import jax
+
+    pdf = _str_key_frame(nulls=True, seed=17)
+    jdf = engine.to_df(pdf)
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return _demean(cols)
+
+    out = fa.transform(
+        jdf,
+        demean,
+        schema="k:str,v:double,d:double",
+        partition={"by": ["k"], "presort": "v"},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)
+    got = out.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, _expected_demean(pdf), check_dtype=False
+    )
+
+
+def test_keyed_compiled_mixed_string_int_keys(engine):
+    import jax
+
+    rng = np.random.default_rng(23)
+    n = 4000
+    pdf = pd.DataFrame(
+        {
+            "g": pd.Series(
+                np.array(["x", "y", "z"])[rng.integers(0, 3, n)], dtype="str"
+            ),
+            "k": rng.integers(0, 11, n),
+            "v": rng.random(n),
+        }
+    )
+    jdf = engine.to_df(pdf)
+
+    def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        m = go.mean(cols, cols["v"])
+        return {
+            "g": cols["g"],
+            "k": cols["k"],
+            "d": cols["v"] - go.per_row(cols, m),
+        }
+
+    out = fa.transform(
+        jdf,
+        demean,
+        schema="g:str,k:long,d:double",
+        partition={"by": ["g", "k"]},
+        engine=engine,
+        as_fugue=True,
+    )
+    assert isinstance(out, JaxDataFrame)
+    got = (
+        out.as_pandas()
+        .groupby(["g", "k"])["d"]
+        .mean()
+        .abs()
+        .max()
+    )
+    assert got < 1e-12
+
+
+def test_keyed_compiled_string_keys_bad_shapes_raise(engine):
+    import jax
+
+    pdf = pd.DataFrame(
+        {
+            "k": pd.Series(["a", "a", "b"], dtype="str"),
+            "s": pd.Series(["p", "q", "r"], dtype="str"),
+            "v": [1.0, 2.0, 3.0],
+        }
+    )
+    jdf = engine.to_df(pdf)
+
     def f(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:  # pragma: no cover
         return cols
 
+    # a non-key encoded column: the UDF would see meaningless codes
     with pytest.raises(Exception):
         fa.transform(
-            jdf, f, schema="k:str,v:double",
+            jdf, f, schema="k:str,s:str,v:double",
+            partition={"by": ["k"]}, engine=engine, as_fugue=True,
+        )
+    # encoded key changing type in the output schema: codes can't become
+    # longs — must raise, not silently emit code values
+    jdf2 = engine.to_df(pdf[["k", "v"]])
+    with pytest.raises(Exception):
+        fa.transform(
+            jdf2, f, schema="k:long,v:double",
             partition={"by": ["k"]}, engine=engine, as_fugue=True,
         )
