@@ -243,6 +243,8 @@ def _bounded_frame_agg(
         group_iter = [np.arange(len(ordered))]
     for gpos in group_iter:
         n = len(gpos)
+        if n == 0:  # empty frame (keys=None path): nothing to window
+            continue
         gv = vals[gpos]
         if kind == "rows":
             lo = (

@@ -109,7 +109,9 @@ class ArrowDataFrame(LocalBoundedDataFrame):
         return self._native
 
     def as_pandas(self) -> pd.DataFrame:
-        return self._native.to_pandas(use_threads=False)
+        from .._utils.arrow import pa_table_to_pandas
+
+        return pa_table_to_pandas(self._native)
 
     def _drop_cols(self, cols: List[str]) -> DataFrame:
         keep = [n for n in self.schema.names if n not in cols]

@@ -35,7 +35,9 @@ def _enforce_type(pdf: pd.DataFrame, schema: Schema) -> pd.DataFrame:
         preserve_index=False,
         safe=False,
     )
-    return tbl.to_pandas(use_threads=False)
+    from .._utils.arrow import pa_table_to_pandas
+
+    return pa_table_to_pandas(tbl)
 
 
 class PandasDataFrame(LocalBoundedDataFrame):
@@ -79,7 +81,9 @@ class PandasDataFrame(LocalBoundedDataFrame):
                 tbl = pa.Table.from_pylist(
                     [dict(zip(s.names, row)) for row in data], schema=s.pa_schema
                 )
-                pdf = tbl.to_pandas(use_threads=False)
+                from .._utils.arrow import pa_table_to_pandas
+
+                pdf = pa_table_to_pandas(tbl)
         else:
             raise FugueDataFrameInitError(f"can't build PandasDataFrame from {type(df)}")
         if not pandas_df_wrapper:

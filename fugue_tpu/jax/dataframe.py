@@ -480,7 +480,9 @@ class JaxDataFrame(DataFrame):
         return pa.Table.from_arrays(arrays, schema=self.schema.pa_schema)
 
     def as_pandas_local(self) -> pd.DataFrame:
-        return self.as_arrow_local().to_pandas(use_threads=False)
+        from .._utils.arrow import pa_table_to_pandas
+
+        return pa_table_to_pandas(self.as_arrow_local())
 
     def as_local_bounded(self) -> LocalBoundedDataFrame:
         res = ArrowDataFrame(self.as_arrow())
@@ -489,7 +491,9 @@ class JaxDataFrame(DataFrame):
         return res
 
     def as_pandas(self) -> pd.DataFrame:
-        return self.as_arrow().to_pandas(use_threads=False)
+        from .._utils.arrow import pa_table_to_pandas
+
+        return pa_table_to_pandas(self.as_arrow())
 
     def peek_array(self) -> List[Any]:
         self.assert_not_empty()

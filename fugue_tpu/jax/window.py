@@ -252,17 +252,25 @@ def _plan_items(
                 ):
                     return None
             out_cast = None
-            if (masked_arg or bounded) and func in ("SUM", "MIN", "MAX"):
-                # the host declares the ARG's type for these (long/bool);
-                # the device computes float64 — mark for conversion back
-                # (values ≤2^53 exact; the host passes through float64 too)
+            if (masked_arg or bounded) and func in (
+                "SUM",
+                "MIN",
+                "MAX",
+                "AVG",
+            ):
+                # the host declares the ARG's type for these (int/long/
+                # float/bool); the device computes float64 — mark for
+                # conversion back to the EXACT declared dtype (values
+                # ≤2^53 exact; the host passes through float64 too)
                 import pyarrow as _pa
 
                 tp = expr.infer_type(jdf.schema)
-                if tp is not None and _pa.types.is_integer(tp):
-                    out_cast = "int64"
-                elif tp is not None and _pa.types.is_boolean(tp):
-                    out_cast = "bool"
+                if tp is not None and (
+                    _pa.types.is_integer(tp)
+                    or _pa.types.is_boolean(tp)
+                    or tp == _pa.float32()
+                ):
+                    out_cast = np.dtype(tp.to_pandas_dtype()).name
             specs.append((out_name, func, arg, tag, n_ord, out_cast))
             continue
         return None
@@ -704,11 +712,10 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
     out_masks = {
         c_: out.pop(f"{mask_prefix}{c_}") for c_ in jdf.null_masks
     }
-    dtype_to_pa = {
-        "int64": "long",
-        "float64": "double",
-        "bool": "bool",
-        "int32": "int",
+    _PA_NAMES = {
+        "int8", "int16", "int32", "int64",
+        "uint8", "uint16", "uint32", "uint64",
+        "float32", "float64", "bool",
     }
     import pyarrow as pa
 
@@ -717,29 +724,37 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
         arr = out[spec[0]]
         out_cast = spec[5] if len(spec) >= 6 else None
         if out_cast is not None:
-            # masked-arg SUM/MIN/MAX computed in float64 with NaN=NULL —
-            # restore the declared integer/bool type + a null mask, exactly
-            # like the host's own float64 round trip
+            # masked-arg/bounded-frame aggregates computed in float64 with
+            # NaN=NULL — restore the exact declared dtype, like the host's
+            # own float64 round trip. float32 keeps NaN as its NULL; the
+            # integer/bool dtypes need a null mask.
             import jax as _jax
             import jax.numpy as _jnp
 
             ck = ("wcast", out_cast, mesh)
             if ck not in cache:
-
-                def _conv(a: Any, _t: str = out_cast):
-                    m = _jnp.isnan(a)
-                    vals = _jnp.where(m, 0.0, a).astype(
-                        _jnp.int64 if _t == "int64" else _jnp.bool_
+                if out_cast == "float32":
+                    cache[ck] = _jax.jit(
+                        lambda a: a.astype(_jnp.float32)
                     )
-                    return vals, m
+                else:
 
-                cache[ck] = _jax.jit(_conv)
-            vals, m = cache[ck](arr)
-            out[spec[0]] = vals
-            out_masks[spec[0]] = m
-            arr = vals
-        tname = dtype_to_pa.get(str(arr.dtype))
-        if tname is None:
+                    def _conv(a: Any, _t: str = out_cast):
+                        m = _jnp.isnan(a)
+                        vals = _jnp.where(m, 0.0, a).astype(_jnp.dtype(_t))
+                        return vals, m
+
+                    cache[ck] = _jax.jit(_conv)
+            if out_cast == "float32":
+                arr = cache[ck](arr)
+                out[spec[0]] = arr
+            else:
+                vals, m = cache[ck](arr)
+                out[spec[0]] = vals
+                out_masks[spec[0]] = m
+                arr = vals
+        tname = str(arr.dtype)
+        if tname not in _PA_NAMES:
             return None  # unexpected dtype — let the host path handle it
         extra_fields.append(pa.field(spec[0], Schema(f"x:{tname}").types[0]))
     work_schema = Schema(list(jdf.schema.fields) + extra_fields)

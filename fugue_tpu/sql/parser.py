@@ -968,6 +968,13 @@ class SQLParser:
                     "UNBOUNDED FOLLOWING and the end bound cannot be "
                     "UNBOUNDED PRECEDING"
                 )
+            if kind == "rows" and any(
+                isinstance(b, tuple) and not isinstance(b[1], int)
+                for b in (start, end)
+            ):
+                raise FugueSQLSyntaxError(
+                    "ROWS frame offsets must be integers"
+                )
             frame = (kind, start, end)
         self.expect_punct(")")
         return _WindowExpr(func, args, partition_by, order_by, frame=frame)
@@ -984,7 +991,10 @@ class SQLParser:
         t = self.next()
         if t.kind != "NUMBER":
             raise FugueSQLSyntaxError(f"invalid frame bound {t.value!r}")
-        n = int(float(t.value))
+        # RANGE offsets are value distances and may be fractional; keep the
+        # exact number (ROWS validates integrality where the frame is built)
+        v = float(t.value)
+        n: Any = int(v) if v.is_integer() else v
         if self.eat_kw("PRECEDING"):
             return ("prec", n)
         self.expect_kw("FOLLOWING")

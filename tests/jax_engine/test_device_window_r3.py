@@ -324,6 +324,77 @@ def test_zero_offset_range_peer_frames(engine, oracle):
     )
 
 
+def test_fractional_range_offsets_are_exact(engine, oracle):
+    """Regression: the parser truncated frame bounds to int, so RANGE
+    BETWEEN 2.5 PRECEDING silently became 2 PRECEDING on BOTH engines
+    (parity tests couldn't see it). Verified against a hand value."""
+    df = pd.DataFrame({"o": [0.0, 2.4, 2.6], "v": [1.0, 10.0, 100.0]})
+    sql = """
+    SELECT o, v, SUM(v) OVER (ORDER BY o
+        RANGE BETWEEN 2.5 PRECEDING AND CURRENT ROW) AS s FROM df
+    """
+    got = _run_both(sql, df, engine, oracle)
+    # 2.4-2.5 <= 0.0 → 0.0 included; 2.6-2.5 > 0.0 → excluded (the old
+    # truncation to "2 PRECEDING" included it: s was 111.0)
+    exp = {0.0: 1.0, 2.4: 11.0, 2.6: 110.0}
+    assert {o: s for o, s in zip(got["o"], got["s"])} == exp
+
+
+def test_rows_fractional_offsets_raise(engine):
+    from fugue_tpu.exceptions import FugueSQLSyntaxError
+
+    with pytest.raises(FugueSQLSyntaxError):
+        fa.fugue_sql(
+            "SELECT o, SUM(v) OVER (ORDER BY o ROWS BETWEEN 1.5 PRECEDING "
+            "AND CURRENT ROW) AS s FROM df YIELD LOCAL DATAFRAME AS r",
+            df=pd.DataFrame({"o": [1.0], "v": [1.0]}),
+            engine=engine,
+        )
+
+
+def test_bounded_int32_arg_keeps_declared_type(engine, oracle):
+    """SUM over an int32 column in a bounded frame must come back as int32
+    on BOTH engines (the device used to widen to long)."""
+    from fugue_tpu.dataframe import PandasDataFrame
+
+    df = pd.DataFrame(
+        {"k": [1, 1, 2, 2], "o": [1, 2, 1, 2], "iv": [5, 6, 7, 8]}
+    )
+    fdf = PandasDataFrame(df, "k:long,o:long,iv:int")
+    sql = """
+    SELECT k, o, SUM(iv) OVER (PARTITION BY k ORDER BY o
+        ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM df
+    """
+    import fugue_tpu.column.window as w
+
+    def boom(*a, **k):  # pragma: no cover
+        raise AssertionError("host window evaluator used on the jax engine")
+
+    with mock.patch.object(w, "eval_window", boom):
+        got = fa.fugue_sql(sql, df=fdf, engine=engine, as_local=True, as_fugue=True)
+    exp = fa.fugue_sql(sql, df=fdf, engine=oracle, as_local=True, as_fugue=True)
+    assert str(got.schema["s"].type) == str(exp.schema["s"].type) == "int32"
+    g = _pd(got.as_pandas()).sort_values(["k", "o"]).reset_index(drop=True)
+    x = _pd(exp.as_pandas()).sort_values(["k", "o"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, x, check_dtype=False)
+
+
+def test_zero_offset_range_on_empty_frame(oracle):
+    """Regression: the host peer branch indexed changed[0] on a 0-row
+    frame."""
+    df = pd.DataFrame({"o": pd.Series([], dtype="float64"),
+                       "v": pd.Series([], dtype="float64")})
+    res = fa.fugue_sql(
+        "SELECT o, SUM(v) OVER (ORDER BY o RANGE BETWEEN CURRENT ROW AND "
+        "CURRENT ROW) AS s FROM df YIELD LOCAL DATAFRAME AS r",
+        df=df,
+        engine=oracle,
+        as_local=True,
+    )
+    res = _pd(res)
+    assert len(res) == 0
+
+
 def test_host_fallback_still_covers_nan_order_keys(engine, oracle, data):
     # RANGE offsets over a maybe-NaN order key must DECLINE to the host
     # path (no poison: we assert the fallback, not the plan)

@@ -142,3 +142,48 @@ def test_uint64_null_falls_back_to_host(engine):
     ).as_pandas()
     assert int(got["hi"].iloc[0]) == 2**63 + 9
     assert int(got["c"].iloc[0]) == 2
+
+
+def test_oracle_now_matches_device_exactness(engine):
+    """Round-3 fidelity closure: the host oracle used to ingest nullable
+    int64 as float64 (lossy past 2^53); with arrow-backed Int64 ingestion
+    (``_utils/arrow.py``) the oracle's SUM/MIN/MAX are exact at 2^62 and
+    AGREE with the device hi/lo-split path, NULLs included."""
+    from fugue_tpu.execution import NativeExecutionEngine
+
+    rng = np.random.default_rng(3)
+    n = 2000
+    base = np.int64(2**62)
+    vals = base + rng.integers(-1000, 1000, n).astype(np.int64)
+    mask = rng.random(n) < 0.2
+    v = pd.array(np.where(mask, None, vals), dtype="Int64")
+    pdf = pd.DataFrame({"k": rng.integers(0, 7, n), "v": v})
+    fdf = PandasDataFrame(pdf, "k:long,v:long")
+
+    oracle = NativeExecutionEngine()
+    try:
+        host_in = oracle.to_df(fdf).as_pandas()
+        # ingestion no longer widens to float64
+        assert str(host_in["v"].dtype) == "Int64", host_in["v"].dtype
+        spec = PartitionSpec(by=["k"])
+        exp = (
+            oracle.aggregate(oracle.to_df(fdf), spec, _aggs())
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        got = (
+            engine.aggregate(engine.to_df(fdf), spec, _aggs())
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        # SUM/MIN/MAX/COUNT exact equality (not allclose) at 2^62 scale
+        for c in ("s", "lo", "hi", "c"):
+            assert got[c].tolist() == exp[c].tolist(), c
+        truth = pdf.dropna(subset=["v"]).groupby("k")["v"].sum()
+        assert exp.set_index("k")["s"].astype("int64").to_dict() == {
+            k: int(x) for k, x in truth.items()
+        }
+    finally:
+        oracle.stop()
