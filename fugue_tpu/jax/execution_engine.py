@@ -1723,8 +1723,11 @@ class JaxExecutionEngine(ExecutionEngine):
         capacity is negotiated with an allgather of the local counts so all
         processes agree on ONE padded global shape, then the device array is
         built from process-local data — no host ever sees another host's
-        rows. String/dictionary outputs would need a cross-process
-        dictionary union; they raise until that lands.
+        rows. String columns get a cross-process dictionary union: local
+        dictionaries allgather (arrow IPC over padded byte buffers), every
+        process derives the SAME sorted union dictionary, and local codes
+        remap into it. Datetime encodings are schema-derived and identical
+        everywhere, so they pass straight through.
         """
         import jax
         from jax.experimental import multihost_utils
@@ -1733,13 +1736,34 @@ class JaxExecutionEngine(ExecutionEngine):
 
         np_cols, host_tbl, meta = encode_arrow_for_device(tbl, encode=True)
         assert_or_throw(
-            host_tbl is None and len(meta["encodings"]) == 0,
+            host_tbl is None,
             FugueInvalidOperation(
-                "multi-host comap outputs support plain numeric/bool/"
-                "datetime-free columns only (string outputs need a cross-"
-                "process dictionary union)"
+                "multi-host comap outputs support device-representable "
+                "columns only (numeric/bool/string/datetime — no binary/"
+                "nested)"
             ),
         )
+        dict_encs = {
+            n: e for n, e in meta["encodings"].items() if e.get("kind") == "dict"
+        }  # datetime encodings are process-independent and pass through
+        if len(dict_encs) > 0:
+            unions = _allgather_dictionaries(
+                {n: e["dictionary"] for n, e in dict_encs.items()}
+            )
+            for name, enc in dict_encs.items():
+                gdict = unions[name].cast(enc["type"])
+                # remap local codes into the union's (sorted) code space
+                to_global = _dict_mapping(enc["dictionary"], gdict)
+                codes = np_cols[name]
+                np_cols[name] = np.where(
+                    codes >= 0, to_global[np.clip(codes, 0, None)], -1
+                ).astype(np.int32)
+                meta["encodings"][name] = {
+                    "kind": "dict",
+                    "dictionary": gdict,
+                    "type": enc["type"],
+                    "sorted": True,
+                }
         local_n = tbl.num_rows
         counts = np.asarray(
             multihost_utils.process_allgather(np.asarray([local_n]))
@@ -1803,7 +1827,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 # processes (different plan gating → collective deadlock);
                 # None = conservatively maybe-NaN everywhere, identically
                 nan_cols=None,
-                encodings={},
+                # dict encodings hold the UNION dictionary (identical on
+                # every process); datetime encodings are schema-derived
+                encodings=meta["encodings"],
                 null_masks=null_masks,
                 schema=Schema(tbl.schema),
             ),
@@ -1853,15 +1879,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 if enc1["kind"] == "datetime":
                     encodings[c] = enc1
                     continue
-                union_dict = pa.concat_arrays(
+                union_dict = _sorted_union_dictionary(
                     [enc1["dictionary"], enc2["dictionary"]]
-                ).unique()
-                order = np.asarray(
-                    pa.compute.sort_indices(union_dict).to_numpy(
-                        zero_copy_only=False
-                    )
                 )
-                union_dict = union_dict.take(pa.array(order))
                 ck = ("zipremap", mesh)
                 if ck not in self._jit_cache:
                     self._jit_cache[ck] = jax.jit(
@@ -1872,15 +1892,9 @@ class JaxExecutionEngine(ExecutionEngine):
                         )
                     )
                 for cols, enc in ((cols1, enc1), (cols2, enc2)):
-                    mapped = np.asarray(
-                        pa.compute.index_in(
-                            enc["dictionary"], value_set=union_dict
-                        ).to_numpy(zero_copy_only=False)
-                    )
-                    if mapped.size == 0:
-                        mapped = np.asarray([-1])
+                    mapped = _dict_mapping(enc["dictionary"], union_dict)
                     cols[c] = self._jit_cache[ck](
-                        cols[c], jnp.asarray(mapped.astype(np.int32))
+                        cols[c], jnp.asarray(mapped)
                     )
                 encodings[c] = {
                     "kind": "dict",
@@ -2800,6 +2814,94 @@ class JaxExecutionEngine(ExecutionEngine):
             out[spec["name"]] = spec["fn"](merged)
         out_schema = plan["schema"]
         return self.to_df(PandasDataFrame(out, out_schema))
+
+
+def _sorted_union_dictionary(pieces: "List[pa.Array]") -> pa.Array:
+    """Distinct sorted union of dictionary arrays — THE canonical way a
+    union dictionary is built everywhere (device union, multi-host comap
+    reassembly), so code order == lexicographic order stays true."""
+    u = pa.concat_arrays(pieces).unique().drop_null()
+    order = pa.compute.sort_indices(u)
+    return u.take(order)
+
+
+def _dict_mapping(local_dict: pa.Array, union_dict: pa.Array) -> np.ndarray:
+    """Index table from local dictionary positions to union positions.
+
+    Apply as ``code >= 0 ? table[code] : -1`` (−1 is the NULL code). An
+    empty local dictionary yields a single ``-1`` placeholder so device
+    gathers stay in-bounds."""
+    mapped = np.asarray(
+        pa.compute.index_in(local_dict, value_set=union_dict).to_numpy(
+            zero_copy_only=False
+        )
+    )
+    if mapped.size == 0:
+        mapped = np.asarray([-1])
+    return mapped.astype(np.int32)
+
+
+def _allgather_dictionaries(
+    dicts: "Dict[str, pa.Array]",
+) -> "Dict[str, pa.Array]":
+    """Union string dictionaries across every process of the multi-host
+    runtime, deterministically, in ONE exchange for all columns.
+
+    All local dictionaries pack into a single tagged arrow table,
+    serialize to an IPC buffer, and allgather exactly twice (lengths, then
+    buffers padded to the global max so shapes agree) regardless of how
+    many string columns the frame has. Every process deserializes all
+    buffers and computes the IDENTICAL sorted distinct union per column —
+    which is what makes the union dictionaries safe to store in frame
+    metadata (divergent metadata would desynchronize later jitted
+    programs into collective deadlock).
+    """
+    from jax.experimental import multihost_utils
+
+    names = sorted(dicts)
+    tags = np.concatenate(
+        [np.full(len(dicts[n]), i, dtype=np.int32) for i, n in enumerate(names)]
+    ) if len(names) > 0 else np.zeros(0, dtype=np.int32)
+    vals = (
+        pa.concat_arrays([dicts[n].cast(pa.large_string()) for n in names])
+        if len(names) > 0
+        else pa.array([], type=pa.large_string())
+    )
+    t = pa.table({"tag": pa.array(tags, pa.int32()), "val": vals})
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    buf = np.frombuffer(sink.getvalue(), dtype=np.uint8)
+    lens = np.asarray(
+        multihost_utils.process_allgather(np.asarray([len(buf)]))
+    ).reshape(-1)
+    mx = int(lens.max())
+    padded = np.zeros(mx, dtype=np.uint8)
+    padded[: len(buf)] = buf
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded)
+    ).reshape(len(lens), mx)
+    tag_pieces: List[Any] = []
+    val_pieces: List[pa.Array] = []
+    for i in range(len(lens)):
+        rd = pa.ipc.open_stream(
+            pa.py_buffer(gathered[i, : int(lens[i])].tobytes())
+        ).read_all()
+        tag_pieces.append(
+            np.asarray(rd["tag"].to_numpy(zero_copy_only=False))
+        )
+        val_pieces.append(rd["val"].combine_chunks())
+    all_tags = np.concatenate(tag_pieces) if tag_pieces else np.zeros(0, np.int32)
+    all_vals = (
+        pa.concat_arrays([p.cast(pa.large_string()) for p in val_pieces])
+        if val_pieces
+        else pa.array([], type=pa.large_string())
+    )
+    out: Dict[str, pa.Array] = {}
+    for i, n in enumerate(names):
+        sel = all_vals.take(pa.array(np.nonzero(all_tags == i)[0]))
+        out[n] = _sorted_union_dictionary([sel])
+    return out
 
 
 def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:

@@ -226,6 +226,26 @@ def _plan_items(
                 # bounded frames: the host evaluator itself computes those
                 # in float64 and coerces back to the declared type.
                 return None
+            exact64 = False
+            if (
+                not bounded
+                and masked_arg
+                and func not in ("COUNT", "FIRST", "LAST")
+                and np.dtype(jdf.device_cols[arg].dtype).itemsize >= 8
+            ):
+                # masked 64-bit ints on running/whole/peer frames: the host
+                # computes these EXACTLY over extension dtypes
+                # (_utils/arrow.py), so the float64 round trip (lossy past
+                # 2^53) is not enough. int64 gets the exact device path
+                # (hi/lo split sums, int-domain MIN/MAX — mirroring
+                # ops/segment.py); uint64 falls back to the host. Bounded
+                # frames stay on float64: the host itself computes those
+                # in float64.
+                if np.dtype(jdf.device_cols[arg].dtype) != np.dtype(
+                    np.int64
+                ):
+                    return None
+                exact64 = True
             if tag[0] == "range_bounded":
                 # value-offset bounds need ONE plain numeric NaN-free
                 # ORDER BY key (the host evaluator requires exactly one,
@@ -252,6 +272,9 @@ def _plan_items(
                 ):
                     return None
             out_cast = None
+            if exact64:
+                specs.append((out_name, func, arg, tag, n_ord, "int64_exact"))
+                continue
             if (masked_arg or bounded) and func in (
                 "SUM",
                 "MIN",
@@ -325,6 +348,16 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
         # global window: one partition ⇒ one shard (the serialization any
         # backend pays for a global OVER; other shards carry padding only)
         jdf = engine._repartition_single(jdf)
+    if any(len(s) >= 6 and s[5] == "int64_exact" for s in specs):
+        # the hi/lo split's float64 prefix sums are exact only while a
+        # shard's low-word sum stays under 2^53: rows/shard < 2^21.
+        # Checked AFTER the repartition — the exchange (hash skew, or the
+        # global single-shard route) is what sets the real shard length.
+        from ..parallel.mesh import num_row_shards
+
+        padded = next(iter(jdf.device_cols.values())).shape[0]
+        if padded // max(1, num_row_shards(jdf.mesh)) > (1 << 21):
+            return None
     mesh = jdf.mesh
     cache = engine._jit_cache
     # null masks ride the sort as extra payload columns (mangled names) so
@@ -527,6 +560,66 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                         continue
                     # aggregates
                     _, _, arg, tag, n_ord = spec[:5]
+                    oc = spec[5] if len(spec) >= 6 else None
+                    if oc == "int64_exact":
+                        # masked int64 over running/peers/whole frames:
+                        # EXACT semantics mirroring ops/segment.py — hi/lo
+                        # 32-bit split sums (each side's float64 prefix sum
+                        # stays exact for shards < 2^21 rows, guarded at
+                        # plan-run time), recombined in wrapping int64
+                        # arithmetic like the pandas oracle's cumsum;
+                        # MIN/MAX scan the raw int domain.
+                        x = sc[arg]
+                        nnm = sv & jnp.logical_not(
+                            sc[f"{mask_prefix}{arg}"]
+                        )
+                        nn64 = nnm.astype(jnp.float64)
+
+                        def rel_prefix(cvals: Any) -> Any:
+                            cc = jnp.cumsum(cvals)
+                            return cc - (cc[seg_start] - cvals[seg_start])
+
+                        at = (
+                            iota
+                            if tag[0] == "running"
+                            else (
+                                peer_end_by[n_ord]
+                                if tag[0] == "peers"
+                                else seg_end
+                            )
+                        )
+                        count = rel_prefix(nn64)[at]
+                        if func in ("SUM", "AVG"):
+                            xm64 = jnp.where(nnm, x, jnp.int64(0))
+                            lo32 = (
+                                xm64 & jnp.int64(0xFFFFFFFF)
+                            ).astype(jnp.float64)
+                            hi32 = (xm64 >> 32).astype(jnp.float64)
+                            s_int = (
+                                rel_prefix(hi32)[at].astype(jnp.int64) << 32
+                            ) + rel_prefix(lo32)[at].astype(jnp.int64)
+                            if func == "SUM":
+                                outs[out_name] = s_int
+                                outs[f"{mask_prefix}{out_name}"] = count == 0
+                            else:  # AVG: exact int sum → one f64 rounding
+                                outs[out_name] = jnp.where(
+                                    count > 0,
+                                    s_int.astype(jnp.float64)
+                                    / jnp.where(count > 0, count, 1.0),
+                                    jnp.nan,
+                                )
+                            continue
+                        # MIN/MAX in the int domain
+                        op = jnp.minimum if func == "MIN" else jnp.maximum
+                        fillv = (
+                            jnp.iinfo(jnp.int64).max
+                            if func == "MIN"
+                            else jnp.iinfo(jnp.int64).min
+                        )
+                        xs64 = jnp.where(nnm, x, jnp.int64(fillv))
+                        outs[out_name] = seg_scan(op, xs64)[at]
+                        outs[f"{mask_prefix}{out_name}"] = count == 0
+                        continue
                     xf, nn, xm, c_rel, n_rel, c_abs, n_abs = prefix_tables(arg)
                     if tag[0] == "whole":
                         total = c_rel[seg_end]
@@ -723,6 +816,11 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
     for spec in specs:
         arr = out[spec[0]]
         out_cast = spec[5] if len(spec) >= 6 else None
+        if out_cast == "int64_exact":
+            # the kernel emitted the final dtype + a null marker directly
+            if spec[1] in ("SUM", "MIN", "MAX"):
+                out_masks[spec[0]] = out.pop(f"{mask_prefix}{spec[0]}")
+            out_cast = None
         if out_cast is not None:
             # masked-arg/bounded-frame aggregates computed in float64 with
             # NaN=NULL — restore the exact declared dtype, like the host's

@@ -117,12 +117,14 @@ def merge(cursor, dfs):
     d1, d2 = dfs[0].as_pandas(), dfs[1].as_pandas()
     k = int(d1["k"].iloc[0]) if len(d1) else int(d2["k"].iloc[0])
     executed.append(k)
+    # string output: exercises the cross-process dictionary union
     return PandasDataFrame(
-        pd.DataFrame({{"k": [k], "sv": [d1["v"].sum()], "sw": [d2["w"].sum()]}}),
-        "k:long,sv:double,sw:double",
+        pd.DataFrame({{"k": [k], "label": [f"g{{k:02d}}"],
+                       "sv": [d1["v"].sum()], "sw": [d2["w"].sum()]}}),
+        "k:long,label:str,sv:double,sw:double",
     )
 
-res = e.comap(z, merge, "k:long,sv:double,sw:double")
+res = e.comap(z, merge, "k:long,label:str,sv:double,sw:double")
 # per-host execution proof: this process only ran its LOCAL shards' keys
 from jax.experimental import multihost_utils
 mine = np.zeros(12, dtype=np.int64); mine[executed] = 1
@@ -136,9 +138,24 @@ assert set(np.nonzero(both.sum(axis=0))[0].tolist()) == inner
 local = res.as_pandas_local()
 for _, row in local.iterrows():
     k = int(row["k"])
+    assert row["label"] == f"g{{k:02d}}", row["label"]
     assert np.isclose(row["sv"], a[a["k"] == k]["v"].sum()), k
     assert np.isclose(row["sw"], b[b["k"] == k]["w"].sum()), k
 assert res.count() == len(inner)
+# the union dictionary must be IDENTICAL on every process (divergent
+# metadata desynchronizes later jitted programs)
+enc = res.encodings.get("label")
+assert enc is not None and enc["kind"] == "dict", enc
+import hashlib
+h = hashlib.sha1("|".join(enc["dictionary"].to_pylist()).encode()).digest()[:8]
+hv = np.frombuffer(h, dtype=np.int64)
+hs = np.asarray(multihost_utils.process_allgather(hv)).reshape(-1)
+assert (hs == hs[0]).all(), hs
+# and the global frame must decode everywhere: a device filter on the
+# string column still works after reassembly
+from fugue_tpu.column import col
+flt = e.filter(res, col("label") == "g05")
+assert flt.count() == (1 if 5 in inner else 0)
 print("MHC_OK", pid, len(executed), flush=True)
 """
 

@@ -411,3 +411,73 @@ def test_host_fallback_still_covers_nan_order_keys(engine, oracle, data):
         oracle,
         poison=False,
     )
+
+
+def test_masked_int64_running_windows_exact_at_2pow62(engine, oracle):
+    """The host now computes masked-int64 running/whole/peer window
+    aggregates exactly (Int64 extension ingestion); the device matches via
+    hi/lo split sums and int-domain MIN/MAX — EXACT equality at 2^62,
+    device plan proven used."""
+    rng = np.random.default_rng(53)
+    n = 300
+    base = np.int64(2**62)
+    vals = base + rng.integers(-1000, 1000, n).astype(np.int64)
+    m = pd.array(
+        np.where(rng.random(n) < 0.2, None, vals), dtype="Int64"
+    )
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 4, n),
+            "o": rng.permutation(n).astype("int64"),
+            "ot": rng.integers(0, 8, n),  # ties → peers frames
+            "m": m,
+        }
+    )
+    got = _run_both(
+        """
+        SELECT k, o, m,
+          SUM(m) OVER (PARTITION BY k ORDER BY o
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rs,
+          MIN(m) OVER (PARTITION BY k ORDER BY o
+                       ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rmin,
+          MAX(m) OVER (PARTITION BY k) AS wmax,
+          AVG(m) OVER (PARTITION BY k ORDER BY o) AS ra
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
+    # spot exactness against int arithmetic (not float): final running sum
+    # of each partition == the exact python-int sum of its non-null values
+    import numpy as _np
+
+    for k in sorted(df["k"].unique()):
+        sub = df[df["k"] == k]
+        exact = int(
+            _np.sum([int(x) for x in sub["m"].dropna()], dtype=object)
+        )
+        wrapped = (exact + 2**63) % 2**64 - 2**63  # int64 wrap like cumsum
+        tail = got[got["k"] == k].sort_values("o")["rs"].iloc[-1]
+        assert int(tail) == wrapped, (k, int(tail), wrapped)
+
+
+def test_masked_int64_peers_frame_exact(engine, oracle):
+    rng = np.random.default_rng(59)
+    n = 200
+    vals = np.int64(2**62) + rng.integers(-500, 500, n).astype(np.int64)
+    m = pd.array(np.where(rng.random(n) < 0.15, None, vals), dtype="Int64")
+    df = pd.DataFrame(
+        {"k": rng.integers(0, 3, n), "o": rng.integers(0, 10, n), "m": m}
+    )
+    _run_both(
+        """
+        SELECT k, o, m,
+          SUM(m) OVER (PARTITION BY k ORDER BY o
+                       RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS ps
+        FROM df
+        """,
+        df,
+        engine,
+        oracle,
+    )
