@@ -316,15 +316,22 @@ class JaxDataFrame(DataFrame):
 
     def device_valid_mask(self) -> Any:
         """A device bool array marking valid rows (built from the row count
-        when no explicit mask exists)."""
+        when no explicit mask exists). Memoized — frames are immutable, and
+        on a remote-chip tunnel every extra program dispatch has real
+        latency, so repeated ops over one frame must not re-run it."""
         if self._valid_mask is not None:
             return self._valid_mask
+        cached = getattr(self, "_tail_mask_cache", None)
+        if cached is not None:
+            return cached
         import numpy as _np
 
         from ..ops.segment import _get_compiled_mask
 
         template = next(iter(self._device_cols.values()))
-        return _get_compiled_mask(self._mesh)(template, _np.int64(self._row_count))
+        mask = _get_compiled_mask(self._mesh)(template, _np.int64(self._row_count))
+        self._tail_mask_cache = mask
+        return mask
 
     def key_range(self, name: str) -> "Tuple[int, int]":
         """Cached ``(min, max)`` of integer device column ``name`` over
@@ -339,22 +346,59 @@ class JaxDataFrame(DataFrame):
         if cache is None:
             cache = self._key_range_cache = {}
         if name not in cache:
-            import jax
-            import numpy as _np
+            host_range = self._host_key_range(name)
+            if host_range is not None:
+                cache[name] = host_range
+            else:
+                import jax
+                import numpy as _np
 
-            from ..ops.segment import _get_compiled_minmax
+                from ..ops.segment import _get_compiled_minmax
 
-            lo_a, hi_a = _get_compiled_minmax(self._mesh)(
-                self._device_cols[name], self.device_valid_mask()
-            )
-            # overlap the two fetches: one tunnel roundtrip, not two
-            lo_a.copy_to_host_async()
-            hi_a.copy_to_host_async()
-            cache[name] = (
-                int(_np.asarray(jax.device_get(lo_a))[0]),
-                int(_np.asarray(jax.device_get(hi_a))[0]),
-            )
+                lo_a, hi_a = _get_compiled_minmax(self._mesh)(
+                    self._device_cols[name], self.device_valid_mask()
+                )
+                # overlap the two fetches: one tunnel roundtrip, not two
+                lo_a.copy_to_host_async()
+                hi_a.copy_to_host_async()
+                cache[name] = (
+                    int(_np.asarray(jax.device_get(lo_a))[0]),
+                    int(_np.asarray(jax.device_get(hi_a))[0]),
+                )
         return cache[name]
+
+    def _host_key_range(self, name: str) -> "Optional[Tuple[int, int]]":
+        """Key range from the retained host/ingest arrow table when one
+        exists — zero device traffic. This matters beyond the saved
+        roundtrip: on the axon tunnel the FIRST device→host transfer of a
+        process permanently drops every later program execution into a
+        ~0.4s slow mode (measured live; see BASELINE.md), so a probe that
+        stays on the host keeps whole device-resident pipelines in fast
+        mode. Only valid for frames without an explicit device mask (all
+        ingested rows valid)."""
+        if self._valid_mask is not None:
+            return None
+        if name in self._null_masks or name in self._encodings:
+            # the device column holds fill values / codes for these — a
+            # host-side min/max (which skips NULLs) would disagree with
+            # the device probe and produce wrong dense-plan bounds
+            return None
+        tbl = self._ingest_tbl if getattr(self, "_ingest_tbl", None) is not None else self._host_tbl
+        if tbl is None or name not in tbl.schema.names:
+            return None
+        import pyarrow.compute as pc
+
+        col = tbl.column(name)
+        if not pa.types.is_integer(col.type):
+            return None
+        mm = pc.min_max(col)
+        lo, hi = mm["min"].as_py(), mm["max"].as_py()
+        if lo is None or hi is None:
+            # empty / all-NULL: the device probe's fill-value convention
+            # (hi < lo) signals emptiness to callers
+            ii = np.iinfo(np.dtype(col.type.to_pandas_dtype()))
+            return (ii.max, ii.min)
+        return (int(lo), int(hi))
 
     @property
     def native(self) -> "JaxDataFrame":

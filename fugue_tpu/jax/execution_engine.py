@@ -25,7 +25,7 @@ Design (SURVEY §7.8, BASELINE.json north_star):
 """
 
 import logging
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -2707,6 +2707,192 @@ class JaxExecutionEngine(ExecutionEngine):
             jdf.device_cols[src], jdf.null_masks[src]
         )
 
+    def _try_dense_device_aggregate(
+        self,
+        jdf: JaxDataFrame,
+        keys: List[str],
+        plan: dict,
+        agg_entries: List[Any],
+        range_hint: Optional[Tuple[int, int]],
+    ) -> Optional[DataFrame]:
+        """Finish a dense-plan aggregate ON DEVICE — no host roundtrip.
+
+        The dense kernel's outputs are already cross-shard merged, so for
+        plain frames (single int key, no masks/dictionaries/virtual
+        columns) the final table is computable in one more jitted step:
+        ``key = kmin + arange``, ``valid = present > 0``, avg = sum/count,
+        dtype casts to the declared schema. The result frame keeps its
+        columns device-resident with an explicit valid mask and a LAZY row
+        count — on a remote-chip link this removes the only per-call
+        device→host transfer (the reference instead materializes backend
+        results per op, e.g. pandas groupby output frames,
+        /root/reference/fugue/execution/native_execution_engine.py:172).
+        Returns None when ineligible (caller runs the fetch+host-merge
+        plan)."""
+        from ..ops.segment import _DENSE_MAX_RANGE, dense_buckets
+
+        if range_hint is None:
+            return None
+        if plan["virtual"] or plan["dict_srcs"] or plan["masked_srcs"]:
+            return None
+        if any(p.get("kind") not in ("pass", "avg") for p in plan["post"]):
+            return None
+        kmin, kmax = range_hint
+        rng = kmax - kmin + 1
+        if not (0 < rng <= _DENSE_MAX_RANGE):
+            return None
+
+        def _jnp_dtype(tp: pa.DataType) -> Optional[np.dtype]:
+            if pa.types.is_integer(tp) or pa.types.is_floating(tp):
+                return np.dtype(tp.to_pandas_dtype())
+            return None
+
+        # predict kernel output dtypes; bail on any cast a NULL could break
+        predicted: Dict[str, np.dtype] = {}
+        for name, agg, arr, _ in agg_entries:
+            predicted[name] = (
+                np.dtype(np.int64)
+                if agg == "count"
+                else np.dtype(arr.dtype)
+            )
+        key_dt = _jnp_dtype(self._field_type(jdf.schema, keys[0]))
+        if key_dt is None:
+            return None
+        spec_rows: List[Tuple[str, str, Tuple[str, ...], str]] = []
+        for p, field_name in zip(plan["post"], plan["schema"].names[1:]):
+            tgt = _jnp_dtype(self._field_type(plan["schema"], field_name))
+            if tgt is None:
+                return None
+            if p["kind"] == "avg":
+                ins = (f"{p['name']}__sum", f"{p['name']}__cnt")
+                src_dt = np.dtype(np.float64)
+            else:
+                ins = (p["name"],)
+                src_dt = predicted[p["name"]]
+            if src_dt.kind == "f" and tgt.kind != "f":
+                return None  # NaN (NULL) would not survive the cast
+            if src_dt.kind not in ("i", "u", "f") or tgt.kind not in (
+                "i",
+                "u",
+                "f",
+            ):
+                return None
+            spec_rows.append((p["kind"], p["name"], ins, tgt.str))
+        buckets = dense_buckets(rng)
+        outs = self._run_dense_fused(
+            jdf, keys[0], agg_entries, kmin, buckets, tuple(spec_rows), key_dt.str
+        )
+        device_cols = {keys[0]: outs[0]}
+        for (_, name, _, _), arr in zip(spec_rows, outs[2:]):
+            device_cols[name] = arr
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols=device_cols,
+                host_tbl=None,
+                row_count=-1,
+                valid_mask=outs[1],
+                schema=plan["schema"],
+            ),
+        )
+
+    @staticmethod
+    def _field_type(schema: Schema, name: str) -> pa.DataType:
+        return schema[name].type
+
+    def _run_dense_fused(
+        self,
+        jdf: JaxDataFrame,
+        key: str,
+        agg_entries: List[Any],
+        kmin: int,
+        buckets: int,
+        spec_rows: Tuple[Any, ...],
+        key_dtype: str,
+    ):
+        """Dense kernel + finish traced into ONE program — one dispatch per
+        aggregate call instead of three (mask/kernel/finish); per-program
+        submission latency is the dominant cost on a remote-chip link."""
+        import jax
+
+        from ..ops.segment import dense_kernel_parts
+
+        kernel, arrays, agg_sig = dense_kernel_parts(
+            self._mesh, agg_entries, buckets
+        )
+        arr_names = tuple(s[0] for s in agg_sig)
+        cache_key = (
+            "dense_fused",
+            self._mesh,
+            buckets,
+            agg_sig,
+            spec_rows,
+            key_dtype,
+        )
+        if cache_key not in self._jit_cache:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            fin = self._make_dense_finish(
+                buckets, arr_names, spec_rows, key_dtype
+            )
+
+            def fused(karr: Any, kmin_s: Any, vm: Any, *arrs: Any):
+                outs = kernel(karr, kmin_s, *arrs, vm)
+                return fin(kmin_s, outs[0], *outs[1:])
+
+            self._jit_cache[cache_key] = jax.jit(
+                fused,
+                out_shardings=NamedSharding(self._mesh, P(ROW_AXIS)),
+            )
+        return self._jit_cache[cache_key](
+            jdf.device_cols[key],
+            np.int64(kmin),
+            jdf.device_valid_mask(),
+            *arrays,
+        )
+
+    def _make_dense_finish(
+        self,
+        buckets: int,
+        arr_names: Tuple[str, ...],
+        spec_rows: Tuple[Tuple[str, str, Tuple[str, ...], str], ...],
+        key_dtype: str,
+    ):
+        """(key, valid, *outs) builder over replicated dense-kernel outputs,
+        padded to the row-shard multiple with padding marked invalid. A
+        plain closure — it is traced inside the fused jit, whose
+        out_shardings reshard the results onto the row axis."""
+        import jax.numpy as jnp
+
+        from ..parallel.mesh import num_row_shards, pad_rows
+
+        shards = num_row_shards(self._mesh)
+        padded = pad_rows(max(buckets, shards), shards)
+
+        def fin(kmin: Any, present: Any, *aggs: Any):
+            named = dict(zip(arr_names, aggs))
+            key = (jnp.arange(buckets, dtype=jnp.int64) + kmin).astype(
+                jnp.dtype(key_dtype)
+            )
+            valid = present > 0
+            outs = []
+            for kind, _, ins, tgt in spec_rows:
+                if kind == "avg":
+                    s = named[ins[0]].astype(jnp.float64)
+                    c = named[ins[1]].astype(jnp.float64)
+                    a = s / jnp.where(c == 0, jnp.nan, c)
+                else:
+                    a = named[ins[0]]
+                outs.append(a.astype(jnp.dtype(tgt)))
+
+            def _pad(a: Any) -> Any:
+                return jnp.pad(a, (0, padded - buckets))
+
+            return tuple(_pad(x) for x in (key, valid, *outs))
+
+        return fin
+
     def aggregate(
         self,
         df: DataFrame,
@@ -2775,28 +2961,34 @@ class JaxExecutionEngine(ExecutionEngine):
             )
         ):
             range_hint = jdf.key_range(keys[0])
+        agg_entries = [
+            (
+                name,
+                agg,
+                value_arrs[src],
+                (
+                    # virtual arrays (hi/lo/notnull/min-max fills) are
+                    # pre-filled plain ints — never NaN-aware
+                    False
+                    if src in plan["virtual"]
+                    else (
+                        jdf.maybe_nan(src)
+                        or src in plan["masked_srcs"]
+                        or src in plan["dict_srcs"]
+                    )
+                ),
+            )
+            for name, agg, src in plan["aggs"]
+        ]
+        res = self._try_dense_device_aggregate(
+            jdf, keys, plan, agg_entries, range_hint
+        )
+        if res is not None:
+            return res
         partials = device_groupby_partials(
             self._mesh,
             key_cols,
-            [
-                (
-                    name,
-                    agg,
-                    value_arrs[src],
-                    (
-                        # virtual arrays (hi/lo/notnull/min-max fills) are
-                        # pre-filled plain ints — never NaN-aware
-                        False
-                        if src in plan["virtual"]
-                        else (
-                            jdf.maybe_nan(src)
-                            or src in plan["masked_srcs"]
-                            or src in plan["dict_srcs"]
-                        )
-                    ),
-                )
-                for name, agg, src in plan["aggs"]
-            ],
+            agg_entries,
             jdf.device_valid_mask(),
             range_hint=range_hint,
         )
@@ -3055,16 +3247,21 @@ def _plan_device_agg(
             post.append({"name": name, "fn": _decode})
         elif func in ("SUM", "MIN", "MAX"):
             aggs.append((name, func.lower(), src))
-            post.append({"name": name, "fn": (lambda m, _n=name: m[_n])})
+            post.append(
+                {"name": name, "kind": "pass", "fn": (lambda m, _n=name: m[_n])}
+            )
         elif func == "COUNT":
             aggs.append((name, "count", src))
-            post.append({"name": name, "fn": (lambda m, _n=name: m[_n])})
+            post.append(
+                {"name": name, "kind": "pass", "fn": (lambda m, _n=name: m[_n])}
+            )
         elif func == "AVG":
             aggs.append((f"{name}__sum", "sum", src))
             aggs.append((f"{name}__cnt", "count", src))
             post.append(
                 {
                     "name": name,
+                    "kind": "avg",
                     "fn": (lambda m, _n=name: m[f"{_n}__sum"] / m[f"{_n}__cnt"]),
                 }
             )

@@ -411,6 +411,46 @@ def _dedupe_cols(
     return tuple(specs), arrays
 
 
+def dense_buckets(rng: int) -> int:
+    """Bucket count for a dense plan over a key range of ``rng`` distinct
+    slots: pow2 (bounds compiled variants) with the top bucket reserved
+    for padding/invalid rows (``rng`` real slots never reach it)."""
+    return 1 << (rng + 1 - 1).bit_length()
+
+
+def dense_kernel_parts(
+    mesh: Any, agg_cols: List[Tuple[Any, ...]], buckets: int
+) -> "Tuple[Any, List[Any], Tuple[Tuple[str, str, int, bool], ...]]":
+    """The callable + deduped value arrays + signature of the dense-bucket
+    kernel — exposed so callers can compose the kernel with further device
+    work inside ONE jitted program (per-program dispatch has real latency
+    on a remote-chip tunnel)."""
+    agg_sig, arrays = _dedupe_cols(agg_cols)
+    return _get_compiled_dense(mesh, buckets, agg_sig), arrays, agg_sig
+
+
+def device_dense_groupby(
+    mesh: Any,
+    key_arr: Any,
+    agg_cols: List[Tuple[Any, ...]],
+    valid: Any,
+    kmin: int,
+    buckets: int,
+) -> "Tuple[Any, List[Tuple[str, Any]]]":
+    """Dense-bucket groupby that STAYS on device.
+
+    Returns ``(present, [(name, array), ...])`` — per-bucket presence
+    counts and aggregate tables, cross-shard merged and replicated, with
+    NaN marking NULL (all-NULL groups). No host transfer happens here;
+    callers either fetch (``_dense_groupby_partials``) or finish the
+    result on device (the engine's device-resident aggregate)."""
+    import numpy as np_
+
+    compiled, arrays, agg_sig = dense_kernel_parts(mesh, agg_cols, buckets)
+    outs = compiled(key_arr, np_.int64(kmin), *arrays, valid)
+    return outs[0], [(spec[0], arr) for spec, arr in zip(agg_sig, outs[1:])]
+
+
 def _dense_groupby_partials(
     mesh: Any,
     key_name: str,
@@ -424,11 +464,11 @@ def _dense_groupby_partials(
     import numpy as np_
     import pandas as pd
 
-    from ..parallel.mesh import ROW_AXIS
-
-    agg_sig, arrays = _dedupe_cols(agg_cols)
-    compiled = _get_compiled_dense(mesh, buckets, agg_sig)
-    outs = compiled(key_arr, np_.int64(kmin), *arrays, valid)
+    present_a, named = device_dense_groupby(
+        mesh, key_arr, agg_cols, valid, kmin, buckets
+    )
+    outs = [present_a] + [a for _, a in named]
+    agg_sig = [(n,) for n, _ in named]
     # outputs are cross-shard merged + replicated: ONE table comes to host.
     # Start every copy before reading any — on a remote-chip tunnel the
     # roundtrips overlap instead of serializing.
@@ -493,9 +533,7 @@ def device_groupby_partials(
                 kmax = int(np_.asarray(jax.device_get(kmax_a))[0])
             rng = kmax - kmin + 1
             if 0 < rng <= _DENSE_MAX_RANGE:
-                # pow2 bucket count bounds the number of compiled variants;
-                # the top bucket is reserved for padding rows
-                buckets = 1 << (rng + 1 - 1).bit_length()
+                buckets = dense_buckets(rng)
                 return _dense_groupby_partials(
                     mesh, key_names[0], karr, agg_cols, valid0, kmin, buckets
                 )

@@ -1,0 +1,156 @@
+"""Device-resident dense aggregates: the result frame must stay on device
+(no host materialization) and remain a first-class input to later device
+ops. Mirrors the reference's aggregate contract
+(/root/reference/fugue/execution/execution_engine.py:898-939) with the
+finish running on the mesh instead of a backend SQL engine."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.jax import JaxExecutionEngine
+
+SPEC = PartitionSpec(by=["k"])
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return JaxExecutionEngine()
+
+
+def test_dense_aggregate_stays_on_device(eng):
+    rng = np.random.default_rng(7)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 500, 50_000), "v": rng.random(50_000)}
+    )
+    res = eng.aggregate(
+        eng.to_df(pdf),
+        SPEC,
+        [
+            ff.sum(col("v")).alias("s"),
+            ff.count(col("v")).alias("n"),
+            ff.avg(col("v")).alias("m"),
+            ff.min(col("v")).alias("lo"),
+            ff.max(col("v")).alias("hi"),
+        ],
+    )
+    # the proof of device residency: no host table, explicit valid mask
+    assert res.host_table is None
+    assert res.valid_mask is not None
+    got = res.as_pandas().sort_values("k").reset_index(drop=True)
+    exp = (
+        pdf.groupby("k")
+        .agg(
+            s=("v", "sum"),
+            n=("v", "count"),
+            m=("v", "mean"),
+            lo=("v", "min"),
+            hi=("v", "max"),
+        )
+        .reset_index()
+    )
+    assert np.allclose(got[["s", "m", "lo", "hi"]], exp[["s", "m", "lo", "hi"]])
+    assert (got["n"].to_numpy() == exp["n"].to_numpy()).all()
+
+
+def test_all_null_group_and_sparse_range(eng):
+    pdf = pd.DataFrame(
+        {
+            "k": np.array([5, 5, 900, 900, 42], dtype=np.int32),
+            "v": [1.0, 2.0, np.nan, np.nan, 7.0],
+        }
+    )
+    res = eng.aggregate(
+        eng.to_df(pdf),
+        SPEC,
+        [ff.sum(col("v")).alias("s"), ff.avg(col("v")).alias("m")],
+    )
+    assert res.host_table is None
+    assert res.count() == 3  # lazy count over the valid mask
+    got = res.as_pandas().sort_values("k").reset_index(drop=True)
+    # int32 key dtype survives the device finish
+    assert str(res.schema["k"].type) == "int32"
+    assert got["k"].tolist() == [5, 42, 900]
+    assert got["s"].tolist()[:2] == [3.0, 7.0] and np.isnan(got["s"][2])
+    assert np.isnan(got["m"][2])
+
+
+def test_aggregate_of_filtered_frame_then_downstream_filter(eng):
+    pdf = pd.DataFrame(
+        {"k": np.arange(100) % 7, "v": np.arange(100, dtype=float)}
+    )
+    f = eng.filter(eng.to_df(pdf), col("v") < 50)
+    r = eng.aggregate(
+        f, SPEC, [ff.count(col("v")).alias("n"), ff.sum(col("v")).alias("s")]
+    )
+    assert r.host_table is None
+    # the aggregate result is itself a valid device input to later ops
+    r2 = eng.filter(r, col("s") > 100.0)
+    exp = (
+        pdf.query("v<50")
+        .groupby("k")
+        .agg(n=("v", "count"), s=("v", "sum"))
+        .reset_index()
+        .query("s>100")
+        .reset_index(drop=True)
+    )
+    got = r2.as_pandas().sort_values("k").reset_index(drop=True)
+    assert (got["k"].to_numpy() == exp["k"].to_numpy()).all()
+    assert np.allclose(got["s"], exp["s"])
+
+
+def test_int_sum_min_max_dtypes(eng):
+    pdf = pd.DataFrame({"k": np.arange(20) % 3, "x": np.arange(20)})
+    r = eng.aggregate(
+        eng.to_df(pdf),
+        SPEC,
+        [
+            ff.sum(col("x")).alias("s"),
+            ff.min(col("x")).alias("lo"),
+            ff.max(col("x")).alias("hi"),
+        ],
+    )
+    assert r.host_table is None
+    got = r.as_pandas().sort_values("k").reset_index(drop=True)
+    exp = (
+        pdf.groupby("k")
+        .agg(s=("x", "sum"), lo=("x", "min"), hi=("x", "max"))
+        .reset_index()
+    )
+    assert (got.to_numpy() == exp.to_numpy()).all()
+    assert str(r.schema["s"].type) == "int64"
+
+
+def test_host_key_range_declines_masked_and_encoded_cols(eng):
+    # host-side min/max skips NULLs, but the device column holds fill
+    # values — the two probes would disagree, so the host path must
+    # decline for masked/encoded columns (device probe stays authoritative)
+    pdf = pd.DataFrame(
+        {
+            "k": pd.array([5, 10, None], dtype="Int64"),
+            "s": ["a", "b", "c"],
+            "p": [1, 2, 3],
+        }
+    )
+    jdf = eng.to_df(pdf)
+    assert jdf._host_key_range("k") is None
+    assert jdf._host_key_range("s") is None
+    assert jdf._host_key_range("p") == (1, 3)
+
+
+def test_masked_int_values_keep_host_finish_exact(eng):
+    # nullable int64 goes through the hi/lo host merge (device finish must
+    # decline) and stays exact at 2^62 scale
+    big = 1 << 62
+    pdf = pd.DataFrame(
+        {
+            "k": [0, 0, 1, 1],
+            "x": pd.array([big, 3, None, None], dtype="Int64"),
+        }
+    )
+    r = eng.aggregate(eng.to_df(pdf), SPEC, [ff.sum(col("x")).alias("s")])
+    got = r.as_pandas().sort_values("k").reset_index(drop=True)
+    assert got["s"][0] == big + 3
+    assert pd.isna(got["s"][1])
