@@ -44,6 +44,24 @@ def _lazy_jax_engine(conf: object, **kwargs: object) -> "ExecutionEngine":
 register_execution_engine("jax", _lazy_jax_engine)
 register_execution_engine("tpu", _lazy_jax_engine)
 
+
+def _lazy_sqlite_engine(conf, **kwargs):
+    from ..warehouse import SQLiteExecutionEngine  # registers the full backend
+
+    return SQLiteExecutionEngine(conf, **kwargs)
+
+
+register_execution_engine("sqlite", _lazy_sqlite_engine)
+
+
+def _lazy_sqlite_sql_engine(engine):
+    from ..warehouse import WarehouseSQLEngine
+
+    return WarehouseSQLEngine(engine)
+
+
+register_sql_engine("sqlite", _lazy_sqlite_sql_engine)
+
 __all__ = [
     "EngineFacet",
     "ExecutionEngine",

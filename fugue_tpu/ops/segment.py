@@ -413,9 +413,10 @@ def _dedupe_cols(
 
 def dense_buckets(rng: int) -> int:
     """Bucket count for a dense plan over a key range of ``rng`` distinct
-    slots: pow2 (bounds compiled variants) with the top bucket reserved
-    for padding/invalid rows (``rng`` real slots never reach it)."""
-    return 1 << (rng + 1 - 1).bit_length()
+    slots: the next power of two STRICTLY greater than ``rng``, so the
+    top bucket is free for padding/invalid rows (real keys occupy
+    ``[0, rng)`` and never reach it); pow2 bounds compiled variants."""
+    return 1 << rng.bit_length()
 
 
 def dense_kernel_parts(

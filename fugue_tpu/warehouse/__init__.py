@@ -1,0 +1,19 @@
+"""External-SQL-warehouse engine role (the reference's fugue_ibis analog):
+Fugue ops pushed down to a DB-API database; sqlite3 is the in-env backend."""
+
+from .dataframe import WarehouseDataFrame
+from .execution_engine import (
+    SQLiteExecutionEngine,
+    WarehouseExecutionEngine,
+    WarehouseMapEngine,
+    WarehouseSQLEngine,
+)
+from . import registry  # noqa: F401  (self-registration at import)
+
+__all__ = [
+    "WarehouseDataFrame",
+    "WarehouseExecutionEngine",
+    "WarehouseMapEngine",
+    "WarehouseSQLEngine",
+    "SQLiteExecutionEngine",
+]

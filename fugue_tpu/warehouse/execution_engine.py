@@ -1,0 +1,830 @@
+"""Warehouse execution engine: Fugue ops pushed down to an external SQL
+database over DB-API — the reference's Ibis role rebuilt in-tree.
+
+Parity target: ``/root/reference/fugue_ibis/execution_engine.py`` —
+``IbisSQLEngine`` (select/join/set-ops/take/sample as backend SQL,
+``:30-300``), ``IbisMapEngine`` (map roundtrips through a local engine,
+``:302-350``), ``IbisExecutionEngine`` (``:352``). Instead of the ibis
+expression tree + per-backend compilers, this engine generates standard
+SQL directly (the in-tree ``SQLExpressionGenerator`` provides the
+column-IR lowering) and speaks plain DB-API, with sqlite3 (stdlib) as the
+in-env warehouse. TPU note: this role is the escape hatch for data that
+lives in an external system — the device engine ingests from it via
+``as_arrow()``; compute-heavy paths belong on the JaxExecutionEngine.
+"""
+
+import datetime
+import itertools
+import logging
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from .._utils.io import load_df as _io_load_df
+from .._utils.io import save_df as _io_save_df
+from ..collections.partition import (
+    PartitionCursor,
+    PartitionSpec,
+    parse_presort_exp,
+)
+from ..collections.sql import StructuredRawSQL
+from ..column import ColumnExpr, SelectColumns
+from ..column.sql import SQLExpressionGenerator
+from ..dataframe import ArrowDataFrame, DataFrame, DataFrames, LocalDataFrame
+from ..dataframe.utils import get_join_schemas
+from ..exceptions import FugueInvalidOperation
+from ..execution.execution_engine import ExecutionEngine, MapEngine, SQLEngine
+from ..execution.native_execution_engine import NativeExecutionEngine
+from ..schema import Schema
+from .dataframe import WarehouseDataFrame
+
+_TEMP_TABLE_NAMES = (f"_fugue_temp_table_{i:d}" for i in itertools.count())
+_SCHEMA_META_TABLE = "__fugue_schemas__"
+_ROWNUM_COL = "__fugue_wh_rn__"
+
+# arrow type → sqlite storage class; everything else must fail loudly
+_STORAGE: List[Tuple[Callable[[pa.DataType], bool], str]] = [
+    (pa.types.is_boolean, "INTEGER"),
+    (pa.types.is_integer, "INTEGER"),
+    (pa.types.is_floating, "REAL"),
+    (pa.types.is_string, "TEXT"),
+    (pa.types.is_large_string, "TEXT"),
+    (pa.types.is_binary, "BLOB"),
+    (pa.types.is_large_binary, "BLOB"),
+    (pa.types.is_timestamp, "TEXT"),
+    (pa.types.is_date, "TEXT"),
+]
+
+
+def _storage_type(tp: pa.DataType) -> str:
+    for pred, st in _STORAGE:
+        if pred(tp):
+            return st
+    raise FugueInvalidOperation(
+        f"type {tp} has no warehouse storage mapping (nested/decimal "
+        "columns are not supported by the warehouse engine)"
+    )
+
+
+class WarehouseSQLEngine(SQLEngine):
+    """SQL facet: raw SELECT statements run in the warehouse (reference
+    ``IbisSQLEngine.select``, ``fugue_ibis/execution_engine.py:41-58``).
+
+    Also usable as a secondary SQL engine on a NON-warehouse execution
+    engine (FugueSQL ``CONNECT sqlite``): frames then move into a private
+    sqlite session for the statement, mirroring how the reference's
+    DuckDB SQL engine serves other engines."""
+
+    def __init__(self, execution_engine: ExecutionEngine):
+        super().__init__(execution_engine)
+        self._wh: "WarehouseExecutionEngine" = (
+            execution_engine
+            if isinstance(execution_engine, WarehouseExecutionEngine)
+            else SQLiteExecutionEngine(execution_engine.conf)
+        )
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    @property
+    def dialect(self) -> Optional[str]:
+        return None  # standard SQL; no transpile step
+
+    def encode_name(self, name: str) -> str:
+        return self._wh.encode_name(name)
+
+    def select(self, dfs: DataFrames, statement: StructuredRawSQL) -> DataFrame:
+        eng = self._wh
+        name_map: Dict[str, str] = {}
+        for k, v in dfs.items():
+            wdf = eng.to_df(v)
+            name_map[k] = eng.encode_name(wdf.table)
+        sql = statement.construct(name_map=name_map, log=self.log)
+        tbl = eng.materialize(sql)
+        return eng.track_temp_table(
+            WarehouseDataFrame(eng, tbl, eng.infer_table_schema(tbl))
+        )
+
+    def table_exists(self, table: str) -> bool:
+        eng = self._wh
+        cur = eng.connection.execute(
+            "SELECT name FROM sqlite_master WHERE type IN ('table','view') "
+            "AND name = ?",
+            (table,),
+        )
+        return cur.fetchone() is not None
+
+    def save_table(
+        self,
+        df: DataFrame,
+        table: str,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        **kwargs: Any,
+    ) -> None:
+        eng = self._wh
+        if self.table_exists(table):
+            assert_or_throw(
+                mode == "overwrite",
+                FugueInvalidOperation(f"table {table} exists, mode must be overwrite"),
+            )
+            eng.connection.execute(f"DROP TABLE {eng.encode_name(table)}")
+        wdf = eng.to_df(df)
+        eng.connection.execute(
+            f"CREATE TABLE {eng.encode_name(table)} AS "
+            f"SELECT * FROM {eng.encode_name(wdf.table)}"
+        )
+        eng.record_schema(table, wdf.schema, persistent=True)
+        eng.connection.commit()
+
+    def load_table(self, table: str, **kwargs: Any) -> DataFrame:
+        eng = self._wh
+        assert_or_throw(
+            self.table_exists(table),
+            FugueInvalidOperation(f"table {table} doesn't exist"),
+        )
+        return WarehouseDataFrame(eng, table, eng.infer_table_schema(table))
+
+
+class WarehouseMapEngine(MapEngine):
+    """Map facet: per-partition UDFs roundtrip through the local engine
+    (reference ``IbisMapEngine.map_dataframe``,
+    ``fugue_ibis/execution_engine.py:330-350``)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:
+        eng: "WarehouseExecutionEngine" = self.execution_engine  # type: ignore
+        local = eng.to_df(df).as_local_bounded()
+        res = eng.local_engine.map_engine.map_dataframe(
+            local,
+            map_func=map_func,
+            output_schema=output_schema,
+            partition_spec=partition_spec,
+            on_init=on_init,
+            map_func_format_hint=map_func_format_hint,
+        )
+        return eng.ingest(res.as_local_bounded())
+
+
+class WarehouseExecutionEngine(ExecutionEngine):
+    """Engine verbs lowered to warehouse SQL (reference
+    ``IbisExecutionEngine``, ``fugue_ibis/execution_engine.py:352``).
+
+    ``connection`` is a DB-API connection; sqlite3 is the stdlib-provided
+    warehouse this repo ships with (:class:`SQLiteExecutionEngine`).
+    Frames are temp tables in that connection; every relational verb is a
+    single SQL statement over them, so the data never leaves the
+    warehouse except for ``map_dataframe`` (local roundtrip) and
+    ``as_*`` fetches.
+    """
+
+    def __init__(self, conf: Any = None, connection: Any = None, path: str = ":memory:"):
+        super().__init__(conf)
+        import sqlite3
+
+        self._own_connection = connection is None
+        self._connection = (
+            connection
+            if connection is not None
+            else sqlite3.connect(path, check_same_thread=False)
+        )
+        self._schemas: Dict[str, Schema] = {}
+        self._local_engine = NativeExecutionEngine(conf)
+        self._log = logging.getLogger("fugue_tpu.warehouse")
+        self._gen = SQLExpressionGenerator(enable_cast=False)
+
+    # ---- base wiring ------------------------------------------------------
+    @property
+    def log(self) -> logging.Logger:
+        return self._log
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    @property
+    def connection(self) -> Any:
+        return self._connection
+
+    @property
+    def local_engine(self) -> ExecutionEngine:
+        """The non-warehouse engine handling ops beyond SQL (reference
+        ``non_ibis_engine``, ``fugue_ibis/execution_engine.py:372``)."""
+        return self._local_engine
+
+    def create_default_map_engine(self) -> MapEngine:
+        return WarehouseMapEngine(self)
+
+    def create_default_sql_engine(self) -> SQLEngine:
+        return WarehouseSQLEngine(self)
+
+    def get_current_parallelism(self) -> int:
+        return 1
+
+    def stop_engine(self) -> None:
+        if self._own_connection:
+            self._connection.close()
+
+    def encode_name(self, name: str) -> str:
+        return '"' + name.replace('"', '""') + '"'
+
+    def convert_yield_dataframe(self, df: DataFrame, as_local: bool) -> DataFrame:
+        # warehouse frames die with the connection (reference DuckDB does
+        # the same for owned connections, fugue_duckdb/execution_engine.py:505):
+        # results yielded past the engine's lifetime must be local copies.
+        # ctx_count <= 1 = the top-level (per-run) context — the engine
+        # stops when it exits, so the yield must not reference it
+        if as_local or (self._own_connection and self._ctx_count <= 1):
+            return df.as_local() if isinstance(df, WarehouseDataFrame) else df
+        return df
+
+    # ---- data movement ----------------------------------------------------
+    def to_df(self, df: Any, schema: Any = None) -> WarehouseDataFrame:
+        if isinstance(df, WarehouseDataFrame):
+            assert_or_throw(
+                schema is None or Schema(schema) == df.schema,
+                FugueInvalidOperation("schema must match the warehouse frame"),
+            )
+            return df
+        local = self._local_engine.to_df(df, schema)
+        return self.ingest(local)
+
+    def temp_frame(self, tbl: str, schema: Schema) -> WarehouseDataFrame:
+        """Wrap a materialized temp table, recording its schema and its
+        drop-on-release lifecycle."""
+        self.record_schema(tbl, schema)
+        return self.track_temp_table(WarehouseDataFrame(self, tbl, schema))
+
+    def track_temp_table(self, frame: WarehouseDataFrame) -> WarehouseDataFrame:
+        """Register ``frame``'s temp table for DROP when the frame is
+        garbage-collected — chained pipelines would otherwise hold a full
+        copy of every intermediate result for the connection's lifetime."""
+        import weakref
+
+        weakref.finalize(frame, _drop_table_quietly, self._connection, frame.table)
+        return frame
+
+    def ingest(self, df: DataFrame) -> WarehouseDataFrame:
+        """Write a local frame into a warehouse temp table."""
+        tbl = next(_TEMP_TABLE_NAMES)
+        schema = df.schema
+        cols = ", ".join(
+            f"{self.encode_name(f.name)} {_storage_type(f.type)}"
+            for f in schema.fields
+        )
+        self._connection.execute(f"CREATE TEMP TABLE {self.encode_name(tbl)} ({cols})")
+        arrow = df.as_arrow() if not isinstance(df, ArrowDataFrame) else df.native
+        rows = _arrow_to_storage_rows(arrow, schema)
+        ph = ", ".join("?" for _ in schema.fields)
+        self._connection.executemany(
+            f"INSERT INTO {self.encode_name(tbl)} VALUES ({ph})", rows
+        )
+        self.record_schema(tbl, schema)
+        return self.track_temp_table(WarehouseDataFrame(self, tbl, schema))
+
+    def materialize(self, sql: str) -> str:
+        """Run ``sql`` into a fresh temp table; return the table name."""
+        tbl = next(_TEMP_TABLE_NAMES)
+        self._connection.execute(
+            f"CREATE TEMP TABLE {self.encode_name(tbl)} AS {sql}"
+        )
+        return tbl
+
+    def record_schema(
+        self, table: str, schema: Schema, persistent: bool = False
+    ) -> None:
+        self._schemas[table] = schema
+        if persistent:
+            # schema fidelity across engine instances over the same DB file:
+            # sqlite's storage classes can't round-trip bool/datetime/int
+            # widths, so the exact Fugue schema rides in a meta table
+            self._connection.execute(
+                f"CREATE TABLE IF NOT EXISTS {_SCHEMA_META_TABLE} "
+                "(tbl TEXT PRIMARY KEY, schema TEXT)"
+            )
+            self._connection.execute(
+                f"INSERT OR REPLACE INTO {_SCHEMA_META_TABLE} VALUES (?, ?)",
+                (table, str(schema)),
+            )
+
+    def infer_table_schema(self, table: str) -> Schema:
+        """Schema of a warehouse table: recorded if known, else inferred
+        from sqlite column decltypes + value sampling (the price of a
+        dynamically-typed warehouse; recorded schemas are authoritative)."""
+        if table in self._schemas:
+            return self._schemas[table]
+        cur = self._connection.execute(
+            f"SELECT tbl, schema FROM {_SCHEMA_META_TABLE} WHERE tbl = ?", (table,)
+        ) if self._meta_exists() else None
+        row = cur.fetchone() if cur is not None else None
+        if row is not None:
+            schema = Schema(row[1])
+            self._schemas[table] = schema
+            return schema
+        fields: List[pa.Field] = []
+        info = self._connection.execute(
+            f"PRAGMA table_info({self.encode_name(table)})"
+        ).fetchall()
+        for _, name, decltype, *_rest in info:
+            decl = (decltype or "").upper()
+            if "INT" in decl:
+                tp: pa.DataType = pa.int64()
+            elif decl in ("REAL", "FLOAT", "DOUBLE"):
+                tp = pa.float64()
+            elif "CHAR" in decl or "TEXT" in decl:
+                tp = pa.string()
+            elif "BLOB" in decl:
+                tp = pa.binary()
+            else:
+                tp = self._sample_type(table, name)
+            fields.append(pa.field(name, tp))
+        schema = Schema(fields)
+        self._schemas[table] = schema
+        return schema
+
+    def _meta_exists(self) -> bool:
+        cur = self._connection.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+            (_SCHEMA_META_TABLE,),
+        )
+        return cur.fetchone() is not None
+
+    def _sample_type(self, table: str, col: str) -> pa.DataType:
+        cur = self._connection.execute(
+            f"SELECT typeof({self.encode_name(col)}) FROM "
+            f"{self.encode_name(table)} WHERE {self.encode_name(col)} "
+            "IS NOT NULL LIMIT 1"
+        )
+        row = cur.fetchone()
+        kind = row[0] if row is not None else None
+        return {
+            "integer": pa.int64(),
+            "real": pa.float64(),
+            "text": pa.string(),
+            "blob": pa.binary(),
+        }.get(kind, pa.string())
+
+    def fetch_arrow(self, table: str, schema: Schema) -> pa.Table:
+        return self.fetch_arrow_query(
+            "SELECT "
+            + ", ".join(self.encode_name(n) for n in schema.names)
+            + f" FROM {self.encode_name(table)}",
+            schema,
+        )
+
+    def fetch_arrow_query(self, sql: str, schema: Schema) -> pa.Table:
+        cur = self._connection.execute(sql)
+        rows = cur.fetchall()
+        cols = list(zip(*rows)) if len(rows) > 0 else [[] for _ in schema.fields]
+        arrays = [
+            _storage_to_arrow(list(vals), f.type)
+            for vals, f in zip(cols, schema.fields)
+        ]
+        return pa.Table.from_arrays(arrays, schema=schema.pa_schema)
+
+    # ---- literals for generated SQL ---------------------------------------
+    def lit_sql(self, value: Any) -> str:
+        if value is None:
+            return "NULL"
+        if isinstance(value, bool):
+            return "1" if value else "0"
+        if isinstance(value, (int, float)):
+            return repr(value)
+        if isinstance(value, bytes):
+            return "X'" + value.hex() + "'"
+        if isinstance(value, datetime.datetime):
+            return "'" + value.isoformat(sep=" ") + "'"
+        if isinstance(value, datetime.date):
+            return "'" + value.isoformat() + "'"
+        return "'" + str(value).replace("'", "''") + "'"
+
+    # ---- distribution primitives (single warehouse: metadata no-ops) ------
+    def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
+        self.log.warning("%s doesn't respect repartition", self)
+        return df
+
+    def broadcast(self, df: DataFrame) -> DataFrame:
+        return df
+
+    def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
+        return self.to_df(df)  # frames are materialized tables already
+
+    # ---- relational verbs as warehouse SQL --------------------------------
+    def join(
+        self,
+        df1: DataFrame,
+        df2: DataFrame,
+        how: str,
+        on: Optional[List[str]] = None,
+    ) -> DataFrame:
+        d1, d2 = self.to_df(df1), self.to_df(df2)
+        key_schema, end_schema = get_join_schemas(d1, d2, how=how, on=on)
+        keys = key_schema.names
+        a, b = self.encode_name(d1.table), self.encode_name(d2.table)
+        how_l = how.lower().replace("_", "").replace(" ", "")
+        # plain = (not null-safe IS): NULL join keys never match, matching
+        # the suites' join semantics on every engine
+        on_clause = " AND ".join(
+            f"a.{self.encode_name(k)} = b.{self.encode_name(k)}" for k in keys
+        )
+
+        def _sel(side_a: str = "a", side_b: str = "b") -> str:
+            cols = []
+            for n in end_schema.names:
+                if n in keys:
+                    cols.append(
+                        f"COALESCE({side_a}.{self.encode_name(n)}, "
+                        f"{side_b}.{self.encode_name(n)}) AS {self.encode_name(n)}"
+                        if how_l == "fullouter"
+                        else f"{side_a}.{self.encode_name(n)} AS {self.encode_name(n)}"
+                    )
+                elif n in d1.schema:
+                    cols.append(f"a.{self.encode_name(n)} AS {self.encode_name(n)}")
+                else:
+                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
+            return ", ".join(cols)
+
+        if how_l == "cross":
+            sql = f"SELECT {_sel()} FROM {a} AS a CROSS JOIN {b} AS b"
+        elif how_l == "inner":
+            sql = f"SELECT {_sel()} FROM {a} AS a JOIN {b} AS b ON {on_clause}"
+        elif how_l == "leftouter":
+            sql = f"SELECT {_sel()} FROM {a} AS a LEFT JOIN {b} AS b ON {on_clause}"
+        elif how_l == "rightouter":
+            # mirrored left join; the right side owns the key values
+            cols = []
+            for n in end_schema.names:
+                if n in keys:
+                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
+                elif n in d1.schema:
+                    cols.append(f"a.{self.encode_name(n)} AS {self.encode_name(n)}")
+                else:
+                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
+            sql = (
+                f"SELECT {', '.join(cols)} FROM {b} AS b "
+                f"LEFT JOIN {a} AS a ON {on_clause}"
+            )
+        elif how_l == "fullouter":
+            sql = f"SELECT {_sel()} FROM {a} AS a FULL OUTER JOIN {b} AS b ON {on_clause}"
+        elif how_l in ("semi", "leftsemi"):
+            cond = " AND ".join(
+                f"b.{self.encode_name(k)} = a.{self.encode_name(k)}" for k in keys
+            )
+            sql = (
+                f"SELECT * FROM {a} AS a WHERE EXISTS "
+                f"(SELECT 1 FROM {b} AS b WHERE {cond})"
+            )
+        elif how_l in ("anti", "leftanti"):
+            cond = " AND ".join(
+                f"b.{self.encode_name(k)} = a.{self.encode_name(k)}" for k in keys
+            )
+            sql = (
+                f"SELECT * FROM {a} AS a WHERE NOT EXISTS "
+                f"(SELECT 1 FROM {b} AS b WHERE {cond})"
+            )
+        else:
+            raise FugueInvalidOperation(f"{how} is not a valid join type")
+        return self.temp_frame(self.materialize(sql), end_schema)
+
+    def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        return self._set_op("UNION" if distinct else "UNION ALL", df1, df2)
+
+    def subtract(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        if distinct:
+            return self._set_op("EXCEPT", df1, df2)
+        return self._bag_set_op("EXCEPT", df1, df2)
+
+    def intersect(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        if distinct:
+            return self._set_op("INTERSECT", df1, df2)
+        return self._bag_set_op("INTERSECT", df1, df2)
+
+    def _set_op(self, op: str, df1: DataFrame, df2: DataFrame) -> DataFrame:
+        d1, d2 = self.to_df(df1), self.to_df(df2)
+        assert_or_throw(
+            d1.schema == d2.schema,
+            FugueInvalidOperation(f"schema mismatch {d1.schema} vs {d2.schema}"),
+        )
+        cols = ", ".join(self.encode_name(n) for n in d1.schema.names)
+        sql = (
+            f"SELECT {cols} FROM {self.encode_name(d1.table)} {op} "
+            f"SELECT {cols} FROM {self.encode_name(d2.table)}"
+        )
+        return self.temp_frame(self.materialize(sql), d1.schema)
+
+    def _bag_set_op(self, op: str, df1: DataFrame, df2: DataFrame) -> DataFrame:
+        """Bag (``ALL``) semantics for EXCEPT/INTERSECT, which sqlite only
+        offers as distinct: number duplicate rows on both sides, apply the
+        distinct op over (row, duplicate-index), then drop the index."""
+        d1, d2 = self.to_df(df1), self.to_df(df2)
+        assert_or_throw(
+            d1.schema == d2.schema,
+            FugueInvalidOperation(f"schema mismatch {d1.schema} vs {d2.schema}"),
+        )
+        names = d1.schema.names
+        cols = ", ".join(self.encode_name(n) for n in names)
+        part = ", ".join(self.encode_name(n) for n in names)
+        rn = self.encode_name(_ROWNUM_COL)
+
+        def _numbered(tbl: str) -> str:
+            return (
+                f"SELECT {cols}, ROW_NUMBER() OVER (PARTITION BY {part}) AS {rn} "
+                f"FROM {self.encode_name(tbl)}"
+            )
+
+        sql = (
+            f"SELECT {cols} FROM ({_numbered(d1.table)} {op} "
+            f"{_numbered(d2.table)})"
+        )
+        return self.temp_frame(self.materialize(sql), d1.schema)
+
+    def distinct(self, df: DataFrame) -> DataFrame:
+        d = self.to_df(df)
+        cols = ", ".join(self.encode_name(n) for n in d.schema.names)
+        return self.temp_frame(
+            self.materialize(
+                f"SELECT DISTINCT {cols} FROM {self.encode_name(d.table)}"
+            ),
+            d.schema,
+        )
+
+    def dropna(
+        self,
+        df: DataFrame,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> DataFrame:
+        d = self.to_df(df)
+        names = subset if subset is not None else d.schema.names
+        assert_or_throw(
+            all(n in d.schema for n in names),
+            FugueInvalidOperation(f"{names} not a subset of {d.schema}"),
+        )
+        nn = [f"({self.encode_name(n)} IS NOT NULL)" for n in names]
+        if thresh is not None:
+            assert_or_throw(
+                how == "any", ValueError("when thresh is set, how must be 'any'")
+            )
+            cond = " + ".join(nn) + f" >= {int(thresh)}"
+        elif how == "any":
+            cond = " AND ".join(nn)
+        else:  # "all": keep rows with at least one non-null
+            cond = " OR ".join(nn)
+        return self.temp_frame(
+            self.materialize(
+                f"SELECT * FROM {self.encode_name(d.table)} WHERE {cond}"
+            ),
+            d.schema,
+        )
+
+    def fillna(
+        self, df: DataFrame, value: Any, subset: Optional[List[str]] = None
+    ) -> DataFrame:
+        d = self.to_df(df)
+        if isinstance(value, dict):
+            assert_or_throw(
+                all(v is not None for v in value.values()),
+                ValueError("fillna value can not be None or contain None"),
+            )
+            vd = value
+        else:
+            assert_or_throw(value is not None, ValueError("fillna value can not be None"))
+            names = subset if subset is not None else d.schema.names
+            vd = {n: value for n in names}
+        cols = []
+        for n in d.schema.names:
+            if n in vd:
+                cols.append(
+                    f"COALESCE({self.encode_name(n)}, {self.lit_sql(vd[n])}) "
+                    f"AS {self.encode_name(n)}"
+                )
+            else:
+                cols.append(self.encode_name(n))
+        return self.temp_frame(
+            self.materialize(
+                f"SELECT {', '.join(cols)} FROM {self.encode_name(d.table)}"
+            ),
+            d.schema,
+        )
+
+    def sample(
+        self,
+        df: DataFrame,
+        n: Optional[int] = None,
+        frac: Optional[float] = None,
+        replace: bool = False,
+        seed: Optional[int] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            (n is None) != (frac is None),
+            ValueError("one and only one of n and frac should be non-negative"),
+        )
+        assert_or_throw(
+            not replace,
+            NotImplementedError("warehouse sample doesn't support replacement"),
+        )
+        d = self.to_df(df)
+        if seed is not None:
+            self.log.warning("warehouse sample ignores seed (sqlite random())")
+        if frac is not None:
+            # random() is a signed 64-bit int; map onto [0, 1)
+            cond = f"(random() / 18446744073709551616.0 + 0.5) < {float(frac)}"
+            sql = f"SELECT * FROM {self.encode_name(d.table)} WHERE {cond}"
+        else:
+            sql = (
+                f"SELECT * FROM {self.encode_name(d.table)} "
+                f"ORDER BY random() LIMIT {int(n)}"
+            )
+        return self.temp_frame(self.materialize(sql), d.schema)
+
+    def take(
+        self,
+        df: DataFrame,
+        n: int,
+        presort: str,
+        na_position: str = "last",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        assert_or_throw(isinstance(n, int), ValueError("n needs to be an integer"))
+        partition_spec = partition_spec or PartitionSpec()
+        d = self.to_df(df)
+        _presort = (
+            parse_presort_exp(presort)
+            if presort is not None and presort != ""
+            else partition_spec.presort
+        )
+        sorts: List[str] = []
+        for k, asc in _presort.items():
+            s = self.encode_name(k) + (" ASC" if asc else " DESC")
+            s += " NULLS FIRST" if na_position == "first" else " NULLS LAST"
+            sorts.append(s)
+        order_by = ("ORDER BY " + ", ".join(sorts)) if len(sorts) > 0 else ""
+        cols = ", ".join(self.encode_name(c) for c in d.schema.names)
+        if len(partition_spec.partition_by) == 0:
+            sql = f"SELECT * FROM {self.encode_name(d.table)} {order_by} LIMIT {n}"
+        else:
+            pcols = ", ".join(
+                self.encode_name(c) for c in partition_spec.partition_by
+            )
+            rn = self.encode_name(_ROWNUM_COL)
+            sql = (
+                f"SELECT {cols} FROM ("
+                f"SELECT {cols}, ROW_NUMBER() OVER (PARTITION BY {pcols} "
+                f"{order_by}) AS {rn} FROM {self.encode_name(d.table)}"
+                f") WHERE {rn} <= {n}"
+            )
+        return self.temp_frame(self.materialize(sql), d.schema)
+
+    # ---- column-IR pushdown ------------------------------------------------
+    def select(
+        self,
+        df: DataFrame,
+        cols: SelectColumns,
+        where: Optional[ColumnExpr] = None,
+        having: Optional[ColumnExpr] = None,
+    ) -> DataFrame:
+        """Column-IR SELECT generated as SQL and run in the warehouse —
+        the pushdown the reference gets from ibis expression compilation
+        (``IbisSQLEngine.select``); the base class would materialize to
+        pandas instead."""
+        d = self.to_df(df)
+        schema = cols.replace_wildcard(d.schema).infer_schema(d.schema)
+        if schema is None:
+            # some expression type can't be statically inferred — fall back
+            # to the base (host-side) evaluation for exactness
+            return super().select(df, cols, where=where, having=having)
+        sql = self._gen.select(
+            cols, self.encode_name(d.table), where=where, having=having
+        )
+        return self.temp_frame(self.materialize(sql), schema)
+
+    # ---- IO ----------------------------------------------------------------
+    def load_df(
+        self,
+        path: Any,
+        format_hint: Any = None,
+        columns: Any = None,
+        **kwargs: Any,
+    ) -> DataFrame:
+        tbl, _ = _io_load_df(path, format_hint=format_hint, columns=columns, **kwargs)
+        return self.ingest(ArrowDataFrame(tbl))
+
+    def save_df(
+        self,
+        df: DataFrame,
+        path: str,
+        format_hint: Any = None,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        force_single: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        partition_cols = (
+            list(partition_spec.partition_by)
+            if partition_spec is not None and len(partition_spec.partition_by) > 0
+            else None
+        )
+        _io_save_df(
+            self.to_df(df).as_arrow(),
+            path,
+            format_hint=format_hint,
+            mode=mode,
+            partition_cols=partition_cols,
+            **kwargs,
+        )
+
+
+class SQLiteExecutionEngine(WarehouseExecutionEngine):
+    """The stdlib-backed concrete warehouse (sqlite3) — registered as
+    engine name ``"sqlite"``. ``conf["fugue.sqlite.path"]`` selects a DB
+    file; default is in-memory."""
+
+    def __init__(self, conf: Any = None, connection: Any = None):
+        path = ":memory:"
+        try:
+            from .._utils.params import ParamDict
+
+            path = ParamDict(conf).get_or_none("fugue.sqlite.path", str) or ":memory:"
+        except Exception:
+            pass
+        super().__init__(conf, connection=connection, path=path)
+
+
+# ---- storage conversion helpers ------------------------------------------
+
+
+def _arrow_to_storage_rows(tbl: pa.Table, schema: Schema) -> List[Tuple]:
+    """Arrow table → python rows in sqlite storage form (bool→int,
+    datetime→ISO text); exact for int64 (python ints are unbounded)."""
+    converters: List[Optional[Callable[[Any], Any]]] = []
+    for f in schema.fields:
+        if pa.types.is_boolean(f.type):
+            converters.append(lambda v: None if v is None else int(v))
+        elif pa.types.is_timestamp(f.type):
+            converters.append(
+                lambda v: None if v is None else v.isoformat(sep=" ")
+            )
+        elif pa.types.is_date(f.type):
+            converters.append(lambda v: None if v is None else v.isoformat())
+        else:
+            converters.append(None)
+    cols = [tbl.column(f.name).to_pylist() for f in schema.fields]
+    out: List[Tuple] = []
+    for row in zip(*cols) if len(cols) > 0 else []:
+        out.append(
+            tuple(
+                v if c is None else c(v) for v, c in zip(row, converters)
+            )
+        )
+    return out
+
+
+def _storage_to_arrow(values: List[Any], tp: pa.DataType) -> pa.Array:
+    """Sqlite storage values → arrow array of the declared type."""
+    if pa.types.is_boolean(tp):
+        values = [None if v is None else bool(v) for v in values]
+        return pa.array(values, type=tp)
+    if pa.types.is_timestamp(tp):
+        values = [
+            None if v is None else datetime.datetime.fromisoformat(str(v))
+            for v in values
+        ]
+        return pa.array(values, type=tp)
+    if pa.types.is_date(tp):
+        values = [
+            None if v is None else datetime.date.fromisoformat(str(v))
+            for v in values
+        ]
+        return pa.array(values, type=tp)
+    if pa.types.is_floating(tp):
+        # sqlite may hand back ints for REAL columns holding whole numbers
+        values = [None if v is None else float(v) for v in values]
+        return pa.array(values, type=tp)
+    return pa.array(values, type=tp)
+
+
+def _drop_table_quietly(connection: Any, table: str) -> None:
+    """weakref-finalizer body: best-effort DROP of a released temp table
+    (the connection may already be closed at interpreter shutdown)."""
+    try:
+        connection.execute('DROP TABLE IF EXISTS "' + table.replace('"', '""') + '"')
+    except Exception:
+        pass

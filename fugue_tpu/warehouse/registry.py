@@ -1,0 +1,51 @@
+"""Register the warehouse (sqlite) engine with the plugin system.
+
+Parity with the reference's backend registries (e.g.
+``fugue_duckdb/registry.py:38-74``): engine available by name
+(``"sqlite"``), inferred from WarehouseDataFrame/sqlite3.Connection
+inputs, and usable as a SQL engine for CONNECT/engine-switch statements.
+"""
+
+import sqlite3
+from typing import Any, List
+
+from ..execution.factory import (
+    infer_execution_engine,
+    parse_execution_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from .dataframe import WarehouseDataFrame
+from .execution_engine import SQLiteExecutionEngine, WarehouseSQLEngine
+
+
+@infer_execution_engine.candidate(
+    lambda objs: any(
+        isinstance(o, (WarehouseDataFrame, sqlite3.Connection)) for o in objs
+    )
+)
+def _infer_warehouse_engine(objs: List[Any]) -> Any:
+    for o in objs:
+        if isinstance(o, WarehouseDataFrame):
+            return o._wh_engine
+        if isinstance(o, sqlite3.Connection):
+            return o
+    return "sqlite"  # pragma: no cover
+
+
+@parse_execution_engine.candidate(
+    lambda engine, conf, **kwargs: isinstance(engine, sqlite3.Connection),
+    priority=1.5,
+)
+def _parse_sqlite_connection(engine: Any, conf: Any, **kwargs: Any) -> Any:
+    return SQLiteExecutionEngine(conf, connection=engine)
+
+
+def _register() -> None:
+    register_execution_engine(
+        "sqlite", lambda conf, **kwargs: SQLiteExecutionEngine(conf)
+    )
+    register_sql_engine("sqlite", lambda engine: WarehouseSQLEngine(engine))
+
+
+_register()
