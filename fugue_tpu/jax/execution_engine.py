@@ -2821,6 +2821,8 @@ class JaxExecutionEngine(ExecutionEngine):
             self._mesh, agg_entries, buckets
         )
         arr_names = tuple(s[0] for s in agg_sig)
+        from ..ops.segment import _DENSE_SUM_BACKEND
+
         cache_key = (
             "dense_fused",
             self._mesh,
@@ -2828,6 +2830,7 @@ class JaxExecutionEngine(ExecutionEngine):
             agg_sig,
             spec_rows,
             key_dtype,
+            _DENSE_SUM_BACKEND[0],
         )
         if cache_key not in self._jit_cache:
             from jax.sharding import NamedSharding

@@ -291,6 +291,34 @@ def _get_compiled_mask(mesh: Any):
 # max bucket table size for the dense (sort-free) groupby path
 _DENSE_MAX_RANGE = 1 << 18
 
+# float32 SUM engine inside the dense kernel: "scatter" (XLA scatter-add,
+# the default), "onehot" (chunked one-hot MXU matmul, jnp), or "pallas"
+# (the Pallas TPU kernel in ops/pallas_groupby.py). Overridable via env
+# FUGUE_TPU_DENSE_SUM or set_dense_sum_backend(); the default stays
+# "scatter" until an on-chip A/B picks the winner (BASELINE.md).
+import os as _os
+
+_DENSE_SUM_BACKENDS = ("scatter", "onehot", "pallas")
+
+
+def _read_backend_env() -> str:
+    raw = _os.environ.get("FUGUE_TPU_DENSE_SUM", "scatter").strip().lower()
+    if raw not in _DENSE_SUM_BACKENDS:
+        raise ValueError(
+            f"FUGUE_TPU_DENSE_SUM={raw!r} is not one of {_DENSE_SUM_BACKENDS}"
+        )
+    return raw
+
+
+_DENSE_SUM_BACKEND = [_read_backend_env()]
+
+
+def set_dense_sum_backend(name: str) -> None:
+    if name not in _DENSE_SUM_BACKENDS:
+        raise ValueError(f"unknown dense sum backend {name!r}")
+    _DENSE_SUM_BACKEND[0] = name
+    _COMPILE_CACHE.clear()  # compiled programs bake the backend in
+
 
 def _get_compiled_minmax(mesh: Any):
     import jax
@@ -340,7 +368,7 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
     from ..parallel.mesh import ROW_AXIS
 
     agg_sig, num_vals = _norm_specs(agg_sig)
-    cache_key = ("dense", mesh, buckets, agg_sig)
+    cache_key = ("dense", mesh, buckets, agg_sig, _DENSE_SUM_BACKEND[0])
     if cache_key not in _COMPILE_CACHE:
 
         def kernel(k: Any, kmin: Any, *rest: Any):
@@ -353,12 +381,26 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
                 ),
                 ROW_AXIS,
             )
+            def sum_of(a: Any) -> Any:
+                if (
+                    _DENSE_SUM_BACKEND[0] != "scatter"
+                    and a.dtype == jnp.float32
+                ):
+                    # one-hot MXU matmul path (ops/pallas_groupby.py):
+                    # scatter on TPU serializes; histograms ride the MXU.
+                    # float32 only — the MXU has no 64-bit path, so f64
+                    # exactness keeps the scatter/XLA-emulation route
+                    from .pallas_groupby import bin_sum_idx
+
+                    return bin_sum_idx(idx, a, buckets, _DENSE_SUM_BACKEND[0])
+                return jnp.zeros(buckets, dtype=a.dtype).at[idx].add(a)
+
             outs = _agg_outputs(
                 jnp,
                 agg_sig,
                 values,
                 valid,
-                sum_of=lambda a: jnp.zeros(buckets, dtype=a.dtype).at[idx].add(a),
+                sum_of=sum_of,
                 min_of=lambda a: (
                     jnp.full(buckets, _max_of(jnp, a.dtype), dtype=a.dtype)
                     .at[idx]

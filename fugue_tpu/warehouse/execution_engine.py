@@ -201,6 +201,14 @@ class WarehouseExecutionEngine(ExecutionEngine):
             if connection is not None
             else sqlite3.connect(path, check_same_thread=False)
         )
+        if self._own_connection:
+            # engines created as private sessions (e.g. CONNECT sqlite's
+            # WarehouseSQLEngine) have no stop() caller — close the owned
+            # connection when the engine is released. Frames keep the
+            # engine alive, so a finalized engine has no live frames.
+            import weakref
+
+            weakref.finalize(self, _close_quietly, self._connection)
         self._schemas: Dict[str, Schema] = {}
         self._local_engine = NativeExecutionEngine(conf)
         self._log = logging.getLogger("fugue_tpu.warehouse")
@@ -401,7 +409,16 @@ class WarehouseExecutionEngine(ExecutionEngine):
             return "NULL"
         if isinstance(value, bool):
             return "1" if value else "0"
-        if isinstance(value, (int, float)):
+        if isinstance(value, float):
+            import math
+
+            if math.isnan(value):
+                return "NULL"  # SQL has no NaN literal; NULL is its storage
+            if math.isinf(value):
+                # sqlite parses out-of-range literals to ±Infinity
+                return "9e999" if value > 0 else "-9e999"
+            return repr(value)
+        if isinstance(value, int):
             return repr(value)
         if isinstance(value, bytes):
             return "X'" + value.hex() + "'"
@@ -441,44 +458,42 @@ class WarehouseExecutionEngine(ExecutionEngine):
             f"a.{self.encode_name(k)} = b.{self.encode_name(k)}" for k in keys
         )
 
-        def _sel(side_a: str = "a", side_b: str = "b") -> str:
+        def _sel(key_side: str, coalesce_keys: bool = False) -> str:
+            """Projection in end-schema order: key columns read from
+            ``key_side`` (COALESCEd across sides for full outer), non-key
+            columns from the side that owns them."""
             cols = []
             for n in end_schema.names:
+                en = self.encode_name(n)
                 if n in keys:
+                    other = "b" if key_side == "a" else "a"
                     cols.append(
-                        f"COALESCE({side_a}.{self.encode_name(n)}, "
-                        f"{side_b}.{self.encode_name(n)}) AS {self.encode_name(n)}"
-                        if how_l == "fullouter"
-                        else f"{side_a}.{self.encode_name(n)} AS {self.encode_name(n)}"
+                        f"COALESCE({key_side}.{en}, {other}.{en}) AS {en}"
+                        if coalesce_keys
+                        else f"{key_side}.{en} AS {en}"
                     )
-                elif n in d1.schema:
-                    cols.append(f"a.{self.encode_name(n)} AS {self.encode_name(n)}")
                 else:
-                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
+                    side = "a" if n in d1.schema else "b"
+                    cols.append(f"{side}.{en} AS {en}")
             return ", ".join(cols)
 
         if how_l == "cross":
-            sql = f"SELECT {_sel()} FROM {a} AS a CROSS JOIN {b} AS b"
+            sql = f"SELECT {_sel('a')} FROM {a} AS a CROSS JOIN {b} AS b"
         elif how_l == "inner":
-            sql = f"SELECT {_sel()} FROM {a} AS a JOIN {b} AS b ON {on_clause}"
+            sql = f"SELECT {_sel('a')} FROM {a} AS a JOIN {b} AS b ON {on_clause}"
         elif how_l == "leftouter":
-            sql = f"SELECT {_sel()} FROM {a} AS a LEFT JOIN {b} AS b ON {on_clause}"
+            sql = f"SELECT {_sel('a')} FROM {a} AS a LEFT JOIN {b} AS b ON {on_clause}"
         elif how_l == "rightouter":
             # mirrored left join; the right side owns the key values
-            cols = []
-            for n in end_schema.names:
-                if n in keys:
-                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
-                elif n in d1.schema:
-                    cols.append(f"a.{self.encode_name(n)} AS {self.encode_name(n)}")
-                else:
-                    cols.append(f"b.{self.encode_name(n)} AS {self.encode_name(n)}")
             sql = (
-                f"SELECT {', '.join(cols)} FROM {b} AS b "
+                f"SELECT {_sel('b')} FROM {b} AS b "
                 f"LEFT JOIN {a} AS a ON {on_clause}"
             )
         elif how_l == "fullouter":
-            sql = f"SELECT {_sel()} FROM {a} AS a FULL OUTER JOIN {b} AS b ON {on_clause}"
+            sql = (
+                f"SELECT {_sel('a', coalesce_keys=True)} FROM {a} AS a "
+                f"FULL OUTER JOIN {b} AS b ON {on_clause}"
+            )
         elif how_l in ("semi", "leftsemi"):
             cond = " AND ".join(
                 f"b.{self.encode_name(k)} = a.{self.encode_name(k)}" for k in keys
@@ -577,6 +592,9 @@ class WarehouseExecutionEngine(ExecutionEngine):
         assert_or_throw(
             all(n in d.schema for n in names),
             FugueInvalidOperation(f"{names} not a subset of {d.schema}"),
+        )
+        assert_or_throw(
+            how in ("any", "all"), ValueError(f"how must be 'any' or 'all', got {how!r}")
         )
         nn = [f"({self.encode_name(n)} IS NOT NULL)" for n in names]
         if thresh is not None:
@@ -757,14 +775,12 @@ class SQLiteExecutionEngine(WarehouseExecutionEngine):
     engine name ``"sqlite"``. ``conf["fugue.sqlite.path"]`` selects a DB
     file; default is in-memory."""
 
-    def __init__(self, conf: Any = None, connection: Any = None):
-        path = ":memory:"
-        try:
-            from .._utils.params import ParamDict
+    def __init__(self, conf: Any = None, connection: Any = None, **kwargs: Any):
+        from .._utils.params import ParamDict
 
-            path = ParamDict(conf).get_or_none("fugue.sqlite.path", str) or ":memory:"
-        except Exception:
-            pass
+        # a malformed path must fail loudly — silently opening :memory:
+        # would let save_table writes vanish with the process
+        path = ParamDict(conf).get_or_none("fugue.sqlite.path", str) or ":memory:"
         super().__init__(conf, connection=connection, path=path)
 
 
@@ -819,6 +835,14 @@ def _storage_to_arrow(values: List[Any], tp: pa.DataType) -> pa.Array:
         values = [None if v is None else float(v) for v in values]
         return pa.array(values, type=tp)
     return pa.array(values, type=tp)
+
+
+def _close_quietly(connection: Any) -> None:
+    """weakref-finalizer body: best-effort close of an owned connection."""
+    try:
+        connection.close()
+    except Exception:
+        pass
 
 
 def _drop_table_quietly(connection: Any, table: str) -> None:

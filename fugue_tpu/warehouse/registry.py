@@ -12,11 +12,9 @@ from typing import Any, List
 from ..execution.factory import (
     infer_execution_engine,
     parse_execution_engine,
-    register_execution_engine,
-    register_sql_engine,
 )
 from .dataframe import WarehouseDataFrame
-from .execution_engine import SQLiteExecutionEngine, WarehouseSQLEngine
+from .execution_engine import SQLiteExecutionEngine
 
 
 @infer_execution_engine.candidate(
@@ -41,11 +39,6 @@ def _parse_sqlite_connection(engine: Any, conf: Any, **kwargs: Any) -> Any:
     return SQLiteExecutionEngine(conf, connection=engine)
 
 
-def _register() -> None:
-    register_execution_engine(
-        "sqlite", lambda conf, **kwargs: SQLiteExecutionEngine(conf)
-    )
-    register_sql_engine("sqlite", lambda engine: WarehouseSQLEngine(engine))
-
-
-_register()
+# NOTE the "sqlite" engine/SQL-engine NAMES register lazily in
+# fugue_tpu/execution/__init__.py (the single registration site, same
+# pattern as "jax"/"tpu") — this module adds only inference/parsing

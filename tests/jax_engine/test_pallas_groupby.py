@@ -1,0 +1,148 @@
+"""One-hot MXU binned reductions (ops/pallas_groupby.py): the standalone
+ops, the Pallas kernel in interpreter mode, and the engine-level backend
+switch — all oracle-checked. On real TPUs the same kernels run compiled;
+the backend default stays "scatter" until the on-chip A/B (BASELINE.md)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.ops.pallas_groupby import (
+    bin_sum_count_pallas,
+    bin_sum_count_xla,
+    bin_sum_idx,
+    bin_sum_pallas,
+)
+from fugue_tpu.ops.segment import set_dense_sum_backend
+
+
+def _oracle(keys, vals, valid, buckets):
+    s = np.zeros(buckets, np.float64)
+    c = np.zeros(buckets, np.int64)
+    for k, v, m in zip(keys, vals, valid):
+        if m:
+            s[k] += v
+            c[k] += 1
+    return s, c
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    n, buckets = 5_000, 256
+    return (
+        rng.integers(0, 200, n).astype(np.int32),
+        rng.random(n).astype(np.float32),
+        rng.random(n) > 0.1,
+        buckets,
+    )
+
+
+def test_xla_onehot_matches_oracle(data):
+    keys, vals, valid, buckets = data
+    exp_s, exp_c = _oracle(keys, vals, valid, buckets)
+    s, c = bin_sum_count_xla(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(valid), buckets
+    )
+    assert np.allclose(np.asarray(s), exp_s, atol=1e-3)
+    assert (np.asarray(c) == exp_c).all()
+
+
+def test_pallas_kernel_interpret_matches_oracle(data):
+    keys, vals, valid, buckets = data
+    exp_s, exp_c = _oracle(keys, vals, valid, buckets)
+    s, c = bin_sum_count_pallas(
+        jnp.asarray(keys),
+        jnp.asarray(vals),
+        jnp.asarray(valid),
+        buckets,
+        interpret=True,
+    )
+    assert np.allclose(np.asarray(s), exp_s, atol=1e-3)
+    assert (np.asarray(c) == exp_c).all()
+
+
+def test_sum_only_pallas_kernel(data):
+    keys, vals, valid, buckets = data
+    exp_s, _ = _oracle(keys, vals, valid, buckets)
+    s = bin_sum_pallas(
+        jnp.asarray(keys),
+        jnp.asarray(vals),
+        jnp.asarray(valid),
+        buckets,
+        interpret=True,
+    )
+    assert np.allclose(np.asarray(s), exp_s, atol=1e-3)
+
+
+def test_bin_sum_idx_equals_scatter(data):
+    keys, vals, valid, buckets = data
+    masked = jnp.where(jnp.asarray(valid), jnp.asarray(vals), 0.0)
+    scatter = jnp.zeros(buckets, jnp.float32).at[jnp.asarray(keys)].add(masked)
+    onehot = bin_sum_idx(jnp.asarray(keys), masked, buckets, "onehot")
+    assert np.allclose(np.asarray(scatter), np.asarray(onehot), atol=1e-3)
+
+
+def test_engine_aggregate_under_onehot_backend():
+    # the full device aggregate must produce identical results whichever
+    # sum engine the dense kernel uses (f32 column → one-hot eligible)
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 100, 20_000),
+            "v": rng.random(20_000).astype(np.float32),
+        }
+    )
+    eng = JaxExecutionEngine()
+    spec = PartitionSpec(by=["k"])
+    aggs = lambda: [  # noqa: E731
+        ff.sum(col("v")).alias("s"),
+        ff.count(col("v")).alias("n"),
+    ]
+    base = (
+        eng.aggregate(eng.to_df(pdf), spec, aggs())
+        .as_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    set_dense_sum_backend("onehot")
+    try:
+        got = (
+            eng.aggregate(eng.to_df(pdf), spec, aggs())
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+    finally:
+        set_dense_sum_backend("scatter")
+    assert (got["k"] == base["k"]).all()
+    assert (got["n"].to_numpy() == base["n"].to_numpy()).all()
+    assert np.allclose(got["s"], base["s"], rtol=1e-5)
+
+
+def test_f64_columns_keep_scatter_even_under_onehot():
+    # f64 exactness must never route through the f32 MXU path
+    pdf = pd.DataFrame({"k": [0, 0, 1], "v": [1e-12, 1.0, 2.0]})
+    eng = JaxExecutionEngine()
+    set_dense_sum_backend("onehot")
+    try:
+        got = (
+            eng.aggregate(
+                eng.to_df(pdf),
+                PartitionSpec(by=["k"]),
+                [ff.sum(col("v")).alias("s")],
+            )
+            .as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+    finally:
+        set_dense_sum_backend("scatter")
+    # 1e-12 + 1.0 survives only in f64 accumulation (f32 rounds it away)
+    assert got["s"][0] == 1.0 + 1e-12 and got["s"][0] != 1.0
