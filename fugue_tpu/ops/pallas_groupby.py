@@ -21,6 +21,14 @@ Both compute per-bucket SUM and COUNT of float32 values in one pass.
 float32 only: the MXU has no 64-bit path — f64 aggregation keeps the
 scatter/XLA-emulation route (see ``ops/segment.py``), a deliberate
 precision/speed split the engine picks per column dtype.
+
+Exactness bound: the COUNT table also accumulates in float32 through the
+matmul, so counts are exact only up to 2**24 rows per bucket — above
+that, float32 cannot represent every integer and increments are lost.
+The engine's dense-groupby path is NOT exposed to this: it keeps COUNT
+in an int64 scatter (``segment.py``) and only routes the f32 SUM through
+these kernels. Direct callers needing bigger per-bucket counts should
+split their input or use the engine path.
 """
 
 from typing import Any, Tuple
@@ -142,22 +150,26 @@ def _pallas_binned(kernel, n_out: int, keys, values, valid, buckets, interpret):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    # the accumulator's last dim must tile to the TPU's 128-lane registers
+    # — a BlockSpec over e.g. (1, 2) buckets fails or misbehaves on real
+    # hardware, so round the bucket table up and slice the result back
+    lanes = ((buckets + 127) // 128) * 128
     keys, values, valid, n_chunks = _pad_inputs(keys, values, valid, buckets)
     kc = keys.reshape(n_chunks, CHUNK)
     vc = values.astype(jnp.float32).reshape(n_chunks, CHUNK)
     mc = valid.astype(jnp.float32).reshape(n_chunks, CHUNK)
 
     row_spec = pl.BlockSpec((1, CHUNK), lambda i: (i, 0))
-    acc_spec = pl.BlockSpec((1, buckets), lambda i: (0, 0))
+    acc_spec = pl.BlockSpec((1, lanes), lambda i: (0, 0))
     out = pl.pallas_call(
         kernel,
         grid=(n_chunks,),
         in_specs=[row_spec, row_spec, row_spec],
         out_specs=[acc_spec] * n_out,
-        out_shape=[jax.ShapeDtypeStruct((1, buckets), jnp.float32)] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((1, lanes), jnp.float32)] * n_out,
         interpret=interpret,
     )(kc, vc, mc)
-    return out
+    return [o[:, :buckets] for o in out]
 
 
 def bin_sum_pallas(

@@ -30,9 +30,16 @@ class WarehouseDataFrame(DataFrame):
     ``fugue_ibis/dataframe.py:23`` — an IbisTable wrapper with the same
     fetch-on-demand contract)."""
 
-    def __init__(self, engine: Any, table: str, schema: Any):
+    def __init__(
+        self, engine: Any, table: str, schema: Any, snapshot: bool = True
+    ):
         self._wh_engine = engine
         self._table = table
+        # snapshot=False for frames bound to persistent NAMED tables
+        # (load_table): those can be overwritten underneath the frame, so
+        # count() must not be memoized for them
+        self._snapshot = snapshot
+        self._count: Optional[int] = None
         super().__init__(schema if isinstance(schema, Schema) else Schema(schema))
 
     @property
@@ -64,10 +71,16 @@ class WarehouseDataFrame(DataFrame):
         return self.count() == 0
 
     def count(self) -> int:
-        cur = self._wh_engine.connection.execute(
-            f"SELECT COUNT(*) FROM {self._wh_engine.encode_name(self._table)}"
-        )
-        return int(cur.fetchone()[0])
+        # temp frames are immutable snapshots of materialized tables, so
+        # the count is computed once — validators hammer count()/empty and
+        # a remote DB-API warehouse would otherwise pay a round-trip each
+        # time; named-table frames (snapshot=False) always re-query
+        if self._count is None or not self._snapshot:
+            cur = self._wh_engine.connection.execute(
+                f"SELECT COUNT(*) FROM {self._wh_engine.encode_name(self._table)}"
+            )
+            self._count = int(cur.fetchone()[0])
+        return self._count
 
     def peek_array(self) -> List[Any]:
         head = self.head(1)

@@ -145,7 +145,9 @@ class WarehouseSQLEngine(SQLEngine):
             self.table_exists(table),
             FugueInvalidOperation(f"table {table} doesn't exist"),
         )
-        return WarehouseDataFrame(eng, table, eng.infer_table_schema(table))
+        return WarehouseDataFrame(
+            eng, table, eng.infer_table_schema(table), snapshot=False
+        )
 
 
 class WarehouseMapEngine(MapEngine):
@@ -331,7 +333,16 @@ class WarehouseExecutionEngine(ExecutionEngine):
     def infer_table_schema(self, table: str) -> Schema:
         """Schema of a warehouse table: recorded if known, else inferred
         from sqlite column decltypes + value sampling (the price of a
-        dynamically-typed warehouse; recorded schemas are authoritative)."""
+        dynamically-typed warehouse; recorded schemas are authoritative).
+
+        Known degradation: a raw-SQL SELECT whose computed columns carry
+        no decltype AND whose result set is empty has nothing to sample,
+        so those columns fall back to string (the reference avoids this
+        by compiling ibis expressions, which carry types end-to-end —
+        `/root/reference/fugue_ibis/execution_engine.py:41-58`; a plain
+        DB-API cursor has no equivalent). Recorded schemas — every table
+        produced by ingest/temp_frame/save_table — never hit this path.
+        """
         if table in self._schemas:
             return self._schemas[table]
         cur = self._connection.execute(
@@ -660,15 +671,40 @@ class WarehouseExecutionEngine(ExecutionEngine):
             NotImplementedError("warehouse sample doesn't support replacement"),
         )
         d = self.to_df(df)
+        cols = ", ".join(self.encode_name(c) for c in d.schema.names)
         if seed is not None:
-            self.log.warning("warehouse sample ignores seed (sqlite random())")
-        if frac is not None:
+            # deterministic seeded sample: a golden-ratio multiplicative
+            # hash of a generated row number mixed with the seed stands in
+            # for random() — same seed + same table contents = same
+            # sample, matching the other engines' reproducibility contract
+            # (consecutive row numbers step by ~0.618 * 2^32 mod 2^32, the
+            # Weyl equidistribution). ROW_NUMBER() rather than rowid: a
+            # user column named "rowid" shadows sqlite's, and views have
+            # none.
+            h = (
+                f"(((__ft_rn + {int(seed) & 0x7FFFFFFF}) * 2654435761) "
+                "% 4294967296)"
+            )
+            src = (
+                f"(SELECT {cols}, ROW_NUMBER() OVER () AS __ft_rn "
+                f"FROM {self.encode_name(d.table)})"
+            )
+            if frac is not None:
+                sql = (
+                    f"SELECT {cols} FROM {src} "
+                    f"WHERE ({h} / 4294967296.0) < {float(frac)}"
+                )
+            else:
+                sql = f"SELECT {cols} FROM {src} ORDER BY {h} LIMIT {int(n)}"
+        elif frac is not None:
             # random() is a signed 64-bit int; map onto [0, 1)
-            cond = f"(random() / 18446744073709551616.0 + 0.5) < {float(frac)}"
-            sql = f"SELECT * FROM {self.encode_name(d.table)} WHERE {cond}"
+            sql = (
+                f"SELECT {cols} FROM {self.encode_name(d.table)} "
+                f"WHERE (random() / 18446744073709551616.0 + 0.5) < {float(frac)}"
+            )
         else:
             sql = (
-                f"SELECT * FROM {self.encode_name(d.table)} "
+                f"SELECT {cols} FROM {self.encode_name(d.table)} "
                 f"ORDER BY random() LIMIT {int(n)}"
             )
         return self.temp_frame(self.materialize(sql), d.schema)

@@ -289,3 +289,50 @@ def test_load_save_df_files(eng, tmp_path, wdf):
     back = eng.load_df(p)
     assert isinstance(back, WarehouseDataFrame)
     assert sorted(back.as_array()) == sorted(wdf.as_array())
+
+
+def test_seeded_sample_is_deterministic(eng):
+    pdf = pd.DataFrame({"a": range(200), "b": np.arange(200) * 0.5})
+    d = eng.to_df(pdf)
+    s1 = eng.sample(d, frac=0.3, seed=42).as_pandas().sort_values("a")
+    s2 = eng.sample(d, frac=0.3, seed=42).as_pandas().sort_values("a")
+    pd.testing.assert_frame_equal(s1.reset_index(drop=True), s2.reset_index(drop=True))
+    assert 20 < len(s1) < 100  # roughly frac * 200
+    s3 = eng.sample(d, frac=0.3, seed=7).as_pandas()
+    assert set(s3["a"]) != set(s1["a"])  # different seed, different rows
+    n1 = eng.sample(d, n=17, seed=5).as_pandas().sort_values("a")
+    n2 = eng.sample(d, n=17, seed=5).as_pandas().sort_values("a")
+    assert len(n1) == 17
+    pd.testing.assert_frame_equal(n1.reset_index(drop=True), n2.reset_index(drop=True))
+
+
+def test_count_memoized_single_query(eng, wdf):
+    calls = []
+    eng.connection.set_trace_callback(calls.append)
+    try:
+        assert wdf.count() == 5
+        assert wdf.count() == 5
+        assert not wdf.empty
+    finally:
+        eng.connection.set_trace_callback(None)
+    count_queries = [s for s in calls if "COUNT(*)" in s]
+    assert len(count_queries) <= 1
+
+
+def test_seeded_sample_with_rowid_column_and_load_table_count(eng):
+    # a user column named "rowid" must not shadow the sample's row hash
+    pdf = pd.DataFrame({"rowid": [f"r{i}" for i in range(100)], "v": range(100)})
+    d = eng.to_df(pdf)
+    s = eng.sample(d, frac=0.3, seed=42).as_pandas()
+    assert 10 < len(s) < 60
+    assert set(s.columns) == {"rowid", "v"}
+    n = eng.sample(d, n=10, seed=1).as_pandas()
+    assert len(n) == 10 and sorted(n["v"]) != list(range(10))
+
+    # load_table frames track overwrites (no stale memoized count)
+    sql_eng = eng.sql_engine
+    sql_eng.save_table(eng.to_df(pd.DataFrame({"a": [1, 2, 3]})), "t_mut")
+    f = sql_eng.load_table("t_mut")
+    assert f.count() == 3
+    sql_eng.save_table(eng.to_df(pd.DataFrame({"a": [1, 2, 3, 4, 5]})), "t_mut")
+    assert f.count() == 5
