@@ -46,6 +46,13 @@ FUGUE_TPU_CONF_DENSE_MAP_RANGE = "fugue.tpu.map.dense_range"
 # keep the ingestion arrow table alive on JaxDataFrames for zero-cost host
 # reads (global conf; ~2x host memory on ingest-heavy pipelines when True)
 FUGUE_TPU_CONF_INGEST_CACHE = "fugue.tpu.ingest_cache"
+# streaming (out-of-core) execution: rows per host->device chunk; the
+# device working set is O(chunk_rows x columns), NOT O(dataset)
+FUGUE_TPU_CONF_STREAM_CHUNK_ROWS = "fugue.tpu.stream.chunk_rows"
+# "lo,hi" inclusive int key range for streaming dense aggregates; without
+# it the range is probed from the FIRST chunk only, and any later
+# out-of-range key raises (one-pass streams can't be re-scanned)
+FUGUE_TPU_CONF_STREAM_KEY_RANGE = "fugue.tpu.stream.key_range"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

@@ -24,6 +24,15 @@ class CreateData(Creator):
         schema = self.params.get_or_none("schema", object)
         if isinstance(data, Yielded):
             return self.execution_engine.load_yielded(data)
-        if isinstance(data, DataFrame):
-            return self.execution_engine.to_df(data, schema=schema)
+        if (
+            isinstance(data, DataFrame)
+            and data.is_local
+            and not data.is_bounded
+            and schema is None
+        ):
+            # one-pass stream frames enter the DAG lazily — eager to_df
+            # would materialize them; downstream verbs with a streaming
+            # plan (aggregate, keyless compiled map) consume them
+            # out-of-core, everything else converts at its own to_df
+            return data
         return self.execution_engine.to_df(data, schema=schema)

@@ -97,6 +97,15 @@ class JaxMapEngine(MapEngine):
         )
         if map_func_format_hint == "jax":
             raw = _sniff_jax_func(map_func)
+            if raw is not None and len(partition_spec.partition_by) == 0:
+                from .streaming import is_stream_frame, streaming_compiled_map
+
+                if is_stream_frame(df):
+                    # one-pass stream + keyless compiled UDF: chunk-wise
+                    # out-of-core map — never materializes on device
+                    return streaming_compiled_map(
+                        engine, df, raw, output_schema, on_init
+                    )
             if raw is not None:
                 jdf = engine.to_df(df)
                 keys = list(partition_spec.partition_by)
@@ -2905,7 +2914,19 @@ class JaxExecutionEngine(ExecutionEngine):
         """Two-phase device groupby when keys+values are device-resident."""
         from ..column.expressions import _FuncExpr, _NamedColumnExpr
         from ..ops.segment import device_groupby_partials, merge_partials
+        from .streaming import is_stream_frame, streaming_dense_aggregate
 
+        if is_stream_frame(df):
+            # one-pass stream: chunked ingestion + device-resident
+            # accumulators (out-of-core); ineligible plans fall through to
+            # materialization below
+            res = streaming_dense_aggregate(self, df, partition_spec, agg_cols)
+            if res is not None:
+                return res
+            self.log.warning(
+                "streaming aggregate ineligible for this plan; "
+                "materializing the stream"
+            )
         jdf = self.to_df(df)
         keys = list(partition_spec.partition_by) if partition_spec is not None else []
         plan = _plan_device_agg(jdf, keys, agg_cols)

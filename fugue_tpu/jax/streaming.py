@@ -1,0 +1,550 @@
+"""Streaming (out-of-core) device execution — SURVEY §5.7's TPU answer.
+
+The reference never materializes a whole partition when it can stream:
+Spark's pandas-UDF path iterates record batches through the executor
+(`/root/reference/fugue_spark/execution_engine.py:262-294`) and chunked
+map outputs flow as `LocalDataFrameIterableDataFrame`
+(`/root/reference/fugue/dataframe/dataframe_iterable_dataframe.py:21`).
+A `JaxDataFrame` instead puts every column fully on device, capping the
+engine at HBM (~16GB on a v5e chip). This module removes that cap for
+the two hot verbs:
+
+- **aggregate** — `streaming_dense_aggregate`: arrow/pandas chunks feed
+  the dense-bucket groupby kernel (`ops/segment.py`) one fixed-capacity
+  device batch at a time; per-bucket SUM/COUNT/MIN/MAX tables are
+  DEVICE-RESIDENT accumulators merged chunk-by-chunk in one jitted step
+  (donated, so XLA updates them in place). Device working set =
+  O(chunk_rows × columns + buckets), independent of dataset size — the
+  only road to the 1B-row north star (`BASELINE.json`).
+- **transform** — `streaming_compiled_map`: a jax-annotated row-wise UDF
+  compiled ONCE for a fixed chunk capacity, applied chunk-wise; outputs
+  stream back to the host as a one-pass `LocalDataFrameIterableDataFrame`
+  so neither input nor output ever fully materializes on device.
+
+Both paths bound device memory by `fugue.tpu.stream.chunk_rows`
+(default 2^20 rows). `last_run_stats` records the measured peak live
+device bytes of the most recent streaming run so tests (and users) can
+PROVE the bound held.
+"""
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from .._utils.assertion import assert_or_throw
+from ..constants import (
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    FUGUE_TPU_CONF_STREAM_KEY_RANGE,
+)
+from ..dataframe import (
+    ArrowDataFrame,
+    DataFrame,
+    IterableDataFrame,
+    LocalDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+)
+from ..exceptions import FugueInvalidOperation
+from ..schema import Schema
+
+DEFAULT_CHUNK_ROWS = 1 << 20
+
+# peak live device bytes + chunk count of the most recent streaming run —
+# the proof artifact that out-of-core execution really is out-of-core
+last_run_stats: Dict[str, Any] = {}
+
+
+def is_stream_frame(df: Any) -> bool:
+    """Frames that are one-pass row streams (must NOT be materialized)."""
+    return isinstance(df, (IterableDataFrame, LocalDataFrameIterableDataFrame))
+
+
+def stream_parquet(
+    path: Any, columns: Optional[List[str]] = None, chunk_rows: int = DEFAULT_CHUNK_ROWS
+) -> LocalDataFrameIterableDataFrame:
+    """Open parquet file(s) as a one-pass stream of arrow chunks — the
+    out-of-core loader (datasets ≫ host/device memory never materialize).
+    """
+    import pyarrow.parquet as pq
+
+    paths = [path] if isinstance(path, str) else list(path)
+    first_schema = pq.ParquetFile(paths[0]).schema_arrow
+    if columns is not None:
+        first_schema = pa.schema([first_schema.field(c) for c in columns])
+
+    def gen() -> Iterator[pa.Table]:
+        for p in paths:
+            f = pq.ParquetFile(p)
+            for batch in f.iter_batches(batch_size=chunk_rows, columns=columns):
+                yield pa.Table.from_batches([batch])
+
+    return LocalDataFrameIterableDataFrame(
+        (ArrowDataFrame(t) for t in gen()), schema=Schema(first_schema)
+    )
+
+
+# --------------------------------------------------------------------------
+# chunk normalization: any stream frame -> iterator of column dicts
+# --------------------------------------------------------------------------
+
+
+def _iter_local_frames(df: Any, chunk_rows: int) -> Iterator[LocalDataFrame]:
+    if isinstance(df, LocalDataFrameIterableDataFrame):
+        yield from df.native
+    elif isinstance(df, IterableDataFrame):
+        # row stream -> bounded row batches
+        from itertools import islice
+
+        it = iter(df.native)
+        schema = df.schema
+        while True:
+            rows = list(islice(it, chunk_rows))
+            if len(rows) == 0:
+                return
+            from ..dataframe import ArrayDataFrame
+
+            yield ArrayDataFrame(rows, schema)
+    elif isinstance(df, DataFrame):
+        yield df.as_local_bounded()
+    else:
+        raise FugueInvalidOperation(f"can't stream from {type(df)}")
+
+
+def _rechunk(
+    frames: Iterator[LocalDataFrame], capacity: int
+) -> Iterator[LocalDataFrame]:
+    """Split oversized chunks so no device batch exceeds ``capacity``
+    (undersized chunks pass through; padding absorbs them)."""
+    for f in frames:
+        n = f.count()
+        if n <= capacity:
+            if n > 0:
+                yield f
+            continue
+        if isinstance(f, ArrowDataFrame):
+            tbl = f.native
+            for s in range(0, n, capacity):
+                yield ArrowDataFrame(tbl.slice(s, min(capacity, n - s)))
+        else:
+            pdf = f.as_pandas()
+            for s in range(0, n, capacity):
+                yield PandasDataFrame(
+                    pdf.iloc[s : s + capacity], f.schema
+                )
+
+
+def _chunk_columns(
+    f: LocalDataFrame, names: List[str]
+) -> Tuple[int, Dict[str, np.ndarray], Dict[str, int]]:
+    """(row_count, {name: numpy}, {name: null_count}) for one chunk.
+
+    Float nulls surface as NaN (the device NULL); int/bool null counts are
+    returned so the caller can reject them (the streaming plan has no mask
+    channel — a later chunk must not silently change the type contract
+    the first chunk established).
+    """
+    cols: Dict[str, np.ndarray] = {}
+    nulls: Dict[str, int] = {}
+    if isinstance(f, ArrowDataFrame):
+        tbl = f.native
+        n = tbl.num_rows
+        for name in names:
+            col = tbl.column(name)
+            nulls[name] = col.null_count
+            cols[name] = np.asarray(col.to_numpy(zero_copy_only=False))
+    else:
+        pdf = f.as_pandas()
+        n = len(pdf)
+        for name in names:
+            s = pdf[name]
+            nulls[name] = int(s.isna().sum())
+            cols[name] = s.to_numpy()
+    return n, cols, nulls
+
+
+def _device_peak_bytes() -> int:
+    import jax
+
+    return sum(
+        a.nbytes for a in jax.live_arrays() if getattr(a, "is_deleted", lambda: False)() is False
+    )
+
+
+# --------------------------------------------------------------------------
+# streaming dense aggregate
+# --------------------------------------------------------------------------
+
+
+def _parse_key_range(conf: Any) -> Optional[Tuple[int, int]]:
+    raw = conf.get_or_none(FUGUE_TPU_CONF_STREAM_KEY_RANGE, str)
+    if raw is None or raw == "":
+        return None
+    try:
+        lo, hi = (int(x) for x in str(raw).split(","))
+    except Exception:
+        raise FugueInvalidOperation(
+            f"{FUGUE_TPU_CONF_STREAM_KEY_RANGE} must be 'lo,hi' ints, got {raw!r}"
+        )
+    assert_or_throw(lo <= hi, ValueError(f"empty key range {raw!r}"))
+    return lo, hi
+
+
+def streaming_dense_aggregate(
+    engine: Any,
+    df: Any,
+    partition_spec: Any,
+    agg_cols: List[Any],
+) -> Optional[DataFrame]:
+    """Keyed aggregate over a one-pass stream with device-resident
+    accumulators. Returns None when the plan is ineligible (caller falls
+    back to materializing) — eligibility mirrors the dense device
+    aggregate: ONE plain int key with a bounded range, un-encoded numeric
+    values, sum/count/avg/min/max only.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS, num_row_shards, pad_rows
+    from ..ops.segment import (
+        _DENSE_MAX_RANGE,
+        _get_compiled_dense,
+        dense_buckets,
+    )
+    from .dataframe import JaxDataFrame
+    from .execution_engine import _plan_device_agg
+
+    keys = list(partition_spec.partition_by) if partition_spec is not None else []
+    if len(keys) != 1:
+        return None
+    mesh = engine._mesh
+    shards = num_row_shards(mesh)
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    capacity = pad_rows(max(chunk_rows, shards), shards)
+
+    # eligibility is decided from the SCHEMA alone (via an empty probe
+    # frame) BEFORE any chunk is consumed — a one-pass stream must not
+    # lose its head to a plan that then falls back to materialization
+    empty = pa.Table.from_pylist([], schema=Schema(df.schema).pa_schema)
+    jdf0 = JaxDataFrame(ArrowDataFrame(empty), mesh=mesh)
+    plan = _plan_device_agg(jdf0, keys, agg_cols)
+    if (
+        plan is None
+        or plan["virtual"]
+        or plan["dict_srcs"]
+        or plan["masked_srcs"]
+        or any(p.get("kind") not in ("pass", "avg") for p in plan["post"])
+    ):
+        return None
+    key = keys[0]
+    key_np = np.dtype(jdf0.device_cols[key].dtype)
+    if key_np.kind not in ("i", "u"):
+        return None
+
+    srcs = sorted({s for _, _, s in plan["aggs"]})
+    src_np: Dict[str, np.dtype] = {}
+    for s in srcs:
+        dt = np.dtype(jdf0.device_cols[s].dtype)
+        if dt.kind not in ("i", "u", "f"):
+            return None
+        src_np[s] = dt
+    del jdf0
+
+    key_range = _parse_key_range(engine.conf)
+    if key_range is not None:
+        kmin, kmax = key_range
+        if not (0 < kmax - kmin + 1 <= _DENSE_MAX_RANGE):
+            return None  # declared range too wide for the dense plan
+
+    # ---- the stream is consumed from here on; failures now RAISE ------
+    frames = _rechunk(_iter_local_frames(df, chunk_rows), capacity)
+    try:
+        first = next(frames)
+    except StopIteration:
+        # empty stream: zero groups, correctly-shaped empty result
+        out0 = pd.DataFrame({n: pd.Series(dtype=object) for n in plan["schema"].names})
+        return engine.to_df(PandasDataFrame(out0, plan["schema"]))
+
+    n0, cols0, nulls0 = _chunk_columns(first, [key] + srcs)
+    assert_or_throw(
+        nulls0[key] == 0,
+        FugueInvalidOperation(f"streaming aggregate: NULL in key column {key!r}"),
+    )
+    probed = key_range is None
+    if probed:
+        key_range = (int(cols0[key].min()), int(cols0[key].max()))
+    kmin, kmax = key_range
+    rng = kmax - kmin + 1
+    if not (0 < rng <= _DENSE_MAX_RANGE):
+        raise FugueInvalidOperation(
+            f"streaming aggregate: first-chunk key range [{kmin},{kmax}] "
+            f"exceeds the dense plan bound ({_DENSE_MAX_RANGE}); set "
+            f"{FUGUE_TPU_CONF_STREAM_KEY_RANGE} or pre-bucket the key"
+        )
+    buckets = dense_buckets(rng)
+
+    # value columns dedupe by source; floats are ALWAYS NaN-aware here — a
+    # later chunk may carry NaN where the first did not
+    vidx = {s: i for i, s in enumerate(srcs)}
+    agg_sig = tuple(
+        (name, agg, vidx[src], src_np[src].kind == "f")
+        for name, agg, src in plan["aggs"]
+    )
+    kernel = _get_compiled_dense(mesh, buckets, agg_sig)
+    sharding = NamedSharding(mesh, P(ROW_AXIS))
+    kmin_s = np.int64(kmin)
+
+    # kmin is baked into the traced step as a constant — it MUST key the
+    # cache or a later stream with a shifted range would reuse a stale
+    # shift and scatter into wrong buckets
+    cache_key = ("stream_agg_step", mesh, buckets, agg_sig, capacity, kmin)
+    cache = engine._jit_cache
+    if cache_key not in cache:
+
+        def step(acc: Tuple[Any, ...], k: Any, valid: Any, *vals: Any):
+            import jax.numpy as jnp
+
+            outs = kernel(k, kmin_s, *vals, valid)
+            new = [acc[0] + outs[0]]  # present counts: plain int add
+            for (name, agg, vi, nullable), a, b in zip(
+                agg_sig, acc[1:], outs[1:]
+            ):
+                if agg == "count":
+                    new.append(a + b)
+                elif agg == "sum":
+                    if nullable:
+                        # NaN marks an all-NULL (or absent) bucket in a
+                        # chunk table — it is the merge identity
+                        new.append(
+                            jnp.where(
+                                jnp.isnan(a),
+                                b,
+                                jnp.where(jnp.isnan(b), a, a + b),
+                            )
+                        )
+                    else:
+                        new.append(a + b)
+                elif agg == "min":
+                    new.append(jnp.fmin(a, b) if nullable else jnp.minimum(a, b))
+                elif agg == "max":
+                    new.append(jnp.fmax(a, b) if nullable else jnp.maximum(a, b))
+                else:  # pragma: no cover - plan gate excludes others
+                    raise AssertionError(agg)
+            return tuple(new)
+
+        cache[cache_key] = jax.jit(step, donate_argnums=0)
+    step_fn = cache[cache_key]
+
+    def put_chunk(n: int, cols: Dict[str, np.ndarray], nulls: Dict[str, int]):
+        assert_or_throw(
+            nulls[key] == 0,
+            FugueInvalidOperation(
+                f"streaming aggregate: NULL in key column {key!r}"
+            ),
+        )
+        ck = cols[key]
+        lo, hi = int(ck.min()), int(ck.max())
+        if lo < kmin or hi > kmax:
+            hint = (
+                f"probed from the first chunk as [{kmin},{kmax}]; set "
+                f"{FUGUE_TPU_CONF_STREAM_KEY_RANGE}='lo,hi' to cover the "
+                "full stream"
+                if probed
+                else f"conf {FUGUE_TPU_CONF_STREAM_KEY_RANGE} was [{kmin},{kmax}]"
+            )
+            raise FugueInvalidOperation(
+                f"streaming aggregate: key {key!r} value outside range "
+                f"([{lo},{hi}] seen): {hint}"
+            )
+        kb = np.zeros(capacity, dtype=key_np)
+        kb[:n] = ck
+        valid = np.zeros(capacity, dtype=bool)
+        valid[:n] = True
+        vals = []
+        for s in srcs:
+            if src_np[s].kind != "f":
+                assert_or_throw(
+                    nulls[s] == 0,
+                    FugueInvalidOperation(
+                        f"streaming aggregate: NULL in non-float column "
+                        f"{s!r} (first chunk established a null-free int "
+                        "contract)"
+                    ),
+                )
+            vb = np.zeros(capacity, dtype=src_np[s])
+            vb[:n] = cols[s].astype(src_np[s], copy=False)
+            vals.append(vb)
+        put = jax.device_put([kb, valid] + vals, sharding)
+        return put[0], put[1], put[2:]
+
+    stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+
+    def track() -> None:
+        stats["peak_device_bytes"] = max(
+            stats["peak_device_bytes"], _device_peak_bytes()
+        )
+
+    k0, v0, a0 = put_chunk(n0, cols0, nulls0)
+    acc = kernel(k0, kmin_s, *a0, v0)
+    stats["chunks"], stats["rows"] = 1, n0
+    del k0, v0, a0, cols0, first
+    track()
+    for f in frames:
+        n, cols, nulls = _chunk_columns(f, [key] + srcs)
+        kd, vd, ad = put_chunk(n, cols, nulls)
+        acc = step_fn(acc, kd, vd, *ad)
+        stats["chunks"] += 1
+        stats["rows"] += n
+        del kd, vd, ad, cols, f
+        track()
+
+    # ONE host transfer: the merged tables (O(buckets), not O(rows))
+    for a in acc:
+        a.copy_to_host_async()
+    host = [np.asarray(jax.device_get(a)) for a in acc]
+    track()
+    global last_run_stats
+    last_run_stats = dict(stats, verb="aggregate")
+    present = host[0]
+    (idx,) = np.nonzero(present > 0)
+    merged: Dict[str, Any] = {key: idx.astype(np.int64) + kmin}
+    for (name, _, _, _), table in zip(agg_sig, host[1:]):
+        merged[name] = table[idx]
+    mdf = pd.DataFrame(merged)
+    out = pd.DataFrame()
+    out[key] = mdf[key].astype(key_np)
+    for spec in plan["post"]:
+        out[spec["name"]] = spec["fn"](mdf)
+    return engine.to_df(PandasDataFrame(out, plan["schema"]))
+
+
+# --------------------------------------------------------------------------
+# streaming compiled map
+# --------------------------------------------------------------------------
+
+
+def streaming_compiled_map(
+    engine: Any,
+    df: Any,
+    fn: Callable,
+    output_schema: Schema,
+    on_init: Optional[Callable] = None,
+) -> DataFrame:
+    """Chunk-wise compiled row map over a one-pass stream.
+
+    The jax-annotated UDF is compiled ONCE for a fixed chunk capacity
+    (padding + the ``__valid__`` mask absorb short chunks) and applied per
+    chunk; each output chunk is fetched to the host and yielded, so the
+    result is a one-pass `LocalDataFrameIterableDataFrame` and device
+    memory stays O(chunk) end to end. The streaming analog of
+    `_compiled_map` (same UDF contract: dict of row-aligned arrays in,
+    dict out, ``__valid__`` marks real rows).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS, num_row_shards, pad_rows
+
+    mesh = engine._mesh
+    shards = num_row_shards(mesh)
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    capacity = pad_rows(max(chunk_rows, shards), shards)
+    in_schema = df.schema
+    names = list(in_schema.names)
+    np_dtypes: Dict[str, np.dtype] = {}
+    for f in in_schema.fields:
+        if not (pa.types.is_integer(f.type) or pa.types.is_floating(f.type) or pa.types.is_boolean(f.type)):
+            raise FugueInvalidOperation(
+                f"streaming compiled map needs numeric/bool columns; "
+                f"{f.name} is {f.type} (use a pandas-annotated transformer)"
+            )
+        np_dtypes[f.name] = np.dtype(f.type.to_pandas_dtype())
+    sharding = NamedSharding(mesh, P(ROW_AXIS))
+
+    cache = engine._jit_cache
+    cache_key = ("stream_map", fn, mesh, capacity)
+    if cache_key not in cache:
+        cache[cache_key] = jax.jit(
+            jax.shard_map(fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS))
+        )
+    mapped = cache[cache_key]
+    if on_init is not None:
+        on_init(0, df)
+
+    out_schema = Schema(output_schema)
+    out_names = list(out_schema.names)
+    out_pd_dtypes = {
+        f.name: np.dtype(f.type.to_pandas_dtype()) for f in out_schema.fields
+    }
+
+    def gen() -> Iterator[LocalDataFrame]:
+        stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+        for f in _rechunk(_iter_local_frames(df, chunk_rows), capacity):
+            n, cols, nulls = _chunk_columns(f, names)
+            buf: Dict[str, Any] = {}
+            for c in names:
+                if np_dtypes[c].kind != "f":
+                    assert_or_throw(
+                        nulls[c] == 0,
+                        FugueInvalidOperation(
+                            f"streaming compiled map: NULL in non-float "
+                            f"column {c!r}"
+                        ),
+                    )
+                b = np.zeros(capacity, dtype=np_dtypes[c])
+                b[:n] = cols[c].astype(np_dtypes[c], copy=False)
+                buf[c] = b
+            valid = np.zeros(capacity, dtype=bool)
+            valid[:n] = True
+            buf["__valid__"] = valid
+            dev = jax.device_put(buf, sharding)
+            out = mapped(dev)
+            assert_or_throw(
+                isinstance(out, dict),
+                FugueInvalidOperation(
+                    "compiled transformer must return Dict[str, jax.Array]"
+                ),
+            )
+            out = {k: v for k, v in out.items() if k != "__valid__"}
+            missing = [c for c in out_names if c not in out]
+            assert_or_throw(
+                len(missing) == 0,
+                FugueInvalidOperation(
+                    f"compiled transformer output missing columns {missing}"
+                ),
+            )
+            for v in out.values():
+                assert_or_throw(
+                    v.shape[0] == capacity,
+                    FugueInvalidOperation(
+                        "streaming compiled transformers must return "
+                        "row-aligned arrays (padding preserved; reductions "
+                        "must mask with __valid__)"
+                    ),
+                )
+            for v in out.values():
+                v.copy_to_host_async()
+            host = {
+                c: np.asarray(jax.device_get(out[c]))[:n] for c in out_names
+            }
+            stats["chunks"] += 1
+            stats["rows"] += n
+            stats["peak_device_bytes"] = max(
+                stats["peak_device_bytes"], _device_peak_bytes()
+            )
+            del dev, out, buf
+            pdf = pd.DataFrame(
+                {c: host[c].astype(out_pd_dtypes[c], copy=False) for c in host}
+            )
+            yield PandasDataFrame(pdf, out_schema)
+        global last_run_stats
+        last_run_stats = dict(stats, verb="map")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)

@@ -42,6 +42,13 @@ class FugueWorkflowContext:
     def run(self, tasks: List[FugueTask]) -> None:
         execution_id = str(_uuid.uuid4())
         self._checkpoint_path.init_temp_path(execution_id)
+        # fan-out map: a ONE-PASS (local unbounded) result consumed by more
+        # than one downstream task must be materialized once, or the second
+        # consumer would silently read an exhausted stream
+        self._consumers: Dict[int, int] = {}
+        for t in tasks:
+            for d in t.inputs:
+                self._consumers[id(d)] = self._consumers.get(id(d), 0) + 1
         rpc_server = self._engine.rpc_server
         rpc_server.start()
         try:
@@ -113,4 +120,13 @@ class FugueWorkflowContext:
             raise
         if result is not None:
             result = task.set_result(self, result)
+            if (
+                getattr(self, "_consumers", {}).get(id(task), 0) > 1
+                and result.is_local
+                and not result.is_bounded
+            ):
+                # stream results stay lazy for single consumers (the
+                # out-of-core contract); a fan-out forces one host-side
+                # materialization so every consumer sees all rows
+                result = result.as_local_bounded()
             self._results[id(task)] = result
