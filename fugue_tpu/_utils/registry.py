@@ -20,6 +20,51 @@ from typing import Any, Callable, List, NamedTuple, Optional
 
 from ..exceptions import FuguePluginsRegistrationError
 
+# entry-point discovery group: third-party distributions expose
+# `[project.entry-points."fugue_tpu.plugins"]` and get loaded on first
+# registry use WITHOUT an explicit import — parity with the reference's
+# setuptools group "fugue.plugins" (`/root/reference/setup.py:104-111`,
+# loaded at `/root/reference/fugue/_utils/registry.py:9-10`)
+ENTRY_POINT_GROUP = "fugue_tpu.plugins"
+
+_EP_STATE = {"loaded": False}
+
+
+def load_entry_point_plugins(reload: bool = False) -> List[str]:
+    """Load every ``fugue_tpu.plugins`` entry point (idempotent).
+
+    Each entry point is imported and, if it resolves to a callable, called
+    with no arguments — both conventions let a package self-register
+    engines/plugins at load. Returns the names that loaded; failures are
+    collected onto the return value's ``.errors`` attribute rather than
+    raised (one broken third-party plugin must not take down the host,
+    matching the reference's tolerant load loop).
+    """
+    if _EP_STATE["loaded"] and not reload:
+        return _PluginLoadResult([], [])
+    _EP_STATE["loaded"] = True  # set FIRST: plugin code may re-enter registry
+    from importlib.metadata import entry_points
+
+    loaded: List[str] = []
+    errors: List[Any] = []
+    for ep in entry_points(group=ENTRY_POINT_GROUP):
+        try:
+            obj = ep.load()
+            if callable(obj) and not inspect.ismodule(obj):
+                obj()
+            loaded.append(ep.name)
+        except Exception as e:  # pragma: no cover - depends on bad plugins
+            errors.append((ep.name, e))
+    return _PluginLoadResult(loaded, errors)
+
+
+class _PluginLoadResult(List[str]):
+    """Names that loaded this call; per-plugin failures on ``.errors``."""
+
+    def __init__(self, loaded: List[str], errors: List[Any]):
+        super().__init__(loaded)
+        self.errors = errors
+
 
 class _Candidate(NamedTuple):
     priority: float
@@ -56,6 +101,8 @@ class ConditionalDispatcher:
         self.candidate(matcher, priority)(func)
 
     def _matches(self, *args: Any, **kwargs: Any):
+        if not _EP_STATE["loaded"]:
+            load_entry_point_plugins()
         for c in self._candidates:
             try:
                 ok = c.matcher(*args, **kwargs)

@@ -1,0 +1,76 @@
+"""Entry-point plugin discovery (reference setup.py:104-111 /
+fugue/_utils/registry.py:9-10): an installed-but-never-imported
+distribution exposing the ``fugue_tpu.plugins`` entry-point group gets
+loaded on first registry use, so its engine resolves by name in
+``make_execution_engine`` with no explicit import anywhere.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from fugue_tpu._utils import registry
+from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.exceptions import FuguePluginsRegistrationError
+
+_MODULE = textwrap.dedent(
+    '''
+    """Synthetic third-party backend package (test fixture)."""
+    from fugue_tpu.execution.factory import register_execution_engine
+    from fugue_tpu.execution.native_execution_engine import NativeExecutionEngine
+
+
+    class ExtEngine(NativeExecutionEngine):
+        marker = "loaded-via-entry-point"
+
+
+    register_execution_engine("extengine", lambda conf, **k: ExtEngine(conf))
+    '''
+)
+
+
+@pytest.fixture()
+def synthetic_dist(tmp_path):
+    site = tmp_path / "site"
+    dist = site / "my_fugue_ext-0.1.dist-info"
+    dist.mkdir(parents=True)
+    (site / "my_fugue_ext.py").write_text(_MODULE)
+    (dist / "METADATA").write_text(
+        "Metadata-Version: 2.1\nName: my-fugue-ext\nVersion: 0.1\n"
+    )
+    (dist / "entry_points.txt").write_text(
+        "[fugue_tpu.plugins]\nextengine = my_fugue_ext\n"
+    )
+    sys.path.insert(0, str(site))
+    prior = registry._EP_STATE["loaded"]
+    registry._EP_STATE["loaded"] = False
+    try:
+        yield site
+    finally:
+        sys.path.remove(str(site))
+        registry._EP_STATE["loaded"] = prior
+        sys.modules.pop("my_fugue_ext", None)
+        from fugue_tpu.execution import factory
+
+        factory._EXECUTION_ENGINE_REGISTRY.pop("extengine", None)
+
+
+def test_engine_resolves_without_import(synthetic_dist):
+    assert "my_fugue_ext" not in sys.modules
+    e = make_execution_engine("extengine")
+    assert getattr(e, "marker", "") == "loaded-via-entry-point"
+    assert "my_fugue_ext" in sys.modules  # loaded by discovery, not by us
+    e.stop_engine()
+
+
+def test_load_is_idempotent(synthetic_dist):
+    loaded = registry.load_entry_point_plugins()
+    assert "extengine" in loaded
+    again = registry.load_entry_point_plugins()
+    assert again == []  # second call is a no-op
+
+
+def test_unknown_engine_still_raises(synthetic_dist):
+    with pytest.raises(FuguePluginsRegistrationError):
+        make_execution_engine("definitely-not-registered")
