@@ -680,13 +680,19 @@ class WarehouseExecutionEngine(ExecutionEngine):
             # (consecutive row numbers step by ~0.618 * 2^32 mod 2^32, the
             # Weyl equidistribution). ROW_NUMBER() rather than rowid: a
             # user column named "rowid" shadows sqlite's, and views have
-            # none.
+            # none. The pre-multiply % 2^31 keeps the product inside
+            # sqlite's signed 64-bit INTEGER (2^31 * 2654435761 < 2^63)
+            # even for billion-row tables / huge seeds; the hash pattern
+            # repeats past 2^31 rows, which sampling tolerates.
+            rn = "__ft_rn"
+            while rn in d.schema.names:
+                rn = "_" + rn
             h = (
-                f"(((__ft_rn + {int(seed) & 0x7FFFFFFF}) * 2654435761) "
-                "% 4294967296)"
+                f"((({rn} + {int(seed) & 0x7FFFFFFF}) % 2147483648) "
+                "* 2654435761 % 4294967296)"
             )
             src = (
-                f"(SELECT {cols}, ROW_NUMBER() OVER () AS __ft_rn "
+                f"(SELECT {cols}, ROW_NUMBER() OVER () AS {rn} "
                 f"FROM {self.encode_name(d.table)})"
             )
             if frac is not None:
