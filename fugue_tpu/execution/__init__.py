@@ -54,6 +54,16 @@ def _lazy_sqlite_engine(conf, **kwargs):
 register_execution_engine("sqlite", _lazy_sqlite_engine)
 
 
+def _lazy_sqlite_jax_engine(conf, **kwargs):
+    from ..warehouse import WarehouseJaxExecutionEngine
+
+    return WarehouseJaxExecutionEngine(conf, **kwargs)
+
+
+# the DuckDask-analog hybrid: warehouse SQL + jax-mesh maps in ONE engine
+register_execution_engine("sqlite_jax", _lazy_sqlite_jax_engine)
+
+
 def _lazy_sqlite_sql_engine(engine):
     from ..warehouse import WarehouseSQLEngine
 

@@ -8,11 +8,14 @@ from .execution_engine import (
     WarehouseMapEngine,
     WarehouseSQLEngine,
 )
+from .hybrid import WarehouseJaxExecutionEngine, WarehouseJaxMapEngine
 from . import registry  # noqa: F401  (self-registration at import)
 
 __all__ = [
     "WarehouseDataFrame",
     "WarehouseExecutionEngine",
+    "WarehouseJaxExecutionEngine",
+    "WarehouseJaxMapEngine",
     "WarehouseMapEngine",
     "WarehouseSQLEngine",
     "SQLiteExecutionEngine",

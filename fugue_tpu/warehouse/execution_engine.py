@@ -67,6 +67,19 @@ def _storage_type(tp: pa.DataType) -> str:
     )
 
 
+class _StorageCastGenerator(SQLExpressionGenerator):
+    """Column-IR → SQL with casts lowered to the warehouse's STORAGE
+    classes (sqlite cast targets are TEXT/INTEGER/REAL/BLOB, not logical
+    type names) — the declared arrow type still rides the recorded frame
+    schema, so fetch reconstructs the exact logical type."""
+
+    def __init__(self) -> None:
+        super().__init__(enable_cast=True)
+
+    def type_to_sql_type(self, tp: pa.DataType) -> str:
+        return _storage_type(tp)
+
+
 class WarehouseSQLEngine(SQLEngine):
     """SQL facet: raw SELECT statements run in the warehouse (reference
     ``IbisSQLEngine.select``, ``fugue_ibis/execution_engine.py:41-58``).
@@ -214,7 +227,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
         self._schemas: Dict[str, Schema] = {}
         self._local_engine = NativeExecutionEngine(conf)
         self._log = logging.getLogger("fugue_tpu.warehouse")
-        self._gen = SQLExpressionGenerator(enable_cast=False)
+        self._gen = _StorageCastGenerator()
 
     # ---- base wiring ------------------------------------------------------
     @property

@@ -1,0 +1,97 @@
+"""Warehouse+device hybrid engine — the reference's DuckDask analog.
+
+The reference composes DuckDB SQL with Dask maps in ONE engine
+(`/root/reference/fugue_duckdb/dask.py:17-40`): relational verbs stay in
+the vectorized SQL backend, per-partition UDFs run on the distributed
+side. `WarehouseJaxExecutionEngine` is the same composition TPU-first:
+
+- SQL/relational verbs (select/filter/join/set-ops/aggregate pushdown…)
+  run in the warehouse over DB-API (inherited from
+  :class:`WarehouseExecutionEngine`);
+- `map_dataframe` hands the frame to the **jax mesh** via ONE arrow
+  fetch — jax-annotated UDFs compile onto the device mesh
+  (`fugue_tpu/jax/execution_engine.py`), pandas UDFs run the engine's
+  partitioned host path — and the result lands back in the warehouse as
+  arrow. No local-oracle map roundtrip anywhere.
+
+A mixed FugueSQL pipeline (SELECT … then TRANSFORM … then SELECT …)
+therefore runs start-to-finish on one engine: storage-side SQL,
+device-side compute.
+"""
+
+from typing import Any, Callable, Optional
+
+from ..collections.partition import PartitionCursor, PartitionSpec
+from ..dataframe import ArrowDataFrame, DataFrame, LocalDataFrame
+from ..execution.execution_engine import ExecutionEngine, MapEngine
+from .execution_engine import SQLiteExecutionEngine
+
+
+class WarehouseJaxMapEngine(MapEngine):
+    """Map facet bridging warehouse tables onto the device mesh."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+    @property
+    def map_handles_repartition(self) -> bool:
+        # the jax map engine owns its partitioning decisions (logical
+        # grouping / device exchange) — no warehouse-side pre-shuffle
+        return True
+
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:
+        eng: "WarehouseJaxExecutionEngine" = self.execution_engine  # type: ignore
+        wdf = eng.to_df(df)
+        # ONE warehouse -> arrow fetch; the jax engine ingests to device
+        arrow = ArrowDataFrame(eng.fetch_arrow(wdf.table, wdf.schema))
+        res = eng.jax_engine.map_engine.map_dataframe(
+            arrow,
+            map_func=map_func,
+            output_schema=output_schema,
+            partition_spec=partition_spec,
+            on_init=on_init,
+            map_func_format_hint=map_func_format_hint,
+        )
+        # ONE device -> arrow handoff back into warehouse storage
+        return eng.ingest(res)
+
+
+class WarehouseJaxExecutionEngine(SQLiteExecutionEngine):
+    """SQL in the warehouse, maps on the jax device mesh (reference
+    ``DuckDaskExecutionEngine``, ``fugue_duckdb/dask.py:17``). Registered
+    as engine name ``"sqlite_jax"``; ``conf["fugue.sqlite.path"]``
+    selects the DB file like the plain sqlite engine."""
+
+    def __init__(self, conf: Any = None, connection: Any = None, **kwargs: Any):
+        super().__init__(conf, connection=connection, **kwargs)
+        from ..jax import JaxExecutionEngine
+
+        self._jax_engine = JaxExecutionEngine(conf)
+
+    @property
+    def jax_engine(self) -> ExecutionEngine:
+        """The device-mesh side handling compute-heavy maps."""
+        return self._jax_engine
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+    def create_default_map_engine(self) -> MapEngine:
+        return WarehouseJaxMapEngine(self)
+
+    def get_current_parallelism(self) -> int:
+        return self._jax_engine.get_current_parallelism()
+
+    def stop_engine(self) -> None:
+        self._jax_engine.stop_engine()
+        super().stop_engine()

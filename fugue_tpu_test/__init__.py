@@ -8,6 +8,12 @@ third-party — so distributed semantics are exercised uniformly.
 from .bag_suite import BagTests
 from .builtin_suite import BuiltInTests
 from .dataframe_suite import DataFrameTests
-from .execution_suite import ExecutionEngineTests
+from .execution_suite import ExecutionEngineTests, WarehouseSuiteOverrides
 
-__all__ = ["BagTests", "BuiltInTests", "DataFrameTests", "ExecutionEngineTests"]
+__all__ = [
+    "BagTests",
+    "BuiltInTests",
+    "DataFrameTests",
+    "ExecutionEngineTests",
+    "WarehouseSuiteOverrides",
+]

@@ -697,3 +697,20 @@ class ExecutionEngineTests:
             rows = sorted(res.as_array())
             assert rows == [["a", 1], ["a", 2], ["b", 1]]
 
+
+
+class WarehouseSuiteOverrides:
+    """Engine-suite cases a sqlite-backed warehouse engine legitimately
+    can't serve, skipped with reasons — mix into suite subclasses (the
+    reference pattern: backend test files subclass the suites and
+    override/skip, reference tests/fugue/execution/test_naive_execution_engine.py:14-31).
+    """
+
+    def test_map_with_dict_col(self):
+        pytest.skip("nested (struct/list) columns have no sqlite storage class")
+
+    def test_sql_grouping_sets(self):
+        pytest.skip(
+            "sqlite has no ROLLUP/GROUPING SETS; the in-tree SQL executor "
+            "serves those on non-warehouse engines"
+        )

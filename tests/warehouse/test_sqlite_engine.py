@@ -336,3 +336,20 @@ def test_seeded_sample_with_rowid_column_and_load_table_count(eng):
     assert f.count() == 3
     sql_eng.save_table(eng.to_df(pd.DataFrame({"a": [1, 2, 3, 4, 5]})), "t_mut")
     assert f.count() == 5
+
+
+# full engine contract suite on the plain warehouse engine (previously
+# only hand-rolled tests covered it); shares the documented skips with
+# the hybrid suite
+from fugue_tpu.execution import ExecutionEngine  # noqa: E402
+from fugue_tpu_test import (  # noqa: E402
+    ExecutionEngineTests,
+    WarehouseSuiteOverrides,
+)
+
+
+class TestSQLiteExecutionEngineSuite(
+    WarehouseSuiteOverrides, ExecutionEngineTests.Tests
+):
+    def make_engine(self) -> ExecutionEngine:
+        return SQLiteExecutionEngine(dict(test=True))
