@@ -1,16 +1,31 @@
 """Benchmark: the reference's flagship workloads, TPU engine vs pandas oracle.
 
-Measurements (BASELINE.md configs #1/#3):
+Measurements (ALL FIVE BASELINE.md configs):
 
-- ``groupby_aggregate`` — the engine-verb path: ``aggregate()`` by key with
-  sum/count/avg. Ours = the JaxExecutionEngine fused dense device aggregate
-  (device-resident result frames); baseline = the same verbs on the
-  NativeExecutionEngine (pandas, i.e. what the reference's default engine
-  does).
-- ``transform_udf`` — BASELINE config #1: ``transform()`` groupby-APPLY with
-  a per-group pandas UDF, the reference's headline workload, on both engines.
+- ``groupby_aggregate`` — config #3's engine-verb path: ``aggregate()`` by
+  key with sum/count/avg. Ours = the JaxExecutionEngine fused dense device
+  aggregate (device-resident result frames); baseline = the same verbs on
+  the NativeExecutionEngine (pandas, i.e. what the reference's default
+  engine does).
+- ``transform_udf`` — config #1: ``transform()`` groupby-APPLY with a
+  per-group pandas UDF, the reference's headline workload, on both engines.
 - ``transform_udf_compiled`` — the same workload as a COMPILED keyed map
   (jax-annotated UDF + group_ops, the device-native answer).
+- ``sql_pipeline`` — config #2: FugueSQL LOAD parquet → SELECT (filter +
+  groupby) → TRANSFORM (pandas UDF), whole pipeline wall time per engine.
+- ``batch_inference`` — config #4: ``transform()`` wrapping an MLP forward
+  pass (the in-env stand-in for BERT-base) as a compiled mesh map, vs the
+  identical numpy model on the pandas engine.
+- ``hpo_sweep`` — config #5: ``out_transform`` hyperparameter sweep, one
+  closed-form ridge fit per config partition, vs the same sweep on pandas.
+
+Also recorded:
+
+- ``extra.dense_sum_backend_ab`` — the scatter/onehot(/pallas on TPU)
+  dense-sum A/B, each backend in its own fast-mode subprocess.
+- ``extra.roofline`` — bytes-touched and achieved GB/s for the aggregate
+  and compiled-map kernels (+ one-hot MXU FLOP/s), with peak fractions
+  against v5e limits when running on TPU, so "transfer-bound" is a number.
 
 Axon-tunnel honesty protocol (measured live, see BASELINE.md): on the
 remote-chip tunnel (a) ``block_until_ready`` does NOT wait for execution —
@@ -34,6 +49,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", "2000000"))
 N_GROUPS = int(os.environ.get("BENCH_GROUPS", "1000"))
@@ -42,6 +58,16 @@ UDF_ROWS = int(os.environ.get("BENCH_UDF_ROWS", "1000000"))
 # burst length for the device metrics: long enough to amortize the one
 # flat tunnel sync at the end of the timed region
 DEVICE_BURST = int(os.environ.get("BENCH_DEVICE_BURST", "20"))
+SQL_ROWS = int(os.environ.get("BENCH_SQL_ROWS", "1000000"))
+INFER_ROWS = int(os.environ.get("BENCH_INFER_ROWS", "1000000"))
+INFER_DIM = int(os.environ.get("BENCH_INFER_DIM", "8"))
+HPO_CONFIGS = int(os.environ.get("BENCH_HPO_CONFIGS", "32"))
+HPO_ROWS_PER = int(os.environ.get("BENCH_HPO_ROWS_PER", "20000"))
+
+# v5e single-chip peaks for roofline fractions (public spec numbers:
+# ~819 GB/s HBM bandwidth; 197 TFLOP/s bf16 MXU, f32 at half rate)
+V5E_HBM_PEAK_GBPS = 819.0
+V5E_MXU_F32_TFLOPS = 98.5
 
 
 def _tpu_reachable(timeout_s: float = 45.0) -> bool:
@@ -205,8 +231,81 @@ def _worker_compiled() -> None:
     _timed_burst(run_once, "v", UDF_ROWS, verify)
 
 
-def _run_worker(name: str, fallback_cpu: bool) -> dict:
+def _worker_infer() -> None:
+    """BASELINE config #4: batch inference — an MLP forward pass (the
+    in-env BERT stand-in) as a compiled mesh map over a feature frame."""
+    from typing import Dict as _Dict
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import fugue_tpu.api as fa
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = np.random.default_rng(7)
+    d_in, d_hidden, d_out = INFER_DIM, 128, 8
+    pdf = _make_infer_frame(rng, INFER_ROWS, d_in)
+    w1 = jnp.asarray(rng.normal(size=(d_in, d_hidden)), dtype=jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(d_hidden, d_out)), dtype=jnp.float32)
+
+    def embed(cols: _Dict[str, jax.Array]) -> _Dict[str, jax.Array]:
+        x = jnp.stack(
+            [cols[f"f{i}"] for i in range(d_in)], axis=1
+        ).astype(jnp.float32)
+        h = jax.nn.relu(x @ w1)
+        e = h @ w2
+        out = {"id": cols["id"]}
+        for i in range(d_out):
+            out[f"e{i}"] = e[:, i].astype(jnp.float64)
+        return out
+
+    eng = JaxExecutionEngine()
+    jdf = eng.to_df(pdf)
+    eng.persist(jdf)
+    schema = "id:long," + ",".join(f"e{i}:double" for i in range(d_out))
+
+    def run_once():
+        return fa.transform(jdf, embed, schema=schema, engine=eng, as_fugue=True)
+
+    def verify(out) -> bool:
+        got = out.as_pandas().sort_values("id").reset_index(drop=True)
+        x = pdf[[f"f{i}" for i in range(d_in)]].to_numpy(np.float32)
+        h = np.maximum(x @ np.asarray(w1), 0.0)
+        e = h @ np.asarray(w2)
+        return bool(np.allclose(got["e0"], e[:, 0], atol=1e-4))
+
+    _timed_burst(run_once, "e0", INFER_ROWS, verify)
+
+
+def _make_infer_frame(rng, rows: int, d_in: int):
+    import numpy as np
+    import pandas as pd
+
+    data = {"id": np.arange(rows)}
+    for i in range(d_in):
+        data[f"f{i}"] = rng.random(rows)
+    return pd.DataFrame(data)
+
+
+def _run_worker_best(
+    name: str, fallback_cpu: bool, runs: int = 2, extra_env: Optional[dict] = None
+) -> dict:
+    """Best-of-N fresh subprocesses — single worker runs are noisy on a
+    shared box (observed 4x swings); the fast-mode protocol requires a
+    fresh process per run anyway, so best-of-N is the natural stabilizer."""
+    best: Optional[dict] = None
+    for _ in range(runs):
+        r = _run_worker(name, fallback_cpu, extra_env=extra_env)
+        if best is None or (r["ok"] and r["rps"] > best["rps"]):
+            best = r
+    return best  # type: ignore[return-value]
+
+
+def _run_worker(name: str, fallback_cpu: bool, extra_env: Optional[dict] = None) -> dict:
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     if fallback_cpu:
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -227,6 +326,124 @@ def _run_worker(name: str, fallback_cpu: bool) -> dict:
             f"bench worker {name} failed:\n{proc.stderr[-2000:]}"
         )
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _bench_sql_pipeline(best_rps, host, eng):
+    """Config #2: LOAD parquet → SELECT filter+groupby → TRANSFORM (pandas
+    UDF), identical FugueSQL text on the jax and native engines (the SAME
+    persistent engine objects as the other configs — a fresh engine per
+    repeat would put mesh build + XLA compile inside the timed region)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from fugue_tpu.sql import fugue_sql
+
+    rng = np.random.default_rng(11)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, N_GROUPS, SQL_ROWS),
+            "v": rng.random(SQL_ROWS),
+            "w": rng.random(SQL_ROWS),
+        }
+    )
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "bench.parquet")
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), path)
+
+    def rescale(df: pd.DataFrame) -> pd.DataFrame:
+        df["s"] = df["s"] / df["s"].max()
+        return df
+
+    sql = f"""
+    src = LOAD "{path}"
+    agg = SELECT k, SUM(v) AS s, COUNT(*) AS n FROM src WHERE w > 0.1 GROUP BY k
+    TRANSFORM agg USING rescale SCHEMA k:long,s:double,n:long
+    """
+
+    def run(engine):
+        return fugue_sql(sql, rescale=rescale, engine=engine, as_fugue=True)
+
+    try:
+        jax_rps = best_rps(lambda: run(eng), SQL_ROWS)
+        host_rps = best_rps(lambda: run(host), SQL_ROWS)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return jax_rps, host_rps
+
+
+def _bench_infer_oracle(best_rps):
+    """The pandas-engine side of config #4: identical MLP in numpy via a
+    pandas-annotated transformer on the NativeExecutionEngine."""
+    import numpy as np
+    import pandas as pd
+
+    import fugue_tpu.api as fa
+
+    rng = np.random.default_rng(7)
+    d_in, d_hidden, d_out = INFER_DIM, 128, 8
+    pdf = _make_infer_frame(rng, INFER_ROWS, d_in)
+    w1 = rng.normal(size=(d_in, d_hidden)).astype(np.float32)
+    w2 = rng.normal(size=(d_hidden, d_out)).astype(np.float32)
+    schema = "id:long," + ",".join(f"e{i}:double" for i in range(d_out))
+
+    def embed_np(df: pd.DataFrame) -> pd.DataFrame:
+        x = df[[f"f{i}" for i in range(d_in)]].to_numpy(np.float32)
+        e = np.maximum(x @ w1, 0.0) @ w2
+        out = pd.DataFrame({"id": df["id"]})
+        for i in range(d_out):
+            out[f"e{i}"] = e[:, i].astype(np.float64)
+        return out
+
+    return best_rps(
+        lambda: fa.transform(pdf, embed_np, schema=schema, engine="native"),
+        INFER_ROWS,
+    )
+
+
+def _bench_hpo(best_rps, host, eng):
+    """Config #5: out_transform sweep — one ridge fit per config partition
+    (closed-form normal equations stand in for sklearn/XGBoost)."""
+    import numpy as np
+    import pandas as pd
+
+    import fugue_tpu.api as fa
+
+    rng = np.random.default_rng(23)
+    x = rng.random((HPO_ROWS_PER, 4))
+    y = x @ np.asarray([1.0, -2.0, 0.5, 3.0]) + rng.normal(0, 0.1, HPO_ROWS_PER)
+    frames = []
+    for c in range(HPO_CONFIGS):
+        f = pd.DataFrame(x, columns=[f"x{i}" for i in range(4)])
+        f["y"] = y
+        f["config"] = c
+        f["alpha"] = 10.0 ** (c / 4 - 4)
+        frames.append(f)
+    sweep = pd.concat(frames, ignore_index=True)
+    total_rows = len(sweep)
+    results = []
+
+    def fit(df: pd.DataFrame) -> None:
+        a = float(df["alpha"].iloc[0])
+        xm = df[[f"x{i}" for i in range(4)]].to_numpy()
+        ym = df["y"].to_numpy()
+        w = np.linalg.solve(xm.T @ xm + a * np.eye(4), xm.T @ ym)
+        results.append((int(df["config"].iloc[0]), float(np.abs(w).sum())))
+
+    def run(engine):
+        results.clear()
+        fa.out_transform(
+            sweep, fit, partition={"by": ["config"]}, engine=engine
+        )
+        assert len(results) == HPO_CONFIGS
+
+    jax_rps = best_rps(lambda: run(eng), total_rows)
+    host_rps = best_rps(lambda: run(host), total_rows)
+    return jax_rps, host_rps
 
 
 def main() -> None:
@@ -267,10 +484,10 @@ def main() -> None:
     )
 
     # ---- pure-device metrics, one fast-mode subprocess each ---------------
-    agg = _run_worker("agg", fallback_cpu=not on_tpu)
+    agg = _run_worker_best("agg", fallback_cpu=not on_tpu)
     assert agg["ok"], "device aggregate mismatch"
     jax_agg_rps = agg["rps"]
-    compiled = _run_worker("compiled", fallback_cpu=not on_tpu)
+    compiled = _run_worker_best("compiled", fallback_cpu=not on_tpu)
     assert compiled["ok"], "compiled keyed transform mismatch"
     jax_compiled_rps = compiled["rps"]
 
@@ -305,6 +522,74 @@ def main() -> None:
         UDF_ROWS,
     )
 
+    # ---- config #2: FugueSQL SELECT+TRANSFORM pipeline over parquet -------
+    sql_jax_rps, sql_host_rps = _bench_sql_pipeline(_best_rps, host, eng)
+
+    # ---- config #4: batch inference (compiled mesh MLP vs numpy oracle) ---
+    infer = _run_worker_best("infer", fallback_cpu=not on_tpu)
+    assert infer["ok"], "batch inference mismatch"
+    host_infer_rps = _bench_infer_oracle(_best_rps)
+
+    # ---- config #5: HPO out_transform sweep -------------------------------
+    hpo_jax_rps, hpo_host_rps = _bench_hpo(_best_rps, host, eng)
+
+    # ---- dense-sum backend A/B (scatter/onehot, + pallas on real TPU) -----
+    ab = {}
+    backends = ["scatter", "onehot"] + (["pallas"] if on_tpu else [])
+    for backend in backends:
+        try:
+            r = _run_worker(
+                "agg",
+                fallback_cpu=not on_tpu,
+                extra_env={"FUGUE_TPU_DENSE_SUM": backend},
+            )
+            ab[backend] = round(r["rps"], 1) if r["ok"] else "mismatch"
+        except Exception as ex:  # timeouts/JSON errors must not void
+            ab[backend] = f"failed: {str(ex)[-120:]}"
+    from fugue_tpu.ops.segment import _DENSE_SUM_BACKEND
+
+    ab["default"] = _DENSE_SUM_BACKEND[0]
+
+    # ---- roofline: bytes touched / achieved bandwidth vs platform peak ----
+    on_tpu_platform = platform == "tpu"
+    agg_bytes_per_run = N_ROWS * (8 + 8 + 1)  # key + value + valid mask
+    agg_gbps = agg_bytes_per_run * DEVICE_BURST / agg["wall"] / 1e9
+    cmp_bytes_per_run = UDF_ROWS * (8 + 8 + 1) * 2  # read + write row-aligned
+    cmp_gbps = cmp_bytes_per_run * DEVICE_BURST / compiled["wall"] / 1e9
+    infer_flops_per_run = INFER_ROWS * 2 * (INFER_DIM * 128 + 128 * 8)
+    infer_tflops = infer_flops_per_run * DEVICE_BURST / infer["wall"] / 1e12
+    onehot_note = None
+    if isinstance(ab.get("onehot"), float):
+        # one-hot path: SUM as a (1,N)x(N,buckets) matmul per f32 column
+        buckets_ab = 1 << N_GROUPS.bit_length()  # dense_buckets(N_GROUPS)
+        onehot_flops = 2.0 * N_ROWS * buckets_ab
+        onehot_note = round(ab["onehot"] * onehot_flops / N_ROWS / 1e12, 4)
+    roofline = {
+        "aggregate": {
+            "bytes_per_row": 17,
+            "achieved_gbps": round(agg_gbps, 2),
+            "hbm_peak_gbps": V5E_HBM_PEAK_GBPS if on_tpu_platform else None,
+            "hbm_fraction": (
+                round(agg_gbps / V5E_HBM_PEAK_GBPS, 4) if on_tpu_platform else None
+            ),
+        },
+        "compiled_map": {
+            "achieved_gbps": round(cmp_gbps, 2),
+            "hbm_fraction": (
+                round(cmp_gbps / V5E_HBM_PEAK_GBPS, 4) if on_tpu_platform else None
+            ),
+        },
+        "batch_inference": {
+            "achieved_tflops": round(infer_tflops, 4),
+            "mxu_fraction": (
+                round(infer_tflops / V5E_MXU_F32_TFLOPS, 4)
+                if on_tpu_platform
+                else None
+            ),
+        },
+        "onehot_sum_tflops": onehot_note,
+    }
+
     print(
         json.dumps(
             {
@@ -325,6 +610,18 @@ def main() -> None:
                     "transform_udf_compiled_vs_baseline": round(
                         jax_compiled_rps / host_udf_rps, 3
                     ),
+                    "sql_pipeline_rows_per_sec": round(sql_jax_rps, 1),
+                    "sql_pipeline_vs_baseline": round(
+                        sql_jax_rps / sql_host_rps, 3
+                    ),
+                    "batch_inference_rows_per_sec": round(infer["rps"], 1),
+                    "batch_inference_vs_baseline": round(
+                        infer["rps"] / host_infer_rps, 3
+                    ),
+                    "hpo_sweep_rows_per_sec": round(hpo_jax_rps, 1),
+                    "hpo_sweep_vs_baseline": round(
+                        hpo_jax_rps / hpo_host_rps, 3
+                    ),
                     "baseline_aggregate_rows_per_sec": round(host_agg_rps, 1),
                     "baseline_transform_udf_rows_per_sec": round(
                         host_udf_rps, 1
@@ -332,6 +629,8 @@ def main() -> None:
                     "device_burst": DEVICE_BURST,
                     "agg_burst_wall_s": round(agg["wall"], 3),
                     "compiled_burst_wall_s": round(compiled["wall"], 3),
+                    "dense_sum_backend_ab": ab,
+                    "roofline": roofline,
                 },
             }
         )
@@ -343,6 +642,10 @@ if __name__ == "__main__":
         if os.environ.get("FUGUE_TPU_FORCE_CPU") == "1":
             _force_cpu_mesh()
         name = sys.argv[1].split("=", 1)[1]
-        {"agg": _worker_agg, "compiled": _worker_compiled}[name]()
+        {
+            "agg": _worker_agg,
+            "compiled": _worker_compiled,
+            "infer": _worker_infer,
+        }[name]()
     else:
         main()
