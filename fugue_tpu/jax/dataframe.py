@@ -163,6 +163,26 @@ def encode_arrow_for_device(tbl: pa.Table, encode: bool = True) -> Any:
     return device_cols, host_tbl, meta
 
 
+def _nan_to_null(tbl: pa.Table) -> pa.Table:
+    """Literal NaN → NULL in float columns (the device NULL convention),
+    applied to host reads of never-ingested frames."""
+    import pyarrow.compute as pc
+
+    arrays: List[Any] = []
+    changed = False
+    for f in tbl.schema:
+        col = tbl.column(f.name)
+        if pa.types.is_floating(f.type):
+            nan = pc.fill_null(pc.is_nan(col), False)
+            if (pc.sum(nan).as_py() or 0) > 0:
+                col = pc.if_else(nan, pa.scalar(None, f.type), col)
+                changed = True
+        arrays.append(col)
+    if not changed:
+        return tbl
+    return pa.Table.from_arrays(arrays, schema=tbl.schema)
+
+
 class JaxDataFrame(DataFrame):
     """Distributed frame over a jax device mesh."""
 
@@ -182,6 +202,7 @@ class JaxDataFrame(DataFrame):
         # None → fall back to the global conf (engines pass their own conf)
         self._ingest_cache_opt = ingest_cache
         if _internal is not None:
+            self._pending_tbl = None
             self._device_cols = _internal["device_cols"]
             self._host_tbl = _internal["host_tbl"]
             self._row_count = _internal["row_count"]
@@ -196,9 +217,15 @@ class JaxDataFrame(DataFrame):
         if isinstance(df, JaxDataFrame):
             if s is not None and s != df.schema:
                 # schema change requires real conversion, not a relabel
-                self._from_arrow(df.as_arrow().cast(s.pa_schema))
+                self._set_pending(df.as_arrow().cast(s.pa_schema))
                 super().__init__(s)
                 return
+            src_pending = getattr(df, "_pending_tbl", None)
+            if src_pending is not None:
+                self._set_pending(src_pending)
+                super().__init__(df.schema)
+                return
+            self._pending_tbl = None
             self._device_cols = dict(df._device_cols)
             self._host_tbl = df._host_tbl
             self._ingest_tbl = getattr(df, "_ingest_tbl", None)
@@ -215,8 +242,40 @@ class JaxDataFrame(DataFrame):
                 tbl = tbl.cast(s.pa_schema)
         else:
             tbl = build_arrow_table(df, s)
-        self._from_arrow(tbl)
+        self._set_pending(tbl)
         super().__init__(Schema(tbl.schema))
+
+    def _set_pending(self, tbl: pa.Table) -> None:
+        """LAZY ingestion: hold the arrow table; device transfer happens on
+        the FIRST device-facing access (`device_cols`/`null_masks`/…).
+
+        Host reads (``as_arrow``/``as_pandas``/``count``) of a never-
+        device-touched frame come straight from the pending table, so a
+        host-map result that flows back to the host — the reference's
+        default `transform()` shape, where the answer is fetched
+        immediately — never pays a device round trip at all."""
+        import threading
+
+        self._pending_tbl: Optional[pa.Table] = tbl
+        self._pending_lock = threading.Lock()
+        self._device_cols = {}
+        self._host_tbl = None
+        self._ingest_tbl = None
+        self._row_count = tbl.num_rows
+        self._valid_mask = None
+        self._nan_cols = None
+        self._encodings = {}
+        self._null_masks = {}
+
+    def _ensure_device(self) -> None:
+        tbl = getattr(self, "_pending_tbl", None)
+        if tbl is None:
+            return
+        with self._pending_lock:
+            if self._pending_tbl is None:  # raced: another thread ingested
+                return
+            self._from_arrow(self._pending_tbl)
+            self._pending_tbl = None
 
     def _from_arrow(self, tbl: pa.Table) -> None:
         import jax
@@ -276,10 +335,12 @@ class JaxDataFrame(DataFrame):
 
     @property
     def device_cols(self) -> Dict[str, Any]:
+        self._ensure_device()
         return self._device_cols
 
     @property
     def host_table(self) -> Optional[pa.Table]:
+        self._ensure_device()
         return self._host_tbl
 
     @property
@@ -293,6 +354,7 @@ class JaxDataFrame(DataFrame):
         False only when ingestion proved the column NaN-free; unknown
         provenance (e.g. transformer outputs) is conservatively True.
         """
+        self._ensure_device()
         if self._nan_cols is None:
             return True
         return name in self._nan_cols
@@ -300,11 +362,13 @@ class JaxDataFrame(DataFrame):
     @property
     def encodings(self) -> Dict[str, dict]:
         """Per-column internal device representations (dict/datetime)."""
+        self._ensure_device()
         return self._encodings
 
     @property
     def null_masks(self) -> Dict[str, Any]:
         """Per-column device null masks (True = NULL) for nullable columns."""
+        self._ensure_device()
         return self._null_masks
 
     @property
@@ -312,6 +376,7 @@ class JaxDataFrame(DataFrame):
         """True when any device column is not plainly-typed (encoded or
         masked) — device fast paths that assume plain semantics must gate
         on this."""
+        self._ensure_device()
         return len(self._encodings) > 0 or len(self._null_masks) > 0
 
     def device_valid_mask(self) -> Any:
@@ -319,6 +384,7 @@ class JaxDataFrame(DataFrame):
         when no explicit mask exists). Memoized — frames are immutable, and
         on a remote-chip tunnel every extra program dispatch has real
         latency, so repeated ops over one frame must not re-run it."""
+        self._ensure_device()
         if self._valid_mask is not None:
             return self._valid_mask
         cached = getattr(self, "_tail_mask_cache", None)
@@ -356,7 +422,7 @@ class JaxDataFrame(DataFrame):
                 from ..ops.segment import _get_compiled_minmax
 
                 lo_a, hi_a = _get_compiled_minmax(self._mesh)(
-                    self._device_cols[name], self.device_valid_mask()
+                    self.device_cols[name], self.device_valid_mask()
                 )
                 # overlap the two fetches: one tunnel roundtrip, not two
                 lo_a.copy_to_host_async()
@@ -378,12 +444,26 @@ class JaxDataFrame(DataFrame):
         ingested rows valid)."""
         if self._valid_mask is not None:
             return None
-        if name in self._null_masks or name in self._encodings:
-            # the device column holds fill values / codes for these — a
-            # host-side min/max (which skips NULLs) would disagree with
-            # the device probe and produce wrong dense-plan bounds
-            return None
-        tbl = self._ingest_tbl if getattr(self, "_ingest_tbl", None) is not None else self._host_tbl
+        pend = getattr(self, "_pending_tbl", None)
+        if pend is not None:
+            # never-ingested frame: probe the pending table, declining
+            # exactly where ingestion would mask/encode (nulls present)
+            if name not in pend.schema.names:
+                return None
+            if pend.column(name).null_count > 0:
+                return None
+            tbl = pend
+        else:
+            if name in self._null_masks or name in self._encodings:
+                # the device column holds fill values / codes for these — a
+                # host-side min/max (which skips NULLs) would disagree with
+                # the device probe and produce wrong dense-plan bounds
+                return None
+            tbl = (
+                self._ingest_tbl
+                if getattr(self, "_ingest_tbl", None) is not None
+                else self._host_tbl
+            )
         if tbl is None or name not in tbl.schema.names:
             return None
         import pyarrow.compute as pc
@@ -461,6 +541,11 @@ class JaxDataFrame(DataFrame):
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
         import jax
 
+        pend = getattr(self, "_pending_tbl", None)
+        if pend is not None:
+            # never ingested: the arrow table IS the data — but the device
+            # convention (literal NaN == NULL) must hold for host reads too
+            return _nan_to_null(pend)
         src = getattr(self, "_ingest_tbl", None)
         if src is not None:
             return src
@@ -574,6 +659,7 @@ class JaxDataFrame(DataFrame):
         )
 
     def _drop_cols(self, cols: List[str]) -> DataFrame:
+        self._ensure_device()
         schema = self.schema - cols
         dc = {k: v for k, v in self._device_cols.items() if k in schema}
         keep_host = [n for n in schema.names if n not in dc]
@@ -581,6 +667,7 @@ class JaxDataFrame(DataFrame):
         return self._with(schema, dc, ht)
 
     def _select_cols(self, cols: List[str]) -> DataFrame:
+        self._ensure_device()
         schema = self.schema.extract(cols)
         dc = {k: v for k, v in self._device_cols.items() if k in schema}
         keep_host = [n for n in schema.names if n not in dc]
@@ -588,6 +675,7 @@ class JaxDataFrame(DataFrame):
         return self._with(schema, dc, ht)
 
     def rename(self, columns: Dict[str, str]) -> DataFrame:
+        self._ensure_device()
         schema = self.schema.rename(columns)  # validates
         dc = {columns.get(k, k): v for k, v in self._device_cols.items()}
         ht = (
