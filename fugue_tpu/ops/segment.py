@@ -291,18 +291,22 @@ def _get_compiled_mask(mesh: Any):
 # max bucket table size for the dense (sort-free) groupby path
 _DENSE_MAX_RANGE = 1 << 18
 
-# float32 SUM engine inside the dense kernel: "scatter" (XLA scatter-add,
-# the default), "onehot" (chunked one-hot MXU matmul, jnp), or "pallas"
-# (the Pallas TPU kernel in ops/pallas_groupby.py). Overridable via env
-# FUGUE_TPU_DENSE_SUM or set_dense_sum_backend(); the default stays
-# "scatter" until an on-chip A/B picks the winner (BASELINE.md).
+# float32 SUM engine inside the dense kernel: "scatter" (XLA scatter-add),
+# "onehot" (chunked one-hot MXU matmul, jnp), or "pallas" (the Pallas TPU
+# kernel in ops/pallas_groupby.py). Resolution order: env FUGUE_TPU_DENSE_SUM
+# → per-platform tuned default written by the bench A/B (``_tuned.json``
+# next to this file, keyed by jax.default_backend()) → "scatter".
+import json as _json
 import os as _os
 
 _DENSE_SUM_BACKENDS = ("scatter", "onehot", "pallas")
+_TUNED_PATH = _os.path.join(_os.path.dirname(__file__), "_tuned.json")
 
 
 def _read_backend_env() -> str:
-    raw = _os.environ.get("FUGUE_TPU_DENSE_SUM", "scatter").strip().lower()
+    raw = _os.environ.get("FUGUE_TPU_DENSE_SUM", "").strip().lower()
+    if not raw:
+        return ""
     if raw not in _DENSE_SUM_BACKENDS:
         raise ValueError(
             f"FUGUE_TPU_DENSE_SUM={raw!r} is not one of {_DENSE_SUM_BACKENDS}"
@@ -310,7 +314,38 @@ def _read_backend_env() -> str:
     return raw
 
 
-_DENSE_SUM_BACKEND = [_read_backend_env()]
+def _read_tuned_default() -> str:
+    """Per-platform default chosen by the bench A/B (bench.py --capture
+    writes the winner per platform). Falls back to scatter — the safe
+    choice on platforms never benchmarked."""
+    try:
+        with open(_TUNED_PATH) as f:
+            tuned = _json.load(f).get("dense_sum", {})
+    except Exception:
+        return "scatter"
+    import jax
+
+    name = tuned.get(jax.default_backend(), "scatter")
+    return name if name in _DENSE_SUM_BACKENDS else "scatter"
+
+
+class _BackendBox:
+    """Lazy one-slot holder: index 0 resolves env → tuned file → scatter on
+    first read (after jax backend selection settles), then sticks."""
+
+    def __init__(self) -> None:
+        self._name: str = _read_backend_env()
+
+    def __getitem__(self, i: int) -> str:
+        if not self._name:
+            self._name = _read_tuned_default()
+        return self._name
+
+    def __setitem__(self, i: int, name: str) -> None:
+        self._name = name
+
+
+_DENSE_SUM_BACKEND = _BackendBox()
 
 
 def set_dense_sum_backend(name: str) -> None:
