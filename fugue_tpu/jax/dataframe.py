@@ -203,6 +203,7 @@ class JaxDataFrame(DataFrame):
         self._ingest_cache_opt = ingest_cache
         if _internal is not None:
             self._pending_tbl = None
+            self._pending_src = None
             self._device_cols = _internal["device_cols"]
             self._host_tbl = _internal["host_tbl"]
             self._row_count = _internal["row_count"]
@@ -221,11 +222,13 @@ class JaxDataFrame(DataFrame):
                 super().__init__(s)
                 return
             src_pending = getattr(df, "_pending_tbl", None)
-            if src_pending is not None:
-                self._set_pending(src_pending)
+            src_frame = getattr(df, "_pending_src", None)
+            if src_pending is not None or src_frame is not None:
+                self._set_pending(src_pending, src=src_frame)
                 super().__init__(df.schema)
                 return
             self._pending_tbl = None
+            self._pending_src = None
             self._device_cols = dict(df._device_cols)
             self._host_tbl = df._host_tbl
             self._ingest_tbl = getattr(df, "_ingest_tbl", None)
@@ -237,6 +240,14 @@ class JaxDataFrame(DataFrame):
             super().__init__(df.schema)
             return
         if isinstance(df, DataFrame):
+            if (s is None or s == df.schema) and df.is_local and df.is_bounded:
+                # retain the SOURCE frame: host reads of a never-device-
+                # touched frame return it as-is (zero conversions); arrow
+                # conversion happens only if the device (or an arrow read)
+                # actually needs it
+                self._set_pending(None, src=df)  # type: ignore[arg-type]
+                super().__init__(df.schema)
+                return
             tbl = df.as_arrow()
             if s is not None and Schema(tbl.schema) != s:
                 tbl = tbl.cast(s.pa_schema)
@@ -245,37 +256,66 @@ class JaxDataFrame(DataFrame):
         self._set_pending(tbl)
         super().__init__(Schema(tbl.schema))
 
-    def _set_pending(self, tbl: pa.Table) -> None:
-        """LAZY ingestion: hold the arrow table; device transfer happens on
-        the FIRST device-facing access (`device_cols`/`null_masks`/…).
+    def _set_pending(
+        self, tbl: Optional[pa.Table], src: Optional[DataFrame] = None
+    ) -> None:
+        """LAZY ingestion: hold the arrow table (or the untouched source
+        frame); device transfer happens on the FIRST device-facing access
+        (`device_cols`/`null_masks`/…).
 
         Host reads (``as_arrow``/``as_pandas``/``count``) of a never-
-        device-touched frame come straight from the pending table, so a
-        host-map result that flows back to the host — the reference's
+        device-touched frame come straight from the pending table/source,
+        so a host-map result that flows back to the host — the reference's
         default `transform()` shape, where the answer is fetched
-        immediately — never pays a device round trip at all."""
+        immediately — never pays a device round trip (or even an arrow
+        conversion) at all."""
         import threading
 
         self._pending_tbl: Optional[pa.Table] = tbl
+        self._pending_src: Optional[DataFrame] = src
         self._pending_lock = threading.Lock()
         self._device_cols = {}
         self._host_tbl = None
         self._ingest_tbl = None
-        self._row_count = tbl.num_rows
+        self._row_count = tbl.num_rows if tbl is not None else src.count()  # type: ignore[union-attr]
         self._valid_mask = None
         self._nan_cols = None
         self._encodings = {}
         self._null_masks = {}
 
+    def _has_pending(self) -> bool:
+        return (
+            getattr(self, "_pending_tbl", None) is not None
+            or getattr(self, "_pending_src", None) is not None
+        )
+
+    def _pending_table(self) -> pa.Table:
+        """The pending arrow table, converting (and caching) from the
+        retained source frame on first need. Callers must hold
+        ``_pending_lock`` (or use ``_pending_snapshot``)."""
+        if self._pending_tbl is None:
+            self._pending_tbl = self._pending_src.as_arrow()  # type: ignore[union-attr]
+        return self._pending_tbl
+
+    def _pending_snapshot(self) -> Optional[pa.Table]:
+        """Lock-guarded read of the pending table — safe against a
+        concurrent ``_ensure_device`` nulling the pending fields."""
+        if not self._has_pending():
+            return None
+        with self._pending_lock:
+            if not self._has_pending():
+                return None
+            return self._pending_table()
+
     def _ensure_device(self) -> None:
-        tbl = getattr(self, "_pending_tbl", None)
-        if tbl is None:
+        if not self._has_pending():
             return
         with self._pending_lock:
-            if self._pending_tbl is None:  # raced: another thread ingested
+            if not self._has_pending():  # raced: another thread ingested
                 return
-            self._from_arrow(self._pending_tbl)
+            self._from_arrow(self._pending_table())
             self._pending_tbl = None
+            self._pending_src = None
 
     def _from_arrow(self, tbl: pa.Table) -> None:
         import jax
@@ -444,7 +484,7 @@ class JaxDataFrame(DataFrame):
         ingested rows valid)."""
         if self._valid_mask is not None:
             return None
-        pend = getattr(self, "_pending_tbl", None)
+        pend = self._pending_snapshot()
         if pend is not None:
             # never-ingested frame: probe the pending table, declining
             # exactly where ingestion would mask/encode (nulls present)
@@ -541,7 +581,7 @@ class JaxDataFrame(DataFrame):
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
         import jax
 
-        pend = getattr(self, "_pending_tbl", None)
+        pend = self._pending_snapshot()
         if pend is not None:
             # never ingested: the arrow table IS the data — but the device
             # convention (literal NaN == NULL) must hold for host reads too
@@ -614,12 +654,25 @@ class JaxDataFrame(DataFrame):
         return pa_table_to_pandas(self.as_arrow_local())
 
     def as_local_bounded(self) -> LocalBoundedDataFrame:
+        src = getattr(self, "_pending_src", None)
+        if src is not None and not self.has_metadata:
+            # never device-touched: the retained source IS the data — a
+            # host map over an ingested-then-fetched frame costs zero
+            # conversions (pandas NaN and arrow NULL are the same thing on
+            # the host side, so the NaN-to-NULL step isn't needed; shared
+            # zero-copy, same contract as pandas_df_wrapper frames). With
+            # metadata to attach, fall through: reset_metadata on the
+            # shared source would mutate the caller's frame
+            return src.as_local_bounded()
         res = ArrowDataFrame(self.as_arrow())
         if self.has_metadata:
             res.reset_metadata(self.metadata)
         return res
 
     def as_pandas(self) -> pd.DataFrame:
+        src = getattr(self, "_pending_src", None)
+        if src is not None:
+            return src.as_pandas()
         from .._utils.arrow import pa_table_to_pandas
 
         return pa_table_to_pandas(self.as_arrow())

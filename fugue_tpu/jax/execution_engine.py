@@ -893,7 +893,18 @@ class JaxExecutionEngine(ExecutionEngine):
         host-resident frames, keys the preparers can't align, and
         expansions past the per-shard slot budget."""
         from ..dataframe.utils import parse_join_type
+        from .streaming import is_stream_frame, streaming_hash_join
 
+        if is_stream_frame(df1) or is_stream_frame(df2):
+            # one-pass input: bounded-memory broadcast-hash join; ineligible
+            # plans materialize the stream below (the only remaining option)
+            res = streaming_hash_join(self, df1, df2, how, on)
+            if res is not None:
+                return res
+            self.log.warning(
+                "streaming join ineligible for this plan; materializing "
+                "the stream"
+            )
         jt = parse_join_type(how)
         if jt in ("inner", "left_outer", "left_semi", "left_anti"):
             kernel_how = {
@@ -1139,6 +1150,15 @@ class JaxExecutionEngine(ExecutionEngine):
                 return arr.astype(jnp.float64)
             return self._jit_cache[cache_key](arr, mask)
 
+        def _cast64(arr: Any, kind: str) -> Any:
+            cache_key = ("joincast", kind, self._mesh)
+            if cache_key not in self._jit_cache:
+                tgt = jnp.float64 if kind == "f" else jnp.int64
+                self._jit_cache[cache_key] = jax.jit(
+                    lambda a, _t=tgt: a.astype(_t)
+                )
+            return self._jit_cache[cache_key](arr)
+
         kp = _safe_prefix("__key", j1.schema.names)
         left_keys: Dict[str, Any] = {}
         right_keys: List[Any] = []
@@ -1149,6 +1169,18 @@ class JaxExecutionEngine(ExecutionEngine):
             if lenc is None and renc is None:
                 if lm is None and rm is None:
                     lk, rk = la, ra
+                    ld, rd = np.dtype(la.dtype), np.dtype(ra.dtype)
+                    if ld != rd:
+                        # cross-dtype keys match by VALUE via the common
+                        # type (pandas/SQL coercion semantics — the host
+                        # oracle does the same; int64 past 2^53 matches
+                        # inexactly there too)
+                        if "f" in (ld.kind, rd.kind):
+                            lk, rk = _cast64(la, "f"), _cast64(ra, "f")
+                        elif ld.kind in "iub" and rd.kind in "iub":
+                            lk, rk = _cast64(la, "i"), _cast64(ra, "i")
+                        else:
+                            return None
                 elif np.dtype(la.dtype).kind == "f" or (
                     np.dtype(la.dtype).itemsize < 8
                     and np.dtype(ra.dtype).itemsize < 8

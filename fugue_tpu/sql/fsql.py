@@ -912,6 +912,7 @@ def fugue_sql(
     last.yield_dataframe_as("__fugue_sql_result__", as_local=as_local)
     dag.run(engine, engine_conf)
     result = dag.yields["__fugue_sql_result__"].result
+    dag.release_task_results()  # free intermediates now, not at cyclic GC
     return result if as_fugue else get_native_as_df(result)
 
 

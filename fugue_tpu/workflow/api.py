@@ -46,6 +46,7 @@ def transform(
     tdf.yield_dataframe_as("result", as_local=as_local)
     dag.run(engine, engine_conf, infer_by=[df])
     result = dag.yields["result"].result  # type: ignore
+    dag.release_task_results()  # free intermediates now, not at cyclic GC
     return _adjust_result(result, df, as_fugue)
 
 
@@ -89,6 +90,7 @@ def out_transform(
         local_vars=local_vars,
     )
     dag.run(engine, engine_conf, infer_by=[df])
+    dag.release_task_results()
 
 
 def raw_sql(
@@ -112,6 +114,7 @@ def raw_sql(
     res.yield_dataframe_as("result", as_local=as_local)
     dag.run(engine, engine_conf, infer_by=raw_inputs)
     result = dag.yields["result"].result  # type: ignore
+    dag.release_task_results()  # free intermediates now, not at cyclic GC
     if as_fugue or any(isinstance(s, (DataFrame, Yielded)) for s in raw_inputs):
         return result
     return get_native_as_df(result)

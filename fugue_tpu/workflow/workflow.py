@@ -396,10 +396,18 @@ class WorkflowDataFrame:
     def yield_dataframe_as(self, name: str, as_local: bool = False) -> None:
         yielded = YieldedDataFrame(self._task.__uuid__())
         self._workflow._register_yield(name, yielded)
-        engine_holder = self._workflow
+        # weakref: a strong workflow ref here would close the cycle
+        # workflow → tasks → handler → workflow, deferring the release of
+        # every result frame (device memory!) to cyclic GC instead of
+        # refcounting. The handler only fires during run(), when the
+        # workflow is necessarily alive.
+        import weakref
+
+        wf_ref = weakref.ref(self._workflow)
 
         def handler(df: DataFrame) -> None:
-            e = engine_holder._last_engine
+            wf = wf_ref()
+            e = wf._last_engine if wf is not None else None
             out = e.convert_yield_dataframe(df, as_local) if e is not None else df
             yielded.set_value(out)
 
@@ -807,6 +815,20 @@ class FugueWorkflow:
 
             raise modify_traceback(ex, e.conf)
         return FugueWorkflowResult(self._yields)
+
+    def release_task_results(self) -> None:
+        """Drop the per-task result frames held by the last run's context.
+
+        The workflow graph contains inherent reference cycles
+        (WorkflowDataFrame ↔ workflow), so a dropped workflow frees its
+        (possibly device-resident) intermediates only at the next cyclic
+        GC pass — measurably late for multi-GB frames. Single-shot API
+        wrappers (transform/raw_sql/fugue_sql) extract their yields and
+        then call this so intermediates free by refcount immediately.
+        After calling, ``get_result``/``WorkflowDataFrame.result`` raise
+        KeyError — yields are unaffected (they hold their own refs)."""
+        if self._last_context is not None:
+            self._last_context._results.clear()
 
     def get_result(self, df: WorkflowDataFrame) -> DataFrame:
         assert_or_throw(

@@ -229,3 +229,33 @@ class TestEncodedJoins:
         for how in ["inner", "left_outer", "semi", "anti"]:
             got = _check(engine, oracle, left, right, how)
             assert isinstance(got, JaxDataFrame)
+
+
+def test_join_mixed_key_dtypes_match_by_value():
+    """Cross-dtype join keys coerce to the common type (pandas/SQL
+    semantics): float 2.0 matches int 2; 1.5/2.7 match nothing; int32
+    joins int64 exactly."""
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    eng = JaxExecutionEngine()
+    try:
+        big = pd.DataFrame({"k": [1.5, 2.0, 2.7], "v": [1.0, 2.0, 3.0]})
+        dim = pd.DataFrame({"k": [1, 2], "w": [10.0, 20.0]})
+        r = eng.join(eng.to_df(big), eng.to_df(dim), how="inner").as_pandas()
+        assert len(r) == 1 and r["v"].iloc[0] == 2.0 and r["w"].iloc[0] == 20.0
+        a = pd.DataFrame({"k": np.array([1, 2, 3], np.int32), "v": [1.0, 2.0, 3.0]})
+        b = pd.DataFrame({"k": np.array([2, 3, 4], np.int64), "w": [5.0, 6.0, 7.0]})
+        r2 = eng.join(eng.to_df(a), eng.to_df(b), how="inner").as_pandas()
+        assert sorted(r2["v"]) == [2.0, 3.0]
+        # left_outer keeps unmatched float keys with NULL payload
+        r3 = (
+            eng.join(eng.to_df(big), eng.to_df(dim), how="left_outer")
+            .as_pandas()
+            .sort_values("v")
+        )
+        assert len(r3) == 3 and list(r3["w"].isna()) == [True, False, True]
+    finally:
+        eng.stop_engine()

@@ -282,3 +282,197 @@ def test_stream_parquet_roundtrip(eng, tmp_path):
     got = res.as_pandas().sort_values("k").reset_index(drop=True)
     pd.testing.assert_frame_equal(got, _oracle(pdf), check_dtype=False, atol=1e-9)
     assert streaming.last_run_stats["chunks"] >= 9
+
+
+# --------------------------------------------------------------------------
+# streaming broadcast-hash join
+# --------------------------------------------------------------------------
+
+
+def _join_stream(pdf: pd.DataFrame, n_chunks: int = 7):
+    return _chunk_stream(pdf, n_chunks)
+
+
+def _join_frames(n_stream: int = 20000, n_dim: int = 400, seed: int = 3):
+    rng = np.random.default_rng(seed)
+    big = pd.DataFrame(
+        {"k": rng.integers(0, 500, n_stream), "v": rng.random(n_stream)}
+    )
+    dim = pd.DataFrame(
+        {
+            "k": np.arange(n_dim),
+            "w": np.arange(n_dim) * 1.5,
+            "c": np.arange(n_dim, dtype=np.int64) * 3,
+            "flag": np.arange(n_dim) % 2 == 0,
+        }
+    )
+    return big, dim
+
+
+@pytest.mark.parametrize("how,p_how", [("inner", "inner"), ("left", "left")])
+def test_streaming_join_stream_left(how, p_how):
+    big, dim = _join_frames()
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 3000})
+    try:
+        res = e.join(_join_stream(big), e.to_df(dim), how=how)
+        assert isinstance(res, LocalDataFrameIterableDataFrame)
+        got = res.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        exp = big.merge(dim, on="k", how=p_how).sort_values(["k", "v"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        assert np.allclose(got["v"], exp["v"]) and (got["k"] == exp["k"]).all()
+        for c in ("w", "c", "flag"):
+            m = exp[c].notna().to_numpy()
+            assert (got[c].isna().to_numpy() == ~m).all()
+            assert (
+                got[c][m].to_numpy(np.float64)
+                == exp[c][m].to_numpy(np.float64)
+            ).all()
+        assert streaming.last_run_stats["verb"] == "join"
+        assert streaming.last_run_stats["chunks"] >= 7
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_join_stream_right_outer():
+    big, dim = _join_frames()
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 3000})
+    try:
+        res = e.join(e.to_df(dim), _join_stream(big), how="right")
+        got = res.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        exp = dim.merge(big, on="k", how="right").sort_values(["k", "v"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        assert (got["w"].isna().to_numpy() == exp["w"].isna().to_numpy()).all()
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_join_nan_keys_never_match():
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 4})
+    big = pd.DataFrame({"k": [1.0, np.nan, 2.0, np.nan, 9.0], "v": [1.0, 2, 3, 4, 5]})
+    dim = pd.DataFrame({"k": [1.0, 2.0], "w": [10.0, 20.0]})
+    try:
+        inner = e.join(
+            _join_stream(big, 2), e.to_df(dim), how="inner"
+        ).as_pandas()
+        assert sorted(inner["v"]) == [1.0, 3.0]
+        left = (
+            e.join(_join_stream(big, 2), e.to_df(dim), how="left")
+            .as_pandas()
+            .sort_values("v")
+        )
+        assert len(left) == 5 and list(left["w"].isna()) == [False, True, False, True, True]
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_join_fallback_materializes():
+    """Duplicate build keys / unsupported types fall back (with a
+    materializing warning) and still produce the right answer."""
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 1000})
+    big = pd.DataFrame({"k": [1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0]})
+    dup = pd.DataFrame({"k": [2, 2, 3], "w": [5.0, 6.0, 7.0]})
+    try:
+        res = e.join(_join_stream(big, 2), e.to_df(dup), how="inner")
+        got = res.as_pandas().sort_values(["k", "v", "w"]).reset_index(drop=True)
+        exp = big.merge(dup, on="k").sort_values(["k", "v", "w"]).reset_index(drop=True)
+        assert len(got) == len(exp) and np.allclose(got["w"], exp["w"])
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_join_empty_build():
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 1000})
+    big = pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+    empty = pd.DataFrame({"k": pd.Series(dtype=np.int64), "w": pd.Series(dtype=np.float64)})
+    try:
+        inner = e.join(_join_stream(big, 1), e.to_df(empty), how="inner").as_pandas()
+        assert len(inner) == 0 and list(inner.columns) == ["k", "v", "w"]
+        left = e.join(_join_stream(big, 1), e.to_df(empty), how="left").as_pandas()
+        assert len(left) == 2 and left["w"].isna().all()
+    finally:
+        e.stop_engine()
+
+
+@pytest.mark.slow
+def test_streaming_join_100m_x_1m_bounded_memory():
+    """VERDICT round-4 done-bar: a 100M-row stream joined against a 1M-row
+    build table with peak device memory < data_bytes/10. Chunks are
+    generated on the fly — the stream never exists in full."""
+    n_chunks, chunk = 50, 2_000_000  # 100M probe rows
+    n_dim = 1_000_000
+    e = JaxExecutionEngine({})
+    dim = pd.DataFrame(
+        {
+            "k": np.arange(n_dim, dtype=np.int64),
+            "w": np.arange(n_dim, dtype=np.float64) * 0.5,
+        }
+    )
+
+    def gen():
+        for i in range(n_chunks):
+            rng = np.random.default_rng(i)
+            yield pd.DataFrame(
+                {
+                    # half the keyspace hits the dim table, half misses
+                    "k": rng.integers(0, 2 * n_dim, chunk),
+                    "v": rng.random(chunk),
+                }
+            )
+
+    try:
+        sdf = LocalDataFrameIterableDataFrame(gen(), schema="k:long,v:double")
+        res = e.join(sdf, e.to_df(dim), how="inner")
+        assert isinstance(res, LocalDataFrameIterableDataFrame)
+        # one-pass consumption: count+checksum without materializing
+        total, hitsum = 0, 0.0
+        for part in res.native:
+            p = part.as_pandas()
+            total += len(p)
+            hitsum += float(p["w"].sum())
+            assert (p["k"] < n_dim).all()
+        stats = streaming.last_run_stats
+        assert stats["verb"] == "join"
+        assert stats["rows"] == n_chunks * chunk
+        # ~half the probe rows hit
+        assert 0.45 * n_chunks * chunk < total < 0.55 * n_chunks * chunk
+        data_bytes = n_chunks * chunk * 16 + n_dim * 16
+        assert stats["peak_device_bytes"] < data_bytes / 10, (
+            stats["peak_device_bytes"],
+            data_bytes,
+        )
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_join_string_and_nullable_payload():
+    """Payload columns never touch the device: strings and nullable ints
+    flow through with NULLs intact (only the key needs a device dtype)."""
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 3})
+    big = pd.DataFrame(
+        {
+            "k": [1, 2, 3, 4, 2, 9],
+            "v": pd.array([10, None, 30, 40, 50, 60], dtype="Int64"),
+            "tag": ["a", "b", None, "d", "e", "f"],
+        }
+    )
+    dim = pd.DataFrame(
+        {
+            "k": [1, 2, 3, 5],
+            "name": ["one", "two", None, "five"],
+            "c": pd.array([100, None, 300, 500], dtype="Int64"),
+        }
+    )
+    try:
+        sdf = _chunk_stream(big, 2)
+        res = e.join(sdf, e.to_df(dim), how="left")
+        assert isinstance(res, LocalDataFrameIterableDataFrame)
+        got = res.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        exp = big.merge(dim, on="k", how="left").sort_values(["k", "v"]).reset_index(drop=True)
+        assert len(got) == len(exp) == 6
+        assert (got["name"].isna().to_numpy() == exp["name"].isna().to_numpy()).all()
+        m = exp["name"].notna()
+        assert list(got["name"][m]) == list(exp["name"][m])
+        assert (got["c"].isna().to_numpy() == exp["c"].isna().to_numpy()).all()
+        assert streaming.last_run_stats["verb"] == "join"
+    finally:
+        e.stop_engine()
