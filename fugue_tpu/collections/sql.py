@@ -3,9 +3,10 @@
 Parity with the reference (`fugue/collections/sql.py:14,48`): SQL statements
 are stored as ``(is_table_ref, text)`` segments so engines can substitute
 their own temp-table naming before execution. Dialect transpilation is a
-plugin (``transpile_sql``) — the default is passthrough since no sqlglot is
-available in this environment; engines that need dialect conversion can
-register a candidate.
+plugin (``transpile_sql``); the in-tree implementation lives in
+``fugue_tpu.sql.dialect`` (registered at import — the sqlglot role:
+quoting/type/function/LIMIT conversions between registered dialect
+profiles) and the decorated default below is the no-dialect passthrough.
 """
 
 import uuid

@@ -245,6 +245,25 @@ class ExecutionEngine(FugueEngineBase):
                 if self._ctx_count == 0 and not self._is_global:
                     self.stop()
 
+    @contextmanager
+    def _as_borrowed_context(self) -> Iterator["ExecutionEngine"]:
+        """Set as the context engine WITHOUT stop-on-last-exit ownership.
+
+        Workflow runs BORROW the engine: the reference's ``dag.run(engine)``
+        never stops a user-held engine (no as_context in
+        `/root/reference/fugue/workflow/workflow.py`), so the same engine
+        instance can run many workflows. Stop-on-exit remains the contract
+        of the explicit ``engine_context``/``as_context`` API only."""
+        with self._rlock:
+            self._ctx_count += 1
+        token = _CONTEXT_ENGINE.set(self)
+        try:
+            yield self
+        finally:
+            _CONTEXT_ENGINE.reset(token)
+            with self._rlock:
+                self._ctx_count -= 1
+
     def set_global(self) -> "ExecutionEngine":
         with _GLOBAL_ENGINE_LOCK:
             old = _GLOBAL_ENGINE[0]

@@ -1175,6 +1175,12 @@ class JaxExecutionEngine(ExecutionEngine):
                         # type (pandas/SQL coercion semantics — the host
                         # oracle does the same; int64 past 2^53 matches
                         # inexactly there too)
+                        if (ld.kind == "u" and ld.itemsize == 8) or (
+                            rd.kind == "u" and rd.itemsize == 8
+                        ):
+                            # uint64 ≥ 2^63 would wrap under an int64 cast
+                            # into false matches — host fallback is exact
+                            return None
                         if "f" in (ld.kind, rd.kind):
                             lk, rk = _cast64(la, "f"), _cast64(ra, "f")
                         elif ld.kind in "iub" and rd.kind in "iub":

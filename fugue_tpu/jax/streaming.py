@@ -493,7 +493,6 @@ def streaming_hash_join(
     if len(bsorted) > 1 and (bsorted[1:] == bsorted[:-1]).any():
         return None  # duplicates need the 1:N expansion kernel
     payload_names = [n for n in build_df.schema.names if n != key]
-    stream_names = list(stream_df.schema.names)
     n_build = len(bkeys)
     key_np = np.dtype(
         build_df.schema[key].type.to_pandas_dtype()

@@ -1,4 +1,6 @@
 from .fsql import FugueSQLWorkflow, fugue_sql, fugue_sql_flow, fill_sql_template
+from . import dialect  # registers the transpile_sql implementation
+from .dialect import DialectProfile, register_dialect, transpile
 from .local_sql import LocalSQLEngine
 from .parser import SQLParser
 from .executor import SQLExecutor
@@ -14,6 +16,9 @@ __all__ = [
     "LocalSQLEngine",
     "SQLParser",
     "SQLExecutor",
+    "DialectProfile",
+    "register_dialect",
+    "transpile",
 ]
 
 from ..execution.factory import register_sql_engine

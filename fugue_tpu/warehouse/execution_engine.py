@@ -40,31 +40,9 @@ from ..schema import Schema
 from .dataframe import WarehouseDataFrame
 
 _TEMP_TABLE_NAMES = (f"_fugue_temp_table_{i:d}" for i in itertools.count())
-_SCHEMA_META_TABLE = "__fugue_schemas__"
 _ROWNUM_COL = "__fugue_wh_rn__"
 
-# arrow type → sqlite storage class; everything else must fail loudly
-_STORAGE: List[Tuple[Callable[[pa.DataType], bool], str]] = [
-    (pa.types.is_boolean, "INTEGER"),
-    (pa.types.is_integer, "INTEGER"),
-    (pa.types.is_floating, "REAL"),
-    (pa.types.is_string, "TEXT"),
-    (pa.types.is_large_string, "TEXT"),
-    (pa.types.is_binary, "BLOB"),
-    (pa.types.is_large_binary, "BLOB"),
-    (pa.types.is_timestamp, "TEXT"),
-    (pa.types.is_date, "TEXT"),
-]
-
-
-def _storage_type(tp: pa.DataType) -> str:
-    for pred, st in _STORAGE:
-        if pred(tp):
-            return st
-    raise FugueInvalidOperation(
-        f"type {tp} has no warehouse storage mapping (nested/decimal "
-        "columns are not supported by the warehouse engine)"
-    )
+from .profile import _SCHEMA_META_TABLE  # single source of truth
 
 
 class _StorageCastGenerator(SQLExpressionGenerator):
@@ -73,11 +51,14 @@ class _StorageCastGenerator(SQLExpressionGenerator):
     type names) — the declared arrow type still rides the recorded frame
     schema, so fetch reconstructs the exact logical type."""
 
-    def __init__(self) -> None:
+    def __init__(self, profile: Any = None) -> None:
         super().__init__(enable_cast=True)
+        from .profile import get_profile
+
+        self._profile = get_profile(profile)
 
     def type_to_sql_type(self, tp: pa.DataType) -> str:
-        return _storage_type(tp)
+        return self._profile.storage_type(tp)
 
 
 class WarehouseSQLEngine(SQLEngine):
@@ -103,7 +84,9 @@ class WarehouseSQLEngine(SQLEngine):
 
     @property
     def dialect(self) -> Optional[str]:
-        return None  # standard SQL; no transpile step
+        # raw SELECT text (usually FugueSQL's spark-flavored dialect)
+        # transpiles to the warehouse driver's dialect before execution
+        return self._wh._profile.name
 
     def encode_name(self, name: str) -> str:
         return self._wh.encode_name(name)
@@ -113,19 +96,43 @@ class WarehouseSQLEngine(SQLEngine):
         name_map: Dict[str, str] = {}
         for k, v in dfs.items():
             wdf = eng.to_df(v)
-            name_map[k] = eng.encode_name(wdf.table)
-        sql = statement.construct(name_map=name_map, log=self.log)
-        tbl = eng.materialize(sql)
-        return eng.track_temp_table(
-            WarehouseDataFrame(eng, tbl, eng.infer_table_schema(tbl))
+            # temp table names are identifier-safe by construction; they
+            # pass through the dialect transpile as bare identifiers
+            name_map[k] = wdf.table
+        sql = statement.construct(
+            name_map=name_map, dialect=self.dialect, log=self.log
         )
+        tbl = eng.materialize(sql)
+        schema: Optional[Schema] = None
+        probe = eng.connection.execute(
+            f"SELECT 1 FROM {eng.encode_name(tbl)} LIMIT 1"
+        ).fetchone()
+        if probe is None:
+            # EMPTY result: nothing to sample, so decltype-less computed
+            # columns would degrade to string — infer the schema statically
+            # from the projected expression IR over the input schemas
+            # instead (parsed in the statement's own dialect text)
+            from ..sql.infer import infer_output_schema
+
+            pre = statement.construct(log=None)
+            inferred = infer_output_schema(
+                pre, {k: v.schema for k, v in dfs.items()}
+            )
+            if inferred is not None:
+                actual_cols = [
+                    n for n, _ in eng._profile.table_info(eng.connection, tbl)
+                ]
+                if list(inferred.names) == actual_cols:
+                    schema = inferred
+                    eng.record_schema(tbl, schema)
+        if schema is None:
+            schema = eng.infer_table_schema(tbl)
+        return eng.track_temp_table(WarehouseDataFrame(eng, tbl, schema))
 
     def table_exists(self, table: str) -> bool:
         eng = self._wh
         cur = eng.connection.execute(
-            "SELECT name FROM sqlite_master WHERE type IN ('table','view') "
-            "AND name = ?",
-            (table,),
+            eng._profile.table_exists_sql(views=True), (table,)
         )
         return cur.fetchone() is not None
 
@@ -206,10 +213,19 @@ class WarehouseExecutionEngine(ExecutionEngine):
     ``as_*`` fetches.
     """
 
-    def __init__(self, conf: Any = None, connection: Any = None, path: str = ":memory:"):
+    def __init__(
+        self,
+        conf: Any = None,
+        connection: Any = None,
+        path: str = ":memory:",
+        profile: Any = None,
+    ):
         super().__init__(conf)
         import sqlite3
 
+        from .profile import get_profile
+
+        self._profile = get_profile(profile)
         self._own_connection = connection is None
         self._connection = (
             connection
@@ -227,7 +243,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
         self._schemas: Dict[str, Schema] = {}
         self._local_engine = NativeExecutionEngine(conf)
         self._log = logging.getLogger("fugue_tpu.warehouse")
-        self._gen = _StorageCastGenerator()
+        self._gen = _StorageCastGenerator(self._profile)
 
     # ---- base wiring ------------------------------------------------------
     @property
@@ -262,7 +278,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
             self._connection.close()
 
     def encode_name(self, name: str) -> str:
-        return '"' + name.replace('"', '""') + '"'
+        return self._profile.quote(name)
 
     def convert_yield_dataframe(self, df: DataFrame, as_local: bool) -> DataFrame:
         # warehouse frames die with the connection (reference DuckDB does
@@ -304,16 +320,13 @@ class WarehouseExecutionEngine(ExecutionEngine):
         """Write a local frame into a warehouse temp table."""
         tbl = next(_TEMP_TABLE_NAMES)
         schema = df.schema
-        cols = ", ".join(
-            f"{self.encode_name(f.name)} {_storage_type(f.type)}"
-            for f in schema.fields
+        self._connection.execute(
+            self._profile.create_temp_table_sql(tbl, schema)
         )
-        self._connection.execute(f"CREATE TEMP TABLE {self.encode_name(tbl)} ({cols})")
         arrow = df.as_arrow() if not isinstance(df, ArrowDataFrame) else df.native
         rows = _arrow_to_storage_rows(arrow, schema)
-        ph = ", ".join("?" for _ in schema.fields)
         self._connection.executemany(
-            f"INSERT INTO {self.encode_name(tbl)} VALUES ({ph})", rows
+            self._profile.insert_sql(tbl, len(schema.fields)), rows
         )
         self.record_schema(tbl, schema)
         return self.track_temp_table(WarehouseDataFrame(self, tbl, schema))
@@ -322,7 +335,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
         """Run ``sql`` into a fresh temp table; return the table name."""
         tbl = next(_TEMP_TABLE_NAMES)
         self._connection.execute(
-            f"CREATE TEMP TABLE {self.encode_name(tbl)} AS {sql}"
+            self._profile.create_temp_table_as_sql(tbl, sql)
         )
         return tbl
 
@@ -334,13 +347,9 @@ class WarehouseExecutionEngine(ExecutionEngine):
             # schema fidelity across engine instances over the same DB file:
             # sqlite's storage classes can't round-trip bool/datetime/int
             # widths, so the exact Fugue schema rides in a meta table
+            self._connection.execute(self._profile.meta_create_sql())
             self._connection.execute(
-                f"CREATE TABLE IF NOT EXISTS {_SCHEMA_META_TABLE} "
-                "(tbl TEXT PRIMARY KEY, schema TEXT)"
-            )
-            self._connection.execute(
-                f"INSERT OR REPLACE INTO {_SCHEMA_META_TABLE} VALUES (?, ?)",
-                (table, str(schema)),
+                self._profile.meta_upsert_sql(), (table, str(schema))
             )
 
     def infer_table_schema(self, table: str) -> Schema:
@@ -359,7 +368,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
         if table in self._schemas:
             return self._schemas[table]
         cur = self._connection.execute(
-            f"SELECT tbl, schema FROM {_SCHEMA_META_TABLE} WHERE tbl = ?", (table,)
+            self._profile.meta_select_sql(), (table,)
         ) if self._meta_exists() else None
         row = cur.fetchone() if cur is not None else None
         if row is not None:
@@ -367,20 +376,9 @@ class WarehouseExecutionEngine(ExecutionEngine):
             self._schemas[table] = schema
             return schema
         fields: List[pa.Field] = []
-        info = self._connection.execute(
-            f"PRAGMA table_info({self.encode_name(table)})"
-        ).fetchall()
-        for _, name, decltype, *_rest in info:
-            decl = (decltype or "").upper()
-            if "INT" in decl:
-                tp: pa.DataType = pa.int64()
-            elif decl in ("REAL", "FLOAT", "DOUBLE"):
-                tp = pa.float64()
-            elif "CHAR" in decl or "TEXT" in decl:
-                tp = pa.string()
-            elif "BLOB" in decl:
-                tp = pa.binary()
-            else:
+        for name, decltype in self._profile.table_info(self._connection, table):
+            tp = self._profile.decl_to_arrow(decltype)
+            if tp is None:
                 tp = self._sample_type(table, name)
             fields.append(pa.field(name, tp))
         schema = Schema(fields)
@@ -389,8 +387,7 @@ class WarehouseExecutionEngine(ExecutionEngine):
 
     def _meta_exists(self) -> bool:
         cur = self._connection.execute(
-            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
-            (_SCHEMA_META_TABLE,),
+            self._profile.table_exists_sql(views=False), (_SCHEMA_META_TABLE,)
         )
         return cur.fetchone() is not None
 

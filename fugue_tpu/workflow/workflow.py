@@ -808,7 +808,7 @@ class FugueWorkflow:
         self._last_context = ctx
         self._apply_auto_persist(e)
         try:
-            with e._as_context():
+            with e._as_borrowed_context():
                 ctx.run(self._tasks)
         except Exception as ex:
             from .._utils.exception import modify_traceback

@@ -1,0 +1,155 @@
+"""SQL dialect transpiler (`fugue_tpu/sql/dialect.py`) — the sqlglot role.
+
+Golden tests pin the emitted SQL text per dialect pair; the plugin test
+proves `StructuredRawSQL.construct(dialect=...)` routes through it
+(reference behavior: `/root/reference/fugue/collections/sql.py:25-45`).
+"""
+
+import pytest
+
+from fugue_tpu.collections.sql import StructuredRawSQL, transpile_sql
+from fugue_tpu.exceptions import FugueSQLSyntaxError
+from fugue_tpu.sql import DialectProfile, register_dialect, transpile
+
+
+def test_quoting_conversions():
+    # spark/fugue: backtick idents, double-quoted strings
+    assert (
+        transpile('SELECT `a b` FROM t WHERE x = "hi"', "fugue", "sqlite")
+        == "SELECT \"a b\" FROM t WHERE x = 'hi'"
+    )
+    # postgres double-quoted identifiers -> fugue backticks
+    assert (
+        transpile('SELECT "a b" FROM t', "postgres", "fugue")
+        == "SELECT `a b` FROM t"
+    )
+    # mssql brackets
+    assert (
+        transpile("SELECT [a b] FROM t", "mssql", "postgres")
+        == 'SELECT "a b" FROM t'
+    )
+    # embedded quotes escape by doubling in the target convention
+    assert (
+        transpile('SELECT `we``ird` FROM t', "fugue", "postgres")
+        == 'SELECT "we`ird" FROM t'
+    )
+    assert (
+        transpile("SELECT a FROM t WHERE s = 'it''s'", "fugue", "postgres")
+        == "SELECT a FROM t WHERE s = 'it''s'"
+    )
+
+
+def test_cast_type_mapping():
+    assert (
+        transpile("SELECT CAST(x AS double) FROM t", "fugue", "postgres")
+        == "SELECT CAST(x AS DOUBLE PRECISION) FROM t"
+    )
+    assert (
+        transpile("SELECT CAST(x AS double) FROM t", "fugue", "sqlite")
+        == "SELECT CAST(x AS REAL) FROM t"
+    )
+    assert (
+        transpile(
+            "SELECT CAST(x AS DOUBLE PRECISION) FROM t", "postgres", "fugue"
+        )
+        == "SELECT CAST(x AS double) FROM t"
+    )
+    assert (
+        transpile("SELECT CAST(b AS bool) FROM t", "fugue", "mssql")
+        == "SELECT CAST(b AS BIT) FROM t"
+    )
+    # nested cast inside a function call
+    assert (
+        transpile("SELECT SUM(CAST(x AS long)) AS s FROM t", "fugue", "postgres")
+        == "SELECT SUM(CAST(x AS BIGINT)) AS s FROM t"
+    )
+
+
+def test_function_renames_round_trip():
+    assert (
+        transpile("SELECT SUBSTRING(s, 1, 2) FROM t", "fugue", "sqlite")
+        == "SELECT SUBSTR(s, 1, 2) FROM t"
+    )
+    assert (
+        transpile("SELECT SUBSTR(s, 1, 2) FROM t", "sqlite", "fugue")
+        == "SELECT SUBSTRING(s, 1, 2) FROM t"
+    )
+    assert (
+        transpile("SELECT STRING_AGG(s) FROM t", "fugue", "mysql")
+        == "SELECT GROUP_CONCAT(s) FROM t"
+    )
+    # a column NAMED like a function is not renamed (no call parens)
+    assert (
+        transpile("SELECT SUBSTRING FROM t", "fugue", "sqlite")
+        == "SELECT SUBSTRING FROM t"
+    )
+
+
+def test_limit_top_conversion():
+    assert (
+        transpile("SELECT a FROM t LIMIT 10", "fugue", "mssql")
+        == "SELECT TOP 10 a FROM t"
+    )
+    assert (
+        transpile("SELECT TOP 3 a FROM t", "mssql", "fugue")
+        == "SELECT a FROM t LIMIT 3"
+    )
+    # LIMIT inside a subquery is not top-level: left in place
+    out = transpile(
+        "SELECT * FROM (SELECT a FROM t LIMIT 5) q", "fugue", "postgres"
+    )
+    assert "LIMIT 5" in out
+
+
+def test_bool_literals():
+    assert (
+        transpile("SELECT * FROM t WHERE ok = TRUE AND bad = FALSE", "fugue", "sqlite")
+        == "SELECT * FROM t WHERE ok = 1 AND bad = 0"
+    )
+    # postgres keeps the keywords
+    assert (
+        transpile("SELECT * FROM t WHERE ok = TRUE", "fugue", "postgres")
+        == "SELECT * FROM t WHERE ok = TRUE"
+    )
+
+
+def test_same_dialect_is_identity():
+    sql = "SeLeCt   weird    , spacing FROM t"
+    assert transpile(sql, "fugue", "fugue") == sql
+
+
+def test_unknown_dialect_raises():
+    with pytest.raises(FugueSQLSyntaxError):
+        transpile("SELECT 1", "fugue", "nope")
+
+
+def test_custom_dialect_registration():
+    register_dialect(
+        DialectProfile(
+            name="testql",
+            ident_quote=("<", ">"),
+            func_map={"SUBSTRING": "SLICE"},
+        )
+    )
+    assert (
+        transpile("SELECT `a b`, SUBSTRING(s, 1) FROM t", "fugue", "testql")
+        == "SELECT <a b>, SLICE(s, 1) FROM t"
+    )
+
+
+def test_structured_raw_sql_routes_through_plugin():
+    s = StructuredRawSQL.from_expr(
+        'SELECT `a b`, CAST(x AS double) AS y FROM <tmpdf:t0> LIMIT 2',
+        dialect="fugue",
+    )
+    out = s.construct(name_map={"t0": "real_table"}, dialect="sqlite")
+    assert out == (
+        'SELECT "a b", CAST(x AS REAL) AS y FROM real_table LIMIT 2'
+    )
+    # plugin callable directly
+    assert (
+        transpile_sql("SELECT CAST(x AS str) FROM t", "fugue", "postgres")
+        == "SELECT CAST(x AS TEXT) FROM t"
+    )
+    # same dialect: untouched
+    assert s.construct(name_map={"t0": "z"}, dialect="fugue").startswith("SELECT `a b`")
