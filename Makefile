@@ -1,4 +1,4 @@
-.PHONY: install test bench dryrun native
+.PHONY: install test test-multihost bench dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -7,6 +7,16 @@ install:
 
 test:
 	python -m pytest tests/ -q
+
+# large-scale proofs (100M-row streaming, 100Mx1M join) — excluded from the
+# default run by addopts='-m "not slow"'; the explicit -m here overrides it
+test-slow:
+	python -m pytest tests/ -q -m slow
+
+# the multihost job: engine verbs + collectives across a REAL 2-process
+# jax.distributed mesh (each worker is its own OS process)
+test-multihost:
+	python -m pytest tests/core/test_multihost.py -q -m "slow or not slow"
 
 bench:
 	python bench.py

@@ -546,6 +546,22 @@ class FugueWorkflow:
     def create(
         self, using: Any, schema: Any = None, params: Any = None
     ) -> WorkflowDataFrame:
+        import pandas as _pd
+        import pyarrow as _pa
+
+        if isinstance(
+            using, (DataFrame, WorkflowDataFrame, _pd.DataFrame, _pa.Table)
+        ):
+            # a dataframe: identical task spec to ``df()`` so the two
+            # spellings share one deterministic uuid (reference
+            # test_create_df_equivalence — checkpoint identity depends on
+            # it). Anything else — Creator instances/classes, callables,
+            # registered names — goes through the creator conversion
+            assert_or_throw(
+                params is None,
+                FugueWorkflowCompileError("params must be None for dataframes"),
+            )
+            return self.create_data(using, schema)
         _g, _l = get_caller_global_local_vars()
         creator = _to_creator(using, schema, global_vars=_g, local_vars=_l)
         return self._add(CreateTask(creator, params=ParamDict(params)))

@@ -173,6 +173,50 @@ class BuiltInTests:
             )
             dag.run(self.engine)
 
+        def test_local_instance_as_extension(self):
+            """Bound methods of a local object as transformers, with
+            ``# schema:`` comments on the METHOD (reference
+            ``builtin_suite.py`` test_local_instance_as_extension) —
+            exercises interfaceless conversion over instance methods."""
+
+            class _Mock(object):
+                # schema: *
+                def t1(self, df: pd.DataFrame) -> pd.DataFrame:
+                    return df
+
+                def t2(self, df: pd.DataFrame) -> pd.DataFrame:
+                    return df
+
+                def run_inner(self, engine: Any) -> None:
+                    dag_ = FugueWorkflow()
+                    a = dag_.df([[0], [1]], "a:int")
+                    b = a.transform(self.t1)
+                    b.assert_eq(a)
+                    dag_.run(engine)
+
+            m = _Mock()
+            m.run_inner(self.engine)
+            dag = FugueWorkflow()
+            a = dag.df([[0], [1]], "a:int")
+            b = a.transform(m.t1).transform(m.t2, schema="*")
+            b.assert_eq(a)
+            dag.run(self.engine)
+
+        def test_create_df_equivalence(self):
+            """``dag.df(x)`` and ``dag.create(x)`` compile to the SAME
+            deterministic spec uuid for an engine-native frame (reference
+            test_create_df_equivalence) — checkpoint determinism depends
+            on this equivalence."""
+            ndf = self.engine.to_df(pd.DataFrame([[0]], columns=["a"]))
+            dag1 = FugueWorkflow()
+            dag1.df(ndf).show()
+            dag2 = FugueWorkflow()
+            dag2.create(ndf).show()
+            assert dag1.spec_uuid() == dag2.spec_uuid()
+            # and both spellings actually run on the engine
+            dag1.run(self.engine)
+            dag2.run(self.engine)
+
         def test_transform_iterable_chunks(self):
             def chunks(dfs: Iterable[pd.DataFrame]) -> Iterable[pd.DataFrame]:
                 for c in dfs:

@@ -12,6 +12,48 @@ import sys
 
 import pytest
 
+def _run_two_workers(tmp_path, template, token, timeout=150, n=2):
+    """Shared two-process launcher: free port, write the worker script,
+    spawn ``n`` coordinated processes, assert every one prints its
+    ``token`` line."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    worker = os.path.join(str(tmp_path), "worker.py")
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    with open(worker, "w") as f:
+        f.write(template.format(repo=repo))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+        )
+        for i in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0 and f"{token} {i}".encode() in out, err.decode()[-3000:]
+    return outs
+
+
 _WORKER = r"""
 import os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -48,41 +90,7 @@ print("MH_OK", pid, flush=True)
 
 
 def test_two_process_distributed_mesh(tmp_path):
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    worker = os.path.join(str(tmp_path), "worker.py")
-    repo = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    with open(worker, "w") as f:
-        f.write(_WORKER.format(repo=repo))
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=150)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (rc, out, err) in enumerate(outs):
-        assert rc == 0 and f"MH_OK {i}".encode() in out, err.decode()[-2000:]
+    _run_two_workers(tmp_path, _WORKER, "MH_OK")
 
 
 _COMAP_WORKER = r"""
@@ -161,44 +169,91 @@ print("MHC_OK", pid, len(executed), flush=True)
 
 
 def test_two_process_per_host_comap(tmp_path):
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    worker = os.path.join(str(tmp_path), "comap_worker.py")
-    repo = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    with open(worker, "w") as f:
-        f.write(_COMAP_WORKER.format(repo=repo))
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=150)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    executed_counts = []
-    for i, (rc, out, err) in enumerate(outs):
-        assert rc == 0 and f"MHC_OK {i}".encode() in out, err.decode()[-3000:]
-        executed_counts.append(
-            int(out.decode().strip().split()[-1])
-        )
+    outs = _run_two_workers(tmp_path, _COMAP_WORKER, "MHC_OK")
+    executed_counts = [int(out.decode().strip().split()[-1]) for _, out, _ in outs]
     # both hosts did real work (keys hash-spread over both processes)
     assert all(c > 0 for c in executed_counts), executed_counts
+
+
+_ENGINE_SUITE_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+sys.path.insert(0, {repo!r})
+from fugue_tpu.parallel.distributed import initialize_distributed
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=pid
+)
+import numpy as np, pandas as pd
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from typing import Dict
+import fugue_tpu.api as fa
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.jax import JaxExecutionEngine, group_ops as go
+
+# the engine-verb slice of the execution contract on a REAL 2-process x
+# 2-device mesh (VERDICT r4 #8): aggregate, compiled keyed map, join,
+# repartition. Every process ingests the same global frame; correctness
+# is asserted through REPLICATED device checksums (a device_get of a
+# non-addressable shard would be invalid multi-process).
+e = JaxExecutionEngine()
+rep = NamedSharding(e.mesh, P())
+
+def rsum(frame, name):
+    # masked, cross-shard replicated sum of one column -> float on every host
+    arr = frame.device_cols[name]
+    m = frame.device_valid_mask()
+    s = jax.jit(
+        lambda a, mm: jnp.sum(jnp.where(mm, a, 0.0)), out_shardings=rep
+    )(arr.astype(jnp.float64), m)
+    return float(s)
+
+rng = np.random.default_rng(7)
+pdf = pd.DataFrame({{"k": rng.integers(0, 40, 4000), "v": rng.random(4000)}})
+jdf = e.to_df(pdf)
+
+# 1) aggregate (dense fused, device-resident result)
+agg = e.aggregate(
+    jdf, PartitionSpec(by=["k"]),
+    [ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n")],
+)
+exp = pdf.groupby("k")["v"].sum()
+assert abs(rsum(agg, "s") - float(exp.sum())) < 1e-8
+assert abs(rsum(agg, "n") - float(len(pdf))) < 1e-8
+
+# 2) compiled keyed map (demean per key)
+def demean(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    m = go.mean(cols, cols["v"])
+    return {{"k": cols["k"], "v": cols["v"] - go.per_row(cols, m)}}
+
+out = fa.transform(
+    jdf, demean, schema="k:long,v:double",
+    partition=PartitionSpec(by=["k"]), engine=e, as_fugue=True,
+)
+exp_dm = pdf["v"] - pdf.groupby("k")["v"].transform("mean")
+assert abs(rsum(out, "v") - float(exp_dm.sum())) < 1e-6
+
+# 3) device join
+dim = pd.DataFrame({{"k": np.arange(30), "w": np.arange(30) * 0.5}})
+joined = e.join(jdf, e.to_df(dim), how="inner")
+exp_j = pdf.merge(dim, on="k", how="inner")
+assert abs(rsum(joined, "w") - float(exp_j["w"].sum())) < 1e-8
+assert abs(rsum(joined, "v") - float(exp_j["v"].sum())) < 1e-8
+
+# 4) repartition (hash exchange) preserves content
+rp = e.repartition(jdf, PartitionSpec(by=["k"], num=4))
+assert abs(rsum(rp, "v") - float(pdf["v"].sum())) < 1e-8
+
+print("MH_ENGINE_OK", pid, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_engine_suite(tmp_path):
+    """Engine verbs (aggregate/keyed map/join/repartition) across a real
+    2-process mesh — the multihost slice of the execution contract."""
+    _run_two_workers(tmp_path, _ENGINE_SUITE_WORKER, "MH_ENGINE_OK", timeout=300)
