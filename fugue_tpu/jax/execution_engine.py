@@ -106,6 +106,20 @@ class JaxMapEngine(MapEngine):
                     return streaming_compiled_map(
                         engine, df, raw, output_schema, on_init
                     )
+            elif raw is not None:
+                from .streaming import (
+                    is_stream_frame,
+                    streaming_keyed_compiled_map,
+                )
+
+                if is_stream_frame(df):
+                    # key-clustered stream + keyed compiled UDF: re-batch
+                    # at key boundaries, fixed-capacity device batches
+                    # (raises with remediation when ineligible — a one-pass
+                    # stream must never silently materialize on device)
+                    return streaming_keyed_compiled_map(
+                        engine, df, raw, output_schema, partition_spec, on_init
+                    )
             if raw is not None:
                 jdf = engine.to_df(df)
                 keys = list(partition_spec.partition_by)
@@ -2153,7 +2167,12 @@ class JaxExecutionEngine(ExecutionEngine):
         """Device distinct when every column is device-resident: the groupby
         kernel with a presence count — keys of the merged partials are the
         distinct rows. Dictionary codes / epoch ints / null masks group by
-        their device identity and decode on the O(groups) host result."""
+        their device identity and decode on the O(groups) host result.
+        One-pass streams dedupe chunk-wise without materializing."""
+        from .streaming import is_stream_frame, streaming_distinct
+
+        if is_stream_frame(df):
+            return streaming_distinct(self, df)
         from ..ops.segment import device_groupby_partials
 
         from ..constants import FUGUE_TPU_CONF_MAX_PARTIAL_ROWS
@@ -2401,7 +2420,13 @@ class JaxExecutionEngine(ExecutionEngine):
         DESC negates floats / bit-inverts ints, both NaN/order preserving).
         """
         from ..collections.partition import parse_presort_exp
+        from .streaming import is_stream_frame, streaming_take
 
+        if is_stream_frame(df):
+            # one-pass stream: running top-n buffers, O(n·keys + chunk)
+            return streaming_take(
+                self, df, n, presort, na_position, partition_spec
+            )
         jdf = self.to_df(df)
         sorts = parse_presort_exp(presort) if presort else (
             partition_spec.presort if partition_spec is not None else {}

@@ -128,3 +128,53 @@ def mean(cols: Dict[str, Any], x: Any) -> Any:
 def per_row(cols: Dict[str, Any], table: Any) -> Any:
     """Broadcast a group table back to rows (``table[segment_id]``)."""
     return table[cols[SEGMENTS]]
+
+
+def _require_ordered(cols: Dict[str, Any], what: str) -> None:
+    if SPANS_SHARDS in cols:
+        from ..exceptions import FugueInvalidOperation
+
+        raise FugueInvalidOperation(
+            f"{what} needs ordered, shard-complete groups (the sorted plan);"
+            " the dense plan leaves groups spanning shards in input order."
+            " Add a presort to the partition spec to force the sorted plan."
+        )
+
+
+def running_sum(cols: Dict[str, Any], x: Any) -> Any:
+    """Per-row RUNNING sum of ``x`` within its group, in sort order — the
+    ``SUM(...) OVER (PARTITION BY k ORDER BY ... ROWS UNBOUNDED PRECEDING)``
+    window kernel. Sorted-plan only (groups must be contiguous + ordered);
+    invalid/padding rows contribute 0. Row-aligned output."""
+    import jax.numpy as jnp
+
+    _require_ordered(cols, "running_sum")
+    # accumulate in the widest type: a global f32/i32 prefix sum would
+    # leak the SHARD's absolute rounding/overflow into every group's
+    # c - base subtraction; the result casts back at the end
+    acc_dt = (
+        jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating) else jnp.int64
+    )
+    xv = jnp.where(cols[VALID], x, jnp.zeros((), dtype=x.dtype)).astype(acc_dt)
+    c = jnp.cumsum(xv)
+    # first row index of each segment -> the cumsum base to subtract
+    idx = jnp.arange(c.shape[0])
+    from jax.ops import segment_min as _sm
+
+    first = _sm(idx, cols[SEGMENTS], num_segments=num_segments(cols))
+    firstc = jnp.where(
+        cols[VALID], c[first[cols[SEGMENTS]]] - xv[first[cols[SEGMENTS]]], 0
+    )
+    run = jnp.where(cols[VALID], c - firstc, jnp.zeros((), dtype=acc_dt))
+    return run.astype(x.dtype)
+
+
+def row_number(cols: Dict[str, Any], dtype: Any = None) -> Any:
+    """Per-row 1-based position within its group, in sort order — the
+    ``ROW_NUMBER() OVER (PARTITION BY k ORDER BY ...)`` window kernel.
+    Sorted-plan only. Row-aligned output."""
+    import jax.numpy as jnp
+
+    _require_ordered(cols, "row_number")
+    dt = dtype if dtype is not None else jnp.int64
+    return running_sum(cols, cols[VALID].astype(dt))

@@ -7,7 +7,7 @@ map outputs flow as `LocalDataFrameIterableDataFrame`
 (`/root/reference/fugue/dataframe/dataframe_iterable_dataframe.py:21`).
 A `JaxDataFrame` instead puts every column fully on device, capping the
 engine at HBM (~16GB on a v5e chip). This module removes that cap for
-the two hot verbs:
+the engine verbs:
 
 - **aggregate** — `streaming_dense_aggregate`: arrow/pandas chunks feed
   the dense-bucket groupby kernel (`ops/segment.py`) one fixed-capacity
@@ -15,13 +15,23 @@ the two hot verbs:
   DEVICE-RESIDENT accumulators merged chunk-by-chunk in one jitted step
   (donated, so XLA updates them in place). Device working set =
   O(chunk_rows × columns + buckets), independent of dataset size — the
-  only road to the 1B-row north star (`BASELINE.json`).
+  road to the 1B-row north star (`BASELINE.json`, NORTH_STAR.json).
 - **transform** — `streaming_compiled_map`: a jax-annotated row-wise UDF
   compiled ONCE for a fixed chunk capacity, applied chunk-wise; outputs
   stream back to the host as a one-pass `LocalDataFrameIterableDataFrame`
   so neither input nor output ever fully materializes on device.
+- **keyed transform / windows** — `streaming_keyed_compiled_map`: keyed
+  compiled maps over KEY-CLUSTERED streams; chunks re-batch at key
+  boundaries so groups stay whole, each batch runs the regular keyed
+  map at one fixed capacity. With `group_ops.running_sum`/`row_number`
+  this is the running-window kernel over key-partitioned streams.
+- **join** — `streaming_hash_join`: stream ⋈ dimension table; sorted
+  build keys replicated on device, per-chunk `searchsorted` probe,
+  payloads host-side (any dtype, NULLs intact).
+- **take / distinct** — running top-n / running-dedupe buffers, memory
+  O(output + chunk); unsorted global take early-stops the stream.
 
-Both paths bound device memory by `fugue.tpu.stream.chunk_rows`
+Every path bounds device memory by `fugue.tpu.stream.chunk_rows`
 (default 2^20 rows). `last_run_stats` records the measured peak live
 device bytes of the most recent streaming run so tests (and users) can
 PROVE the bound held.
@@ -743,3 +753,304 @@ def streaming_compiled_map(
         last_run_stats = dict(stats, verb="map")
 
     return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+
+
+# --------------------------------------------------------------------------
+# streaming take / distinct
+# --------------------------------------------------------------------------
+
+
+def streaming_take(
+    engine: Any,
+    df: Any,
+    n: int,
+    presort: Any,
+    na_position: str = "last",
+    partition_spec: Any = None,
+) -> DataFrame:
+    """``take`` over a one-pass stream with a bounded working set.
+
+    - no presort, no keys: consume until ``n`` rows (early stop — the
+      stream's tail is never generated);
+    - presort: a running top-``n`` buffer merged per chunk (O(n + chunk));
+    - partition keys: a running per-key head buffer (O(keys·n + chunk)).
+
+    All row movement is host-side pandas per chunk — take outputs are
+    O(n·keys), far below device-offload profitability."""
+    from ..collections.partition import parse_presort_exp
+
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    sorts = (
+        parse_presort_exp(presort)
+        if presort
+        else (partition_spec.presort if partition_spec is not None else {})
+    )
+    keys = (
+        list(partition_spec.partition_by) if partition_spec is not None else []
+    )
+    names = list(sorts.keys())
+    asc = list(sorts.values())
+    schema = Schema(df.schema)
+    buf: Optional[pd.DataFrame] = None
+    stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+    for f in _iter_local_frames(df, chunk_rows):
+        pf = f.as_pandas()
+        stats["chunks"] += 1
+        stats["rows"] += len(pf)
+        buf = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
+        if len(names) > 0:
+            buf = buf.sort_values(
+                names, ascending=asc, na_position=na_position, kind="stable"
+            )
+        if len(keys) == 0:
+            buf = buf.head(n)
+            if len(names) == 0 and len(buf) >= n:
+                break  # unsorted global take: the rest of the stream is moot
+        else:
+            buf = buf.groupby(keys, dropna=False, sort=False).head(n)
+        buf = buf.reset_index(drop=True)
+    global last_run_stats
+    last_run_stats = dict(stats, verb="take")
+    out = buf if buf is not None else pd.DataFrame(columns=schema.names)
+    return engine.to_df(PandasDataFrame(out, schema))
+
+
+def streaming_distinct(engine: Any, df: Any) -> DataFrame:
+    """DISTINCT over a one-pass stream: chunk-wise dedupe against the
+    running distinct set — memory is O(distinct rows + chunk), independent
+    of stream length (SQL NaN==NaN semantics, matching the engines)."""
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    from ..execution.native_execution_engine import _drop_duplicates
+
+    schema = Schema(df.schema)
+    buf: Optional[pd.DataFrame] = None
+    stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+    for f in _iter_local_frames(df, chunk_rows):
+        pf = f.as_pandas()
+        stats["chunks"] += 1
+        stats["rows"] += len(pf)
+        merged = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
+        buf = _drop_duplicates(merged)
+    global last_run_stats
+    last_run_stats = dict(stats, verb="distinct")
+    out = buf if buf is not None else pd.DataFrame(columns=schema.names)
+    return engine.to_df(PandasDataFrame(out, schema))
+
+
+# --------------------------------------------------------------------------
+# streaming KEYED compiled map (the out-of-core window/groupby-apply path)
+# --------------------------------------------------------------------------
+
+
+def streaming_keyed_compiled_map(
+    engine: Any,
+    df: Any,
+    fn: Callable,
+    output_schema: Schema,
+    partition_spec: Any,
+    on_init: Optional[Callable] = None,
+) -> Optional[DataFrame]:
+    """Keyed compiled map over a KEY-CLUSTERED one-pass stream.
+
+    Contract: all rows of one partition key are contiguous in the stream
+    (the natural layout of key-sorted files). Chunks re-batch at key
+    boundaries — the trailing key's rows carry into the next batch so no
+    group is ever split — then each batch runs the regular compiled keyed
+    map (`JaxMapEngine._compiled_keyed_map`) on a FIXED-capacity padded
+    device frame (one XLA compilation for the whole stream). With
+    ``group_ops.running_sum``/``row_number`` inside the UDF this is the
+    window kernel over key-partitioned streams: device memory stays
+    O(capacity), independent of stream length.
+
+    A key that reappears after its batch closed raises (the contract is
+    checkable, not assumed). A single key run larger than the chunk
+    capacity raises with a remediation hint. Returns None (caller
+    materializes) when the schema is ineligible (non-numeric columns)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import ROW_AXIS, num_row_shards, pad_rows
+    from .dataframe import JaxDataFrame
+
+    keys = list(partition_spec.partition_by)
+    if len(keys) == 0:
+        return None
+    in_schema = Schema(df.schema)
+    np_dtypes: Dict[str, np.dtype] = {}
+    for f in in_schema.fields:
+        if not (
+            pa.types.is_integer(f.type)
+            or pa.types.is_floating(f.type)
+            or pa.types.is_boolean(f.type)
+        ):
+            # raising (not a materializing fallback) matches the keyless
+            # streaming map: a one-pass stream exists precisely because it
+            # must not be materialized on device
+            raise FugueInvalidOperation(
+                f"streaming keyed compiled map needs numeric/bool columns; "
+                f"{f.name} is {f.type} (use a pandas-annotated transformer)"
+            )
+        np_dtypes[f.name] = np.dtype(f.type.to_pandas_dtype())
+    mesh = engine._mesh
+    shards = num_row_shards(mesh)
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    capacity = pad_rows(max(chunk_rows, shards), shards)
+    sharding = NamedSharding(mesh, P(ROW_AXIS))
+    out_schema = Schema(output_schema)
+    map_engine = engine.map_engine
+    names = list(in_schema.names)
+
+    def run_batch(batch: pd.DataFrame, closed: set, first: List[bool]):
+        uk = set(
+            map(tuple, batch[keys].drop_duplicates().itertuples(index=False, name=None))
+        )
+        overlap = uk & closed
+        assert_or_throw(
+            len(overlap) == 0,
+            FugueInvalidOperation(
+                "streaming keyed map: the stream is not key-clustered — "
+                f"key(s) {sorted(overlap)[:3]} reappeared after their rows "
+                "were already processed. Sort/cluster the stream by "
+                f"{keys} first."
+            ),
+        )
+        closed |= uk
+        k = len(batch)
+        assert_or_throw(
+            k <= capacity,
+            FugueInvalidOperation(
+                f"streaming keyed map: a contiguous key run ({k} rows) "
+                f"exceeds the chunk capacity ({capacity}); raise "
+                f"{FUGUE_TPU_CONF_STREAM_CHUNK_ROWS}"
+            ),
+        )
+        bufs: Dict[str, Any] = {}
+        for c in names:
+            s = batch[c]
+            assert_or_throw(
+                np_dtypes[c].kind == "f" or not s.isna().any(),
+                FugueInvalidOperation(
+                    f"streaming keyed map: NULL in non-float column {c!r}"
+                ),
+            )
+            b = np.zeros(capacity, dtype=np_dtypes[c])
+            b[:k] = s.to_numpy().astype(np_dtypes[c], copy=False)
+            bufs[c] = b
+        put = jax.device_put([bufs[c] for c in names], sharding)
+        jdf = JaxDataFrame(
+            mesh=mesh,
+            _internal=dict(
+                device_cols=dict(zip(names, put)),
+                host_tbl=None,
+                row_count=k,  # tail-padding validity semantics
+                valid_mask=None,
+                schema=in_schema,
+            ),
+        )
+        res = map_engine._compiled_keyed_map(
+            jdf,
+            fn,
+            out_schema,
+            partition_spec,
+            on_init if first[0] else None,
+        )
+        first[0] = False
+        peak = _device_peak_bytes()  # input + output batches both live here
+        return res.as_pandas(), peak
+
+    def gen() -> Iterator[LocalDataFrame]:
+        stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+        carry: Optional[pd.DataFrame] = None
+        closed: set = set()
+        first = [True]
+        for f in _iter_local_frames(df, chunk_rows):
+            pf = f.as_pandas()
+            stats["chunks"] += 1
+            stats["rows"] += len(pf)
+            merged = (
+                pf
+                if carry is None or len(carry) == 0
+                else pd.concat([carry, pf], ignore_index=True)
+            )
+            if len(merged) == 0:
+                carry = None
+                continue
+            assert_or_throw(
+                not merged[keys].isna().any().any(),
+                FugueInvalidOperation(
+                    "streaming keyed map: NULL/NaN partition keys are not "
+                    "supported (NaN breaks key-run detection); filter or "
+                    "fill the key column first"
+                ),
+            )
+            eq_last = (
+                (merged[keys] == merged[keys].iloc[-1].values)
+                .all(axis=1)
+                .to_numpy()
+            )
+            if eq_last.all():
+                # one key so far: keep accumulating — but fail fast once
+                # the run can no longer fit (it would only grow, with
+                # quadratic host copying, before run_batch raised anyway)
+                assert_or_throw(
+                    len(merged) <= capacity,
+                    FugueInvalidOperation(
+                        f"streaming keyed map: a contiguous key run "
+                        f"({len(merged)}+ rows) exceeds the chunk capacity "
+                        f"({capacity}); raise {FUGUE_TPU_CONF_STREAM_CHUNK_ROWS}"
+                    ),
+                )
+                carry = merged
+                continue
+            tail = int(np.argmin(eq_last[::-1]))  # trailing run length
+            emit = merged.iloc[: len(merged) - tail]
+            carry = merged.iloc[len(merged) - tail :].reset_index(drop=True)
+            for sub in _key_aligned_splits(emit, keys, capacity):
+                out, peak = run_batch(sub, closed, first)
+                stats["peak_device_bytes"] = max(
+                    stats["peak_device_bytes"], peak
+                )
+                yield PandasDataFrame(out, out_schema)
+        if carry is not None and len(carry) > 0:
+            for sub in _key_aligned_splits(carry, keys, capacity):
+                out, peak = run_batch(sub, closed, first)
+                stats["peak_device_bytes"] = max(
+                    stats["peak_device_bytes"], peak
+                )
+                yield PandasDataFrame(out, out_schema)
+        global last_run_stats
+        last_run_stats = dict(stats, verb="keyed_map")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+
+
+def _key_aligned_splits(
+    batch: pd.DataFrame, keys: List[str], capacity: int
+) -> Iterator[pd.DataFrame]:
+    """Split a group-complete batch into <=capacity pieces WITHOUT cutting
+    any key's run (greedy accumulation of whole groups)."""
+    if len(batch) <= capacity:
+        yield batch
+        return
+    sizes = batch.groupby(keys, dropna=False, sort=False).size().to_numpy()
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    start = 0
+    cur = 0
+    for gi in range(len(sizes)):
+        if bounds[gi + 1] - start > capacity:
+            if bounds[gi] == start:  # single group larger than capacity
+                yield batch.iloc[start : bounds[gi + 1]]  # run_batch raises
+                start = int(bounds[gi + 1])
+                continue
+            yield batch.iloc[start : bounds[gi]].reset_index(drop=True)
+            start = int(bounds[gi])
+        cur = int(bounds[gi + 1])
+    if cur > start:
+        yield batch.iloc[start:cur].reset_index(drop=True)

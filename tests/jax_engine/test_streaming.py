@@ -24,6 +24,7 @@ from fugue_tpu.dataframe import (
     ArrowDataFrame,
     IterableDataFrame,
     LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
 )
 from fugue_tpu.exceptions import FugueInvalidOperation
 from fugue_tpu.jax import JaxExecutionEngine
@@ -474,5 +475,231 @@ def test_streaming_join_string_and_nullable_payload():
         assert list(got["name"][m]) == list(exp["name"][m])
         assert (got["c"].isna().to_numpy() == exp["c"].isna().to_numpy()).all()
         assert streaming.last_run_stats["verb"] == "join"
+    finally:
+        e.stop_engine()
+
+
+# --------------------------------------------------------------------------
+# streaming take / distinct
+# --------------------------------------------------------------------------
+
+
+def test_streaming_take_variants():
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 6, 5000), "v": rng.random(5000)}
+    )
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 700})
+    try:
+        # unsorted global take: early-stops (not all chunks consumed)
+        r = e.take(_chunk_stream(pdf, 10), 100, presort="")
+        assert r.count() == 100
+        assert streaming.last_run_stats["rows"] < 5000
+        # presorted global take
+        r2 = e.take(_chunk_stream(pdf, 10), 5, presort="v desc").as_pandas()
+        exp2 = pdf.sort_values("v", ascending=False).head(5).reset_index(drop=True)
+        assert np.allclose(r2["v"], exp2["v"])
+        assert streaming.last_run_stats["rows"] == 5000
+        # per-key take with presort
+        r3 = e.take(
+            _chunk_stream(pdf, 10),
+            2,
+            presort="v",
+            partition_spec=PartitionSpec(by=["k"]),
+        ).as_pandas()
+        exp3 = (
+            pdf.sort_values("v").groupby("k", sort=False).head(2)
+        )
+        assert len(r3) == len(exp3)
+        assert np.allclose(
+            sorted(r3["v"]), sorted(exp3["v"])
+        )
+        assert streaming.last_run_stats["verb"] == "take"
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_distinct():
+    pdf = pd.DataFrame(
+        {
+            "k": [1, 2, 1, 2, 3, np.nan, np.nan],
+            "s": ["a", "b", "a", "b", "c", "d", "d"],
+        }
+    )
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 2})
+    try:
+        r = e.distinct(_chunk_stream(pdf, 4)).as_pandas()
+        # SQL DISTINCT: NaN == NaN, so 4 value rows + one NaN row
+        assert len(r) == 4
+        assert streaming.last_run_stats["verb"] == "distinct"
+        assert streaming.last_run_stats["chunks"] >= 3
+    finally:
+        e.stop_engine()
+
+
+# --------------------------------------------------------------------------
+# streaming KEYED compiled map (running windows over key-clustered streams)
+# --------------------------------------------------------------------------
+
+
+def _clustered_frame(n_keys=40, seed=9):
+    rng = np.random.default_rng(seed)
+    pdf = pd.DataFrame({"k": np.repeat(np.arange(n_keys), rng.integers(5, 200, n_keys))})
+    pdf["v"] = rng.random(len(pdf))
+    return pdf
+
+
+def _clustered_stream(pdf, step=333):
+    def gen():
+        for s in range(0, len(pdf), step):
+            yield PandasDataFrame(pdf.iloc[s : s + step], "k:long,v:double")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema="k:long,v:double")
+
+
+def _window_fn():
+    from typing import Dict
+
+    import jax
+
+    from fugue_tpu.jax import group_ops as go
+
+    def fn(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return {
+            "k": cols["k"],
+            "rn": go.row_number(cols),
+            "rs": go.running_sum(cols, cols["v"]),
+        }
+
+    return fn
+
+
+def test_streaming_keyed_window():
+    """ROW_NUMBER + running SUM over a key-clustered stream — groups are
+    re-batched whole (chunks cut mid-key), one compilation for the whole
+    stream, outputs match pandas cumcount/cumsum exactly."""
+    import fugue_tpu.api as fa
+
+    pdf = _clustered_frame()
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 512})
+    try:
+        out = fa.transform(
+            _clustered_stream(pdf),
+            _window_fn(),
+            schema="k:long,rn:long,rs:double",
+            partition=PartitionSpec(by=["k"], presort="v"),
+            engine=e,
+            as_fugue=True,
+        )
+        assert isinstance(out, LocalDataFrameIterableDataFrame)
+        got = out.as_pandas().sort_values(["k", "rn"]).reset_index(drop=True)
+        sp = pdf.sort_values(["k", "v"]).reset_index(drop=True)
+        assert (got["rn"].to_numpy() == (sp.groupby("k").cumcount() + 1).to_numpy()).all()
+        assert np.allclose(got["rs"], sp.groupby("k")["v"].cumsum())
+        assert streaming.last_run_stats["verb"] == "keyed_map"
+        assert streaming.last_run_stats["peak_device_bytes"] > 0
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_keyed_map_contract_violation():
+    """A key reappearing after its batch closed (stream NOT clustered)
+    raises with remediation, instead of silently wrong per-group results."""
+    import fugue_tpu.api as fa
+
+    pdf = pd.DataFrame(
+        {"k": [1] * 50 + [2] * 50 + [1] * 50, "v": np.random.rand(150)}
+    )
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 64})
+    try:
+        out = fa.transform(
+            _clustered_stream(pdf, step=60),
+            _window_fn(),
+            schema="k:long,rn:long,rs:double",
+            partition=PartitionSpec(by=["k"], presort="v"),
+            engine=e,
+            as_fugue=True,
+        )
+        with pytest.raises(FugueInvalidOperation, match="not key-clustered"):
+            out.as_pandas()
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_keyed_map_key_run_exceeds_capacity():
+    import fugue_tpu.api as fa
+
+    pdf = pd.DataFrame({"k": [7] * 500 + [8] * 10, "v": np.random.rand(510)})
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 128})
+    try:
+        with pytest.raises(FugueInvalidOperation, match="exceeds the chunk capacity"):
+            out = fa.transform(
+                _clustered_stream(pdf, step=100),
+                _window_fn(),
+                schema="k:long,rn:long,rs:double",
+                partition=PartitionSpec(by=["k"], presort="v"),
+                engine=e,
+                as_fugue=True,
+            )
+            out.as_pandas()
+    finally:
+        e.stop_engine()
+
+
+def test_running_ops_reject_dense_plan():
+    """running_sum/row_number need ordered shard-complete groups; the
+    dense (unsorted, groups-span-shards) plan must refuse loudly."""
+    import fugue_tpu.api as fa
+
+    pdf = pd.DataFrame({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+    e = JaxExecutionEngine()
+    try:
+        with pytest.raises(Exception, match="sorted plan"):
+            # no presort -> dense plan eligible -> running op must raise
+            fa.transform(
+                e.to_df(pdf),
+                _window_fn(),
+                schema="k:long,rn:long,rs:double",
+                partition=PartitionSpec(by=["k"]),
+                engine=e,
+                as_fugue=True,
+            )
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_keyed_map_rejects_nan_keys_and_strings():
+    import fugue_tpu.api as fa
+
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 64})
+    try:
+        nan_keys = pd.DataFrame({"k": [1.0, 1.0, np.nan, np.nan], "v": [1.0, 2, 3, 4]})
+
+        def gen_nan():
+            yield PandasDataFrame(nan_keys, "k:double,v:double")
+
+        with pytest.raises(FugueInvalidOperation, match="NULL/NaN partition keys"):
+            fa.transform(
+                LocalDataFrameIterableDataFrame(gen_nan(), schema="k:double,v:double"),
+                _window_fn(),
+                schema="k:double,rn:long,rs:double",
+                partition=PartitionSpec(by=["k"], presort="v"),
+                engine=e,
+                as_fugue=True,
+            ).as_pandas()
+        strs = pd.DataFrame({"k": [1, 1], "v": [1.0, 2.0], "s": ["a", "b"]})
+
+        def gen_s():
+            yield PandasDataFrame(strs, "k:long,v:double,s:str")
+
+        with pytest.raises(FugueInvalidOperation, match="numeric/bool columns"):
+            fa.transform(
+                LocalDataFrameIterableDataFrame(gen_s(), schema="k:long,v:double,s:str"),
+                _window_fn(),
+                schema="k:long,rn:long,rs:double",
+                partition=PartitionSpec(by=["k"], presort="v"),
+                engine=e,
+                as_fugue=True,
+            ).as_pandas()
     finally:
         e.stop_engine()
