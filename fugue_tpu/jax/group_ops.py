@@ -90,11 +90,7 @@ def segment_min(cols: Dict[str, Any], x: Any) -> Any:
     import jax.numpy as jnp
     from jax.ops import segment_min as _sm
 
-    fill = (
-        jnp.array(jnp.inf, dtype=x.dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating)
-        else jnp.array(jnp.iinfo(x.dtype).max, dtype=x.dtype)
-    )
+    fill = jnp.array(_minmax_identity(jnp, x.dtype, "min"), dtype=x.dtype)
     xv = jnp.where(cols[VALID], x, fill)
     return _merge(
         cols, _sm(xv, cols[SEGMENTS], num_segments=num_segments(cols)), "min"
@@ -105,11 +101,7 @@ def segment_max(cols: Dict[str, Any], x: Any) -> Any:
     import jax.numpy as jnp
     from jax.ops import segment_max as _sm
 
-    fill = (
-        jnp.array(-jnp.inf, dtype=x.dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating)
-        else jnp.array(jnp.iinfo(x.dtype).min, dtype=x.dtype)
-    )
+    fill = jnp.array(_minmax_identity(jnp, x.dtype, "max"), dtype=x.dtype)
     xv = jnp.where(cols[VALID], x, fill)
     return _merge(
         cols, _sm(xv, cols[SEGMENTS], num_segments=num_segments(cols)), "max"
@@ -178,3 +170,126 @@ def row_number(cols: Dict[str, Any], dtype: Any = None) -> Any:
     _require_ordered(cols, "row_number")
     dt = dtype if dtype is not None else jnp.int64
     return running_sum(cols, cols[VALID].astype(dt))
+
+
+def _minmax_identity(jnp: Any, dtype: Any, kind: str) -> Any:
+    """The min/max identity for ``dtype`` (shared by segment_* and
+    running_* kernels)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if kind == "min" else -jnp.inf
+    if dtype == jnp.bool_:
+        return True if kind == "min" else False
+    ii = jnp.iinfo(dtype)
+    return ii.max if kind == "min" else ii.min
+
+
+def _segmented_scan(cols: Dict[str, Any], x: Any, combine: Any, identity: Any) -> Any:
+    """Generic inclusive per-group scan via ``lax.associative_scan`` over
+    (value, segment-start flag) pairs — the classic segmented-scan
+    construction: a start flag resets the accumulation. NaN inputs (the
+    device NULL) are masked to the identity, matching the engine's SQL
+    window semantics (NULLs are skipped, not propagated)."""
+    import jax
+    import jax.numpy as jnp
+
+    seg = cols[SEGMENTS]
+    start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), seg[1:] != seg[:-1]]
+    )
+    ident = jnp.full((), identity, dtype=x.dtype)
+    mask = cols[VALID]
+    is_float = jnp.issubdtype(x.dtype, jnp.floating)
+    if is_float:
+        mask = mask & jnp.logical_not(jnp.isnan(x))
+    xv = jnp.where(mask, x, ident)
+
+    def op(a, b):
+        av, am, af = a
+        bv, bm, bf = b
+        return (
+            jnp.where(bf, bv, combine(av, bv)),
+            jnp.where(bf, bm, am | bm),  # any non-NULL value seen so far
+            af | bf,
+        )
+
+    out, seen, _ = jax.lax.associative_scan(op, (xv, mask, start))
+    if is_float:
+        # a frame with no non-NULL values yet is NULL (SQL), not the
+        # scan identity — e.g. the leading NULL row's own running MIN
+        out = jnp.where(seen, out, jnp.nan)
+    return jnp.where(cols[VALID], out, ident)
+
+
+def running_min(cols: Dict[str, Any], x: Any) -> Any:
+    """Per-row running MIN within its group, in sort order (the
+    ``MIN(...) OVER (... ROWS UNBOUNDED PRECEDING)`` kernel); NaN (NULL)
+    inputs are skipped, SQL-style. Sorted-plan only."""
+    import jax.numpy as jnp
+
+    _require_ordered(cols, "running_min")
+    return _segmented_scan(
+        cols, x, jnp.minimum, _minmax_identity(jnp, x.dtype, "min")
+    )
+
+
+def running_max(cols: Dict[str, Any], x: Any) -> Any:
+    """Per-row running MAX within its group, in sort order; NaN (NULL)
+    inputs are skipped, SQL-style. Sorted-plan only."""
+    import jax.numpy as jnp
+
+    _require_ordered(cols, "running_max")
+    return _segmented_scan(
+        cols, x, jnp.maximum, _minmax_identity(jnp, x.dtype, "max")
+    )
+
+
+def _shift(cols: Dict[str, Any], x: Any, n: int, fill: Any, forward: bool) -> Any:
+    """Shared LAG/LEAD body: shift ``x`` by ``n`` rows within its group."""
+    import jax.numpy as jnp
+
+    from .._utils.assertion import assert_or_throw
+    from ..exceptions import FugueInvalidOperation
+
+    assert_or_throw(
+        isinstance(n, int) and n >= 1,
+        FugueInvalidOperation(f"lag/lead offset must be an int >= 1, got {n!r}"),
+    )
+    if fill is None:
+        assert_or_throw(
+            jnp.issubdtype(x.dtype, jnp.floating),
+            FugueInvalidOperation(
+                "lag/lead over a non-float column needs an explicit fill "
+                "value (there is no integer NULL on this path; a silent 0 "
+                "would be indistinguishable from data)"
+            ),
+        )
+        fill = jnp.nan
+    fv = jnp.full((), fill, dtype=x.dtype)
+    seg = cols[SEGMENTS]
+    pad_v = jnp.full((n,), fv)
+    pad_s = jnp.full((n,), -1, dtype=seg.dtype)
+    if forward:  # lag: value from n rows EARLIER
+        shifted = jnp.concatenate([pad_v, x[:-n]])
+        seg_shift = jnp.concatenate([pad_s, seg[:-n]])
+    else:  # lead: value from n rows LATER
+        shifted = jnp.concatenate([x[n:], pad_v])
+        seg_shift = jnp.concatenate([seg[n:], pad_s])
+    ok = (seg_shift == seg) & cols[VALID]
+    return jnp.where(ok, shifted, fv)
+
+
+def lag(cols: Dict[str, Any], x: Any, n: int = 1, fill: Any = None) -> Any:
+    """Value of ``x`` ``n`` rows EARLIER within the same group (SQL
+    ``LAG(x, n)``); rows with no predecessor get ``fill`` (NaN for floats
+    when unset; non-float columns require an explicit fill).
+    Sorted-plan only."""
+    _require_ordered(cols, "lag")
+    return _shift(cols, x, n, fill, forward=True)
+
+
+def lead(cols: Dict[str, Any], x: Any, n: int = 1, fill: Any = None) -> Any:
+    """Value of ``x`` ``n`` rows LATER within the same group (SQL
+    ``LEAD(x, n)``); non-float columns require an explicit fill.
+    Sorted-plan only."""
+    _require_ordered(cols, "lead")
+    return _shift(cols, x, n, fill, forward=False)

@@ -703,3 +703,102 @@ def test_streaming_keyed_map_rejects_nan_keys_and_strings():
             ).as_pandas()
     finally:
         e.stop_engine()
+
+
+def test_window_kernels_lag_lead_running_minmax():
+    """The remaining window kernels over a key-clustered stream: LAG/LEAD
+    and running MIN/MAX, validated against pandas shift/cummin/cummax."""
+    from typing import Dict
+
+    import jax
+
+    import fugue_tpu.api as fa
+    from fugue_tpu.jax import group_ops as go
+
+    def fn(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return {
+            "k": cols["k"],
+            "v": cols["v"],
+            "lag1": go.lag(cols, cols["v"]),
+            "lead2": go.lead(cols, cols["v"], n=2),
+            "rmin": go.running_min(cols, cols["v"]),
+            "rmax": go.running_max(cols, cols["v"]),
+        }
+
+    pdf = _clustered_frame(n_keys=25, seed=13)
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 400})
+    try:
+        out = fa.transform(
+            _clustered_stream(pdf, step=271),
+            fn,
+            schema="k:long,v:double,lag1:double,lead2:double,rmin:double,rmax:double",
+            partition=PartitionSpec(by=["k"], presort="v"),
+            engine=e,
+            as_fugue=True,
+        )
+        got = out.as_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        sp = pdf.sort_values(["k", "v"]).reset_index(drop=True)
+        g = sp.groupby("k")["v"]
+        exp_lag = g.shift(1)
+        exp_lead = g.shift(-2)
+        assert (got["lag1"].isna().to_numpy() == exp_lag.isna().to_numpy()).all()
+        m = exp_lag.notna().to_numpy()
+        assert np.allclose(got["lag1"].to_numpy()[m], exp_lag.to_numpy()[m])
+        m2 = exp_lead.notna().to_numpy()
+        assert (got["lead2"].isna().to_numpy() == exp_lead.isna().to_numpy()).all()
+        assert np.allclose(got["lead2"].to_numpy()[m2], exp_lead.to_numpy()[m2])
+        assert np.allclose(got["rmin"], g.cummin())
+        assert np.allclose(got["rmax"], g.cummax())
+    finally:
+        e.stop_engine()
+
+
+def test_running_minmax_skip_nan_and_int_lag_needs_fill():
+    from typing import Dict
+
+    import jax
+
+    import fugue_tpu.api as fa
+    from fugue_tpu.jax import group_ops as go
+
+    def fn(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return {
+            "k": cols["k"],
+            "rmin": go.running_min(cols, cols["v"]),
+            "rmax": go.running_max(cols, cols["v"]),
+        }
+
+    # NaN (NULL) rows are skipped, not propagated (SQL window semantics)
+    pdf = pd.DataFrame(
+        {"k": [1, 1, 1, 1], "v": [5.0, np.nan, 3.0, 4.0], "o": [1.0, 2, 3, 4]}
+    )
+    e = JaxExecutionEngine()
+    try:
+        out = fa.transform(
+            e.to_df(pdf),
+            fn,
+            schema="k:long,rmin:double,rmax:double",
+            partition=PartitionSpec(by=["k"], presort="v"),
+            engine=e,
+            as_fugue=True,
+        ).as_pandas()
+        # sorted by v: NaN first (NULL), then 3,4,5
+        assert np.allclose(
+            sorted(out["rmin"].dropna()), [3.0, 3.0, 3.0]
+        )
+        assert np.allclose(sorted(out["rmax"].dropna()), [3.0, 4.0, 5.0])
+
+        def bad(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+            return {"k": cols["k"], "p": go.lag(cols, cols["k"])}
+
+        with pytest.raises(Exception, match="explicit fill"):
+            fa.transform(
+                e.to_df(pdf),
+                bad,
+                schema="k:long,p:long",
+                partition=PartitionSpec(by=["k"], presort="v"),
+                engine=e,
+                as_fugue=True,
+            ).as_pandas()
+    finally:
+        e.stop_engine()
