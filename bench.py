@@ -969,8 +969,9 @@ def main(strict_tpu: bool = False) -> None:
     # ---- config #2: FugueSQL SELECT+TRANSFORM pipeline over parquet -------
     sql_jax_rps, sql_host_rps = _bench_sql_pipeline(_best_rps, host, eng)
 
-    # ---- config #4: batch inference (compiled mesh MLP vs numpy oracle) ---
-    infer = _run_worker_best("infer", fallback_cpu=not on_tpu)
+    # ---- config #4: batch inference (compiled mesh BERT vs numpy oracle) --
+    # best-of-3: the margin at honest BERT shapes is thin on 1 CPU core
+    infer = _run_worker_best("infer", fallback_cpu=not on_tpu, runs=3)
     assert infer["ok"], "batch inference mismatch"
     host_infer_rps = _bench_infer_oracle(_best_rps)
 
