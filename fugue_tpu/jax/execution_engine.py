@@ -1512,9 +1512,17 @@ class JaxExecutionEngine(ExecutionEngine):
         keys whose device form isn't comparable across frames (strings /
         nullable / NaN-able keys)."""
         from ..collections.partition import PartitionSpec as _PSpec
+        from .streaming import is_stream_frame, streaming_zip
         from .zipped import ZippedJaxDataFrame
 
         spec = partition_spec if partition_spec is not None else _PSpec()
+        if any(is_stream_frame(d) for d in dfs.values()):
+            # key-sorted one-pass inputs: defer to the co-batched
+            # sorted-merge comap (bounded memory); ineligible shapes
+            # (cross / keyless) materialize below
+            zs = streaming_zip(self, dfs, how, spec)
+            if zs is not None:
+                return zs
         keys = list(spec.partition_by)
         if how.lower() != "cross" and len(keys) == 0 and len(dfs) > 0:
             keys = [
@@ -1659,8 +1667,14 @@ class JaxExecutionEngine(ExecutionEngine):
         ever built or parsed."""
         from ..collections.partition import PartitionSpec as _PSpec
         from ..dataframe import ArrayDataFrame
+        from .streaming import ZippedStreamDataFrame, streaming_comap
         from .zipped import ZippedJaxDataFrame
 
+        if isinstance(df, ZippedStreamDataFrame):
+            return streaming_comap(
+                self, df, map_func, output_schema,
+                partition_spec=partition_spec, on_init=on_init,
+            )
         if not isinstance(df, ZippedJaxDataFrame):
             return super().comap(
                 df,

@@ -1054,3 +1054,359 @@ def _key_aligned_splits(
         cur = int(bounds[gi + 1])
     if cur > start:
         yield batch.iloc[start:cur].reset_index(drop=True)
+
+
+# --------------------------------------------------------------------------
+# streaming zip/comap (key-SORTED streams, co-batched at key horizons)
+# --------------------------------------------------------------------------
+
+
+class ZippedStreamDataFrame(DataFrame):
+    """``zip`` of key-SORTED one-pass streams (+ optionally bounded
+    frames, treated as single-chunk streams).
+
+    A thin metadata holder, like ``ZippedJaxDataFrame``: presents the blob
+    protocol's logical schema so workflow metadata checks are identical,
+    but physically carries the stream objects. The only consumer is
+    ``comap`` (via ``streaming_comap``) — any other access raises, because
+    a one-pass zipped stream cannot be materialized twice."""
+
+    def __init__(
+        self,
+        streams: List[Any],
+        names: List[str],
+        named: bool,
+        how: str,
+        keys: List[str],
+        schemas: List[Schema],
+        presort: Dict[str, bool],
+    ):
+        key_schema = schemas[0].extract(keys)
+        blob_fields = ",".join(
+            f"__fugue_blob__{i}:binary" for i in range(len(streams))
+        )
+        super().__init__(Schema(str(key_schema) + "," + blob_fields))
+        self.zip_streams = streams
+        self.zip_names = names
+        self.zip_named = named
+        self.zip_how = how
+        self.zip_keys = keys
+        self.zip_schemas = schemas
+        self.zip_presort = presort
+        # the cotransform processor recognizes zipped inputs (and rebuilds
+        # their empty frames) from this metadata — same contract as the
+        # blob protocol and ZippedJaxDataFrame
+        self.reset_metadata(
+            {
+                "serialized": True,
+                "serialized_cols": [
+                    f"__fugue_blob__{i}" for i in range(len(streams))
+                ],
+                "schemas": [str(s) for s in schemas],
+                "serialized_has_name": named,
+                "names": names,
+                "how": how,
+                "keys": keys,
+                "stream_zip": True,
+            }
+        )
+
+    @property
+    def is_local(self) -> bool:
+        return True
+
+    @property
+    def is_bounded(self) -> bool:
+        return False  # one-pass
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    @property
+    def empty(self) -> bool:
+        return False
+
+    def _no(self, what: str) -> Any:
+        raise FugueInvalidOperation(
+            f"{what} is not available on a zipped one-pass stream; "
+            "apply a cotransformer (comap) to consume it"
+        )
+
+    def peek_array(self) -> List[Any]:
+        return self._no("peek")
+
+    def count(self) -> int:
+        return self._no("count")
+
+    def as_local_bounded(self) -> Any:
+        return self._no("as_local_bounded")
+
+    def as_array(self, columns: Any = None, type_safe: bool = False) -> Any:
+        return self._no("as_array")
+
+    def as_array_iterable(self, columns: Any = None, type_safe: bool = False) -> Any:
+        return self._no("as_array_iterable")
+
+    def _drop_cols(self, cols: Any) -> Any:
+        return self._no("drop")
+
+    def _select_cols(self, cols: Any) -> Any:
+        return self._no("select")
+
+    def rename(self, columns: Any) -> Any:
+        return self._no("rename")
+
+    def alter_columns(self, columns: Any) -> Any:
+        return self._no("alter_columns")
+
+    def head(self, n: int, columns: Any = None) -> Any:
+        return self._no("head")
+
+
+def streaming_zip(
+    engine: Any,
+    dfs: Any,
+    how: str,
+    partition_spec: Any,
+) -> Optional[DataFrame]:
+    """Build a :class:`ZippedStreamDataFrame` when any zip input is a
+    one-pass stream. Eligibility: a non-cross zip with explicit or
+    inferable keys, and no NULL keys in the BOUNDED inputs (those need
+    the blob protocol; stream inputs are checked chunk by chunk).
+    Bounded inputs are host-sorted by the zip keys and ride along as
+    single-chunk streams — only actual streams must arrive pre-sorted."""
+    if how.lower() == "cross":
+        return None
+    keys = list(partition_spec.partition_by) if partition_spec is not None else []
+    if len(keys) == 0 and len(dfs) > 0:
+        keys = [
+            n
+            for n in dfs[0].schema.names
+            if all(n in d.schema for d in dfs.values())
+        ]
+    if len(keys) == 0:
+        return None
+    schemas = [Schema(d.schema) for d in dfs.values()]
+    inputs: List[Any] = []
+    for d in dfs.values():
+        if is_stream_frame(d):
+            inputs.append(d)
+            continue
+        pf = d.as_pandas()
+        if len(pf) > 0 and pf[keys].isna().any().any():
+            # NULL keys need the blob protocol's NULL-group handling
+            return None
+        inputs.append(
+            PandasDataFrame(
+                pf.sort_values(keys, kind="stable").reset_index(drop=True),
+                Schema(d.schema),
+            )
+        )
+    presort = dict(partition_spec.presort) if partition_spec is not None else {}
+    return ZippedStreamDataFrame(
+        streams=inputs,
+        names=list(dfs.keys()),
+        named=dfs.has_key,
+        how=how.lower(),
+        keys=keys,
+        schemas=schemas,
+        presort=presort,
+    )
+
+
+def _key_view(frame: pd.DataFrame, keys: List[str]) -> Any:
+    """A lexicographically comparable view of the key columns: the bare
+    numpy column for one key (fast path), a MultiIndex otherwise."""
+    if len(keys) == 1:
+        return frame[keys[0]].to_numpy()
+    return pd.MultiIndex.from_frame(frame[keys])
+
+
+def _is_sorted(kv: Any) -> bool:
+    if isinstance(kv, pd.MultiIndex):
+        return kv.is_monotonic_increasing
+    return bool(np.all(kv[1:] >= kv[:-1])) if len(kv) > 1 else True
+
+
+def _split_below(b: pd.DataFrame, keys: List[str], horizon: Tuple) -> int:
+    """Index of the first row with key >= horizon (buffer is sorted)."""
+    kv = _key_view(b, keys)
+    if isinstance(kv, pd.MultiIndex):
+        # lexicographic binary search over the sorted MultiIndex
+        lo, hi = 0, len(kv)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if tuple(kv[mid]) < horizon:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+    return int(np.searchsorted(kv, horizon[0], side="left"))
+
+
+def streaming_comap(
+    engine: Any,
+    zdf: "ZippedStreamDataFrame",
+    map_func: Callable,
+    output_schema: Any,
+    partition_spec: Any = None,
+    on_init: Optional[Callable] = None,
+) -> DataFrame:
+    """Cotransform over zipped key-SORTED streams with bounded memory.
+
+    The classic sorted-merge co-batching: each input keeps a buffer; the
+    emit horizon is the smallest "last key seen" over non-exhausted
+    inputs; rows strictly below the horizon are complete on every input
+    (ascending-sorted contract, validated chunk by chunk) and batch
+    through the regular zip+comap; rows at/above it carry. Memory is
+    O(chunk × inputs), independent of stream length."""
+    from ..dataframe import DataFrames
+
+    out_schema = (
+        output_schema if isinstance(output_schema, Schema) else Schema(output_schema)
+    )
+    keys = zdf.zip_keys
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    from ..collections.partition import PartitionSpec as _PSpec
+
+    spec = _PSpec(
+        partition_spec, by=keys, presort=zdf.zip_presort
+    ) if partition_spec is not None else _PSpec(by=keys, presort=zdf.zip_presort)
+
+    def gen() -> Iterator[LocalDataFrame]:
+        stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+        iters = [
+            _iter_local_frames(s, chunk_rows) for s in zdf.zip_streams
+        ]
+        bufs: List[Optional[pd.DataFrame]] = [None] * len(iters)
+        done = [False] * len(iters)
+        first = [True]
+
+        def pull(i: int) -> bool:
+            """Append ONE validated chunk to input i's buffer; False at
+            stream end. The one place every chunk enters a buffer — the
+            sorted-contract checks live here and only here."""
+            try:
+                f = next(iters[i])
+            except StopIteration:
+                done[i] = True
+                return False
+            pf = f.as_pandas().reset_index(drop=True)
+            stats["chunks"] += 1
+            stats["rows"] += len(pf)
+            if len(pf) == 0:
+                return True
+            kv = pf[keys]
+            assert_or_throw(
+                not kv.isna().any().any(),
+                FugueInvalidOperation(
+                    "streaming zip: NULL keys are not supported on the "
+                    "sorted-stream path"
+                ),
+            )
+            assert_or_throw(
+                _is_sorted(_key_view(pf, keys)),
+                FugueInvalidOperation(
+                    f"streaming zip: input {i} is not sorted ascending "
+                    f"by {keys} within a chunk"
+                ),
+            )
+            prev = bufs[i]
+            if prev is not None and len(prev) > 0:
+                lo = tuple(pf[keys].iloc[0])
+                hi = tuple(prev[keys].iloc[-1])
+                assert_or_throw(
+                    lo >= hi,
+                    FugueInvalidOperation(
+                        f"streaming zip: input {i} is not sorted "
+                        f"ascending by {keys} ({lo!r} after {hi!r})"
+                    ),
+                )
+                bufs[i] = pd.concat([prev, pf], ignore_index=True)
+            else:
+                bufs[i] = pf
+            return True
+
+        def run_batch(parts: List[pd.DataFrame]):
+            pieces = DataFrames(
+                dict(zip(zdf.zip_names, (
+                    PandasDataFrame(p, s)
+                    for p, s in zip(parts, zdf.zip_schemas)
+                )))
+                if zdf.zip_named
+                else [
+                    PandasDataFrame(p, s)
+                    for p, s in zip(parts, zdf.zip_schemas)
+                ]
+            )
+            z = engine.zip(pieces, how=zdf.zip_how, partition_spec=spec)
+            res = engine.comap(
+                z,
+                map_func,
+                out_schema,
+                partition_spec=spec,
+                on_init=on_init if first[0] else None,
+            )
+            first[0] = False
+            out = res.as_pandas()
+            stats["peak_device_bytes"] = max(
+                stats["peak_device_bytes"], _device_peak_bytes()
+            )
+            return out
+
+        while True:
+            for i in range(len(iters)):
+                while not done[i] and (bufs[i] is None or len(bufs[i]) == 0):
+                    pull(i)
+            live = [
+                i
+                for i in range(len(iters))
+                if bufs[i] is not None and len(bufs[i]) > 0
+            ]
+            if len(live) == 0:
+                break
+            # horizon: the smallest last-key over inputs that may still grow
+            horizons = [
+                tuple(bufs[i][keys].iloc[-1]) for i in live if not done[i]
+            ]
+            horizon = min(horizons) if len(horizons) > 0 else None
+            parts: List[pd.DataFrame] = []
+            any_rows = False
+            for i in range(len(iters)):
+                b = bufs[i]
+                if b is None or len(b) == 0:
+                    parts.append(pd.DataFrame(columns=zdf.zip_schemas[i].names))
+                    continue
+                cut = len(b) if horizon is None else _split_below(b, keys, horizon)
+                parts.append(b.iloc[:cut].reset_index(drop=True))
+                bufs[i] = b.iloc[cut:].reset_index(drop=True)
+                any_rows = any_rows or cut > 0
+            if any_rows:
+                yield PandasDataFrame(run_batch(parts), out_schema)
+            elif horizon is not None:
+                # nothing below the horizon: only the inputs PINNED at the
+                # horizon can extend it — drain one chunk from each (ahead
+                # inputs must not grow, or the memory bound erodes)
+                progressed = False
+                for i in range(len(iters)):
+                    if (
+                        not done[i]
+                        and bufs[i] is not None
+                        and len(bufs[i]) > 0
+                        and tuple(bufs[i][keys].iloc[-1]) == horizon
+                    ):
+                        pull(i)
+                        progressed = True
+                assert_or_throw(
+                    progressed,
+                    FugueInvalidOperation(
+                        "streaming zip: no progress possible (internal)"
+                    ),
+                )
+        global last_run_stats
+        last_run_stats = dict(stats, verb="comap")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)

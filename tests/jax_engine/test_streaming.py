@@ -802,3 +802,148 @@ def test_running_minmax_skip_nan_and_int_lag_needs_fill():
             ).as_pandas()
     finally:
         e.stop_engine()
+
+
+# --------------------------------------------------------------------------
+# streaming zip/comap (key-SORTED streams)
+# --------------------------------------------------------------------------
+
+
+def _sorted_stream(pdf, schema, step):
+    def gen():
+        for s in range(0, len(pdf), step):
+            yield PandasDataFrame(pdf.iloc[s : s + step], schema)
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=schema)
+
+
+def _zip_merge():
+    def merge(d1: pd.DataFrame, d2: pd.DataFrame) -> pd.DataFrame:
+        k = int(d1["k"].iloc[0]) if len(d1) else int(d2["k"].iloc[0])
+        return pd.DataFrame(
+            {
+                "k": [k],
+                "n1": [len(d1)],
+                "n2": [len(d2)],
+                "sv": [float(d1["v"].sum()) if len(d1) else 0.0],
+            }
+        )
+
+    return merge
+
+
+def _zip_frames_sorted(seed=11):
+    rng = np.random.default_rng(seed)
+    a = pd.DataFrame(
+        {"k": np.sort(rng.integers(0, 30, 900)), "v": rng.random(900)}
+    )
+    b = pd.DataFrame(
+        {"k": np.sort(rng.integers(5, 35, 400)), "w": rng.random(400)}
+    )
+    return a, b
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer"])
+def test_streaming_zip_comap(how):
+    from fugue_tpu import FugueWorkflow
+
+    a, b = _zip_frames_sorted()
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 128})
+    try:
+        dag = FugueWorkflow()
+        za = dag.df(_sorted_stream(a, "k:long,v:double", 97))
+        zb = dag.df(_sorted_stream(b, "k:long,w:double", 61))
+        res = dag.zip(za, zb, how=how, partition={"by": ["k"]}).transform(
+            _zip_merge(), schema="k:long,n1:long,n2:long,sv:double"
+        )
+        res.yield_dataframe_as("r", as_local=True)
+        dag.run(e)
+        got = (
+            dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+        if how == "inner":
+            exp_keys = sorted(set(a["k"]) & set(b["k"]))
+        else:
+            exp_keys = sorted(set(a["k"]))
+        assert got["k"].tolist() == exp_keys
+        ea, eb = a.groupby("k").size(), b.groupby("k").size()
+        ev = a.groupby("k")["v"].sum()
+        assert got.set_index("k")["n1"].to_dict() == {
+            k: int(ea[k]) for k in exp_keys
+        }
+        assert got.set_index("k")["n2"].to_dict() == {
+            k: int(eb.get(k, 0)) for k in exp_keys
+        }
+        assert np.allclose(
+            got.set_index("k")["sv"], [ev[k] for k in exp_keys]
+        )
+        assert streaming.last_run_stats["verb"] == "comap"
+        assert streaming.last_run_stats["chunks"] >= 10
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_zip_rejects_unsorted():
+    from fugue_tpu import FugueWorkflow
+
+    a = pd.DataFrame({"k": [3, 1, 2], "v": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"k": [1, 2], "w": [1.0, 2.0]})
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 2})
+    try:
+        dag = FugueWorkflow()
+        z = dag.zip(
+            dag.df(_sorted_stream(a, "k:long,v:double", 2)),
+            dag.df(_sorted_stream(b, "k:long,w:double", 2)),
+            partition={"by": ["k"]},
+        ).transform(_zip_merge(), schema="k:long,n1:long,n2:long,sv:double")
+        z.yield_dataframe_as("r", as_local=True)
+        with pytest.raises(Exception, match="not sorted ascending"):
+            dag.run(e)
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_zip_bounded_dim_any_order():
+    """A bounded co-input needs NO pre-sorting (it is host-sorted on
+    entry); only actual streams carry the sorted contract."""
+    from fugue_tpu import FugueWorkflow
+
+    rng = np.random.default_rng(3)
+    a = pd.DataFrame({"k": np.sort(rng.integers(0, 10, 200)), "v": rng.random(200)})
+    dim = pd.DataFrame({"k": [3, 1, 2, 7], "w": [1.0, 2.0, 3.0, 4.0]})
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 32})
+    try:
+        dag = FugueWorkflow()
+        r = dag.zip(
+            dag.df(_sorted_stream(a, "k:long,v:double", 37)),
+            dag.df(dim),
+            partition={"by": ["k"]},
+        ).transform(_zip_merge(), schema="k:long,n1:long,n2:long,sv:double")
+        r.yield_dataframe_as("r", as_local=True)
+        dag.run(e)
+        got = dag.yields["r"].result.as_pandas().sort_values("k")
+        assert got["k"].tolist() == sorted(set(a["k"]) & set(dim["k"]))
+    finally:
+        e.stop_engine()
+
+
+def test_streaming_zip_force_drain_checks_order():
+    """An unsorted chunk arriving through the force-progress drain (an
+    input pinned at the horizon) still raises — not silent mis-grouping."""
+    from fugue_tpu import FugueWorkflow
+
+    A = pd.DataFrame({"k": [2, 2, 5, 5, 5, 2, 9], "v": [1.0] * 7})
+    B = pd.DataFrame({"k": [2, 5, 9], "w": [1.0] * 3})
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 2})
+    try:
+        dag = FugueWorkflow()
+        r = dag.zip(
+            dag.df(_sorted_stream(A, "k:long,v:double", 2)),
+            dag.df(_sorted_stream(B, "k:long,w:double", 1)),
+            partition={"by": ["k"]},
+        ).transform(_zip_merge(), schema="k:long,n1:long,n2:long,sv:double")
+        r.yield_dataframe_as("r", as_local=True)
+        with pytest.raises(Exception, match="not sorted ascending"):
+            dag.run(e)
+    finally:
+        e.stop_engine()
