@@ -1272,18 +1272,31 @@ def streaming_comap(
     )
     from ..collections.partition import PartitionSpec as _PSpec
 
-    spec = _PSpec(
-        partition_spec, by=keys, presort=zdf.zip_presort
-    ) if partition_spec is not None else _PSpec(by=keys, presort=zdf.zip_presort)
+    # presort precedence matches the non-streaming comap: a comap-time
+    # presort overrides the zip-time one
+    presort = dict(zdf.zip_presort)
+    if partition_spec is not None and len(partition_spec.presort) > 0:
+        presort = dict(partition_spec.presort)
+    spec = (
+        _PSpec(partition_spec, by=keys, presort=presort)
+        if partition_spec is not None
+        else _PSpec(by=keys, presort=presort)
+    )
 
     def gen() -> Iterator[LocalDataFrame]:
         stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
         iters = [
             _iter_local_frames(s, chunk_rows) for s in zdf.zip_streams
         ]
-        bufs: List[Optional[pd.DataFrame]] = [None] * len(iters)
+        # chunk LISTS, concatenated only at emit time: per-pull concat
+        # would be O(run^2) copying while a hot key spans many chunks
+        bufs: List[List[pd.DataFrame]] = [[] for _ in iters]
+        last_key: List[Optional[Tuple]] = [None] * len(iters)
         done = [False] * len(iters)
         first = [True]
+
+        def _nrows(i: int) -> int:
+            return sum(len(c) for c in bufs[i])
 
         def pull(i: int) -> bool:
             """Append ONE validated chunk to input i's buffer; False at
@@ -1314,20 +1327,17 @@ def streaming_comap(
                     f"by {keys} within a chunk"
                 ),
             )
-            prev = bufs[i]
-            if prev is not None and len(prev) > 0:
-                lo = tuple(pf[keys].iloc[0])
-                hi = tuple(prev[keys].iloc[-1])
+            lo = tuple(pf[keys].iloc[0])
+            if last_key[i] is not None:
                 assert_or_throw(
-                    lo >= hi,
+                    lo >= last_key[i],
                     FugueInvalidOperation(
                         f"streaming zip: input {i} is not sorted "
-                        f"ascending by {keys} ({lo!r} after {hi!r})"
+                        f"ascending by {keys} ({lo!r} after {last_key[i]!r})"
                     ),
                 )
-                bufs[i] = pd.concat([prev, pf], ignore_index=True)
-            else:
-                bufs[i] = pf
+            bufs[i].append(pf)
+            last_key[i] = tuple(pf[keys].iloc[-1])
             return True
 
         def run_batch(parts: List[pd.DataFrame]):
@@ -1359,30 +1369,37 @@ def streaming_comap(
 
         while True:
             for i in range(len(iters)):
-                while not done[i] and (bufs[i] is None or len(bufs[i]) == 0):
+                while not done[i] and _nrows(i) == 0:
                     pull(i)
-            live = [
-                i
-                for i in range(len(iters))
-                if bufs[i] is not None and len(bufs[i]) > 0
-            ]
+            live = [i for i in range(len(iters)) if _nrows(i) > 0]
             if len(live) == 0:
                 break
             # horizon: the smallest last-key over inputs that may still grow
-            horizons = [
-                tuple(bufs[i][keys].iloc[-1]) for i in live if not done[i]
-            ]
+            horizons = [last_key[i] for i in live if not done[i]]
             horizon = min(horizons) if len(horizons) > 0 else None
             parts: List[pd.DataFrame] = []
             any_rows = False
             for i in range(len(iters)):
-                b = bufs[i]
-                if b is None or len(b) == 0:
+                if _nrows(i) == 0:
                     parts.append(pd.DataFrame(columns=zdf.zip_schemas[i].names))
                     continue
+                if horizon is not None and tuple(
+                    bufs[i][0][keys].iloc[0]
+                ) >= horizon:
+                    # whole buffer at/above the horizon: nothing to emit —
+                    # skip the concat (a stalled input must not be
+                    # re-copied every round)
+                    parts.append(pd.DataFrame(columns=zdf.zip_schemas[i].names))
+                    continue
+                b = (
+                    bufs[i][0]
+                    if len(bufs[i]) == 1
+                    else pd.concat(bufs[i], ignore_index=True)
+                )
                 cut = len(b) if horizon is None else _split_below(b, keys, horizon)
                 parts.append(b.iloc[:cut].reset_index(drop=True))
-                bufs[i] = b.iloc[cut:].reset_index(drop=True)
+                rest = b.iloc[cut:].reset_index(drop=True)
+                bufs[i] = [rest] if len(rest) > 0 else []
                 any_rows = any_rows or cut > 0
             if any_rows:
                 yield PandasDataFrame(run_batch(parts), out_schema)
@@ -1394,9 +1411,8 @@ def streaming_comap(
                 for i in range(len(iters)):
                     if (
                         not done[i]
-                        and bufs[i] is not None
-                        and len(bufs[i]) > 0
-                        and tuple(bufs[i][keys].iloc[-1]) == horizon
+                        and _nrows(i) > 0
+                        and last_key[i] == horizon
                     ):
                         pull(i)
                         progressed = True
@@ -1406,6 +1422,25 @@ def streaming_comap(
                         "streaming zip: no progress possible (internal)"
                     ),
                 )
+        if first[0] and on_init is not None:
+            # zero non-empty batches: on_init still fires once over empty
+            # frames (non-streaming comap parity)
+            on_init(
+                0,
+                DataFrames(
+                    dict(zip(zdf.zip_names, (
+                        PandasDataFrame(
+                            pd.DataFrame(columns=s.names), s
+                        )
+                        for s in zdf.zip_schemas
+                    )))
+                    if zdf.zip_named
+                    else [
+                        PandasDataFrame(pd.DataFrame(columns=s.names), s)
+                        for s in zdf.zip_schemas
+                    ]
+                ),
+            )
         global last_run_stats
         last_run_stats = dict(stats, verb="comap")
 

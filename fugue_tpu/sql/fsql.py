@@ -643,6 +643,27 @@ class FugueSQLCompiler:
             "PERSIST", "BROADCAST", "CHECKPOINT", "DETERMINISTIC", "WEAK",
             "STRONG", "YIELD",
         )
+        # compile-dialect support: FugueSQL SELECTs written in a foreign
+        # dialect (conf ``fugue.sql.compile.dialect``, e.g. "postgres")
+        # transpile to the in-tree dialect BEFORE parsing — table-name
+        # discovery and execution then see native text (the reference
+        # routes this through sqlglot, fugue/constants.py:9 +
+        # collections/sql.py:25-45)
+        from ..constants import _FUGUE_GLOBAL_CONF, FUGUE_CONF_SQL_DIALECT
+
+        compile_dialect = str(
+            self._wf.conf.get(
+                FUGUE_CONF_SQL_DIALECT,
+                _FUGUE_GLOBAL_CONF.get(FUGUE_CONF_SQL_DIALECT, "spark"),
+            )
+        ).lower()
+        if compile_dialect not in ("spark", "fugue"):
+            from ..collections.sql import transpile_sql
+            from .dialect import get_dialect
+
+            get_dialect(compile_dialect)  # unknown dialects raise HERE —
+            # a silent passthrough would parse foreign quoting as strings
+            text = transpile_sql(text, compile_dialect, "fugue")
         # find referenced table names: parse and collect Scan nodes
         from .parser import SQLParser, Scan as ScanNode, PlanNode, JoinNode, Subquery, SelectNode, SetOpNode, SortNode, LimitNode
 

@@ -153,3 +153,37 @@ def test_structured_raw_sql_routes_through_plugin():
     )
     # same dialect: untouched
     assert s.construct(name_map={"t0": "z"}, dialect="fugue").startswith("SELECT `a b`")
+
+
+def test_fugue_sql_foreign_compile_dialect():
+    """FugueSQL written in a foreign dialect executes via the conf
+    ``fugue.sql.compile.dialect`` (reference: sqlglot behind
+    ``fugue/constants.py:9``): SELECT text transpiles to the in-tree
+    dialect before table discovery and execution."""
+    import pandas as pd
+
+    import fugue_tpu.api as fa
+    from fugue_tpu.constants import register_global_conf
+    from fugue_tpu.sql import FugueSQLWorkflow
+
+    df = pd.DataFrame(
+        {"k": [1, 2, 2], "v": [1.0, 2.0, 3.0], "ok": [True, True, False]}
+    )
+    register_global_conf({"fugue.sql.compile.dialect": "postgres"})
+    try:
+        r = fa.fugue_sql(
+            "SELECT k, SUM(CAST(v AS DOUBLE PRECISION)) AS s FROM df "
+            "WHERE ok = TRUE GROUP BY k",
+            df=df,
+            engine="native",
+        )
+        got = r.sort_values("k").reset_index(drop=True)
+        assert got["s"].tolist() == [1.0, 2.0]
+    finally:
+        register_global_conf({"fugue.sql.compile.dialect": "spark"})
+    # per-workflow compile conf: mssql TOP syntax
+    dag = FugueSQLWorkflow(compile_conf={"fugue.sql.compile.dialect": "mssql"})
+    dag("SELECT TOP 2 k, v FROM df ORDER BY v YIELD DATAFRAME AS r2", df=df)
+    dag.run("native")
+    out = dag.yields["r2"].result.as_pandas()
+    assert out["v"].tolist() == [1.0, 2.0]
