@@ -46,16 +46,15 @@ MODULES = [
 ]
 
 
-def _doc_first(obj, n=3) -> str:
+def _doc_first(obj, n=None) -> str:
+    """The docstring's whole first paragraph (up to the first blank line) —
+    truncating at a fixed line count published half-sentences."""
     doc = inspect.getdoc(obj) or ""
-    lines = [ln for ln in doc.splitlines()]
     head = []
-    for ln in lines:
+    for ln in doc.splitlines():
         if ln.strip() == "" and head:
             break
         head.append(ln)
-        if len(head) >= n:
-            break
     return " ".join(s.strip() for s in head).strip()
 
 

@@ -1,0 +1,61 @@
+"""Everything needed to develop and extend fugue_tpu, in one import.
+
+The extension-developer facade (reference ``fugue/dev.py``): backend
+authors get the engine contract, the annotated-param machinery, the raw
+SQL/partition collections, RPC, and the workflow internals without
+hunting through submodules. The user-facing surface lives in
+``fugue_tpu.api``; the plugin hooks in ``fugue_tpu.plugins``.
+"""
+
+# flake8: noqa
+
+from .bag.bag import BagDisplay
+from .collections.partition import PartitionCursor, PartitionSpec
+from .collections.sql import StructuredRawSQL, TempTableName, transpile_sql
+from .collections.yielded import PhysicalYielded, Yielded
+from .dataframe.function_wrapper import (
+    AnnotatedParam,
+    DataFrameFunctionWrapper,
+    DataFrameParam,
+    LocalDataFrameParam,
+    fugue_annotated_param,
+)
+from .dataset import DatasetDisplay
+from .execution import ExecutionEngineParam
+from .execution.execution_engine import (
+    EngineFacet,
+    ExecutionEngine,
+    MapEngine,
+    SQLEngine,
+)
+from .execution.factory import (
+    is_pandas_or,
+    make_execution_engine,
+    make_sql_engine,
+    register_default_execution_engine,
+    register_default_sql_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from .execution.native_execution_engine import (
+    NativeExecutionEngine,
+    PandasMapEngine,
+)
+from .rpc import (
+    EmptyRPCHandler,
+    RPCClient,
+    RPCFunc,
+    RPCHandler,
+    RPCServer,
+    make_rpc_server,
+    to_rpc_handler,
+)
+from .sql.dialect import DialectProfile, register_dialect
+from .warehouse.profile import WarehouseProfile
+from .workflow._workflow_context import FugueWorkflowContext
+from .workflow.module import module
+from .workflow.workflow import (
+    FugueWorkflow,
+    WorkflowDataFrame,
+    WorkflowDataFrames,
+)

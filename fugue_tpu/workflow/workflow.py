@@ -10,7 +10,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 from .._utils.assertion import assert_or_throw
 from .._utils.convert import get_caller_global_local_vars
-from .._utils.params import ParamDict
+from .._utils.params import IndexedOrderedDict, ParamDict
 from ..collections.partition import PartitionSpec
 from ..collections.sql import StructuredRawSQL
 from ..collections.yielded import PhysicalYielded, Yielded
@@ -34,6 +34,78 @@ from ..extensions.transformer.convert import _to_output_transformer, _to_transfo
 from ._checkpoint import Checkpoint, StrongCheckpoint, WeakCheckpoint
 from ._tasks import CreateTask, FugueTask, OutputTask, ProcessTask
 from ._workflow_context import FugueWorkflowContext
+
+
+
+class WorkflowDataFrames(IndexedOrderedDict):
+    """Ordered dictionary of :class:`WorkflowDataFrame` (reference
+    ``fugue/workflow/workflow.py:1413``): the lazy-handle counterpart of
+    :class:`~fugue_tpu.dataframe.DataFrames` — keyed or positional
+    (``_<n>`` keys), immutable once built, and every member must belong
+    to the SAME workflow."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__()
+        self._has_dict_key = False
+        for a in args:
+            self._append(a)
+        for k, v in kwargs.items():
+            self[k] = v
+        self.set_readonly()
+
+    @property
+    def has_key(self) -> bool:
+        return self._has_dict_key
+
+    @property
+    def workflow(self) -> "FugueWorkflow":
+        assert_or_throw(
+            len(self) > 0, FugueWorkflowCompileError("empty WorkflowDataFrames")
+        )
+        return next(iter(self.values())).workflow
+
+    def _append(self, obj: Any) -> None:
+        if obj is None:
+            return
+        if isinstance(obj, WorkflowDataFrame):
+            self[f"_{len(self)}"] = obj
+        elif isinstance(obj, (WorkflowDataFrames, dict)):
+            for k, v in obj.items():
+                if isinstance(k, str) and k.startswith("_"):
+                    # positional members RE-KEY on merge, or the second
+                    # container's "_0" would silently overwrite the first's
+                    self._append(v)
+                else:
+                    self[k] = v
+        elif isinstance(obj, (list, tuple)):
+            for x in obj:
+                self._append(x)
+        else:
+            raise FugueWorkflowCompileError(
+                f"can't add {type(obj)} to WorkflowDataFrames"
+            )
+
+    def __getitem__(self, key: Any) -> "WorkflowDataFrame":  # type: ignore
+        if isinstance(key, int):
+            return self.get_value_by_index(key)
+        return super().__getitem__(key)
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        assert_or_throw(
+            isinstance(key, str),
+            FugueWorkflowCompileError(f"key {key!r} must be a string"),
+        )
+        assert_or_throw(
+            isinstance(value, WorkflowDataFrame),
+            FugueWorkflowCompileError(f"{key} value must be a WorkflowDataFrame"),
+        )
+        if len(self) > 0 and value.workflow is not next(iter(self.values())).workflow:
+            raise FugueWorkflowCompileError(
+                "all members must come from the same workflow"
+            )
+        super().__setitem__(key, value)  # readonly check runs FIRST
+        if not key.startswith("_"):
+            self._has_dict_key = True
 
 
 class FugueWorkflowResult:

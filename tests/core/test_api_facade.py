@@ -81,3 +81,61 @@ class TestFacadeVerbs:
 
     def test_parallelism(self):
         assert fa.get_current_parallelism(engine="native") == 1
+
+
+def test_dev_facade_exports():
+    """`fugue_tpu.dev` mirrors the reference's extension-developer facade
+    (`fugue/dev.py`): one import for backend authors."""
+    import fugue_tpu.dev as dev
+
+    for name in (
+        "AnnotatedParam",
+        "DataFrameFunctionWrapper",
+        "EngineFacet",
+        "ExecutionEngine",
+        "ExecutionEngineParam",
+        "MapEngine",
+        "SQLEngine",
+        "PandasMapEngine",
+        "PartitionCursor",
+        "PartitionSpec",
+        "StructuredRawSQL",
+        "TempTableName",
+        "Yielded",
+        "PhysicalYielded",
+        "RPCServer",
+        "RPCHandler",
+        "make_rpc_server",
+        "register_execution_engine",
+        "register_sql_engine",
+        "make_execution_engine",
+        "FugueWorkflow",
+        "WorkflowDataFrame",
+        "WorkflowDataFrames",
+        "FugueWorkflowContext",
+        "module",
+        "DialectProfile",
+        "WarehouseProfile",
+    ):
+        assert hasattr(dev, name), name
+
+
+def test_workflow_dataframes_container():
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.workflow.workflow import WorkflowDataFrames
+
+    dag = FugueWorkflow()
+    a = dag.df([[1]], "a:int")
+    b = dag.df([[2]], "b:int")
+    arr = WorkflowDataFrames(a, b)
+    assert not arr.has_key and arr["_0"] is a and arr["_1"] is b
+    named = WorkflowDataFrames(x=a, y=b)
+    assert named.has_key and named.workflow is dag
+    import pytest as _pytest
+
+    from fugue_tpu.exceptions import FugueWorkflowCompileError
+
+    with _pytest.raises(FugueWorkflowCompileError):
+        WorkflowDataFrames(a, FugueWorkflow().df([[3]], "c:int"))
+    with _pytest.raises(FugueWorkflowCompileError):
+        WorkflowDataFrames(123)
