@@ -45,11 +45,20 @@ _FUNCS_SQLITE = {
     "CEILING": "CEIL",
 }
 _FUNCS_MYSQL = {"RANDOM": "RAND", "STRING_AGG": "GROUP_CONCAT"}
-_FUNCS_MSSQL = {"RANDOM": "RAND", "SUBSTR": "SUBSTRING", "CEIL": "CEILING"}
+# canonical spellings are already SUBSTRING/CEILING (the T-SQL ones)
+_FUNCS_MSSQL = {"RANDOM": "RAND"}
 
 # read-side aliases accepted from ANY dialect and normalized to the
-# canonical spelling (same arg shapes)
-_READ_ALIASES = {"NVL": "COALESCE", "IFNULL": "COALESCE"}
+# canonical spelling (same arg shapes) — SUBSTR/CEIL normalize here so a
+# fugue/spark query using the short forms still emits valid SQL on
+# dialects that only have the long spellings (sqlite targets re-shorten
+# via their own func_map)
+_READ_ALIASES = {
+    "NVL": "COALESCE",
+    "IFNULL": "COALESCE",
+    "SUBSTR": "SUBSTRING",
+    "CEIL": "CEILING",
+}
 
 # canonical type names are fugue schema-expression names (lower) plus the
 # standard SQL spellings normalized onto them
@@ -104,6 +113,10 @@ class DialectProfile:
     type_map: Dict[str, str] = field(default_factory=dict)
     # canonical function name -> dialect function name
     func_map: Dict[str, str] = field(default_factory=dict)
+    # dialect type name -> canonical name OVERRIDES for reading (the
+    # shared _CANON_TYPES table assumes standard-SQL meanings; e.g.
+    # sqlite's REAL is 8-byte and mssql's FLOAT is double precision)
+    type_read_map: Dict[str, str] = field(default_factory=dict)
 
     def func_to_canonical(self) -> Dict[str, str]:
         return {v.upper(): k for k, v in self.func_map.items()}
@@ -149,6 +162,8 @@ register_dialect(
         name="sqlite",
         ident_quote=('"', '"'),
         bool_literals=("1", "0"),
+        # sqlite REAL is ALWAYS 8-byte; INTEGER is up to 8-byte
+        type_read_map={"REAL": "double", "INTEGER": "long", "INT": "long"},
         type_map={
             "int": "INTEGER",
             "long": "INTEGER",
@@ -207,8 +222,17 @@ register_dialect(
         bracket_idents=True,
         limit_style="top",
         bool_literals=("1", "0"),
+        # T-SQL: FLOAT defaults to FLOAT(53) = double; REAL is float32
+        type_read_map={
+            "FLOAT": "double",
+            "REAL": "float",
+            "NVARCHAR": "str",
+            "BIT": "bool",
+            "DATETIME2": "datetime",
+        },
         type_map={
             "long": "BIGINT",
+            "float": "REAL",  # T-SQL: bare FLOAT means FLOAT(53) = double
             "double": "FLOAT",
             "str": "NVARCHAR(MAX)",
             "bool": "BIT",
@@ -349,9 +373,9 @@ def transpile(
     return _emit(toks, dst)
 
 
-def _canonicalize(toks: List[_Tok], src: DialectProfile) -> List[_Tok]:
+def _canonicalize(toks: List[_Tok], src_profile: DialectProfile) -> List[_Tok]:
     """Rename dialect functions/types to canonical names in place."""
-    to_canon = src.func_to_canonical()
+    to_canon = src_profile.func_to_canonical()
     out: List[_Tok] = []
     i = 0
     cast_depth: List[int] = []  # paren depths of open CAST(
@@ -389,7 +413,9 @@ def _canonicalize(toks: List[_Tok], src: DialectProfile) -> List[_Tok]:
                     words.append(nxt.value.upper())
                     i += 1
                 tname = " ".join(words)
-                canon = _CANON_TYPES.get(tname)
+                canon = src_profile.type_read_map.get(tname) or _CANON_TYPES.get(
+                    tname
+                )
                 out.append(_Tok("TYPE", canon if canon is not None else t.value))
                 i += 1
                 # drop a parenthesized size suffix of a RECOGNIZED type —
