@@ -324,6 +324,54 @@ def eval_select(
     return res
 
 
+def substitute_exprs(expr: ColumnExpr, mapping: Dict[str, str]) -> ColumnExpr:
+    """Replace every subtree whose structural uuid (alias/cast ignored)
+    appears in ``mapping`` with a reference to the mapped column name —
+    used by GROUP BY-expression materialization to point projections and
+    HAVING at the computed helper columns. Unknown node types pass
+    through unchanged (no substitution inside them)."""
+    from .expressions import col as _named_col
+
+    def rw(e: ColumnExpr) -> ColumnExpr:
+        key = e.alias("").cast(None).__uuid__()
+        if key in mapping:
+            out: ColumnExpr = _named_col(mapping[key])
+            if e.as_type is not None:
+                out = out.cast(e.as_type)
+            if e.output_name != "":
+                out = out.alias(e.output_name)
+            return out
+        if isinstance(e, _FuncExpr) and e.is_agg:
+            # aggregate subtrees stay UNTOUCHED: their args evaluate over
+            # pre-group rows, and rebuilding would downgrade the agg
+            # subclass to a plain _FuncExpr (losing is_agg)
+            return e
+        if isinstance(e, _BinaryOpExpr):
+            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
+        if isinstance(e, _UnaryOpExpr):
+            return _UnaryOpExpr(e.op, rw(e.col))
+        if isinstance(e, _FuncExpr):
+            out2: ColumnExpr = _FuncExpr(
+                e.func, *[rw(a) for a in e.args], arg_distinct=e.is_distinct
+            )
+            if e.as_type is not None:
+                out2 = out2.cast(e.as_type)
+            if e.output_name != "":
+                out2 = out2.alias(e.output_name)
+            return out2
+        if isinstance(e, _InExpr):
+            return _InExpr(rw(e.col), e.values, e.positive)
+        if isinstance(e, _LikeExpr):
+            return _LikeExpr(rw(e.col), e.pattern, e.positive)
+        if isinstance(e, _CaseWhenExpr):
+            return _CaseWhenExpr(
+                [(rw(c), rw(v)) for c, v in e.cases], rw(e.default)
+            )
+        return e
+
+    return rw(expr)
+
+
 def rewrite_having_aggs(
     having: ColumnExpr, agg_cols: List[ColumnExpr]
 ) -> ColumnExpr:

@@ -693,12 +693,24 @@ class SQLExecutor:
         cols = SelectColumns(
             *[c.infer_alias() for c in node.projections], arg_distinct=node.distinct
         )
+        if len(node.group_by) > 0 and any(
+            not isinstance(g, _NamedColumnExpr) for g in node.group_by
+        ):
+            # GROUP BY <expression>: materialize each computed key as a
+            # helper column, group by its name, and rewrite matching
+            # projection/having subexpressions to reference it
+            node, child = self._materialize_groupby_exprs(node, child)
+            cols = SelectColumns(
+                *[c.infer_alias() for c in node.projections],
+                arg_distinct=node.distinct,
+            )
         if len(node.group_by) > 0:
             gb_names: List[str] = []
             for g in node.group_by:
                 if not isinstance(g, _NamedColumnExpr):
                     raise NotImplementedError(
-                        "GROUP BY supports plain column references only"
+                        "GROUP BY supports plain column references or "
+                        "expressions that also appear in the SELECT list"
                     )
                 gb_names.append(g.name)
             expanded = cols.replace_wildcard(child.schema).all_cols
@@ -721,6 +733,60 @@ class SQLExecutor:
                 # key-only projection (eval_select can't see those aggs)
                 return self._exec_decoupled_groupby(node, child, gb_names)
         return e.select(child, cols, where=node.where, having=node.having)
+
+    def _materialize_groupby_exprs(
+        self, node: SelectNode, child: DataFrame
+    ) -> Any:
+        """GROUP BY over computed expressions (the reference gets this free
+        from backend SQL): each non-named key materializes as an assigned
+        helper column on the child; identical TOP-LEVEL projections (by
+        structural uuid, alias/cast ignored) rewrite to the helper name so
+        the grouped evaluator sees plain keys. A grouped expression only
+        appearing NESTED inside a projection still raises downstream."""
+        import dataclasses
+
+        from ..column.expressions import col as _named_col
+
+        e = self._engine
+        from ..column.eval import substitute_exprs
+
+        # the wildcard must expand against the ORIGINAL schema, or the
+        # helper columns would leak into SELECT *
+        projections = list(
+            SelectColumns(
+                *[c.infer_alias() for c in node.projections]
+            ).replace_wildcard(child.schema).all_cols
+        )
+        assigns: List[ColumnExpr] = []
+        repl: Dict[str, str] = {}
+        new_gb: List[ColumnExpr] = []
+        for i, g in enumerate(node.group_by):
+            if isinstance(g, _NamedColumnExpr):
+                new_gb.append(g)
+                continue
+            # a readable derived name (what SQL backends show for an
+            # unaliased grouped expression), not an internal token
+            name = repr(g.alias("").cast(None))
+            repl[g.alias("").cast(None).__uuid__()] = name
+            assigns.append(g.alias(name))
+            new_gb.append(_named_col(name))
+        child2 = e.assign(child, assigns)
+        new_proj = [substitute_exprs(c, repl) for c in projections]
+        new_having = None
+        if node.having is not None:
+            # HAVING evaluates over the AGGREGATED frame, whose columns are
+            # the projection OUTPUT names — a grouped expr that is also
+            # projected must rewrite to its output alias, not the helper
+            having_map = dict(repl)
+            for c in projections:
+                key = c.alias("").cast(None).__uuid__()
+                if key in repl and c.output_name != "":
+                    having_map[key] = c.output_name
+            new_having = substitute_exprs(node.having, having_map)
+        new_node = dataclasses.replace(
+            node, projections=new_proj, group_by=new_gb, having=new_having
+        )
+        return new_node, child2
 
     def _substitute_subqueries(self, node: SelectNode) -> SelectNode:
         """Evaluate uncorrelated subqueries and substitute their results:

@@ -706,3 +706,57 @@ class TestSubqueries:
         t = pd.DataFrame({"v": [1.0, 2.0]})
         with pytest.raises(Exception, match="one row|one column"):
             fugue_sql("SELECT (SELECT v FROM t) AS s")
+
+
+def test_group_by_expression():
+    """GROUP BY over computed expressions (reference gets this from
+    backend SQL; here the key materializes as a helper column)."""
+    import fugue_tpu.api as fa
+
+    df = pd.DataFrame(
+        {"s": ["apple", "avocado", "banana", "blueberry"], "v": [1.0, 2.0, 3.0, 4.0]}
+    )
+    r = fa.fugue_sql(
+        "SELECT SUBSTRING(s,1,1) AS c, SUM(v) AS t FROM df "
+        "GROUP BY SUBSTRING(s,1,1)",
+        df=df,
+        engine="native",
+        as_fugue=True,
+    ).as_pandas().sort_values("c").reset_index(drop=True)
+    assert r["c"].tolist() == ["a", "b"] and r["t"].tolist() == [3.0, 7.0]
+    # mixed named + computed keys, WHERE before grouping, HAVING after
+    df2 = pd.DataFrame({"k": [1, 1, 2, 2, 2], "x": [1.0, 2.0, 3.0, 4.0, 10.0]})
+    r2 = fa.fugue_sql(
+        "SELECT k, x > 2.5 AS hi, COUNT(*) AS n FROM df2 WHERE x < 9 "
+        "GROUP BY k, x > 2.5 HAVING COUNT(*) > 1",
+        df2=df2,
+        engine="native",
+        as_fugue=True,
+    ).as_pandas().sort_values("k").reset_index(drop=True)
+    assert r2["n"].tolist() == [2, 2]
+    assert r2["hi"].tolist() == [False, True]
+    # HAVING referencing the grouped expression rewrites to the output col
+    r3 = fa.fugue_sql(
+        "SELECT SUBSTRING(s,1,1) AS c, SUM(v) AS t FROM df "
+        "GROUP BY SUBSTRING(s,1,1) HAVING SUBSTRING(s,1,1) <> 'a'",
+        df=df,
+        engine="native",
+        as_fugue=True,
+    ).as_pandas()
+    assert r3["c"].tolist() == ["b"] and r3["t"].tolist() == [7.0]
+    # an unaliased grouped projection gets a readable derived name
+    r4 = fa.fugue_sql(
+        "SELECT SUBSTRING(s,1,1), SUM(v) AS t FROM df GROUP BY SUBSTRING(s,1,1)",
+        df=df,
+        engine="native",
+        as_fugue=True,
+    )
+    assert r4.schema.names == ["SUBSTRING(s,1,1)", "t"]
+    # SELECT * with a computed key never leaks the helper columns
+    r5 = fa.fugue_sql(
+        "SELECT * FROM df2 GROUP BY k, x, x > 2.5",
+        df2=df2,
+        engine="native",
+        as_fugue=True,
+    )
+    assert r5.schema.names == ["k", "x"]
