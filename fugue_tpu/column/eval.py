@@ -330,42 +330,48 @@ def substitute_exprs(expr: ColumnExpr, mapping: Dict[str, str]) -> ColumnExpr:
     used by GROUP BY-expression materialization to point projections and
     HAVING at the computed helper columns. Unknown node types pass
     through unchanged (no substitution inside them)."""
-    from .expressions import col as _named_col
+    from .expressions import col as _named_col, structural_key
+
+    def _finish(out: ColumnExpr, e: ColumnExpr) -> ColumnExpr:
+        """Restore the original node's cast/alias onto a rebuilt node."""
+        if e.as_type is not None and out.as_type is None:
+            out = out.cast(e.as_type)
+        if e.output_name != "" and out.output_name != e.output_name:
+            out = out.alias(e.output_name)
+        return out
 
     def rw(e: ColumnExpr) -> ColumnExpr:
-        key = e.alias("").cast(None).__uuid__()
-        if key in mapping:
-            out: ColumnExpr = _named_col(mapping[key])
-            if e.as_type is not None:
-                out = out.cast(e.as_type)
-            if e.output_name != "":
-                out = out.alias(e.output_name)
-            return out
+        if structural_key(e) in mapping:
+            out: ColumnExpr = _named_col(mapping[structural_key(e)])
+            return _finish(out, e)
         if isinstance(e, _FuncExpr) and e.is_agg:
             # aggregate subtrees stay UNTOUCHED: their args evaluate over
             # pre-group rows, and rebuilding would downgrade the agg
             # subclass to a plain _FuncExpr (losing is_agg)
             return e
         if isinstance(e, _BinaryOpExpr):
-            return _BinaryOpExpr(e.op, rw(e.left), rw(e.right))
+            return _finish(_BinaryOpExpr(e.op, rw(e.left), rw(e.right)), e)
         if isinstance(e, _UnaryOpExpr):
-            return _UnaryOpExpr(e.op, rw(e.col))
+            return _finish(_UnaryOpExpr(e.op, rw(e.col)), e)
         if isinstance(e, _FuncExpr):
-            out2: ColumnExpr = _FuncExpr(
-                e.func, *[rw(a) for a in e.args], arg_distinct=e.is_distinct
+            return _finish(
+                _FuncExpr(
+                    e.func,
+                    *[rw(a) for a in e.args],
+                    arg_distinct=e.is_distinct,
+                ),
+                e,
             )
-            if e.as_type is not None:
-                out2 = out2.cast(e.as_type)
-            if e.output_name != "":
-                out2 = out2.alias(e.output_name)
-            return out2
         if isinstance(e, _InExpr):
-            return _InExpr(rw(e.col), e.values, e.positive)
+            return _finish(_InExpr(rw(e.col), e.values, e.positive), e)
         if isinstance(e, _LikeExpr):
-            return _LikeExpr(rw(e.col), e.pattern, e.positive)
+            return _finish(_LikeExpr(rw(e.col), e.pattern, e.positive), e)
         if isinstance(e, _CaseWhenExpr):
-            return _CaseWhenExpr(
-                [(rw(c), rw(v)) for c, v in e.cases], rw(e.default)
+            return _finish(
+                _CaseWhenExpr(
+                    [(rw(c), rw(v)) for c, v in e.cases], rw(e.default)
+                ),
+                e,
             )
         return e
 

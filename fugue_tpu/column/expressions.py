@@ -584,3 +584,21 @@ class _WindowExpr(ColumnExpr):
             repr(self._order_by),
             repr(self._frame),
         ]
+
+
+def structural_key(e: "ColumnExpr") -> str:
+    """Identity of an expression ignoring its output alias (cast KEPT —
+    ``CAST(x AS int)`` must not match plain ``x``). The shared matching
+    key for GROUP BY / ORDER BY expression materialization."""
+    return e.alias("").__uuid__()
+
+
+def derived_name(e: "ColumnExpr") -> str:
+    """The readable derived column name of an unaliased expression (what
+    SQL backends display), used to name materialized helper columns.
+    Casts render explicitly — ``repr`` omits them, and ``CAST(x AS int)``
+    must not collide with plain ``x``."""
+    bare = e.alias("")
+    if bare.as_type is not None:
+        return f"CAST({repr(bare.cast(None))} AS {bare.as_type})"
+    return repr(bare)

@@ -100,7 +100,9 @@ class SQLExecutor:
             sort_names = [n for n, _ in node.by]
             extras: List[str] = []
             # standard SQL: ORDER BY may reference source columns that the
-            # projection drops — augment the projection, sort, then drop
+            # projection drops — augment the projection, sort, then drop.
+            # Expression sorts whose inputs the projection drops compute
+            # INSIDE the select scope the same way
             if isinstance(child, SelectNode) and child.child is not None:
                 out_names = {
                     c.output_name
@@ -112,25 +114,81 @@ class SQLExecutor:
                     for c in child.projections
                 )
                 missing = [
-                    n for n in sort_names if n not in out_names and not has_wildcard
+                    n
+                    for n in sort_names
+                    if n not in node.exprs
+                    and n not in out_names
+                    and not has_wildcard
+                ]
+                missing_exprs = [
+                    n
+                    for n in sort_names
+                    if n in node.exprs
+                    and not has_wildcard
+                    and not all(
+                        r in out_names
+                        for r in _referenced_names(node.exprs[n])
+                    )
                 ]
                 if (
-                    len(missing) > 0
+                    len(missing) + len(missing_exprs) > 0
                     and len(child.group_by) == 0
                     and not child.distinct
                     and not any(is_agg(c) for c in child.projections)
                 ):
                     child = SelectNode(
                         child.child,
-                        list(child.projections) + [_col(n) for n in missing],
+                        list(child.projections)
+                        + [_col(n) for n in missing]
+                        + [node.exprs[n].alias(n) for n in missing_exprs],
                         child.where,
                         child.group_by,
                         child.having,
                         child.distinct,
                     )
-                    extras = missing
+                    extras = missing + missing_exprs
             df = self._exec(child)
             local = e.to_df(df).as_local_bounded()
+            # ORDER BY <ordinal>: a bare int literal is SQL positional
+            # ordering — resolve it to the Nth output column
+            for j, (n, asc) in enumerate(list(node.by)):
+                ex = node.exprs.get(n)
+                if isinstance(ex, _LitColumnExpr):
+                    if not isinstance(ex.value, int) or isinstance(ex.value, bool):
+                        raise FugueSQLSyntaxError(
+                            f"can't ORDER BY the constant {ex.value!r}"
+                        )
+                    if not (1 <= ex.value <= len(local.schema)):
+                        raise FugueSQLSyntaxError(
+                            f"ORDER BY position {ex.value} is out of range "
+                            f"(select has {len(local.schema)} columns)"
+                        )
+                    sort_names[j] = local.schema.names[ex.value - 1]
+            # expression sorts not yet materialized evaluate over the
+            # RESULT frame (its columns are the select outputs)
+            still = [
+                n
+                for n in sort_names
+                if n in node.exprs and n not in local.schema
+            ]
+            for n in still:
+                bad = [
+                    r
+                    for r in _referenced_names(node.exprs[n])
+                    if r not in local.schema
+                ]
+                if len(bad) > 0:
+                    raise FugueSQLSyntaxError(
+                        f"ORDER BY expression {n!r} references column(s) "
+                        f"{bad} not in the select output "
+                        f"{local.schema.names} (aggregated selects can "
+                        "only order by projected columns)"
+                    )
+            if len(still) > 0:
+                local = e.to_df(
+                    e.assign(local, [node.exprs[n].alias(n) for n in still])
+                ).as_local_bounded()
+                extras = extras + still
             absent = [n for n in sort_names if n not in local.schema]
             if len(absent) > 0:
                 raise FugueSQLSyntaxError(
@@ -749,6 +807,8 @@ class SQLExecutor:
 
         e = self._engine
         from ..column.eval import substitute_exprs
+        from ..column.expressions import derived_name as _derived_name
+        from ..column.expressions import structural_key as _structural_key
 
         # the wildcard must expand against the ORIGINAL schema, or the
         # helper columns would leak into SELECT *
@@ -766,8 +826,8 @@ class SQLExecutor:
                 continue
             # a readable derived name (what SQL backends show for an
             # unaliased grouped expression), not an internal token
-            name = repr(g.alias("").cast(None))
-            repl[g.alias("").cast(None).__uuid__()] = name
+            name = _derived_name(g)
+            repl[_structural_key(g)] = name
             assigns.append(g.alias(name))
             new_gb.append(_named_col(name))
         child2 = e.assign(child, assigns)
@@ -779,7 +839,7 @@ class SQLExecutor:
             # projected must rewrite to its output alias, not the helper
             having_map = dict(repl)
             for c in projections:
-                key = c.alias("").cast(None).__uuid__()
+                key = _structural_key(c)
                 if key in repl and c.output_name != "":
                     having_map[key] = c.output_name
             new_having = substitute_exprs(node.having, having_map)

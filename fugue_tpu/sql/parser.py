@@ -22,14 +22,16 @@ Grammar (spark-ish subset)::
 """
 
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 from ..column import ColumnExpr, col, function, lit
 from ..column.expressions import (
+    derived_name,
     _BinaryOpExpr,
     _CaseWhenExpr,
     _InExpr,
     _LikeExpr,
+    _NamedColumnExpr,
     _UnaryOpExpr,
 )
 from ..exceptions import FugueSQLSyntaxError
@@ -313,6 +315,9 @@ class SetOpNode(PlanNode):
 class SortNode(PlanNode):
     child: PlanNode
     by: List[Tuple[str, bool]]
+    # ORDER BY <expression>: generated sort names -> their expressions
+    # (materialized as helper columns at execution, dropped after the sort)
+    exprs: Dict[str, ColumnExpr] = field(default_factory=dict)
 
 
 @dataclass
@@ -412,8 +417,23 @@ class SQLParser:
             self.next()
             self.expect_kw("BY")
             by: List[Tuple[str, bool]] = []
+            exprs: Dict[str, ColumnExpr] = {}
             while True:
-                name = self._parse_name()
+                item = self._parse_expr()
+                if (
+                    isinstance(item, _NamedColumnExpr)
+                    and item.as_name == ""
+                    and item.as_type is None
+                    and item.name != "*"
+                ):
+                    name = item.name
+                else:
+                    # ORDER BY <expression>: name it by its readable
+                    # derived form (cast KEPT — CAST(x AS t) must not
+                    # collide with plain x); bare int literals resolve as
+                    # SQL positional ordering in the executor
+                    name = derived_name(item)
+                    exprs[name] = item
                 asc = True
                 if self.eat_kw("DESC"):
                     asc = False
@@ -422,7 +442,7 @@ class SQLParser:
                 by.append((name, asc))
                 if not self.eat_punct(","):
                     break
-            plan = SortNode(plan, by)
+            plan = SortNode(plan, by, exprs)
         if self.at_kw("LIMIT"):
             self.next()
             t = self.next()

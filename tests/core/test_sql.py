@@ -760,3 +760,72 @@ def test_group_by_expression():
         as_fugue=True,
     )
     assert r5.schema.names == ["k", "x"]
+
+
+def test_order_by_expression():
+    """ORDER BY over computed expressions — projected-column inputs,
+    dropped-source-column inputs, and mixed plain+expression sorts."""
+    import fugue_tpu.api as fa
+
+    df = pd.DataFrame({"s": ["bb", "za", "ccc"], "v": [1.0, 2.0, 3.0]})
+    r = fa.fugue_sql(
+        "SELECT s FROM df ORDER BY SUBSTRING(s,2,1) DESC",
+        df=df, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r["s"].tolist() == ["ccc", "bb", "za"]
+    assert r.columns.tolist() == ["s"]  # helper columns never leak
+    r2 = fa.fugue_sql(
+        "SELECT s FROM df ORDER BY v * -1",
+        df=df, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r2["s"].tolist() == ["ccc", "za", "bb"]
+    r3 = fa.fugue_sql(
+        "SELECT s, v FROM df ORDER BY SUBSTRING(s,1,1), v DESC",
+        df=df, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r3["s"].tolist() == ["bb", "ccc", "za"]
+    # an aggregated select can still order by an expression over outputs
+    r4 = fa.fugue_sql(
+        "SELECT s, SUM(v) AS t FROM df GROUP BY s ORDER BY t * -1",
+        df=df, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r4["t"].tolist() == [3.0, 2.0, 1.0]
+
+
+def test_order_by_ordinal_and_cast():
+    import fugue_tpu.api as fa
+    import pytest as _pytest
+
+    df = pd.DataFrame(
+        {"s": ["bb", "za", "ccc"], "v": [1.0, 2.0, 3.0], "x": ["10", "2", "1"]}
+    )
+    # SQL positional ordering
+    r = fa.fugue_sql(
+        "SELECT s, v FROM df ORDER BY 2 DESC", df=df, engine="native", as_fugue=True
+    ).as_pandas()
+    assert r["s"].tolist() == ["ccc", "za", "bb"]
+    # CAST sort keys don't collide with the plain column
+    r2 = fa.fugue_sql(
+        "SELECT x FROM df ORDER BY CAST(x AS int)",
+        df=df, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r2["x"].tolist() == ["1", "2", "10"]
+    # constants and out-of-range positions raise typed errors
+    with _pytest.raises(Exception, match="constant"):
+        fa.fugue_sql("SELECT s FROM df ORDER BY 'q'", df=df, engine="native")
+    with _pytest.raises(Exception, match="out of range"):
+        fa.fugue_sql("SELECT s FROM df ORDER BY 5", df=df, engine="native")
+    # aggregated selects give the crafted error for dropped-column exprs
+    with _pytest.raises(Exception, match="order by projected"):
+        fa.fugue_sql(
+            "SELECT s, SUM(v) AS t FROM df GROUP BY s ORDER BY v * 2",
+            df=df, engine="native",
+        )
+    # aliases survive substitution on rebuilt compound projections
+    df2 = pd.DataFrame({"k": [1, 1, 2], "x": [1.0, 3.0, 4.0]})
+    r3 = fa.fugue_sql(
+        "SELECT k + 1 AS k1, x > 2.5 AS hi, COUNT(*) AS n FROM df2 "
+        "GROUP BY k + 1, x > 2.5",
+        df2=df2, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert set(r3.columns) == {"k1", "hi", "n"}
