@@ -85,6 +85,49 @@ REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 CAPTURE_PATH = os.path.join(REPO_ROOT, "TPU_CAPTURE.json")
 CAPTURE_LOG = os.path.join(REPO_ROOT, "tpu_capture.log")
 TUNED_PATH = os.path.join(REPO_ROOT, "fugue_tpu", "ops", "_tuned.json")
+# while a foreground bench run holds this lock, the daemon stops probing
+# (each probe spawns a jax-importing subprocess — real contention on a
+# 1-core box that would skew the very numbers being measured)
+BENCH_LOCK = os.path.join(REPO_ROOT, ".bench_running.lock")
+
+
+class _bench_lock:
+    def __enter__(self):
+        import threading
+
+        try:
+            with open(BENCH_LOCK, "w") as f:
+                f.write(str(os.getpid()))
+        except Exception:
+            pass
+        # keep the lock fresh for runs longer than the staleness window
+        self._stop = threading.Event()
+
+        def _touch() -> None:
+            while not self._stop.wait(300):
+                try:
+                    os.utime(BENCH_LOCK, None)
+                except Exception:
+                    pass
+
+        self._t = threading.Thread(target=_touch, daemon=True)
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._stop.set()
+        try:
+            os.remove(BENCH_LOCK)
+        except Exception:
+            pass
+
+
+def _bench_lock_held() -> bool:
+    try:
+        age = time.time() - os.path.getmtime(BENCH_LOCK)
+        return age < 3600  # stale locks (crashed bench) expire
+    except Exception:
+        return False
 
 
 def _tpu_reachable(timeout_s: float = 45.0) -> bool:
@@ -162,6 +205,11 @@ def _daemon(interval: float = 120.0, recapture_every: float = 7200.0) -> None:
 
     say(f"daemon start pid={os.getpid()} interval={interval}s")
     while True:
+        if _bench_lock_held():
+            # a foreground bench run owns the box: probing now would both
+            # skew its numbers and waste the window
+            time.sleep(30)
+            continue
         if _tpu_reachable():
             say("tunnel UP — starting on-chip capture")
             try:
@@ -883,6 +931,16 @@ def _north_star() -> None:
 
 
 def main(strict_tpu: bool = False) -> None:
+    if not strict_tpu:
+        # foreground run: silence the capture daemon's probe subprocesses
+        # for the duration (capture runs ARE daemon work — no lock there)
+        with _bench_lock():
+            _main_impl(strict_tpu)
+    else:
+        _main_impl(strict_tpu)
+
+
+def _main_impl(strict_tpu: bool = False) -> None:
     on_tpu = _tpu_reachable()
     if strict_tpu and not on_tpu:
         print("tunnel down: --capture requires a reachable TPU", file=sys.stderr)
@@ -1153,7 +1211,8 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--capture":
         main(strict_tpu=True)
     elif len(sys.argv) > 1 and sys.argv[1] == "--north-star":
-        _north_star()
+        with _bench_lock():
+            _north_star()
     elif len(sys.argv) > 1 and sys.argv[1] == "--daemon":
         interval = float(sys.argv[2]) if len(sys.argv) > 2 else 120.0
         _daemon(interval=interval)

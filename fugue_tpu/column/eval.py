@@ -325,8 +325,10 @@ def eval_select(
 
 
 def substitute_exprs(expr: ColumnExpr, mapping: Dict[str, str]) -> ColumnExpr:
-    """Replace every subtree whose structural uuid (alias/cast ignored)
-    appears in ``mapping`` with a reference to the mapped column name —
+    """Replace every subtree whose structural uuid (alias ignored; cast
+    kept, with a cast-stripped second probe so ``CAST(expr AS t)`` matches
+    ``expr`` and keeps the cast) appears in ``mapping`` with a reference
+    to the mapped column name —
     used by GROUP BY-expression materialization to point projections and
     HAVING at the computed helper columns. Unknown node types pass
     through unchanged (no substitution inside them)."""
@@ -341,9 +343,16 @@ def substitute_exprs(expr: ColumnExpr, mapping: Dict[str, str]) -> ColumnExpr:
         return out
 
     def rw(e: ColumnExpr) -> ColumnExpr:
-        if structural_key(e) in mapping:
-            out: ColumnExpr = _named_col(mapping[structural_key(e)])
-            return _finish(out, e)
+        key = structural_key(e)
+        if key in mapping:
+            return _finish(_named_col(mapping[key]), e)
+        if e.as_type is not None:
+            # CAST(<mapped expr> AS t) matches the bare expr and keeps the
+            # cast — the cast-KEPT first probe only prevents CAST(x) from
+            # silently COLLIDING with plain x when naming helpers
+            bare_key = structural_key(e.cast(None))
+            if bare_key in mapping:
+                return _finish(_named_col(mapping[bare_key]).cast(e.as_type), e)
         if isinstance(e, _FuncExpr) and e.is_agg:
             # aggregate subtrees stay UNTOUCHED: their args evaluate over
             # pre-group rows, and rebuilding would downgrade the agg

@@ -120,16 +120,33 @@ class SQLExecutor:
                     and n not in out_names
                     and not has_wildcard
                 ]
-                missing_exprs = [
-                    n
-                    for n in sort_names
-                    if n in node.exprs
-                    and not has_wildcard
-                    and not all(
-                        r in out_names
-                        for r in _referenced_names(node.exprs[n])
+                alias_names = {
+                    c.output_name
+                    for c in child.projections
+                    if c.output_name not in ("", "*")
+                    and not (
+                        isinstance(c, _NamedColumnExpr)
+                        and c.name == c.output_name
                     )
-                ]
+                }
+                missing_exprs = []
+                for n in sort_names:
+                    if n not in node.exprs or has_wildcard:
+                        continue
+                    refs = _referenced_names(node.exprs[n])
+                    if all(r in out_names for r in refs):
+                        continue  # evaluates over the select output later
+                    used_aliases = [r for r in refs if r in alias_names]
+                    if len(used_aliases) > 0:
+                        # pre-projection scope has no aliases; the select
+                        # output lacks the dropped source columns — no
+                        # scope can evaluate this expression
+                        raise FugueSQLSyntaxError(
+                            f"ORDER BY expression {n!r} mixes projection "
+                            f"aliases {used_aliases} with source columns "
+                            "the projection drops"
+                        )
+                    missing_exprs.append(n)
                 if (
                     len(missing) + len(missing_exprs) > 0
                     and len(child.group_by) == 0
@@ -150,7 +167,9 @@ class SQLExecutor:
             df = self._exec(child)
             local = e.to_df(df).as_local_bounded()
             # ORDER BY <ordinal>: a bare int literal is SQL positional
-            # ordering — resolve it to the Nth output column
+            # ordering — resolve it against the USER-VISIBLE columns (the
+            # augmented frame also carries hidden sort helpers)
+            visible = [n for n in local.schema.names if n not in extras]
             for j, (n, asc) in enumerate(list(node.by)):
                 ex = node.exprs.get(n)
                 if isinstance(ex, _LitColumnExpr):
@@ -158,12 +177,12 @@ class SQLExecutor:
                         raise FugueSQLSyntaxError(
                             f"can't ORDER BY the constant {ex.value!r}"
                         )
-                    if not (1 <= ex.value <= len(local.schema)):
+                    if not (1 <= ex.value <= len(visible)):
                         raise FugueSQLSyntaxError(
                             f"ORDER BY position {ex.value} is out of range "
-                            f"(select has {len(local.schema)} columns)"
+                            f"(select has {len(visible)} columns)"
                         )
-                    sort_names[j] = local.schema.names[ex.value - 1]
+                    sort_names[j] = visible[ex.value - 1]
             # expression sorts not yet materialized evaluate over the
             # RESULT frame (its columns are the select outputs)
             still = [

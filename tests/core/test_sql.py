@@ -829,3 +829,28 @@ def test_order_by_ordinal_and_cast():
         df2=df2, engine="native", as_fugue=True,
     ).as_pandas()
     assert set(r3.columns) == {"k1", "hi", "n"}
+
+
+def test_order_by_edge_cases_round2():
+    """Review-found edges: hidden sort helpers don't satisfy ordinals;
+    CAST of a grouped expression matches and keeps its cast; alias+dropped
+    -source mixes raise typed errors."""
+    import fugue_tpu.api as fa
+    import pytest as _pytest
+
+    df = pd.DataFrame(
+        {"s": ["bb", "za", "ccc"], "v": [1.0, 2.0, 3.0], "x": ["10", "2", "1"]}
+    )
+    with _pytest.raises(Exception, match="out of range"):
+        fa.fugue_sql("SELECT s FROM df ORDER BY v, 2", df=df, engine="native")
+    df2 = pd.DataFrame({"k": [1, 1, 2], "x": [1.0, 3.0, 4.0]})
+    r = fa.fugue_sql(
+        "SELECT CAST(k+1 AS int) AS k1, COUNT(*) AS n FROM df2 GROUP BY k+1",
+        df2=df2, engine="native", as_fugue=True,
+    ).as_pandas()
+    assert sorted(r["k1"].tolist()) == [2, 3]
+    assert str(r.dtypes["k1"]) in ("int32", "Int32")
+    with _pytest.raises(Exception, match="mixes projection aliases"):
+        fa.fugue_sql(
+            "SELECT v AS w, s FROM df ORDER BY w * x", df=df, engine="native"
+        )
