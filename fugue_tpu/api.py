@@ -46,6 +46,7 @@ from .dataframe.api import (  # noqa: F401
 from .execution.api import (  # noqa: F401
     aggregate,
     anti_join,
+    as_fugue_engine_df,
     assign,
     broadcast,
     clear_global_engine,

@@ -33,6 +33,16 @@ def engine_context(
         yield ctx
 
 
+def as_fugue_engine_df(
+    engine: ExecutionEngine, df: Any, schema: Any = None
+) -> DataFrame:
+    """Convert any dataframe-like object into ``engine``'s native
+    DataFrame (reference ``execution/api.py:125``) — used by workflow
+    internals and tests; prefer ``engine.to_df`` in user code."""
+    fdf = as_fugue_df(df) if schema is None else as_fugue_df(df, schema=schema)
+    return engine.to_df(fdf)
+
+
 def set_global_engine(engine: AnyExecutionEngine, conf: Any = None) -> ExecutionEngine:
     """Make an engine the process-global default
     (reference ``execution/api.py:53``)."""

@@ -139,3 +139,18 @@ def test_workflow_dataframes_container():
         WorkflowDataFrames(a, FugueWorkflow().df([[3]], "c:int"))
     with _pytest.raises(FugueWorkflowCompileError):
         WorkflowDataFrames(123)
+
+
+def test_as_fugue_engine_df():
+    """`fa.as_fugue_engine_df` converts any dataframe-like object to the
+    engine's native frame (reference `execution/api.py:125`)."""
+    import pandas as pd
+
+    import fugue_tpu.api as fa
+    from fugue_tpu.execution import NativeExecutionEngine
+
+    e = NativeExecutionEngine()
+    d = fa.as_fugue_engine_df(e, pd.DataFrame({"a": [1, 2]}))
+    assert d.schema.names == ["a"] and d.count() == 2
+    d2 = fa.as_fugue_engine_df(e, pd.DataFrame({"a": [1]}), schema="a:int")
+    assert str(d2.schema) == "a:int"
