@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost bench dryrun native
+.PHONY: install test test-multihost test-resilience bench dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -17,6 +17,12 @@ test-slow:
 # jax.distributed mesh (each worker is its own OS process)
 test-multihost:
 	python -m pytest tests/core/test_multihost.py -q -m "slow or not slow"
+
+# fault-injection suite (docs/resilience.md): worker SIGKILL recovery,
+# chunk deadlines, poison quarantine, RPC retry, checkpoint-aware replay.
+# not marked slow — tier-1 runs it too; this target is the focused loop
+test-resilience:
+	JAX_PLATFORMS=cpu python -m pytest tests/core/test_resilience.py -q -m "not slow"
 
 bench:
 	python bench.py

@@ -46,6 +46,32 @@ FUGUE_TPU_CONF_DENSE_MAP_RANGE = "fugue.tpu.map.dense_range"
 # keep the ingestion arrow table alive on JaxDataFrames for zero-cost host
 # reads (global conf; ~2x host memory on ingest-heavy pipelines when True)
 FUGUE_TPU_CONF_INGEST_CACHE = "fugue.tpu.ingest_cache"
+# --- resilience layer (see fugue_tpu/resilience and docs/resilience.md) ---
+# retry policy for fork-pool map chunks: attempts (1 disables retry),
+# exponential backoff base/multiplier/cap (seconds) and jitter fraction
+FUGUE_TPU_CONF_RETRY_ATTEMPTS = "fugue.tpu.retry.attempts"
+FUGUE_TPU_CONF_RETRY_BASE = "fugue.tpu.retry.base"
+FUGUE_TPU_CONF_RETRY_MULTIPLIER = "fugue.tpu.retry.multiplier"
+FUGUE_TPU_CONF_RETRY_MAX_BACKOFF = "fugue.tpu.retry.max_backoff"
+FUGUE_TPU_CONF_RETRY_JITTER = "fugue.tpu.retry.jitter"
+# per-workflow-task retry attempts (default 1 = fail fast, matching the
+# reference); retried tasks re-consult StrongCheckpoint.exists so finished
+# upstream work replays from disk instead of recomputing
+FUGUE_TPU_CONF_RETRY_TASK_ATTEMPTS = "fugue.tpu.retry.task.attempts"
+# HTTP RPC client retry attempts (connect-phase failures and idempotent
+# calls only — a request that may have reached the server is never blindly
+# re-sent)
+FUGUE_TPU_CONF_RETRY_RPC_ATTEMPTS = "fugue.tpu.retry.rpc.attempts"
+# per-chunk wall-clock deadline (seconds) on the fork-pool map path;
+# 0/unset = unbounded
+FUGUE_TPU_CONF_MAP_CHUNK_TIMEOUT = "fugue.tpu.map.chunk_timeout"
+# fault-injection plan (see fugue_tpu/resilience/fault.py for the grammar);
+# also settable via the FUGUE_TPU_FAULT_PLAN env var
+FUGUE_TPU_CONF_FAULT_PLAN = "fugue.tpu.fault.plan"
+# HTTP RPC client socket timeouts (seconds)
+FUGUE_RPC_CONF_HTTP_CONNECT_TIMEOUT = "fugue.rpc.http_client.connect_timeout"
+FUGUE_RPC_CONF_HTTP_READ_TIMEOUT = "fugue.rpc.http_client.read_timeout"
+
 # streaming (out-of-core) execution: rows per host->device chunk; the
 # device working set is O(chunk_rows x columns), NOT O(dataset)
 FUGUE_TPU_CONF_STREAM_CHUNK_ROWS = "fugue.tpu.stream.chunk_rows"

@@ -180,6 +180,7 @@ class ExecutionEngine(FugueEngineBase):
         self._is_global = False
         self._compile_conf = ParamDict()
         self._rpc_server: Any = None
+        self._resilience_stats: Any = None
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}"
@@ -307,6 +308,18 @@ class ExecutionEngine(FugueEngineBase):
 
     def set_rpc_server(self, server: Any) -> None:
         self._rpc_server = server
+
+    # ---- resilience observability -----------------------------------------
+    @property
+    def resilience_stats(self) -> Any:
+        """Structured recovery counters (``fugue_tpu.resilience``): every
+        retry, quarantine and fallback on this engine increments one — the
+        graceful-degradation machinery is observable, never silent."""
+        if self._resilience_stats is None:
+            from ..resilience import ResilienceStats
+
+            self._resilience_stats = ResilienceStats()
+        return self._resilience_stats
 
     # ---- physical ops (abstract) ------------------------------------------
     @abstractmethod

@@ -198,8 +198,11 @@ class PandasMapEngine(MapEngine):
         output_schema: Schema,
         workers: int,
     ) -> DataFrame:
+        from ..constants import FUGUE_TPU_CONF_MAP_CHUNK_TIMEOUT
+        from ..resilience import FaultInjector, RetryPolicy
         from .parallel_map import run_partitions_forked
 
+        engine = self.execution_engine
         tables = run_partitions_forked(
             pdf,
             schema,
@@ -210,6 +213,14 @@ class PandasMapEngine(MapEngine):
             workers,
             wrap_df=_wrap_pandas_part,
             to_arrow=_result_to_arrow,
+            chunk_timeout=float(
+                engine.conf.get(FUGUE_TPU_CONF_MAP_CHUNK_TIMEOUT, 0.0)
+            ),
+            policy=RetryPolicy.from_conf(engine.conf),
+            # fresh injector per map call: fault budgets ("kill one worker")
+            # are per-map, not per-process
+            injector=FaultInjector.from_conf(engine.conf),
+            stats=engine.resilience_stats,
         )
         tables = [t for t in tables if t.num_rows > 0]
         if len(tables) == 0:

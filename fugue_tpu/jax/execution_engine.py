@@ -630,6 +630,10 @@ class JaxExecutionEngine(ExecutionEngine):
             mesh = build_mesh(shape if shape is None else tuple(shape))
         self._mesh = mesh
         self._host_engine = NativeExecutionEngine(conf)
+        # the host fallback engine executes the general (pandas) map path on
+        # this engine's behalf — share one counter sink so recovery events
+        # (retries, quarantines) are observable on the engine the user holds
+        self._host_engine._resilience_stats = self.resilience_stats
         self._jit_cache: dict = {}
 
     @property

@@ -1,0 +1,46 @@
+"""Resilience layer: retry/backoff policy, deadlines, failure taxonomy,
+fault injection, and structured recovery counters.
+
+Graceful-degradation order everywhere in the codebase:
+**parallel → retry → serial → raise** (see ``docs/resilience.md``).
+"""
+
+from .counters import ResilienceStats
+from .fault import (
+    NULL_INJECTOR,
+    SITE_CHECKPOINT_SAVE,
+    SITE_MAP_CHUNK,
+    SITE_MAP_DISPATCH,
+    SITE_RPC_REQUEST,
+    SITE_TASK_EXECUTE,
+    FaultInjector,
+)
+from .policy import (
+    ChunkTimeoutError,
+    Deadline,
+    FailureCategory,
+    InjectedFaultError,
+    ParallelMapError,
+    RetryPolicy,
+    WorkerLostError,
+    classify_failure,
+)
+
+__all__ = [
+    "ResilienceStats",
+    "FaultInjector",
+    "NULL_INJECTOR",
+    "SITE_MAP_DISPATCH",
+    "SITE_MAP_CHUNK",
+    "SITE_TASK_EXECUTE",
+    "SITE_RPC_REQUEST",
+    "SITE_CHECKPOINT_SAVE",
+    "RetryPolicy",
+    "Deadline",
+    "FailureCategory",
+    "classify_failure",
+    "WorkerLostError",
+    "ChunkTimeoutError",
+    "InjectedFaultError",
+    "ParallelMapError",
+]

@@ -2,42 +2,139 @@
 
 Replaces the reference's flask server (`fugue/rpc/flask.py:17` — flask is
 not in this environment) with a stdlib ``ThreadingHTTPServer``. Payloads are
-cloudpickle over POST. Conf keys mirror the reference:
+cloudpickle over POST. Conf keys mirror the reference, plus resilience
+controls:
 
 - ``fugue.rpc.http_server.host`` (default 127.0.0.1)
 - ``fugue.rpc.http_server.port`` (default 0 = ephemeral)
-- ``fugue.rpc.http_server.timeout`` (client timeout seconds)
+- ``fugue.rpc.http_server.timeout`` (legacy single client timeout seconds;
+  still honoured as the read-timeout default)
+- ``fugue.rpc.http_client.connect_timeout`` (default 5s)
+- ``fugue.rpc.http_client.read_timeout`` (default = legacy timeout, 30s)
+- ``fugue.tpu.retry.rpc.attempts`` (+ ``fugue.tpu.retry.*`` backoff keys)
+
+Every request is bounded: connect and read each have their own deadline —
+a driver that vanished mid-call can no longer hang a worker forever.
+
+Retry semantics respect idempotency: a failure BEFORE the request is sent
+(refused/unreachable/connect timeout) is always retried with backoff — the
+server never saw it. A failure AFTER the request went out is only retried
+when the client was built with ``idempotent=True``; blindly re-sending a
+stateful callback could double-apply it.
 """
 
 import base64
+import http.client
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
-from urllib import request as _urlrequest
+from typing import Any, Optional
 
 import cloudpickle
 
+from ..resilience import (
+    SITE_RPC_REQUEST,
+    FaultInjector,
+    NULL_INJECTOR,
+    ResilienceStats,
+    RetryPolicy,
+    classify_failure,
+)
 from .base import RPCClient, RPCServer
 
 
 class HttpRPCClient(RPCClient):
-    """Picklable client stub carrying only (host, port, key)."""
+    """Picklable client stub carrying only (host, port, key) + timeouts.
 
-    def __init__(self, host: str, port: int, key: str, timeout: float = 30.0):
+    The retry policy travels with the stub (it's plain data); the stats
+    sink and fault injector do not — a forked/remote worker increments its
+    own copies, and only driver-side counters are observable anyway.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        key: str,
+        timeout: float = 30.0,
+        connect_timeout: float = 5.0,
+        policy: Optional[RetryPolicy] = None,
+        idempotent: bool = False,
+        stats: Optional[ResilienceStats] = None,
+        injector: Optional[FaultInjector] = None,
+    ):
         self._host = host
         self._port = port
         self._key = key
         self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._policy = policy or RetryPolicy(max_attempts=1)
+        self._idempotent = idempotent
+        self._stats = stats
+        self._injector = injector
+
+    def __getstate__(self) -> dict:
+        # stats/injector hold locks & shared memory — strip them so the
+        # stub stays cloudpickle-able into any worker
+        state = dict(self.__dict__)
+        state["_stats"] = None
+        state["_injector"] = None
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+
+    def _invoke_once(self, payload: bytes) -> bytes:
+        """One request; exceptions carry ``_fugue_request_sent`` so the
+        retry loop can honour idempotency."""
+        sent = False
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self._connect_timeout
+        )
+        try:
+            conn.connect()
+            # connected: switch the socket to the (usually longer) read
+            # deadline for the request/response exchange
+            if conn.sock is not None:
+                conn.sock.settimeout(self._timeout)
+            sent = True
+            conn.request(
+                "POST",
+                "/invoke",
+                body=payload,
+                headers={"Content-Length": str(len(payload))},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise ConnectionError(f"RPC server returned HTTP {resp.status}")
+            return body
+        except Exception as ex:
+            ex._fugue_request_sent = sent  # type: ignore[attr-defined]
+            raise
+        finally:
+            conn.close()
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         payload = base64.b64encode(cloudpickle.dumps((self._key, args, kwargs)))
-        req = _urlrequest.Request(
-            f"http://{self._host}:{self._port}/invoke",
-            data=payload,
-            method="POST",
-        )
-        with _urlrequest.urlopen(req, timeout=self._timeout) as resp:
-            body = resp.read()
+        policy = self._policy
+        attempts = 0
+        while True:
+            try:
+                (self._injector or NULL_INJECTOR).fire(SITE_RPC_REQUEST)
+                body = self._invoke_once(payload)
+                break
+            except Exception as ex:
+                attempts += 1
+                sent = getattr(ex, "_fugue_request_sent", False)
+                retryable = (self._idempotent or not sent) and policy.should_retry(
+                    classify_failure(ex), attempts
+                )
+                if not retryable:
+                    raise
+                if self._stats is not None:
+                    self._stats.inc("rpc.retries")
+                time.sleep(policy.delay(attempts, seed=self._key))
         ok, result = cloudpickle.loads(base64.b64decode(body))
         if not ok:
             raise result
@@ -49,9 +146,25 @@ class HttpRPCServer(RPCServer):
 
     def __init__(self, conf: Any = None):
         super().__init__(conf)
+        from ..constants import (
+            FUGUE_RPC_CONF_HTTP_CONNECT_TIMEOUT,
+            FUGUE_RPC_CONF_HTTP_READ_TIMEOUT,
+        )
+
         self._host = self.conf.get("fugue.rpc.http_server.host", "127.0.0.1")
         self._port = int(self.conf.get("fugue.rpc.http_server.port", 0))
-        self._timeout = float(self.conf.get("fugue.rpc.http_server.timeout", 30.0))
+        # legacy single-timeout key remains the read-timeout default
+        legacy = float(self.conf.get("fugue.rpc.http_server.timeout", 30.0))
+        self._timeout = float(
+            self.conf.get(FUGUE_RPC_CONF_HTTP_READ_TIMEOUT, legacy)
+        )
+        self._connect_timeout = float(
+            self.conf.get(FUGUE_RPC_CONF_HTTP_CONNECT_TIMEOUT, 5.0)
+        )
+        self._client_policy = RetryPolicy.from_conf(
+            self.conf, prefix="fugue.tpu.retry.rpc", default_attempts=3
+        )
+        self._stats = ResilienceStats()
         self._httpd: Any = None
         self._thread: Any = None
 
@@ -63,8 +176,21 @@ class HttpRPCServer(RPCServer):
     def port(self) -> int:
         return self._port
 
+    @property
+    def resilience_stats(self) -> ResilienceStats:
+        return self._stats
+
     def create_client(self, key: str) -> RPCClient:
-        return HttpRPCClient(self._host, self._port, key, self._timeout)
+        return HttpRPCClient(
+            self._host,
+            self._port,
+            key,
+            timeout=self._timeout,
+            connect_timeout=self._connect_timeout,
+            policy=self._client_policy,
+            stats=self._stats,
+            injector=FaultInjector.from_conf(self.conf),
+        )
 
     def start_server(self) -> None:
         server = self

@@ -242,6 +242,9 @@ class WarehouseExecutionEngine(ExecutionEngine):
             weakref.finalize(self, _close_quietly, self._connection)
         self._schemas: Dict[str, Schema] = {}
         self._local_engine = NativeExecutionEngine(conf)
+        # delegated map/fallback work reports recovery counters on THIS
+        # engine (see fugue_tpu/resilience/counters.py)
+        self._local_engine._resilience_stats = self.resilience_stats
         self._log = logging.getLogger("fugue_tpu.warehouse")
         self._gen = _StorageCastGenerator(self._profile)
 

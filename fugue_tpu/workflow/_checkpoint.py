@@ -20,6 +20,30 @@ from ..exceptions import FugueWorkflowCompileError, FugueWorkflowRuntimeError
 from ..execution.execution_engine import ExecutionEngine
 
 
+def _atomic_publish(tmp: str, final: str) -> None:
+    """Atomically move a finished write into place. ``tmp`` may be a single
+    parquet file or a partitioned directory; same-directory rename is atomic
+    on POSIX for both."""
+    if os.path.isdir(tmp):
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        elif os.path.exists(final):
+            os.remove(final)
+        os.rename(tmp, final)
+    else:
+        os.replace(tmp, final)
+
+
+def _best_effort_remove(p: str) -> None:
+    try:
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        else:
+            os.remove(p)
+    except OSError:  # pragma: no cover - cleanup only
+        pass
+
+
 class Checkpoint:
     """No-op checkpoint base."""
 
@@ -127,15 +151,30 @@ class StrongCheckpoint(Checkpoint):
         fp = self._file_path(path)
         if self.storage_type == "file":
             if not (self.deterministic and os.path.exists(fp)):
-                engine.save_df(
-                    df,
-                    fp,
-                    format_hint="parquet",
-                    mode="overwrite",
-                    partition_spec=self.partition,
-                    force_single=self.single,
-                    **self.kwargs,
-                )
+                # write to a temp name and atomically publish: an
+                # interrupted write must never leave a torn file at the
+                # final path, or a later run's exists() would resume from
+                # corrupt data
+                tmp = f"{fp}.__tmp_{_uuid.uuid4().hex}"
+                try:
+                    engine.save_df(
+                        df,
+                        tmp,
+                        format_hint="parquet",
+                        mode="overwrite",
+                        partition_spec=self.partition,
+                        force_single=self.single,
+                        **self.kwargs,
+                    )
+                    from ..resilience import SITE_CHECKPOINT_SAVE, FaultInjector
+
+                    # injection point between write and publish: a fault
+                    # here proves torn checkpoints are invisible
+                    FaultInjector.from_conf(engine.conf).fire(SITE_CHECKPOINT_SAVE)
+                    _atomic_publish(tmp, fp)
+                finally:
+                    if os.path.exists(tmp):  # failed before publish
+                        _best_effort_remove(tmp)
             res = engine.load_df(fp, format_hint="parquet")
         else:
             table = self._table_name()

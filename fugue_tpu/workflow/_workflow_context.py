@@ -7,6 +7,7 @@ checkpoint-aware pruning: tasks whose deterministic checkpoint already exists
 load from storage and their exclusive ancestors are skipped (true resume).
 """
 
+import time
 import uuid as _uuid
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
 from typing import Any, Dict, List, Optional, Set
@@ -15,6 +16,12 @@ from ..constants import FUGUE_CONF_WORKFLOW_CONCURRENCY
 from ..dataframe import DataFrame
 from ..exceptions import FugueWorkflowRuntimeError
 from ..execution.execution_engine import ExecutionEngine
+from ..resilience import (
+    SITE_TASK_EXECUTE,
+    FaultInjector,
+    RetryPolicy,
+    classify_failure,
+)
 from ._checkpoint import CheckpointPath, StrongCheckpoint
 from ._tasks import FugueTask
 
@@ -24,6 +31,17 @@ class FugueWorkflowContext:
         self._engine = execution_engine
         self._checkpoint_path = CheckpointPath(execution_engine)
         self._results: Dict[str, DataFrame] = {}
+        # fault budgets span the whole run (an injected `error@1` fails one
+        # task once, not once per retry attempt)
+        self._injector = FaultInjector.from_conf(execution_engine.conf)
+        # default 1 attempt = fail fast, the reference behavior; retried
+        # attempts re-consult StrongCheckpoint.exists so work that already
+        # reached storage replays from disk instead of recomputing
+        self._task_policy = RetryPolicy.from_conf(
+            execution_engine.conf,
+            prefix="fugue.tpu.retry.task",
+            default_attempts=1,
+        )
 
     @property
     def execution_engine(self) -> ExecutionEngine:
@@ -99,11 +117,47 @@ class FugueWorkflowContext:
             raise first_error[0]
 
     def _run_task(self, task: FugueTask) -> None:
+        """One task under the per-task retry policy (``fugue.tpu.retry.task.*``).
+
+        Each attempt starts by re-consulting the task's deterministic
+        StrongCheckpoint: work that reached storage on a previous attempt
+        (or a previous RUN — checkpoint files are uuid-keyed and permanent)
+        replays from disk instead of recomputing. Deterministic (POISON)
+        failures are never retried — the same inputs would fail the same
+        way."""
+        policy = self._task_policy
+        attempts = 0
+        while True:
+            try:
+                self._run_task_once(task)
+                return
+            except Exception as ex:
+                cat = classify_failure(ex)
+                attempts += 1
+                if not policy.should_retry(cat, attempts):
+                    if task.defined_at and hasattr(ex, "add_note"):
+                        ex.add_note(
+                            f"[fugue-tpu] failing task defined at {task.defined_at}"
+                        )
+                    raise
+                self._engine.resilience_stats.inc("workflow.task_retries")
+                self._engine.log.warning(
+                    "task %s failed with %s [%s]; retry %d/%d",
+                    task.name or type(task).__name__,
+                    type(ex).__name__,
+                    cat.value,
+                    attempts,
+                    policy.max_attempts - 1,
+                )
+                time.sleep(policy.delay(attempts, seed=task.__uuid__()))
+
+    def _run_task_once(self, task: FugueTask) -> None:
         tid = task.__uuid__()
         cp = task.checkpoint
         if isinstance(cp, StrongCheckpoint):
             cp.set_id(tid)
             if cp.exists(self._checkpoint_path, tid):
+                self._engine.resilience_stats.inc("workflow.checkpoint_replays")
                 df = cp.load(self._checkpoint_path)
                 if task.broadcast_flag:
                     df = self._engine.broadcast(df)
@@ -112,12 +166,8 @@ class FugueWorkflowContext:
                 self._results[id(task)] = df
                 return
         inputs = [self._results[id(d)] for d in task.inputs]
-        try:
-            result = task.execute(self, inputs)
-        except Exception as ex:
-            if task.defined_at and hasattr(ex, "add_note"):
-                ex.add_note(f"[fugue-tpu] failing task defined at {task.defined_at}")
-            raise
+        self._injector.fire(SITE_TASK_EXECUTE)
+        result = task.execute(self, inputs)
         if result is not None:
             result = task.set_result(self, result)
             if (

@@ -1,0 +1,460 @@
+"""Fault-injection suite for the resilience layer (fugue_tpu/resilience).
+
+Every test here configures the conf/env-driven FaultInjector to break the
+system at a named site and asserts BOTH that the run still produces correct
+results AND that the engine's resilience counters report the recovery that
+happened — the graceful-degradation order (parallel → retry → serial →
+raise) is observable, never silent. See docs/resilience.md.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.execution.parallel_map import fork_available
+from fugue_tpu.resilience import (
+    ChunkTimeoutError,
+    Deadline,
+    FailureCategory,
+    FaultInjector,
+    InjectedFaultError,
+    ParallelMapError,
+    RetryPolicy,
+    WorkerLostError,
+    classify_failure,
+)
+
+PARENT_PID = os.getpid()
+
+PAR_CONF = {
+    "fugue.tpu.map.parallelism": 2,
+    "fugue.tpu.map.parallel_min_rows": 0,
+    "fugue.tpu.retry.base": 0.02,
+}
+
+fork_only = pytest.mark.skipif(not fork_available(), reason="no fork")
+
+
+def _demean(pdf: pd.DataFrame) -> pd.DataFrame:
+    return pdf.assign(d=pdf["v"] - pdf["v"].mean())
+
+
+def _frame(n_keys: int = 16, rows: int = 4000) -> pd.DataFrame:
+    rng = np.random.default_rng(7)
+    return pd.DataFrame(
+        {"k": rng.integers(0, n_keys, rows), "v": rng.random(rows)}
+    )
+
+
+def _transform(df: pd.DataFrame, engine) -> pd.DataFrame:
+    res = fa.transform(
+        df,
+        _demean,
+        schema="k:long,v:double,d:double",
+        partition={"by": ["k"]},
+        engine=engine,
+        as_local=True,
+    )
+    return pd.DataFrame(res).sort_values(["k", "v"]).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# policy / taxonomy units
+# ---------------------------------------------------------------------------
+class TestPolicy:
+    def test_classification(self):
+        assert classify_failure(ConnectionRefusedError()) is FailureCategory.TRANSIENT
+        assert classify_failure(InjectedFaultError()) is FailureCategory.TRANSIENT
+        assert classify_failure(TimeoutError()) is FailureCategory.TIMEOUT
+        assert classify_failure(ChunkTimeoutError()) is FailureCategory.TIMEOUT
+        assert classify_failure(WorkerLostError()) is FailureCategory.WORKER_LOST
+        assert classify_failure(ValueError("bad udf")) is FailureCategory.POISON
+        assert classify_failure(KeyboardInterrupt()) is FailureCategory.FATAL
+
+    def test_retry_policy_bounds_and_determinism(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.1, multiplier=2.0, jitter=0.5)
+        assert p.should_retry(FailureCategory.TRANSIENT, 1)
+        assert p.should_retry(FailureCategory.WORKER_LOST, 2)
+        assert not p.should_retry(FailureCategory.TRANSIENT, 3)  # exhausted
+        assert not p.should_retry(FailureCategory.POISON, 1)  # never retried
+        assert not p.should_retry(FailureCategory.FATAL, 1)
+        # exponential growth + deterministic jitter
+        d1, d2 = p.delay(1, seed="x"), p.delay(2, seed="x")
+        assert d2 > d1
+        assert p.delay(2, seed="x") == d2  # same seed, same schedule
+        assert p.delay(2, seed="y") != d2  # distinct seeds de-synchronize
+
+    def test_retry_policy_from_conf(self):
+        from fugue_tpu._utils.params import ParamDict
+
+        p = RetryPolicy.from_conf(
+            ParamDict({"fugue.tpu.retry.attempts": 5, "fugue.tpu.retry.jitter": 0})
+        )
+        assert p.max_attempts == 5 and p.jitter == 0
+
+    def test_deadline(self):
+        assert Deadline.after(None).unbounded
+        assert Deadline.after(0).unbounded
+        assert not Deadline.after(None).expired
+        d = Deadline.after(0.01)
+        time.sleep(0.03)
+        assert d.expired and d.remaining() == 0.0
+        with pytest.raises(ChunkTimeoutError):
+            d.raise_if_expired("chunk")
+
+
+class TestFaultInjector:
+    def test_plan_parsing_and_budget(self):
+        inj = FaultInjector("a.site=error:ValueError@2; b.site=delay:0")
+        with pytest.raises(ValueError):
+            inj.fire("a.site")
+        with pytest.raises(ValueError):
+            inj.fire("a.site")
+        inj.fire("a.site")  # budget spent → inert
+        inj.fire("b.site")  # 0s delay → no-op
+        inj.fire("unknown.site")  # no rule → no-op
+
+    def test_kill_in_driver_degrades_to_raise(self):
+        inj = FaultInjector("x=kill")
+        with pytest.raises(InjectedFaultError):
+            inj.fire("x")  # must NOT SIGKILL the test process
+
+    def test_bad_plans_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjector("site=explode")
+        with pytest.raises(ValueError):
+            FaultInjector("just-garbage")
+
+    def test_disabled_without_plan(self):
+        from fugue_tpu._utils.params import ParamDict
+
+        assert not FaultInjector.from_conf(ParamDict()).enabled
+
+
+# ---------------------------------------------------------------------------
+# fork-pool recovery (the acceptance scenario and its neighbours)
+# ---------------------------------------------------------------------------
+@fork_only
+class TestForkPoolRecovery:
+    def test_worker_sigkill_recovers_bit_identical(self):
+        """Acceptance: with the injector SIGKILLing one fork worker per map,
+        a 16-partition transform returns bit-identical results to the
+        unfaulted run, and the counters report the recovery."""
+        df = _frame(n_keys=16)
+        baseline = _transform(df, NativeExecutionEngine(PAR_CONF))
+        e = NativeExecutionEngine({**PAR_CONF, "fugue.tpu.fault.plan": "map.chunk=kill"})
+        out = _transform(df, e)
+        pd.testing.assert_frame_equal(baseline, out)
+        stats = e.resilience_stats.as_dict()
+        assert stats.get("map.worker_lost", 0) >= 1
+        assert stats.get("map.chunk_retries", 0) >= 1
+        assert stats.get("map.pool_rebuilds", 0) >= 1
+
+    def test_chunk_deadline_expiry_recovers(self):
+        """An injected in-chunk stall blows the per-chunk deadline; the
+        supervisor tears the wave down and the retry (budget spent) runs
+        clean."""
+        df = _frame(n_keys=8, rows=2000)
+        baseline = _transform(df, NativeExecutionEngine(PAR_CONF))
+        e = NativeExecutionEngine(
+            {
+                **PAR_CONF,
+                "fugue.tpu.fault.plan": "map.chunk=delay:10",
+                "fugue.tpu.map.chunk_timeout": 0.6,
+            }
+        )
+        t0 = time.perf_counter()
+        out = _transform(df, e)
+        wall = time.perf_counter() - t0
+        pd.testing.assert_frame_equal(baseline, out)
+        assert e.resilience_stats.get("map.deadline_expiries") >= 1
+        assert wall < 8, wall  # never waited out the injected 10s stall
+
+    def test_poison_partition_quarantined_to_serial(self):
+        """A partition that fails deterministically in workers must fall
+        back to in-driver serial execution (where it happens to succeed —
+        e.g. it needed driver-process state) without failing the map."""
+        df = _frame(n_keys=8, rows=2000)
+
+        def child_poison(pdf: pd.DataFrame) -> pd.DataFrame:
+            if os.getpid() != PARENT_PID and pdf["k"].iloc[0] == 3:
+                raise ValueError("poison in worker")
+            return pdf.assign(d=1.0)
+
+        e = NativeExecutionEngine(PAR_CONF)
+        res = fa.transform(
+            df,
+            child_poison,
+            schema="k:long,v:double,d:double",
+            partition={"by": ["k"]},
+            engine=e,
+            as_local=True,
+        )
+        assert len(pd.DataFrame(res)) == len(df)
+        stats = e.resilience_stats.as_dict()
+        assert stats.get("map.quarantined_chunks", 0) >= 1
+        assert stats.get("map.quarantined_partitions", 0) >= 1
+        assert stats.get("map.serial_fallbacks", 0) >= 1
+
+    def test_unrecoverable_poison_raises_partition_report(self):
+        """When the serial fallback fails too, the map raises a
+        ParallelMapError naming the exact poison partitions."""
+        df = _frame(n_keys=6, rows=1200)
+
+        def always_poison(pdf: pd.DataFrame) -> pd.DataFrame:
+            if pdf["k"].iloc[0] == 2:
+                raise ValueError("always poison")
+            return pdf.assign(d=1.0)
+
+        e = NativeExecutionEngine(PAR_CONF)
+        with pytest.raises(Exception) as ei:
+            fa.transform(
+                df,
+                always_poison,
+                schema="k:long,v:double,d:double",
+                partition={"by": ["k"]},
+                engine=e,
+                as_local=True,
+            )
+        # the report survives the workflow's exception rewrapping
+        msg = str(ei.value)
+        assert "partition" in msg and "always poison" in msg
+
+    def test_single_chunk_short_circuits_pool(self, monkeypatch):
+        """A map whose partitions collapse into one chunk must skip pool
+        setup entirely (~100ms) and run serially in-driver."""
+        import pyarrow as pa
+
+        from fugue_tpu.execution import parallel_map as pm
+
+        def no_pool(*a, **k):  # pragma: no cover - failing is the assert
+            raise AssertionError("pool must not be created for a single chunk")
+
+        monkeypatch.setattr(pm, "_make_pool", no_pool)
+
+        class Cur:
+            def set(self, *a):
+                pass
+
+        pdf = pd.DataFrame({"a": np.arange(101, dtype=np.int64)})
+        # sizes [1, 100] collapse into one chunk under the quantile cuts
+        tables = pm.run_partitions_forked(
+            pdf,
+            None,
+            [slice(0, 1), slice(1, 101)],
+            lambda cursor, part: part,
+            Cur(),
+            None,
+            n_workers=2,
+            wrap_df=lambda sub, schema: sub,
+            to_arrow=lambda res, schema: pa.Table.from_pandas(res),
+        )
+        assert sum(t.num_rows for t in tables) == 101
+        assert pm.run_partitions_forked(
+            pdf, None, [], lambda c, p: p, Cur(), None, 2,
+            wrap_df=lambda s, sc: s,
+            to_arrow=lambda r, sc: pa.Table.from_pandas(r),
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# RPC retry / timeouts
+# ---------------------------------------------------------------------------
+class TestRPCResilience:
+    def _free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_retry_exhaustion_counts_and_raises(self):
+        from fugue_tpu.resilience import ResilienceStats
+        from fugue_tpu.rpc.http import HttpRPCClient
+
+        stats = ResilienceStats()
+        client = HttpRPCClient(
+            "127.0.0.1",
+            self._free_port(),
+            "key",
+            policy=RetryPolicy(max_attempts=3, base_delay=0.01, jitter=0),
+            idempotent=True,
+            stats=stats,
+        )
+        with pytest.raises(ConnectionError):
+            client("payload")
+        assert stats.get("rpc.retries") == 2  # 3 attempts = 2 retries
+
+    def test_connect_phase_failures_retry_even_when_not_idempotent(self):
+        """A refused connection means the server never saw the request —
+        always safe to retry regardless of idempotency."""
+        from fugue_tpu.resilience import ResilienceStats
+        from fugue_tpu.rpc.http import HttpRPCClient
+
+        stats = ResilienceStats()
+        client = HttpRPCClient(
+            "127.0.0.1",
+            self._free_port(),
+            "key",
+            policy=RetryPolicy(max_attempts=2, base_delay=0.01, jitter=0),
+            idempotent=False,
+            stats=stats,
+        )
+        with pytest.raises(ConnectionError):
+            client("payload")
+        assert stats.get("rpc.retries") == 1
+
+    def test_server_conf_timeouts_reach_clients(self):
+        from fugue_tpu._utils.params import ParamDict
+        from fugue_tpu.rpc.http import HttpRPCServer
+
+        srv = HttpRPCServer(
+            ParamDict(
+                {
+                    "fugue.rpc.http_client.connect_timeout": 1.5,
+                    "fugue.rpc.http_client.read_timeout": 7.5,
+                    "fugue.tpu.retry.rpc.attempts": 4,
+                }
+            )
+        )
+        c = srv.create_client("k")
+        assert c._connect_timeout == 1.5
+        assert c._timeout == 7.5
+        assert c._policy.max_attempts == 4
+
+    def test_client_stub_survives_pickle(self):
+        import cloudpickle
+
+        from fugue_tpu._utils.params import ParamDict
+        from fugue_tpu.rpc.http import HttpRPCServer
+
+        srv = HttpRPCServer(ParamDict({"fugue.rpc.http_server.port": 0}))
+        srv.start()
+        try:
+            key = srv.register(lambda x: x + 1)
+            stub = cloudpickle.loads(cloudpickle.dumps(srv.create_client(key)))
+            assert stub(41) == 42
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# workflow: task retry + checkpoint-aware replay + atomic checkpoints
+# ---------------------------------------------------------------------------
+class TestWorkflowResilience:
+    def test_injected_task_failure_retried(self):
+        from fugue_tpu.workflow import FugueWorkflow
+
+        def make() -> pd.DataFrame:
+            return pd.DataFrame({"a": [1, 2]})
+
+        e = NativeExecutionEngine(
+            {
+                "fugue.tpu.fault.plan": "task.execute=error",
+                "fugue.tpu.retry.task.attempts": 2,
+                "fugue.tpu.retry.base": 0.01,
+            }
+        )
+        dag = FugueWorkflow()
+        dag.create(make).yield_dataframe_as("out", as_local=True)
+        res = dag.run(e)
+        assert res["out"].result.as_array() == [[1], [2]]
+        assert e.resilience_stats.get("workflow.task_retries") == 1
+
+    def test_poison_task_not_retried(self):
+        from fugue_tpu.workflow import FugueWorkflow
+
+        calls = []
+
+        def bad() -> pd.DataFrame:
+            calls.append(1)
+            raise ValueError("deterministic user bug")
+
+        e = NativeExecutionEngine(
+            {"fugue.tpu.retry.task.attempts": 3, "fugue.tpu.retry.base": 0.01}
+        )
+        dag = FugueWorkflow()
+        dag.create(bad).yield_dataframe_as("out", as_local=True)
+        with pytest.raises(Exception):
+            dag.run(e)
+        assert len(calls) == 1  # POISON is never retried
+
+    def test_checkpoint_aware_replay_runs_upstream_once(self, tmp_path):
+        """Across a failed run + retry run, the checkpointed upstream task
+        body executes exactly once — the retry replays it from disk."""
+        from fugue_tpu.workflow import FugueWorkflow
+
+        calls = []
+        fail = [True]
+
+        def upstream() -> pd.DataFrame:
+            calls.append(1)
+            return pd.DataFrame({"a": [1, 2, 3]})
+
+        def downstream(df: pd.DataFrame) -> pd.DataFrame:
+            if fail[0]:
+                raise RuntimeError("transient downstream failure")
+            return df.assign(b=df["a"] * 2)
+
+        def build() -> FugueWorkflow:
+            dag = FugueWorkflow()
+            a = dag.create(upstream).deterministic_checkpoint()
+            a.transform(downstream, schema="a:long,b:long").yield_dataframe_as(
+                "out", as_local=True
+            )
+            return dag
+
+        e = NativeExecutionEngine(
+            {"fugue.workflow.checkpoint.path": str(tmp_path)}
+        )
+        with pytest.raises(Exception):
+            build().run(e)
+        assert len(calls) == 1
+        fail[0] = False
+        res = build().run(e)
+        assert len(calls) == 1  # replayed from disk, not recomputed
+        assert res["out"].result.as_array() == [[1, 2], [2, 4], [3, 6]]
+        assert e.resilience_stats.get("workflow.checkpoint_replays") >= 1
+
+    def test_interrupted_checkpoint_write_leaves_no_torn_file(self, tmp_path):
+        """A fault between the checkpoint's data write and its atomic
+        publish must leave nothing at the final path — the next run
+        recomputes instead of resuming from a torn file."""
+        from fugue_tpu.workflow import FugueWorkflow
+
+        calls = []
+
+        def upstream() -> pd.DataFrame:
+            calls.append(1)
+            return pd.DataFrame({"a": [7]})
+
+        def build() -> FugueWorkflow:
+            dag = FugueWorkflow()
+            dag.create(upstream).deterministic_checkpoint().yield_dataframe_as(
+                "out", as_local=True
+            )
+            return dag
+
+        e_faulted = NativeExecutionEngine(
+            {
+                "fugue.workflow.checkpoint.path": str(tmp_path),
+                "fugue.tpu.fault.plan": "checkpoint.save=error",
+            }
+        )
+        with pytest.raises(Exception):
+            build().run(e_faulted)
+        # neither a final checkpoint nor a stray temp file anywhere
+        assert list(tmp_path.rglob("*.parquet")) == []
+        e_clean = NativeExecutionEngine(
+            {"fugue.workflow.checkpoint.path": str(tmp_path)}
+        )
+        res = build().run(e_clean)
+        assert len(calls) == 2  # torn write was NOT mistaken for a checkpoint
+        assert res["out"].result.as_array() == [[7]]
+        assert len(list(tmp_path.rglob("*.parquet"))) == 1
