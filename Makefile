@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience bench dryrun native
+.PHONY: install test test-multihost test-resilience bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -7,6 +7,13 @@ install:
 
 test:
 	python -m pytest tests/ -q
+	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
+
+# downsized perf gate (≤~30s): device-aggregate worker only, fails when the
+# oracle-normalized groupby_aggregate vs_baseline drops >20% below the
+# recorded value (BENCH_SMOKE_BASELINE.json for this env, else BENCH_r05)
+bench-smoke:
+	python bench.py --smoke
 
 # large-scale proofs (100M-row streaming, 100Mx1M join) — excluded from the
 # default run by addopts='-m "not slow"'; the explicit -m here overrides it

@@ -189,9 +189,15 @@ def _load_csv(p: str, columns: Any, kwargs: Dict[str, Any]) -> pa.Table:
         )
     if schema is not None:
         pdf = pdf[schema.names]
-        return pa.Table.from_pandas(
-            pdf, schema=schema.pa_schema, preserve_index=False, safe=False
-        )
+        if infer_schema:
+            return pa.Table.from_pandas(
+                pdf, schema=schema.pa_schema, preserve_index=False, safe=False
+            )
+        # without inference every column was read as str — arrow's
+        # from_pandas refuses str→numeric, but a string-table CAST parses
+        # the values into the declared types (the reference's semantics)
+        tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+        return tbl.cast(schema.pa_schema)
     if isinstance(columns, list):
         pdf = pdf[columns]
     return pa.Table.from_pandas(pdf, preserve_index=False)

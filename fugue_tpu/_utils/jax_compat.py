@@ -1,0 +1,32 @@
+"""Version-compat shims for the jax API surface the engine depends on.
+
+``jax.shard_map`` only became a top-level export in newer jax releases;
+on the versions that ship without it the same implementation lives at
+``jax.experimental.shard_map.shard_map`` (identical signature, keyword
+``mesh``/``in_specs``/``out_specs`` included). Every kernel imports the
+symbol from here so the engine runs on either vintage.
+"""
+
+from typing import Any
+
+import jax
+
+try:
+    shard_map: Any = jax.shard_map
+except AttributeError:  # older jax: the experimental namespace
+    from jax.experimental.shard_map import shard_map  # type: ignore # noqa: F401
+
+
+def axis_size(axis: str) -> Any:
+    """Static mapped-axis size inside ``shard_map``/``pmap`` tracing.
+
+    ``lax.axis_size`` is a recent addition; ``psum(1, axis)`` is the
+    old-jax spelling and is equally static at trace time (a python-int
+    reduction over the axis env, no device work).
+    """
+    from jax import lax
+
+    try:
+        return lax.axis_size(axis)
+    except AttributeError:
+        return lax.psum(1, axis)

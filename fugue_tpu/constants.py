@@ -75,6 +75,11 @@ FUGUE_RPC_CONF_HTTP_READ_TIMEOUT = "fugue.rpc.http_client.read_timeout"
 # streaming (out-of-core) execution: rows per host->device chunk; the
 # device working set is O(chunk_rows x columns), NOT O(dataset)
 FUGUE_TPU_CONF_STREAM_CHUNK_ROWS = "fugue.tpu.stream.chunk_rows"
+# depth of the background ingest pipeline's chunk queue (see
+# fugue_tpu/jax/pipeline.py and docs/streaming.md): host decode + H2D of
+# the NEXT chunks overlap device compute on the CURRENT one; device working
+# set grows to O((depth+1) x chunk). 0 disables (strictly serial chunks)
+FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH = "fugue.tpu.stream.prefetch_depth"
 # "lo,hi" inclusive int key range for streaming dense aggregates; without
 # it the range is probed from the FIRST chunk only, and any later
 # out-of-range key raises (one-pass streams can't be re-scanned)

@@ -19,25 +19,59 @@ from .arrow_dataframe import ArrowDataFrame
 
 
 def _enforce_type(pdf: pd.DataFrame, schema: Schema) -> pd.DataFrame:
-    """Coerce a pandas frame to a schema via an arrow round trip.
+    """Coerce a pandas frame to a schema.
 
     Fast path: if every column's dtype already equals the schema's expected
-    pandas dtype, return as-is (zero copy).
+    pandas dtype, return as-is (zero copy; the check reads only dtype
+    metadata — no per-column Series materialization, it runs once per map
+    partition). Otherwise columns coerce individually: plain numeric/bool
+    conversions from NaN-free kinds go through ``astype`` (semantics match
+    the arrow path's ``safe=False``), and ONLY the columns that need real
+    conversion semantics (objects, nullables, datetimes, float→int) pay an
+    arrow round trip — one conversion per partition, never a whole-frame
+    pandas↔arrow↔pandas bounce per boundary crossing.
     """
     expected = schema.pandas_dtype
-    if list(pdf.columns) == schema.names and all(
-        pdf[c].dtype == expected[c] for c in schema.names
+    names = schema.names
+    if list(pdf.columns) == names and all(
+        dt == expected[c] for c, dt in pdf.dtypes.items()
     ):
         return pdf
-    tbl = pa.Table.from_pandas(
-        pdf[schema.names] if list(pdf.columns) != schema.names else pdf,
-        schema=schema.pa_schema,
-        preserve_index=False,
-        safe=False,
-    )
-    from .._utils.arrow import pa_table_to_pandas
+    idx = pdf.index
+    if not (isinstance(idx, pd.RangeIndex) and idx.start == 0 and idx.step == 1):
+        # positional semantics (the arrow path's preserve_index=False):
+        # all coerced pieces below must share one clean range index
+        pdf = pdf.reset_index(drop=True)
+    cols: Dict[str, Any] = {}
+    arrow_names: List[str] = []
+    for c in names:
+        s = pdf[c]
+        et = expected[c]
+        if s.dtype == et:
+            cols[c] = s
+        elif (
+            isinstance(s.dtype, np.dtype)
+            and isinstance(et, np.dtype)
+            and et.kind in "iufb"
+            and (s.dtype.kind in "iub" or (s.dtype.kind == "f" and et.kind == "f"))
+        ):
+            cols[c] = s.astype(et)
+        else:
+            arrow_names.append(c)
+    if len(arrow_names) > 0:
+        from .._utils.arrow import pa_table_to_pandas
 
-    return pa_table_to_pandas(tbl)
+        tbl = pa.Table.from_pandas(
+            pdf[arrow_names],
+            schema=pa.schema([schema.pa_schema.field(c) for c in arrow_names]),
+            preserve_index=False,
+            safe=False,
+        )
+        conv = pa_table_to_pandas(tbl)
+        for c in arrow_names:
+            cols[c] = conv[c]
+    # rebuild in schema order — arrow-coerced columns joined the dict last
+    return pd.DataFrame({c: cols[c] for c in names})
 
 
 class PandasDataFrame(LocalBoundedDataFrame):
@@ -58,7 +92,13 @@ class PandasDataFrame(LocalBoundedDataFrame):
             pdf = df.as_pandas()
             s = s or df.schema
         elif isinstance(df, pd.DataFrame):
-            pdf = df.reset_index(drop=True) if not df.index.equals(pd.RangeIndex(len(df))) else df
+            idx = df.index
+            clean = (
+                isinstance(idx, pd.RangeIndex)
+                and (idx.start or 0) == 0
+                and idx.step == 1
+            ) or idx.equals(pd.RangeIndex(len(df)))
+            pdf = df if clean else df.reset_index(drop=True)
             if s is None:
                 s = Schema(pdf)
         elif isinstance(df, pd.Series):

@@ -159,28 +159,39 @@ class PandasMapEngine(MapEngine):
                 LocalDataFrameIterableDataFrame(iter(results), output_schema),
                 output_schema,
             )
-        # positional row selections per logical partition, in first-appearance
-        # group order — computed WITHOUT materializing subframes so the
-        # parallel path forks before any per-group copying happens
+        # ONE global gather into group-clustered order, then each logical
+        # partition is a contiguous zero-copy slice — the per-group
+        # ``take(idx)`` row copies (one gather per partition) collapse into
+        # a single reorder per map call
         gid = pdf.groupby(keys, dropna=False, sort=False).ngroup().to_numpy()
         if len(gid) > 0 and gid.min() < 0:  # defensive: shouldn't happen w/ dropna=False
             gid = np.where(gid < 0, gid.max() + 1, gid)
-        order = np.argsort(gid, kind="stable")
         counts = np.bincount(gid, minlength=gid.max() + 1 if len(gid) else 0)
-        groups = [
-            a for a in np.split(order, np.cumsum(counts)[:-1]) if len(a) > 0
-        ]
-        if len(groups) == 0:
+        counts = counts[counts > 0]
+        if len(counts) == 0:
             return PandasDataFrame(None, output_schema)
-        workers = self._pool_workers(map_func, len(pdf), len(groups))
+        if len(counts) == len(gid) or (np.diff(gid) >= 0).all():
+            # already clustered (sorted input, or all-singleton groups in
+            # appearance order == input order): skip the reorder entirely
+            sorted_pdf = pdf
+        else:
+            order = np.argsort(gid, kind="stable")
+            sorted_pdf = pdf.take(order).reset_index(drop=True)
+        bounds = np.concatenate([[0], np.cumsum(counts)])
+        groups: List[Any] = [
+            slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])
+        ]
+        workers = self._pool_workers(map_func, len(sorted_pdf), len(groups))
         if workers > 1:
             return self._run_forked(
-                pdf, schema, groups, map_func, cursor, output_schema, workers
+                sorted_pdf, schema, groups, map_func, cursor, output_schema, workers
             )
         results: List[LocalDataFrame] = []
-        for no, idx in enumerate(groups):
+        for no, sl in enumerate(groups):
             part = PandasDataFrame(
-                pdf.take(idx).reset_index(drop=True), schema, pandas_df_wrapper=True
+                sorted_pdf.iloc[sl].reset_index(drop=True),
+                schema,
+                pandas_df_wrapper=True,
             )
             cursor.set(lambda p=part: p.peek_array(), no, 0)
             results.append(map_func(cursor, part).as_local_bounded())

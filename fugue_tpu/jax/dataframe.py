@@ -48,6 +48,11 @@ _DEVICE_DTYPES = {
 }
 
 
+# bulk-ingest tables below this size skip the background column pipeline:
+# thread + queue setup (~1ms) would exceed the decode it hides
+_MIN_PIPELINED_INGEST_BYTES = 8 << 20
+
+
 def _is_device_type(f: pa.Field) -> bool:
     return str(f.type) in _DEVICE_DTYPES
 
@@ -60,6 +65,87 @@ def split_arrow_for_device(tbl: pa.Table) -> Any:
     """
     device_cols, host_tbl, meta = encode_arrow_for_device(tbl, encode=False)
     return device_cols, host_tbl, meta["nan_cols"]
+
+
+def _encode_column(col: Any, f: pa.Field, encode: bool) -> Any:
+    """Encode ONE arrow column for the device: ``(arr, extra)``.
+
+    ``arr`` is the device-bound numpy array, or None when the column stays
+    host-resident. ``extra`` carries the per-column metadata: ``nan``
+    (float column may hold NaN), ``encoding`` (dict/datetime internal
+    representation) and ``null_mask`` (np bool array, True = NULL).
+    The per-column unit of work for the pipelined ingest (`_from_arrow`) —
+    the whole-table collector is :func:`encode_arrow_for_device`.
+    """
+    t = f.type
+    if _is_device_type(f):
+        if col.null_count == 0:
+            arr = np.asarray(col.to_numpy(zero_copy_only=False))
+            nan = np.issubdtype(arr.dtype, np.floating) and bool(
+                np.isnan(arr).any()
+            )
+            return arr, ({"nan": True} if nan else {})
+        if encode and pa.types.is_floating(t):
+            # arrow float→numpy turns nulls into NaN — the device NULL
+            arr = np.asarray(col.to_numpy(zero_copy_only=False))
+            return arr, {"nan": True}
+        if encode:  # nullable int/bool: value array + null mask
+            mask = np.asarray(col.is_null().to_numpy(zero_copy_only=False))
+            fill = False if pa.types.is_boolean(t) else 0
+            vals = np.asarray(
+                col.fill_null(fill).to_numpy(zero_copy_only=False)
+            )
+            return vals, {"null_mask": mask}
+    if encode and (pa.types.is_string(t) or pa.types.is_large_string(t)):
+        plain = (
+            col.chunk(0)
+            if isinstance(col, pa.ChunkedArray) and col.num_chunks == 1
+            else (
+                pa.array([], type=t)
+                if isinstance(col, pa.ChunkedArray) and col.num_chunks == 0
+                else col
+            )
+        )
+        if isinstance(plain, pa.ChunkedArray):  # pragma: no cover
+            plain = pa.concat_arrays(plain.chunks)
+        d = plain.dictionary_encode()
+        codes = np.asarray(
+            d.indices.fill_null(-1).to_numpy(zero_copy_only=False)
+        ).astype(np.int32)
+        # SORT the dictionary so code order == lexicographic order:
+        # MIN/MAX aggregates and presorts on the codes are then exact
+        dictionary = d.dictionary.cast(t)
+        if len(dictionary) > 1:
+            order = np.asarray(
+                pa.compute.sort_indices(dictionary).to_numpy(
+                    zero_copy_only=False
+                )
+            )
+            dictionary = dictionary.take(pa.array(order))
+            inverse = np.empty(len(order), dtype=np.int32)
+            inverse[order] = np.arange(len(order), dtype=np.int32)
+            codes = np.where(codes >= 0, inverse[np.clip(codes, 0, None)], -1).astype(np.int32)
+        return codes, {
+            "encoding": {
+                "kind": "dict",
+                "dictionary": dictionary,
+                "type": t,
+                "sorted": True,
+            }
+        }
+    if encode and (pa.types.is_timestamp(t) or pa.types.is_date(t)):
+        storage = pa.int64() if not pa.types.is_date32(t) else pa.int32()
+        ints = col.cast(storage)
+        extra: Dict[str, Any] = {
+            "encoding": {"kind": "datetime", "dictionary": None, "type": t}
+        }
+        if col.null_count > 0:
+            extra["null_mask"] = np.asarray(
+                col.is_null().to_numpy(zero_copy_only=False)
+            )
+            ints = ints.fill_null(0)
+        return np.asarray(ints.to_numpy(zero_copy_only=False)), extra
+    return None, None  # host-resident
 
 
 def encode_arrow_for_device(tbl: pa.Table, encode: bool = True) -> Any:
@@ -78,87 +164,17 @@ def encode_arrow_for_device(tbl: pa.Table, encode: bool = True) -> Any:
     host_names: List[str] = []
     meta: Dict[str, Any] = {"nan_cols": set(), "encodings": {}, "null_masks": {}}
     for i, f in enumerate(tbl.schema):
-        col = tbl.column(i).combine_chunks()
-        t = f.type
-        if _is_device_type(f):
-            if col.null_count == 0:
-                arr = np.asarray(col.to_numpy(zero_copy_only=False))
-                device_cols[f.name] = arr
-                if np.issubdtype(arr.dtype, np.floating) and bool(
-                    np.isnan(arr).any()
-                ):
-                    meta["nan_cols"].add(f.name)
-                continue
-            if encode and pa.types.is_floating(t):
-                # arrow float→numpy turns nulls into NaN — the device NULL
-                arr = np.asarray(col.to_numpy(zero_copy_only=False))
-                device_cols[f.name] = arr
-                meta["nan_cols"].add(f.name)
-                continue
-            if encode:  # nullable int/bool: value array + null mask
-                mask = np.asarray(col.is_null().to_numpy(zero_copy_only=False))
-                fill = False if pa.types.is_boolean(t) else 0
-                vals = np.asarray(
-                    col.fill_null(fill).to_numpy(zero_copy_only=False)
-                )
-                device_cols[f.name] = vals
-                meta["null_masks"][f.name] = mask
-                continue
-        if encode and (pa.types.is_string(t) or pa.types.is_large_string(t)):
-            plain = (
-                col.chunk(0)
-                if isinstance(col, pa.ChunkedArray) and col.num_chunks == 1
-                else (
-                    pa.array([], type=t)
-                    if isinstance(col, pa.ChunkedArray) and col.num_chunks == 0
-                    else col
-                )
-            )
-            if isinstance(plain, pa.ChunkedArray):  # pragma: no cover
-                plain = pa.concat_arrays(plain.chunks)
-            d = plain.dictionary_encode()
-            codes = np.asarray(
-                d.indices.fill_null(-1).to_numpy(zero_copy_only=False)
-            ).astype(np.int32)
-            # SORT the dictionary so code order == lexicographic order:
-            # MIN/MAX aggregates and presorts on the codes are then exact
-            dictionary = d.dictionary.cast(t)
-            if len(dictionary) > 1:
-                order = np.asarray(
-                    pa.compute.sort_indices(dictionary).to_numpy(
-                        zero_copy_only=False
-                    )
-                )
-                dictionary = dictionary.take(pa.array(order))
-                inverse = np.empty(len(order), dtype=np.int32)
-                inverse[order] = np.arange(len(order), dtype=np.int32)
-                codes = np.where(codes >= 0, inverse[np.clip(codes, 0, None)], -1).astype(np.int32)
-            device_cols[f.name] = codes
-            meta["encodings"][f.name] = {
-                "kind": "dict",
-                "dictionary": dictionary,
-                "type": t,
-                "sorted": True,
-            }
+        arr, extra = _encode_column(tbl.column(i).combine_chunks(), f, encode)
+        if arr is None:
+            host_names.append(f.name)
             continue
-        if encode and (pa.types.is_timestamp(t) or pa.types.is_date(t)):
-            storage = pa.int64() if not pa.types.is_date32(t) else pa.int32()
-            ints = col.cast(storage)
-            if col.null_count > 0:
-                meta["null_masks"][f.name] = np.asarray(
-                    col.is_null().to_numpy(zero_copy_only=False)
-                )
-                ints = ints.fill_null(0)
-            device_cols[f.name] = np.asarray(
-                ints.to_numpy(zero_copy_only=False)
-            )
-            meta["encodings"][f.name] = {
-                "kind": "datetime",
-                "dictionary": None,
-                "type": t,
-            }
-            continue
-        host_names.append(f.name)
+        device_cols[f.name] = arr
+        if extra.get("nan"):
+            meta["nan_cols"].add(f.name)
+        if "encoding" in extra:
+            meta["encodings"][f.name] = extra["encoding"]
+        if "null_mask" in extra:
+            meta["null_masks"][f.name] = extra["null_mask"]
     host_tbl = tbl.select(host_names) if len(host_names) > 0 else None
     return device_cols, host_tbl, meta
 
@@ -193,6 +209,8 @@ class JaxDataFrame(DataFrame):
         mesh: Any = None,
         _internal: Optional[dict] = None,
         ingest_cache: Optional[bool] = None,
+        ingest_prefetch_depth: Optional[int] = None,
+        pipeline_stats: Any = None,
     ):
         if mesh is None:
             from ..parallel.mesh import build_mesh
@@ -201,6 +219,10 @@ class JaxDataFrame(DataFrame):
         self._mesh = mesh
         # None → fall back to the global conf (engines pass their own conf)
         self._ingest_cache_opt = ingest_cache
+        # pipelined ingest knobs (engines pass their conf's prefetch depth
+        # and their PipelineStats sink; direct constructions use defaults)
+        self._ingest_prefetch_depth = ingest_prefetch_depth
+        self._pipeline_stats = pipeline_stats
         if _internal is not None:
             self._pending_tbl = None
             self._pending_src = None
@@ -323,16 +345,72 @@ class JaxDataFrame(DataFrame):
         n = tbl.num_rows
         shards = num_row_shards(self._mesh)
         padded = pad_rows(max(n, shards), shards) if n > 0 else shards
-        np_cols, host_tbl, meta = encode_arrow_for_device(tbl)
         sharding = row_sharding(self._mesh)
 
-        def _pad_put(arr: np.ndarray) -> Any:
+        def _pad(arr: np.ndarray) -> np.ndarray:
             if len(arr) < padded:
                 pad_val = np.zeros(padded - len(arr), dtype=arr.dtype)
                 arr = np.concatenate([arr, pad_val])
-            return jax.device_put(arr, sharding)
+            return arr
 
-        self._device_cols = {k: _pad_put(v) for k, v in np_cols.items()}
+        # PIPELINED bulk ingest: a background producer decodes + pads the
+        # NEXT column (arrow→numpy, dictionary encode, null masks) while
+        # the consumer issues the H2D `device_put` of the CURRENT one —
+        # the per-column analog of the chunk pipeline (docs/streaming.md).
+        # Tiny tables skip the thread: its ~ms setup would dominate.
+        from .pipeline import default_prefetch_depth, maybe_prefetch
+
+        depth = (
+            self._ingest_prefetch_depth
+            if getattr(self, "_ingest_prefetch_depth", None) is not None
+            else default_prefetch_depth()
+        )
+        if tbl.nbytes < _MIN_PIPELINED_INGEST_BYTES:
+            depth = 0
+
+        def produce() -> Any:
+            for i, f in enumerate(tbl.schema):
+                arr, extra = _encode_column(
+                    tbl.column(i).combine_chunks(), f, True
+                )
+                if arr is None:
+                    yield f.name, None, None, None
+                    continue
+                mask = extra.get("null_mask")
+                yield f.name, _pad(arr), (
+                    None if mask is None else _pad(mask)
+                ), extra
+
+        host_names: List[str] = []
+        meta: Dict[str, Any] = {
+            "nan_cols": set(),
+            "encodings": {},
+            "null_masks": {},
+        }
+        device_cols: Dict[str, Any] = {}
+        device_masks: Dict[str, Any] = {}
+        cols_it = maybe_prefetch(
+            produce(),
+            depth,
+            stats=getattr(self, "_pipeline_stats", None),
+            verb="ingest",
+        )
+        try:
+            for name, arr, mask, extra in cols_it:
+                if arr is None:
+                    host_names.append(name)
+                    continue
+                device_cols[name] = jax.device_put(arr, sharding)
+                if extra.get("nan"):
+                    meta["nan_cols"].add(name)
+                if "encoding" in extra:
+                    meta["encodings"][name] = extra["encoding"]
+                if mask is not None:
+                    device_masks[name] = jax.device_put(mask, sharding)
+        finally:
+            cols_it.close()
+        self._device_cols = device_cols
+        host_tbl = tbl.select(host_names) if len(host_names) > 0 else None
         self._host_tbl = host_tbl
         # frames are immutable — the ingestion table stays valid for this
         # instance's lifetime, so host reads (as_arrow/as_pandas) skip the
@@ -364,9 +442,7 @@ class JaxDataFrame(DataFrame):
         self._valid_mask = None
         self._nan_cols = meta["nan_cols"]
         self._encodings = meta["encodings"]
-        self._null_masks = {
-            k: _pad_put(v) for k, v in meta["null_masks"].items()
-        }
+        self._null_masks = device_masks
 
     # -- properties ---------------------------------------------------------
     @property

@@ -54,6 +54,7 @@ from ..parallel.mesh import (
 )
 from ..schema import Schema
 from .dataframe import JaxDataFrame, _DEVICE_DTYPES
+from .._utils.jax_compat import shard_map
 
 
 def _safe_prefix(base: str, *name_sets: Any) -> str:
@@ -294,7 +295,7 @@ class JaxMapEngine(MapEngine):
                     out["__valid__"] = sv
                     return out
 
-                return jax.shard_map(
+                return shard_map(
                     shard_fn,
                     mesh=mesh,
                     in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
@@ -431,7 +432,7 @@ class JaxMapEngine(MapEngine):
                         if k2 not in (SEGMENTS, VALID, SEGMENT_SPACE, SPANS_SHARDS)
                     }
 
-                return jax.shard_map(
+                return shard_map(
                     shard_fn,
                     mesh=mesh,
                     in_specs=(P(ROW_AXIS), P(ROW_AXIS), P(), P(), P()),
@@ -522,7 +523,7 @@ class JaxMapEngine(MapEngine):
         key = ("map", fn, mesh)
         if key not in cache:
             cache[key] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS)
                 )
             )
@@ -634,11 +635,26 @@ class JaxExecutionEngine(ExecutionEngine):
         # this engine's behalf — share one counter sink so recovery events
         # (retries, quarantines) are observable on the engine the user holds
         self._host_engine._resilience_stats = self.resilience_stats
-        self._jit_cache: dict = {}
+        from .pipeline import JitCache, PipelineStats
+
+        self._jit_cache: JitCache = JitCache()
+        self._pipeline_stats = PipelineStats()
 
     @property
     def mesh(self) -> Any:
         return self._mesh
+
+    @property
+    def pipeline_stats(self) -> Any:
+        """Ingest-pipeline observability (``fugue_tpu/jax/pipeline.py``):
+        chunks prefetched, producer-wait vs consumer-wait seconds, and the
+        measured overlap fraction, cumulative plus last run."""
+        return self._pipeline_stats
+
+    @property
+    def jit_cache_stats(self) -> Dict[str, int]:
+        """Compile-cache hit/miss/entry counters for this engine."""
+        return self._jit_cache.stats()
 
     @property
     def is_distributed(self) -> bool:
@@ -672,6 +688,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 )
             return df
         from ..constants import FUGUE_TPU_CONF_INGEST_CACHE
+        from .pipeline import prefetch_depth
 
         res = JaxDataFrame(
             df if isinstance(df, DataFrame) else self._host_engine.to_df(df, schema),
@@ -679,6 +696,8 @@ class JaxExecutionEngine(ExecutionEngine):
             ingest_cache=self.conf.get_or_none(
                 FUGUE_TPU_CONF_INGEST_CACHE, bool
             ),
+            ingest_prefetch_depth=prefetch_depth(self.conf),
+            pipeline_stats=self._pipeline_stats,
         )
         src_meta = df.metadata if isinstance(df, DataFrame) and df.has_metadata else None
         if src_meta is not None:
@@ -2011,7 +2030,7 @@ class JaxExecutionEngine(ExecutionEngine):
                         out[vp] = jnp.concatenate([va, vb])
                         return out
 
-                    return jax.shard_map(
+                    return shard_map(
                         shard_fn,
                         mesh=mesh,
                         in_specs=(JP(ROW_AXIS),) * 4,
@@ -2541,7 +2560,7 @@ class JaxExecutionEngine(ExecutionEngine):
                             out[tvp] = v[perm]
                             return out
 
-                        return jax.shard_map(
+                        return shard_map(
                             shard_fn,
                             mesh=mesh,
                             in_specs=(JP(ROW_AXIS), JP(ROW_AXIS), JP(ROW_AXIS)),

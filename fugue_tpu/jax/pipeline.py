@@ -1,0 +1,390 @@
+"""Pipelined (double-buffered) streaming ingest — ISSUE 2's tentpole.
+
+Every streaming path used to be strictly sequential per chunk: parquet
+decode → arrow/pandas → numpy staging → ``jax.device_put`` → jitted step,
+each stage blocking the next, so the device idled during host work and the
+host idled during device work (BENCH_r05: only 36.3s of the 192s north-star
+wall was the device aggregate pass). This module overlaps them:
+
+- :class:`ChunkPrefetcher` runs the *producer* side (host decode +
+  staging + H2D ``device_put``) of a chunk stream in ONE background
+  thread feeding a bounded queue (depth = ``fugue.tpu.stream.prefetch_depth``,
+  default 2 — double buffering), while the caller consumes already-on-device
+  chunks. Device memory stays bounded: at most ``depth`` decoded chunks are
+  in flight beyond the one being consumed, and the streaming paths' peak
+  accounting (``jax.live_arrays()``) naturally counts them because
+  prefetched device buffers ARE live arrays.
+- Errors raised inside the producer (poison chunks, contract violations,
+  injected faults) are carried across the thread boundary and re-raised in
+  the consumer WITH the original traceback; a failed producer never leaves
+  the consumer blocked on an empty queue, and an abandoned consumer
+  (early-stop ``take``, a downstream exception) never leaves the producer
+  blocked on a full one (``close()`` drains and signals stop).
+- The :data:`SITE_STREAM_CHUNK` fault-injection site fires per produced
+  chunk, so the resilience suite can prove the no-deadlock property.
+- :class:`PipelineStats` (surfaced as ``engine.pipeline_stats``) records
+  chunks prefetched, producer-wait vs consumer-wait seconds and the
+  measured overlap fraction; :class:`JitCache` (the engine's
+  ``_jit_cache``) counts compile-cache hits/misses. Both feed ``bench.py``'s
+  ``extra`` block so the trajectory tracks them.
+
+``prefetch_depth <= 0`` disables the thread entirely and returns a serial
+iterator with the identical interface — the A/B switch the parity tests
+use (results must be bit-identical either way).
+"""
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+__all__ = [
+    "DEFAULT_PREFETCH_DEPTH",
+    "ChunkPrefetcher",
+    "JitCache",
+    "PipelineStats",
+    "last_run_stats",
+    "maybe_prefetch",
+    "prefetch_depth",
+]
+
+DEFAULT_PREFETCH_DEPTH = 2
+
+# most recent finished prefetch run (mirrors streaming.last_run_stats) —
+# the proof artifact tests read without holding an engine
+last_run_stats: Dict[str, Any] = {}
+
+
+def default_prefetch_depth() -> int:
+    """The auto default: overlap needs a spare execution unit.
+
+    On a multi-core host (or any non-cpu jax backend, where device compute
+    runs off-host) double buffering is free throughput. On a single-core
+    host whose "devices" ARE that core (the virtual CPU mesh), the
+    producer thread can only steal time from the consumer — measured
+    10-15% slower end to end — so the default degrades to serial there.
+    An explicit ``fugue.tpu.stream.prefetch_depth`` always wins.
+    """
+    import os
+
+    if (os.cpu_count() or 1) > 1:
+        return DEFAULT_PREFETCH_DEPTH
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            return DEFAULT_PREFETCH_DEPTH
+    except Exception:  # pragma: no cover - jax always importable here
+        pass
+    return 0
+
+
+def prefetch_depth(conf: Any) -> int:
+    """Resolve ``fugue.tpu.stream.prefetch_depth`` from an engine conf
+    (unset → :func:`default_prefetch_depth`)."""
+    from ..constants import FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH
+
+    raw = conf.get_or_none(FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH, object)
+    if raw is None:
+        return default_prefetch_depth()
+    return int(raw)
+
+
+class PipelineStats:
+    """Thread-safe ingest-pipeline counters for one engine.
+
+    ``overlap_fraction`` is the fraction of the serial-time estimate
+    (producer busy + consumer busy) that pipelining actually removed from
+    the wall clock: 0 = fully serialized, → 1 = fully hidden. Producer
+    wait is time the producer sat on a FULL queue (consumer-bound run);
+    consumer wait is time the consumer sat on an EMPTY queue
+    (producer-bound run) — whichever dominates names the bottleneck.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {
+            "runs": 0,
+            "chunks_prefetched": 0,
+            "producer_busy_s": 0.0,
+            "producer_wait_s": 0.0,
+            "consumer_wait_s": 0.0,
+            "wall_s": 0.0,
+            "overlap_saved_s": 0.0,
+        }
+        self._last: Dict[str, Any] = {}
+
+    def record_run(
+        self,
+        verb: str,
+        chunks: int,
+        producer_busy_s: float,
+        producer_wait_s: float,
+        consumer_wait_s: float,
+        wall_s: float,
+    ) -> None:
+        consumer_busy = max(wall_s - consumer_wait_s, 0.0)
+        serial_estimate = producer_busy_s + consumer_busy
+        saved = max(serial_estimate - wall_s, 0.0)
+        run = {
+            "verb": verb,
+            "chunks_prefetched": chunks,
+            "producer_busy_s": round(producer_busy_s, 6),
+            "producer_wait_s": round(producer_wait_s, 6),
+            "consumer_wait_s": round(consumer_wait_s, 6),
+            "wall_s": round(wall_s, 6),
+            "overlap_saved_s": round(saved, 6),
+            "overlap_fraction": round(saved / serial_estimate, 6)
+            if serial_estimate > 0
+            else 0.0,
+        }
+        with self._lock:
+            t = self._totals
+            t["runs"] += 1
+            t["chunks_prefetched"] += chunks
+            t["producer_busy_s"] += producer_busy_s
+            t["producer_wait_s"] += producer_wait_s
+            t["consumer_wait_s"] += consumer_wait_s
+            t["wall_s"] += wall_s
+            t["overlap_saved_s"] += saved
+            self._last = run
+        global last_run_stats
+        last_run_stats = run
+
+    @property
+    def last_run(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._last)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            t = dict(self._totals)
+        serial = t["producer_busy_s"] + max(t["wall_s"] - t["consumer_wait_s"], 0.0)
+        t["overlap_fraction"] = (
+            round(t["overlap_saved_s"] / serial, 6) if serial > 0 else 0.0
+        )
+        for k in (
+            "producer_busy_s",
+            "producer_wait_s",
+            "consumer_wait_s",
+            "wall_s",
+            "overlap_saved_s",
+        ):
+            t[k] = round(t[k], 6)
+        t["last_run"] = self.last_run
+        return t
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._totals:
+                self._totals[k] = 0 if k in ("runs", "chunks_prefetched") else 0.0
+            self._last = {}
+
+
+class JitCache(dict):
+    """The engine's compile cache with hit/miss observability.
+
+    Every compiled-path gate in the codebase is the ``key not in cache``
+    idiom, so counting at ``__contains__`` maps 1:1 onto "would this call
+    have paid an XLA compile": absent = miss (a compile follows), present =
+    hit. Exposed via ``engine.jit_cache_stats`` and ``bench.py`` extra.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self.hits = 0
+        self.misses = 0
+
+    def __contains__(self, key: Any) -> bool:
+        present = super().__contains__(key)
+        if present:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return present
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses, "entries": len(self)}
+
+
+class _SerialChunks:
+    """depth<=0 path: the same iterator/close() surface, no thread — the
+    bit-identical serial baseline the parity tests compare against."""
+
+    def __init__(self, source: Iterator[Any]):
+        self._src = source
+
+    def __iter__(self) -> "_SerialChunks":
+        return self
+
+    def __next__(self) -> Any:
+        return next(self._src)
+
+    def close(self) -> None:
+        close = getattr(self._src, "close", None)
+        if close is not None:
+            close()
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+class ChunkPrefetcher:
+    """Background producer over ``source`` with a depth-bounded queue.
+
+    The producer thread advances ``source`` (which performs the host decode
+    and ``device_put`` work) and enqueues results; ``__next__`` dequeues.
+    At most ``depth`` finished items wait in the queue, plus one being
+    produced — the memory bound callers account for.
+    """
+
+    def __init__(
+        self,
+        source: Iterator[Any],
+        depth: int,
+        stats: Optional[PipelineStats] = None,
+        verb: str = "",
+        injector: Any = None,
+    ):
+        self._src = source
+        self._depth = max(1, int(depth))
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._stats = stats
+        self._verb = verb
+        self._injector = injector
+        self._chunks = 0
+        self._producer_busy = 0.0
+        self._producer_wait = 0.0
+        self._consumer_wait = 0.0
+        self._finished = False
+        self._recorded = False
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._produce,
+            name=f"fugue-tpu-prefetch-{verb or 'chunks'}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer side ------------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._src)
+                except StopIteration:
+                    break
+                if self._injector is not None:
+                    # the poison-chunk site: an injected error here must
+                    # surface in the consumer, never hang the queue
+                    from ..resilience import SITE_STREAM_CHUNK
+
+                    self._injector.fire(SITE_STREAM_CHUNK)
+                self._producer_busy += time.perf_counter() - t0
+                if not self._put(item):
+                    return
+            self._put(_DONE)
+        except BaseException as ex:  # noqa: BLE001 — carried to the consumer
+            self._put(_Failure(ex))
+
+    def _put(self, obj: Any) -> bool:
+        """Blocking put that aborts when the consumer closed the pipeline —
+        a stalled consumer must not pin this thread (and the stream's
+        remaining chunks) forever."""
+        t0 = time.perf_counter()
+        while not self._stop.is_set():
+            try:
+                self._q.put(obj, timeout=0.05)
+                self._producer_wait += time.perf_counter() - t0
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished:
+            raise StopIteration
+        t0 = time.perf_counter()
+        obj = self._q.get()
+        self._consumer_wait += time.perf_counter() - t0
+        if obj is _DONE:
+            self._finish()
+            raise StopIteration
+        if isinstance(obj, _Failure):
+            self._finish()
+            self.close()
+            # re-raising the ORIGINAL exception object keeps its traceback
+            # (the producer-side frames), satisfying the propagation
+            # contract: the user sees where the decode actually failed
+            raise obj.exc
+        self._chunks += 1
+        return obj
+
+    def _finish(self) -> None:
+        self._finished = True
+        if self._recorded:
+            return
+        self._recorded = True
+        if self._stats is not None:
+            self._stats.record_run(
+                self._verb,
+                self._chunks,
+                self._producer_busy,
+                self._producer_wait,
+                self._consumer_wait,
+                time.perf_counter() - self._t0,
+            )
+
+    def close(self) -> None:
+        """Stop the producer and release everything it buffered. Safe to
+        call multiple times; always called from the consuming ``finally``."""
+        self._stop.set()
+        while True:  # drain so a blocked producer put() can observe stop
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+        self._finish()
+
+
+def maybe_prefetch(
+    source: Iterator[Any],
+    depth: int,
+    stats: Optional[PipelineStats] = None,
+    verb: str = "",
+    injector: Any = None,
+) -> Any:
+    """Wrap a chunk iterator in a :class:`ChunkPrefetcher` (depth > 0) or a
+    same-interface serial shim (depth <= 0)."""
+    if depth <= 0:
+        return _SerialChunks(iter(source))
+    return ChunkPrefetcher(iter(source), depth, stats=stats, verb=verb, injector=injector)
+
+
+def engine_prefetcher(
+    engine: Any, source: Iterator[Any], verb: str
+) -> Any:
+    """The streaming paths' one-liner: depth/stats/injector from ``engine``."""
+    from ..resilience import FaultInjector
+
+    return maybe_prefetch(
+        source,
+        prefetch_depth(engine.conf),
+        stats=getattr(engine, "pipeline_stats", None),
+        verb=verb,
+        injector=FaultInjector.from_conf(engine.conf),
+    )

@@ -58,6 +58,7 @@ from ..dataframe import (
 )
 from ..exceptions import FugueInvalidOperation
 from ..schema import Schema
+from .._utils.jax_compat import shard_map
 
 DEFAULT_CHUNK_ROWS = 1 << 20
 
@@ -169,7 +170,13 @@ def _chunk_columns(
         n = len(pdf)
         for name in names:
             s = pdf[name]
-            nulls[name] = int(s.isna().sum())
+            dt = s.dtype
+            if isinstance(dt, np.dtype) and dt.kind in "iubf":
+                # plain numpy int/uint/bool cannot hold NULL, and float NaN
+                # IS the device NULL — skip the O(n) isna scan either way
+                nulls[name] = 0
+            else:
+                nulls[name] = int(s.isna().sum())
             cols[name] = s.to_numpy()
     return n, cols, nulls
 
@@ -179,6 +186,32 @@ def _device_peak_bytes() -> int:
 
     return sum(
         a.nbytes for a in jax.live_arrays() if getattr(a, "is_deleted", lambda: False)() is False
+    )
+
+
+def _closing(chunks_it: Any) -> Iterator[Any]:
+    """Consume a (possibly prefetched) chunk iterator, guaranteeing its
+    producer thread is stopped on exhaustion, error, or an abandoned
+    downstream generator (GeneratorExit reaches the finally)."""
+    try:
+        yield from chunks_it
+    finally:
+        chunks_it.close()
+
+
+def _prefetched_pandas_chunks(
+    engine: Any, df: Any, chunk_rows: int, verb: str
+) -> Any:
+    """The host-side chunk pipeline: decode chunks to pandas in the
+    background thread while the caller consumes — used by the paths whose
+    per-chunk device work happens downstream (keyed map, take, distinct,
+    join probe)."""
+    from .pipeline import engine_prefetcher
+
+    return engine_prefetcher(
+        engine,
+        (f.as_pandas() for f in _iter_local_frames(df, chunk_rows)),
+        verb,
     )
 
 
@@ -349,6 +382,22 @@ def streaming_dense_aggregate(
         cache[cache_key] = jax.jit(step, donate_argnums=0)
     step_fn = cache[cache_key]
 
+    # full-capacity chunks skip the zero+copy staging buffers entirely and
+    # share ONE device-resident all-valid mask (the kernel never donates
+    # its chunk inputs, so the mask is reusable across every chunk)
+    full_valid_dev: List[Any] = []
+
+    def _valid_for(n: int) -> Any:
+        if n == capacity:
+            if not full_valid_dev:
+                full_valid_dev.append(
+                    jax.device_put(np.ones(capacity, dtype=bool), sharding)
+                )
+            return full_valid_dev[0]
+        valid = np.zeros(capacity, dtype=bool)
+        valid[:n] = True
+        return valid
+
     def put_chunk(n: int, cols: Dict[str, np.ndarray], nulls: Dict[str, int]):
         assert_or_throw(
             nulls[key] == 0,
@@ -370,10 +419,12 @@ def streaming_dense_aggregate(
                 f"streaming aggregate: key {key!r} value outside range "
                 f"([{lo},{hi}] seen): {hint}"
             )
-        kb = np.zeros(capacity, dtype=key_np)
-        kb[:n] = ck
-        valid = np.zeros(capacity, dtype=bool)
-        valid[:n] = True
+        full = n == capacity
+        if full:
+            kb = np.ascontiguousarray(ck.astype(key_np, copy=False))
+        else:
+            kb = np.zeros(capacity, dtype=key_np)
+            kb[:n] = ck
         vals = []
         for s in srcs:
             if src_np[s].kind != "f":
@@ -385,10 +436,16 @@ def streaming_dense_aggregate(
                         "contract)"
                     ),
                 )
-            vb = np.zeros(capacity, dtype=src_np[s])
-            vb[:n] = cols[s].astype(src_np[s], copy=False)
+            if full:
+                vb = np.ascontiguousarray(
+                    cols[s].astype(src_np[s], copy=False)
+                )
+            else:
+                vb = np.zeros(capacity, dtype=src_np[s])
+                vb[:n] = cols[s].astype(src_np[s], copy=False)
             vals.append(vb)
-        put = jax.device_put([kb, valid] + vals, sharding)
+        vd = _valid_for(n)
+        put = jax.device_put([kb, vd] + vals, sharding)
         return put[0], put[1], put[2:]
 
     stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
@@ -398,19 +455,33 @@ def streaming_dense_aggregate(
             stats["peak_device_bytes"], _device_peak_bytes()
         )
 
-    k0, v0, a0 = put_chunk(n0, cols0, nulls0)
-    acc = kernel(k0, kmin_s, *a0, v0)
-    stats["chunks"], stats["rows"] = 1, n0
-    del k0, v0, a0, cols0, first
-    track()
-    for f in frames:
-        n, cols, nulls = _chunk_columns(f, [key] + srcs)
-        kd, vd, ad = put_chunk(n, cols, nulls)
-        acc = step_fn(acc, kd, vd, *ad)
-        stats["chunks"] += 1
-        stats["rows"] += n
-        del kd, vd, ad, cols, f
-        track()
+    def produce() -> Iterator[Tuple[int, Any]]:
+        nonlocal cols0, nulls0, first
+        yield n0, put_chunk(n0, cols0, nulls0)
+        cols0 = nulls0 = first = None  # release the head chunk's host copy
+        for f in frames:
+            n, cols, nulls = _chunk_columns(f, [key] + srcs)
+            yield n, put_chunk(n, cols, nulls)
+
+    # DOUBLE-BUFFERED ingest (ISSUE 2 tentpole): the producer thread
+    # decodes + device_puts chunk i+1..i+depth while the jitted step folds
+    # chunk i into the donated device accumulators
+    from .pipeline import engine_prefetcher
+
+    chunks_it = engine_prefetcher(engine, produce(), "aggregate")
+    acc: Any = None
+    try:
+        for n, (kd, vd, ad) in chunks_it:
+            if acc is None:
+                acc = kernel(kd, kmin_s, *ad, vd)
+            else:
+                acc = step_fn(acc, kd, vd, *ad)
+            stats["chunks"] += 1
+            stats["rows"] += n
+            del kd, vd, ad
+            track()
+    finally:
+        chunks_it.close()
 
     # ONE host transfer: the merged tables (O(buckets), not O(rows))
     for a in acc:
@@ -569,8 +640,18 @@ def streaming_hash_join(
 
     def gen() -> Iterator[LocalDataFrame]:
         stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-        for f in _rechunk(_iter_local_frames(stream_df, chunk_rows), capacity):
-            pf = f.as_pandas().reset_index(drop=True)
+        full_valid_dev: List[Any] = []
+        from .pipeline import engine_prefetcher
+
+        chunks_it = engine_prefetcher(
+            engine,
+            (
+                f.as_pandas().reset_index(drop=True)
+                for f in _rechunk(_iter_local_frames(stream_df, chunk_rows), capacity)
+            ),
+            "join",
+        )
+        for pf in _closing(chunks_it):
             n = len(pf)
             stats["chunks"] += 1
             stats["rows"] += n
@@ -586,13 +667,24 @@ def streaming_hash_join(
                 yield PandasDataFrame(pd.DataFrame(data), out_schema)
                 continue
             karr, knull = _extract_key(pf)
-            kb = np.zeros(capacity, dtype=key_np)
-            kb[:n] = karr
-            valid = np.zeros(capacity, dtype=bool)
-            valid[:n] = True
-            if knull.any():
-                valid[:n] &= ~knull
-            kd, vd = jax.device_put([kb, valid], sharding)
+            has_null = bool(knull.any())
+            if n == capacity and not has_null:
+                # full-capacity chunk: probe the key column directly and
+                # share one device-resident all-valid mask — no staging
+                kb = np.ascontiguousarray(karr)
+                if not full_valid_dev:
+                    full_valid_dev.append(
+                        jax.device_put(np.ones(capacity, dtype=bool), sharding)
+                    )
+                kd, vd = jax.device_put([kb, full_valid_dev[0]], sharding)
+            else:
+                kb = np.zeros(capacity, dtype=key_np)
+                kb[:n] = karr
+                valid = np.zeros(capacity, dtype=bool)
+                valid[:n] = True
+                if has_null:
+                    valid[:n] &= ~knull
+                kd, vd = jax.device_put([kb, valid], sharding)
             hit_d, idx_d = probe_fn(bk_dev, kd, vd)
             hit_d.copy_to_host_async()
             idx_d.copy_to_host_async()
@@ -611,6 +703,14 @@ def streaming_hash_join(
                     else:
                         g = bs[nm].take(pos).reset_index(drop=True)
                         data[nm] = g.where(hit_s)
+            elif hit.all():
+                # every probe hit (the dimension-table norm): skip the
+                # nonzero + per-column gathers — rows pass through as-is
+                for nm in out_schema.names:
+                    if nm in pf.columns:
+                        data[nm] = pf[nm]
+                    else:
+                        data[nm] = bs[nm].take(pos).reset_index(drop=True)
             else:
                 (sel,) = np.nonzero(hit)
                 for nm in out_schema.names:
@@ -677,7 +777,7 @@ def streaming_compiled_map(
     cache_key = ("stream_map", fn, mesh, capacity)
     if cache_key not in cache:
         cache[cache_key] = jax.jit(
-            jax.shard_map(fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS))
+            shard_map(fn, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS))
         )
     mapped = cache[cache_key]
     if on_init is not None:
@@ -691,25 +791,52 @@ def streaming_compiled_map(
 
     def gen() -> Iterator[LocalDataFrame]:
         stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-        for f in _rechunk(_iter_local_frames(df, chunk_rows), capacity):
-            n, cols, nulls = _chunk_columns(f, names)
-            buf: Dict[str, Any] = {}
-            for c in names:
-                if np_dtypes[c].kind != "f":
-                    assert_or_throw(
-                        nulls[c] == 0,
-                        FugueInvalidOperation(
-                            f"streaming compiled map: NULL in non-float "
-                            f"column {c!r}"
-                        ),
-                    )
-                b = np.zeros(capacity, dtype=np_dtypes[c])
-                b[:n] = cols[c].astype(np_dtypes[c], copy=False)
-                buf[c] = b
-            valid = np.zeros(capacity, dtype=bool)
-            valid[:n] = True
-            buf["__valid__"] = valid
-            dev = jax.device_put(buf, sharding)
+        # one device-resident all-valid mask shared by every full chunk
+        # (mapped() never donates inputs, so reuse is safe)
+        full_valid_dev: List[Any] = []
+
+        def produce() -> Iterator[Tuple[int, Any]]:
+            for f in _rechunk(_iter_local_frames(df, chunk_rows), capacity):
+                n, cols, nulls = _chunk_columns(f, names)
+                full = n == capacity
+                buf: Dict[str, Any] = {}
+                for c in names:
+                    if np_dtypes[c].kind != "f":
+                        assert_or_throw(
+                            nulls[c] == 0,
+                            FugueInvalidOperation(
+                                f"streaming compiled map: NULL in non-float "
+                                f"column {c!r}"
+                            ),
+                        )
+                    if full:
+                        # full-capacity chunk: no staging copy at all
+                        buf[c] = np.ascontiguousarray(
+                            cols[c].astype(np_dtypes[c], copy=False)
+                        )
+                    else:
+                        b = np.zeros(capacity, dtype=np_dtypes[c])
+                        b[:n] = cols[c].astype(np_dtypes[c], copy=False)
+                        buf[c] = b
+                if full:
+                    if not full_valid_dev:
+                        full_valid_dev.append(
+                            jax.device_put(
+                                np.ones(capacity, dtype=bool), sharding
+                            )
+                        )
+                    buf["__valid__"] = full_valid_dev[0]
+                else:
+                    valid = np.zeros(capacity, dtype=bool)
+                    valid[:n] = True
+                    buf["__valid__"] = valid
+                # device_put is a no-op for the already-committed mask
+                yield n, jax.device_put(buf, sharding)
+
+        from .pipeline import engine_prefetcher
+
+        chunks_it = engine_prefetcher(engine, produce(), "map")
+        for n, dev in _closing(chunks_it):
             out = mapped(dev)
             assert_or_throw(
                 isinstance(out, dict),
@@ -744,7 +871,7 @@ def streaming_compiled_map(
             stats["peak_device_bytes"] = max(
                 stats["peak_device_bytes"], _device_peak_bytes()
             )
-            del dev, out, buf
+            del dev, out
             pdf = pd.DataFrame(
                 {c: host[c].astype(out_pd_dtypes[c], copy=False) for c in host}
             )
@@ -795,22 +922,27 @@ def streaming_take(
     schema = Schema(df.schema)
     buf: Optional[pd.DataFrame] = None
     stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-    for f in _iter_local_frames(df, chunk_rows):
-        pf = f.as_pandas()
-        stats["chunks"] += 1
-        stats["rows"] += len(pf)
-        buf = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
-        if len(names) > 0:
-            buf = buf.sort_values(
-                names, ascending=asc, na_position=na_position, kind="stable"
-            )
-        if len(keys) == 0:
-            buf = buf.head(n)
-            if len(names) == 0 and len(buf) >= n:
-                break  # unsorted global take: the rest of the stream is moot
-        else:
-            buf = buf.groupby(keys, dropna=False, sort=False).head(n)
-        buf = buf.reset_index(drop=True)
+    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "take")
+    try:
+        for pf in chunks_it:
+            stats["chunks"] += 1
+            stats["rows"] += len(pf)
+            buf = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
+            if len(names) > 0:
+                buf = buf.sort_values(
+                    names, ascending=asc, na_position=na_position, kind="stable"
+                )
+            if len(keys) == 0:
+                buf = buf.head(n)
+                if len(names) == 0 and len(buf) >= n:
+                    # unsorted global take: the rest of the stream is moot —
+                    # close() also stops the producer's read-ahead
+                    break
+            else:
+                buf = buf.groupby(keys, dropna=False, sort=False).head(n)
+            buf = buf.reset_index(drop=True)
+    finally:
+        chunks_it.close()
     global last_run_stats
     last_run_stats = dict(stats, verb="take")
     out = buf if buf is not None else pd.DataFrame(columns=schema.names)
@@ -829,12 +961,15 @@ def streaming_distinct(engine: Any, df: Any) -> DataFrame:
     schema = Schema(df.schema)
     buf: Optional[pd.DataFrame] = None
     stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-    for f in _iter_local_frames(df, chunk_rows):
-        pf = f.as_pandas()
-        stats["chunks"] += 1
-        stats["rows"] += len(pf)
-        merged = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
-        buf = _drop_duplicates(merged)
+    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "distinct")
+    try:
+        for pf in chunks_it:
+            stats["chunks"] += 1
+            stats["rows"] += len(pf)
+            merged = pf if buf is None else pd.concat([buf, pf], ignore_index=True)
+            buf = _drop_duplicates(merged)
+    finally:
+        chunks_it.close()
     global last_run_stats
     last_run_stats = dict(stats, verb="distinct")
     out = buf if buf is not None else pd.DataFrame(columns=schema.names)
@@ -970,8 +1105,10 @@ def streaming_keyed_compiled_map(
         carry: Optional[pd.DataFrame] = None
         closed: set = set()
         first = [True]
-        for f in _iter_local_frames(df, chunk_rows):
-            pf = f.as_pandas()
+        # prefetch the host decode of the NEXT chunk while run_batch runs
+        # the compiled keyed map on the current batch
+        chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "keyed_map")
+        for pf in _closing(chunks_it):
             stats["chunks"] += 1
             stats["rows"] += len(pf)
             merged = (

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..column.expressions import _LitColumnExpr, _NamedColumnExpr, _WindowExpr
 from ..schema import Schema
+from .._utils.jax_compat import shard_map
 
 _AGGS = {"SUM", "AVG", "MIN", "MAX", "COUNT", "FIRST", "LAST"}
 _RANKS = {"ROW_NUMBER", "RANK", "DENSE_RANK"}
@@ -789,7 +790,7 @@ def run_device_windows(engine: Any, jdf: Any, plan: Tuple) -> Optional[Any]:
                 sc_out["__wvalid__"] = sv
                 return sc_out
 
-            return jax.shard_map(
+            return shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(JP(ROW_AXIS), JP(ROW_AXIS)),

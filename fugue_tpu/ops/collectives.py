@@ -28,6 +28,8 @@ collectives ARE the transport, so platform quirks surface in-tree.
 import os
 from typing import Any
 
+from .._utils.jax_compat import axis_size
+
 __all__ = ["psum", "pmin", "pmax", "all_gather", "all_to_all"]
 
 
@@ -41,7 +43,7 @@ def _gather_via_psum(x: Any, axis: str) -> Any:
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     i = lax.axis_index(axis)
     buf = jnp.zeros((n,) + x.shape, x.dtype).at[i].set(x)
     # psum upcasts bool to int32 — restore the caller's dtype (the buffers
@@ -58,7 +60,7 @@ def psum(x: Any, axis: str) -> Any:
 def pmin(x: Any, axis: str) -> Any:
     from jax import lax
 
-    if lax.axis_size(axis) == 1:
+    if axis_size(axis) == 1:
         return lax.psum(x, axis).astype(x.dtype)
     if _sum_only():
         return _gather_via_psum(x, axis).min(axis=0)
@@ -68,7 +70,7 @@ def pmin(x: Any, axis: str) -> Any:
 def pmax(x: Any, axis: str) -> Any:
     from jax import lax
 
-    if lax.axis_size(axis) == 1:
+    if axis_size(axis) == 1:
         return lax.psum(x, axis).astype(x.dtype)
     if _sum_only():
         return _gather_via_psum(x, axis).max(axis=0)
@@ -79,7 +81,7 @@ def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
     import jax.numpy as jnp
     from jax import lax
 
-    if lax.axis_size(axis) == 1:
+    if axis_size(axis) == 1:
         g = lax.psum(x, axis).astype(x.dtype)
         return g if tiled else g[None]
     if _sum_only():
@@ -94,7 +96,7 @@ def all_to_all(x: Any, axis: str, split_axis: int, concat_axis: int) -> Any:
     from jax import lax
 
     assert split_axis == 0 and concat_axis == 0
-    if lax.axis_size(axis) == 1:
+    if axis_size(axis) == 1:
         return x
     if _sum_only():
         # g[src, dest, ...] replicated via psum; my receive row is g[:, i]

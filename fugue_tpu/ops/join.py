@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..parallel.mesh import ROW_AXIS, num_row_shards
 from . import collectives
 from .shuffle import _hash_cols
+from .._utils.jax_compat import shard_map
 
 _JOIN_CACHE: Dict[Any, Any] = {}
 
@@ -129,7 +130,7 @@ def _get_compiled_right_prep(mesh: Any, n_keys: int, dtypes: Any, local: bool):
         if local:
             spec = P(ROW_AXIS)
             _JOIN_CACHE[key] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     prep,
                     mesh=mesh,
                     in_specs=tuple(spec for _ in range(1 + n_keys)),
@@ -176,7 +177,7 @@ def _get_compiled_probe(
             n_out = 1 + (
                 (n_values + 1) if how == "left_outer" else (n_values if how == "inner" else 0)
             )
-            return jax.shard_map(
+            return shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(row, right, right, right)
@@ -337,7 +338,7 @@ def _get_compiled_expand_count(mesh: Any, n_keys: int, dtypes: Any, local: bool,
         row = P(ROW_AXIS)
         right = row if local else P()
         _JOIN_CACHE[key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 count,
                 mesh=mesh,
                 in_specs=(row, right, right) + tuple(row for _ in range(n_keys)),
@@ -427,7 +428,7 @@ def _get_compiled_expand(
             else 1 + n_left + n_values + (1 if how == "left_outer" else 0)
         )
         _JOIN_CACHE[key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 expand,
                 mesh=mesh,
                 in_specs=(row_spec, row_spec, row_spec, row_spec, right)

@@ -227,7 +227,7 @@ def _get_compiled_kernel(mesh: Any, num_keys: int, agg_sig: Tuple[Tuple[Any, ...
         n_out = 1 + num_keys + len(agg_sig)
         spec = P(ROW_AXIS)
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=tuple(spec for _ in range(n_in)),
@@ -251,7 +251,7 @@ def _get_compiled_slicer(mesh: Any, n_arrays: int, k: int):
             return tuple(a[:k] for a in arrs)
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 take_k,
                 mesh=mesh,
                 in_specs=tuple(spec for _ in range(n_arrays)),
@@ -277,7 +277,7 @@ def _get_compiled_mask(mesh: Any):
                 base = jax.lax.axis_index(ROW_AXIS).astype(jnp.int64) * n_local
                 return base + jax.lax.iota(jnp.int64, n_local) < rc
 
-            return jax.shard_map(
+            return shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P()),
@@ -298,6 +298,7 @@ _DENSE_MAX_RANGE = 1 << 18
 # next to this file, keyed by jax.default_backend()) → "scatter".
 import json as _json
 import os as _os
+from .._utils.jax_compat import shard_map
 
 _DENSE_SUM_BACKENDS = ("scatter", "onehot", "pallas")
 _TUNED_PATH = _os.path.join(_os.path.dirname(__file__), "_tuned.json")
@@ -374,7 +375,7 @@ def _get_compiled_minmax(mesh: Any):
                     collectives.pmax(small.max(), ROW_AXIS)[None],
                 )
 
-            return jax.shard_map(
+            return shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
@@ -456,7 +457,7 @@ def _get_compiled_dense(mesh: Any, buckets: int, agg_sig: Tuple[Tuple[str, str],
             return (present,) + tuple(outs)
 
         n_out = 1 + len(agg_sig)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             kernel,
             mesh=mesh,
             in_specs=(P(ROW_AXIS), P()) + tuple(P(ROW_AXIS) for _ in range(num_vals + 1)),

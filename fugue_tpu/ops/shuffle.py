@@ -28,6 +28,7 @@ import numpy as np
 
 from ..parallel.mesh import ROW_AXIS, num_row_shards
 from . import collectives
+from .._utils.jax_compat import shard_map
 
 _COMPILE_CACHE: Dict[Any, Any] = {}
 
@@ -77,7 +78,7 @@ def _get_compiled_dest_hash(mesh: Any, n_keys: int, dtypes: Tuple[Any, ...]):
             return (h % np.uint64(shards)).astype(jnp.int32)
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=tuple(P(ROW_AXIS) for _ in range(n_keys)),
@@ -112,7 +113,7 @@ def _get_compiled_dest_even(mesh: Any):
             return jnp.clip(rank // block, 0, shards - 1).astype(jnp.int32)
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel, mesh=mesh, in_specs=(P(ROW_AXIS),), out_specs=P(ROW_AXIS)
             )
         )
@@ -136,7 +137,7 @@ def _get_compiled_dest_rand(mesh: Any):
             )
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P()),
@@ -154,7 +155,7 @@ def _get_compiled_dest_single(mesh: Any):
     cache_key = ("dest_single", mesh)
     if cache_key not in _COMPILE_CACHE:
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda template: jnp.zeros(template.shape, jnp.int32),
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS),),
@@ -191,7 +192,7 @@ def _get_compiled_counts(mesh: Any):
             )
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
@@ -267,7 +268,7 @@ def _get_compiled_exchange(
         n_in = 2 + len(dtypes)
         n_out = 1 + len(dtypes)
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=tuple(P(ROW_AXIS) for _ in range(n_in)),
@@ -302,7 +303,7 @@ def _get_compiled_rank(mesh: Any):
             return jnp.zeros(n, dtype=jnp.int32).at[perm].set(pos)
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS), P(ROW_AXIS)),
@@ -370,7 +371,7 @@ def _get_compiled_round(
 
         row = P(ROW_AXIS)
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(row, row, row, row, P())
@@ -429,7 +430,7 @@ def _get_compiled_lenmask(mesh: Any, out_cap: int):
             return lax.iota(jnp.int32, out_cap) < out_len[0]
 
         _COMPILE_CACHE[cache_key] = jax.jit(
-            jax.shard_map(
+            shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(ROW_AXIS),),

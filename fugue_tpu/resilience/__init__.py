@@ -12,6 +12,7 @@ from .fault import (
     SITE_MAP_CHUNK,
     SITE_MAP_DISPATCH,
     SITE_RPC_REQUEST,
+    SITE_STREAM_CHUNK,
     SITE_TASK_EXECUTE,
     FaultInjector,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "SITE_TASK_EXECUTE",
     "SITE_RPC_REQUEST",
     "SITE_CHECKPOINT_SAVE",
+    "SITE_STREAM_CHUNK",
     "RetryPolicy",
     "Deadline",
     "FailureCategory",

@@ -54,6 +54,7 @@ __all__ = [
     "SITE_TASK_EXECUTE",
     "SITE_RPC_REQUEST",
     "SITE_CHECKPOINT_SAVE",
+    "SITE_STREAM_CHUNK",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -61,6 +62,11 @@ SITE_MAP_CHUNK = "map.chunk"
 SITE_TASK_EXECUTE = "task.execute"
 SITE_RPC_REQUEST = "rpc.request"
 SITE_CHECKPOINT_SAVE = "checkpoint.save"
+# inside the streaming ingest pipeline's producer thread, after a chunk is
+# decoded and before it is enqueued (fugue_tpu/jax/pipeline.py) — `error`
+# here is the poison-chunk scenario: it must propagate to the consumer
+# with its traceback and must never deadlock the bounded queue
+SITE_STREAM_CHUNK = "stream.chunk"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

@@ -1,0 +1,380 @@
+"""The double-buffered streaming ingest pipeline — `fugue_tpu/jax/pipeline.py`.
+
+Proves the ISSUE 2 contracts:
+
+- every prefetched streaming path is BIT-IDENTICAL to the serial
+  (`prefetch_depth=0`) path: aggregate, compiled map, keyed compiled map,
+  take;
+- producer-thread exceptions propagate to the consumer with the ORIGINAL
+  traceback;
+- the queue depth bound holds under a slow consumer (bounded read-ahead);
+- a FaultInjector poison chunk (`stream.chunk=error`) raises cleanly —
+  no deadlock, no hang;
+- `engine.pipeline_stats` and `engine.jit_cache_stats` observe real runs;
+- the pipelined bulk `to_df` ingest round-trips identically to serial.
+"""
+
+import time
+import traceback
+from typing import Dict
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import jax
+
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_FAULT_PLAN,
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH,
+)
+from fugue_tpu.dataframe import (
+    ArrowDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+)
+from fugue_tpu.jax import JaxExecutionEngine, pipeline, streaming
+from fugue_tpu.resilience import InjectedFaultError
+
+CHUNK = 2048
+
+AGGS = [
+    ff.sum(col("v")).alias("sv"),
+    ff.count(col("v")).alias("n"),
+    ff.avg(col("v")).alias("m"),
+]
+
+
+def _engine(depth: int, **conf):
+    return JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: CHUNK,
+            FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH: depth,
+            **conf,
+        }
+    )
+
+
+def _frame(n: int = 30_000, groups: int = 128, seed: int = 3) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {"k": rng.integers(0, groups, n), "v": rng.random(n)}
+    )
+
+
+def _stream(pdf: pd.DataFrame, n_chunks: int = 11) -> LocalDataFrameIterableDataFrame:
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    step = max(1, (tbl.num_rows + n_chunks - 1) // n_chunks)
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+# --------------------------------------------------------------------------
+# bit-identical parity: prefetched vs serial, all four streaming paths
+# --------------------------------------------------------------------------
+
+
+def test_prefetch_aggregate_bit_identical():
+    pdf = _frame()
+    spec = PartitionSpec(by=["k"])
+    frames = {}
+    for depth in (0, 2):
+        e = _engine(depth)
+        try:
+            res = e.aggregate(_stream(pdf), spec, AGGS)
+            frames[depth] = (
+                res.as_pandas().sort_values("k").reset_index(drop=True)
+            )
+            if depth > 0:
+                run = e.pipeline_stats.last_run
+                assert run["verb"] == "aggregate"
+                assert run["chunks_prefetched"] >= 11
+        finally:
+            e.stop_engine()
+    pd.testing.assert_frame_equal(frames[0], frames[2])  # exact, dtypes too
+    assert streaming.last_run_stats["rows"] == len(pdf)
+
+
+def test_prefetch_compiled_map_bit_identical():
+    import fugue_tpu.api as fa
+
+    pdf = _frame()
+
+    def fn(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return {"k": cols["k"], "v2": cols["v"] * 2.0 + cols["k"]}
+
+    frames = {}
+    for depth in (0, 2):
+        e = _engine(depth)
+        try:
+            out = fa.transform(
+                _stream(pdf),
+                fn,
+                schema="k:long,v2:double",
+                engine=e,
+                as_fugue=True,
+            )
+            assert isinstance(out, LocalDataFrameIterableDataFrame)
+            frames[depth] = out.as_pandas()
+            if depth > 0:
+                assert e.pipeline_stats.last_run["verb"] == "map"
+        finally:
+            e.stop_engine()
+    pd.testing.assert_frame_equal(frames[0], frames[2])
+
+
+def test_prefetch_keyed_map_bit_identical():
+    import fugue_tpu.api as fa
+
+    from fugue_tpu.jax import group_ops as go
+
+    rng = np.random.default_rng(9)
+    pdf = pd.DataFrame(
+        {"k": np.repeat(np.arange(40), rng.integers(5, 120, 40))}
+    )
+    pdf["v"] = rng.random(len(pdf))
+
+    def stream():
+        def gen():
+            for s in range(0, len(pdf), 333):
+                yield PandasDataFrame(pdf.iloc[s : s + 333], "k:long,v:double")
+
+        return LocalDataFrameIterableDataFrame(gen(), schema="k:long,v:double")
+
+    def fn(cols: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return {
+            "k": cols["k"],
+            "rn": go.row_number(cols),
+            "rs": go.running_sum(cols, cols["v"]),
+        }
+
+    frames = {}
+    for depth in (0, 2):
+        e = _engine(depth)
+        try:
+            out = fa.transform(
+                stream(),
+                fn,
+                schema="k:long,rn:long,rs:double",
+                partition=PartitionSpec(by=["k"], presort="v"),
+                engine=e,
+                as_fugue=True,
+            )
+            frames[depth] = out.as_pandas()
+            if depth > 0:
+                assert e.pipeline_stats.last_run["verb"] == "keyed_map"
+        finally:
+            e.stop_engine()
+    pd.testing.assert_frame_equal(frames[0], frames[2])
+
+
+def test_prefetch_take_bit_identical_and_early_stop():
+    pdf = _frame()
+    frames = {}
+    pulled = {0: 0, 2: 0}
+    for depth in (0, 2):
+        e = _engine(depth)
+
+        def counting_stream(d=depth):
+            def gen():
+                for s in range(0, len(pdf), CHUNK):
+                    pulled[d] += 1
+                    yield PandasDataFrame(
+                        pdf.iloc[s : s + CHUNK], "k:long,v:double"
+                    )
+
+            return LocalDataFrameIterableDataFrame(
+                gen(), schema="k:long,v:double"
+            )
+
+        try:
+            # presorted take: full consumption, order-deterministic output
+            res = e.take(counting_stream(), n=7, presort="v desc")
+            frames[depth] = res.as_pandas().reset_index(drop=True)
+            # unsorted global take: early stop must bound read-ahead
+            before = pulled[depth]
+            e.take(counting_stream(), n=5, presort=None)
+            consumed = pulled[depth] - before
+            # 5 rows fit in the first chunk; serial pulls 1, prefetch may
+            # read ahead at most depth+1 chunks beyond it
+            assert consumed <= 1 + depth + 2
+        finally:
+            e.stop_engine()
+    pd.testing.assert_frame_equal(frames[0], frames[2])
+
+
+# --------------------------------------------------------------------------
+# prefetcher unit contracts
+# --------------------------------------------------------------------------
+
+
+def test_producer_exception_propagates_with_original_traceback():
+    def poisoned_source():
+        yield 1
+        yield 2
+        raise ValueError("poison chunk #3")
+
+    pf = pipeline.maybe_prefetch(poisoned_source(), depth=2)
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(ValueError, match="poison chunk #3") as ei:
+        next(pf)
+    # the producer-side frame must be visible in the traceback
+    frames = traceback.extract_tb(ei.value.__traceback__)
+    assert any(f.name == "poisoned_source" for f in frames)
+
+
+def test_bounded_queue_depth_under_slow_consumer():
+    produced = []
+
+    def src():
+        for i in range(40):
+            produced.append(i)
+            yield i
+
+    depth = 2
+    pf = pipeline.maybe_prefetch(src(), depth=depth)
+    got = []
+    try:
+        for x in pf:
+            time.sleep(0.003)  # slow consumer: the producer must NOT run away
+            got.append(x)
+            # queue(depth) + one handed to consumer + one mid-produce
+            assert len(produced) <= len(got) + depth + 2
+    finally:
+        pf.close()
+    assert got == list(range(40))
+
+
+def test_serial_mode_is_threadless_passthrough():
+    it = pipeline.maybe_prefetch(iter([1, 2, 3]), depth=0)
+    assert isinstance(it, pipeline._SerialChunks)
+    assert list(it) == [1, 2, 3]
+    it.close()  # no-op, must not raise
+
+
+def test_abandoned_consumer_stops_producer():
+    def src():
+        for i in range(10_000):
+            yield i
+
+    pf = pipeline.maybe_prefetch(src(), depth=2)
+    assert next(pf) == 0
+    pf.close()  # consumer walks away mid-stream
+    deadline = time.time() + 5
+    while pf._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not pf._thread.is_alive(), "producer thread must terminate"
+
+
+def test_poison_chunk_fault_injection_no_deadlock():
+    """`stream.chunk=error` fires inside the producer thread; the consumer
+    must see InjectedFaultError promptly — the bounded queue never hangs."""
+    pdf = _frame(10_000)
+    e = _engine(2, **{FUGUE_TPU_CONF_FAULT_PLAN: "stream.chunk=error@1"})
+    try:
+        t0 = time.time()
+        with pytest.raises(InjectedFaultError, match="stream.chunk"):
+            e.aggregate(_stream(pdf), PartitionSpec(by=["k"]), AGGS)
+        assert time.time() - t0 < 30  # raised, not hung
+    finally:
+        e.stop_engine()
+    # same engine conf minus the plan: the stream works fine
+    e2 = _engine(2)
+    try:
+        res = e2.aggregate(_stream(pdf), PartitionSpec(by=["k"]), AGGS)
+        assert res.as_pandas()["n"].sum() == len(pdf)
+    finally:
+        e2.stop_engine()
+
+
+# --------------------------------------------------------------------------
+# observability: pipeline_stats + jit cache counters
+# --------------------------------------------------------------------------
+
+
+def test_pipeline_stats_measures_overlap():
+    stats = pipeline.PipelineStats()
+
+    def slow_src():
+        for i in range(20):
+            time.sleep(0.004)  # host decode stand-in
+            yield i
+
+    pf = pipeline.maybe_prefetch(slow_src(), depth=2, stats=stats, verb="x")
+    try:
+        for _ in pf:
+            time.sleep(0.004)  # device compute stand-in
+    finally:
+        pf.close()
+    run = stats.last_run
+    assert run["verb"] == "x"
+    assert run["chunks_prefetched"] == 20
+    assert run["producer_busy_s"] > 0
+    # both sides busy ~80ms each, wall ≪ 160ms serial → real overlap
+    assert 0.0 < run["overlap_fraction"] <= 1.0
+    total = stats.as_dict()
+    assert total["runs"] == 1
+    assert total["chunks_prefetched"] == 20
+    assert total["last_run"]["verb"] == "x"
+
+
+def test_jit_cache_hit_miss_counters():
+    pdf = _frame(8_192, groups=32)
+    e = _engine(2)
+    try:
+        spec = PartitionSpec(by=["k"])
+        e.aggregate(_stream(pdf, 4), spec, AGGS)
+        s1 = e.jit_cache_stats
+        assert s1["misses"] >= 1 and s1["entries"] >= 1
+        e.aggregate(_stream(pdf, 4), spec, AGGS)
+        s2 = e.jit_cache_stats
+        assert s2["hits"] > s1["hits"]  # second run reuses the compiled step
+        assert s2["entries"] == s1["entries"]
+    finally:
+        e.stop_engine()
+
+
+# --------------------------------------------------------------------------
+# pipelined bulk to_df ingest
+# --------------------------------------------------------------------------
+
+
+def test_pipelined_ingest_round_trip_identical():
+    n = 1_500_000  # > the 8MB pipeline threshold
+    rng = np.random.default_rng(11)
+    v = rng.random(n)
+    v[:100] = np.nan
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 1000, n),
+            "v": v,
+            "s": pd.array(
+                np.where(rng.random(n) < 0.5, "alpha", "beta"), dtype=object
+            ),
+        }
+    )
+    tables = {}
+    for depth in (0, 3):
+        e = _engine(depth)
+        try:
+            jdf = e.to_df(PandasDataFrame(pdf, "k:long,v:double,s:str"))
+            assert len(jdf.device_cols) == 3  # forces (pipelined) ingest
+            tables[depth] = jdf.as_arrow()
+            if depth > 0:
+                run = e.pipeline_stats.last_run
+                assert run["verb"] == "ingest"
+                assert run["chunks_prefetched"] == 3  # one per column
+        finally:
+            e.stop_engine()
+    assert tables[0].schema == tables[3].schema
+    assert tables[0].equals(tables[3])
