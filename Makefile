@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs trace-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -30,6 +30,19 @@ test-multihost:
 # not marked slow — tier-1 runs it too; this target is the focused loop
 test-resilience:
 	JAX_PLATFORMS=cpu python -m pytest tests/core/test_resilience.py -q -m "not slow"
+
+# observability suite (docs/observability.md): span-tree shape, Chrome
+# trace export, disabled-path overhead guard, fork-boundary round trip
+test-obs:
+	JAX_PLATFORMS=cpu python -m pytest tests/obs -q -m "not slow"
+
+# end-to-end trace proof: run the traced smoke workflow, then assert the
+# exported file is valid Chrome trace-event JSON (Perfetto-loadable)
+trace-smoke:
+	python bench.py --smoke --trace /tmp/fugue_trace_smoke
+	python -c "from fugue_tpu.obs import validate_chrome_trace; \
+	  s = validate_chrome_trace('/tmp/fugue_trace_smoke/trace.json'); \
+	  print('trace OK:', s['spans'], 'spans,', s['events'], 'events')"
 
 bench:
 	python bench.py

@@ -1028,6 +1028,77 @@ def _smoke() -> None:
         raise SystemExit(4)
 
 
+def _trace_smoke(trace_dir: str) -> None:
+    """``bench.py --smoke --trace <dir>``: run one small traced streaming
+    workflow (workflow task → engine verb → streaming chunks) and emit a
+    Chrome-trace-event JSON that Perfetto/about:tracing loads, next to the
+    bench output. Runs BEFORE the perf gate with the tracer scoped to this
+    function, so the gate's timings stay untraced."""
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_STREAM_CHUNK_ROWS
+    from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.obs import get_tracer, validate_chrome_trace, write_chrome_trace
+
+    rng = np.random.default_rng(7)
+    n = 40_000
+    tbl = pa.Table.from_pandas(
+        pd.DataFrame({"k": rng.integers(0, 128, n), "v": rng.random(n)}),
+        preserve_index=False,
+    )
+    step = 4096
+    stream = LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enable()
+    tracer.clear()
+    try:
+        eng = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: step})
+        dag = FugueWorkflow()
+        res = (
+            dag.df(stream)
+            .partition_by("k")
+            .aggregate(
+                ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n")
+            )
+        )
+        res.yield_dataframe_as("r", as_local=True)
+        dag.run(eng)
+        assert len(dag.yields["r"].result.as_pandas()) == 128
+        records = tracer.records()
+        path = write_chrome_trace(os.path.join(trace_dir, "trace.json"), records)
+        summary = validate_chrome_trace(path)
+        names = set(summary["names"])
+        # the contract: nested workflow task → engine verb → streaming chunk
+        assert "workflow.task" in names and "stream.chunk" in names, names
+        assert any(nm.startswith("engine.") for nm in names), names
+        print(
+            json.dumps(
+                {
+                    "trace": path,
+                    "events": summary["events"],
+                    "spans": summary["spans"],
+                    "span_names": summary["names"],
+                }
+            )
+        )
+    finally:
+        if not was_enabled:
+            tracer.disable()
+        tracer.clear()
+
+
 def main(strict_tpu: bool = False) -> None:
     if not strict_tpu:
         # foreground run: silence the capture daemon's probe subprocesses
@@ -1115,15 +1186,22 @@ def _main_impl(strict_tpu: bool = False) -> None:
         UDF_ROWS,
     )
     eng = JaxExecutionEngine()
+    # per-case stat deltas (ISSUE 3): snapshot the unified registry before
+    # each in-process case instead of reading cumulative values at the end
+    per_case_stats: dict = {}
+    _snap = eng.metrics.snapshot()
     jax_udf_rps = _best_rps(
         lambda: fa.transform(
             udf_pdf, demean, schema="*", partition=spec, engine=eng
         ),
         UDF_ROWS,
     )
+    per_case_stats["transform_udf"] = eng.metrics.delta(_snap)
 
     # ---- config #2: FugueSQL SELECT+TRANSFORM pipeline over parquet -------
+    _snap = eng.metrics.snapshot()
     sql_jax_rps, sql_host_rps = _bench_sql_pipeline(_best_rps, host, eng)
+    per_case_stats["sql_pipeline"] = eng.metrics.delta(_snap)
 
     # ---- config #4: batch inference (compiled mesh BERT vs numpy oracle) --
     # best-of-3: the margin at honest BERT shapes is thin on 1 CPU core
@@ -1240,9 +1318,12 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     "agg_burst_wall_s": round(agg["wall"], 3),
                     "compiled_burst_wall_s": round(compiled["wall"], 3),
                     # ingest pipeline + compile cache observability for the
-                    # in-process engine (udf + sql configs ran on it)
+                    # in-process engine (udf + sql configs ran on it);
+                    # cumulative via the legacy shims + per-case deltas
+                    # from the unified registry (engine.metrics)
                     "pipeline_stats": eng.pipeline_stats.as_dict(),
                     "jit_cache": eng.jit_cache_stats,
+                    "per_case_stats": per_case_stats,
                     "dense_sum_backend_ab": ab,
                     "roofline": roofline,
                     # most recent `bench.py --north-star` run (the literal
@@ -1300,6 +1381,18 @@ def _main_impl(strict_tpu: bool = False) -> None:
 
 
 if __name__ == "__main__":
+    # --trace <dir>: emit a Chrome trace-event JSON next to the bench JSON
+    # (with --smoke: a dedicated small traced workflow; with the full
+    # bench: the whole in-process run is traced)
+    TRACE_DIR: Optional[str] = None
+    if "--trace" in sys.argv:
+        _ti = sys.argv.index("--trace")
+        if _ti + 1 >= len(sys.argv):
+            print("--trace requires a directory argument", file=sys.stderr)
+            raise SystemExit(2)
+        TRACE_DIR = sys.argv[_ti + 1]
+        del sys.argv[_ti : _ti + 2]
+        os.makedirs(TRACE_DIR, exist_ok=True)
     if len(sys.argv) > 1 and sys.argv[1].startswith("--worker="):
         if os.environ.get("FUGUE_TPU_FORCE_CPU") == "1":
             _force_cpu_mesh()
@@ -1314,6 +1407,10 @@ if __name__ == "__main__":
         main(strict_tpu=True)
     elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         with _bench_lock():
+            # trace first: the artifact must exist even if the perf gate
+            # then fails, and the gate's timings stay untraced
+            if TRACE_DIR is not None:
+                _trace_smoke(TRACE_DIR)
             _smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "--north-star":
         with _bench_lock():
@@ -1326,4 +1423,17 @@ if __name__ == "__main__":
         print(json.dumps({"tpu_reachable": up}))
         raise SystemExit(0 if up else 3)
     else:
-        main()
+        if TRACE_DIR is not None:
+            from fugue_tpu.obs import get_tracer, write_chrome_trace
+
+            get_tracer().enable()
+            try:
+                main()
+            finally:
+                path = write_chrome_trace(
+                    os.path.join(TRACE_DIR, "trace.json"),
+                    get_tracer().records(),
+                )
+                print(json.dumps({"trace": path}), file=sys.stderr)
+        else:
+            main()

@@ -72,6 +72,22 @@ FUGUE_TPU_CONF_FAULT_PLAN = "fugue.tpu.fault.plan"
 FUGUE_RPC_CONF_HTTP_CONNECT_TIMEOUT = "fugue.rpc.http_client.connect_timeout"
 FUGUE_RPC_CONF_HTTP_READ_TIMEOUT = "fugue.rpc.http_client.read_timeout"
 
+# --- observability (see fugue_tpu/obs and docs/observability.md) ---
+# master switch for the hierarchical span tracer (workflow task → engine
+# verb → streaming chunk / map worker attempt); the FUGUE_TPU_TRACE env
+# var overrides this in both directions. Disabled costs ~an attribute
+# check per instrumented site.
+FUGUE_TPU_CONF_TRACE_ENABLED = "fugue.tpu.trace.enabled"
+# mirror host spans into the XLA timeline via jax.profiler.TraceAnnotation
+# so device and host spans line up in a Perfetto capture (default True;
+# only active while tracing is enabled)
+FUGUE_TPU_CONF_TRACE_XLA = "fugue.tpu.trace.xla"
+# directory to auto-export a Chrome trace-event JSON into after every
+# workflow run (one file per run); empty/unset = no auto-export
+FUGUE_TPU_CONF_TRACE_DIR = "fugue.tpu.trace.dir"
+# span buffer cap; past it new spans are dropped (and counted as dropped)
+FUGUE_TPU_CONF_TRACE_MAX_SPANS = "fugue.tpu.trace.max_spans"
+
 # streaming (out-of-core) execution: rows per host->device chunk; the
 # device working set is O(chunk_rows x columns), NOT O(dataset)
 FUGUE_TPU_CONF_STREAM_CHUNK_ROWS = "fugue.tpu.stream.chunk_rows"

@@ -181,6 +181,12 @@ class ExecutionEngine(FugueEngineBase):
         self._compile_conf = ParamDict()
         self._rpc_server: Any = None
         self._resilience_stats: Any = None
+        self._metrics: Any = None
+        # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
+        # constructing an engine with tracing conf turns the tracer on
+        from ..obs import configure_from_conf
+
+        configure_from_conf(self._conf)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}"
@@ -309,12 +315,48 @@ class ExecutionEngine(FugueEngineBase):
     def set_rpc_server(self, server: Any) -> None:
         self._rpc_server = server
 
-    # ---- resilience observability -----------------------------------------
+    # ---- observability ----------------------------------------------------
+    @property
+    def metrics(self) -> Any:
+        """The engine's :class:`~fugue_tpu.obs.MetricsRegistry` — one
+        surface over every stats object (resilience on all engines;
+        pipeline + jit_cache on the jax engine). The legacy
+        ``engine.*_stats`` attributes delegate to the same objects."""
+        if self._metrics is None:
+            from ..obs import MetricsRegistry
+
+            reg = MetricsRegistry()
+            reg.register("resilience", lambda: self.resilience_stats)
+            self._metrics = reg
+        return self._metrics
+
+    def stats(self) -> Dict[str, Any]:
+        """All registered stats as one dict — the unified replacement for
+        reading ``pipeline_stats`` / ``jit_cache_stats`` /
+        ``resilience_stats`` separately."""
+        return self.metrics.as_dict()
+
+    def reset_stats(self) -> None:
+        """Reset every registered stats source (consistent semantics:
+        counters to zero; the jit cache keeps its compiled entries but
+        zeroes its hit/miss counters)."""
+        self.metrics.reset()
+
+    def report(self, top_n: int = 15) -> str:
+        """Plain-text observability report: top-N spans by total wall from
+        the global tracer, plus this engine's metrics."""
+        from ..obs import get_tracer, render_report
+
+        return render_report(get_tracer().records(), self.stats(), top_n=top_n)
+
     @property
     def resilience_stats(self) -> Any:
         """Structured recovery counters (``fugue_tpu.resilience``): every
         retry, quarantine and fallback on this engine increments one — the
-        graceful-degradation machinery is observable, never silent."""
+        graceful-degradation machinery is observable, never silent.
+
+        Kept as a stable alias of ``engine.metrics.get("resilience")`` —
+        prefer ``engine.stats()["resilience"]`` for reads."""
         if self._resilience_stats is None:
             from ..resilience import ResilienceStats
 

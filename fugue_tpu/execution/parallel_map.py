@@ -43,6 +43,7 @@ Not engaged when:
 """
 
 import multiprocessing as mp
+import os
 import threading
 import time
 import warnings
@@ -144,35 +145,79 @@ def _exec_partition(
     return to_tbl(res, output_schema)
 
 
-def _run_chunk(part_ids: Any) -> List[bytes]:
+def _run_chunk(part_ids: Any) -> Dict[str, Any]:
     """Worker body: run the inherited UDF over a contiguous partition range.
 
     Results serialize as arrow IPC streams — pyarrow tables cross process
-    boundaries far cheaper than pickled pandas frames.
+    boundaries far cheaper than pickled pandas frames. The return payload
+    also carries the worker's OBSERVABILITY delta across the fork
+    boundary: per-chunk resilience counters and any trace spans recorded
+    while the chunk ran (a forked child's in-memory increments are
+    otherwise invisible to the driver). Failed/killed chunks can't ship a
+    delta — by design the payload rides the success path only.
     """
+    from ..obs import get_tracer
+
     st = _FORK_STATE
     injector: FaultInjector = st.get("injector", NULL_INJECTOR)
-    # fault-injection site: a `kill` here SIGKILLs this worker mid-chunk,
-    # exactly the OOM-killer scenario the supervisor must recover from
-    injector.fire(SITE_MAP_CHUNK)
+    tracer = get_tracer()
+    mark = tracer.mark()
+    counters: Dict[str, int] = {"map.worker_chunks": 1}
+    rows_out = 0
     out: List[bytes] = []
-    for no in part_ids:
-        tbl = _exec_partition(
-            no,
-            st["pdf"],
-            st["groups"],
-            st["map_func"],
-            st["cursor"],
-            st["schema"],
-            st["output_schema"],
-            st["wrap_df"],
-            st["to_arrow"],
-        )
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, tbl.schema) as w:
-            w.write_table(tbl)
-        out.append(sink.getvalue().to_pybytes())
-    return out
+    with tracer.span(
+        "map.worker_chunk",
+        cat="worker",
+        parent=st.get("trace_parent"),
+        worker_pid=os.getpid(),
+        partitions=len(part_ids),
+    ) as chunk_sp:
+        # fault-injection site: a `kill` here SIGKILLs this worker
+        # mid-chunk, exactly the OOM-killer scenario the supervisor must
+        # recover from
+        injector.fire(SITE_MAP_CHUNK)
+        for no in part_ids:
+            with tracer.span("map.partition", cat="worker", partition=no) as sp:
+                tbl = _exec_partition(
+                    no,
+                    st["pdf"],
+                    st["groups"],
+                    st["map_func"],
+                    st["cursor"],
+                    st["schema"],
+                    st["output_schema"],
+                    st["wrap_df"],
+                    st["to_arrow"],
+                )
+                sp.set(rows_out=tbl.num_rows)
+            counters["map.worker_partitions"] = (
+                counters.get("map.worker_partitions", 0) + 1
+            )
+            rows_out += tbl.num_rows
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, tbl.schema) as w:
+                w.write_table(tbl)
+            out.append(sink.getvalue().to_pybytes())
+        chunk_sp.set(rows_out=rows_out)
+    counters["map.worker_rows_out"] = rows_out
+    return {"blobs": out, "counters": counters, "spans": tracer.take_since(mark)}
+
+
+def _harvest_chunk(payload: Any, stats: ResilienceStats) -> List[pa.Table]:
+    """Driver side of the fork-boundary protocol: merge the worker's
+    counter delta into the driver registry, ingest its spans into the
+    global tracer, and decode the arrow blobs."""
+    if isinstance(payload, dict):
+        stats.merge(payload.get("counters", {}))
+        spans = payload.get("spans")
+        if spans:
+            from ..obs import get_tracer
+
+            get_tracer().ingest(spans)
+        blobs = payload["blobs"]
+    else:  # defensive: pre-ISSUE-3 plain-list payload
+        blobs = payload
+    return [_decode_blob(b) for b in blobs]
 
 
 def _decode_blob(blob: bytes) -> pa.Table:
@@ -257,12 +302,26 @@ def run_partitions_forked(
             for no in part_ids
         ]
 
+    from ..obs import get_tracer
+
+    tracer = get_tracer()
     # a single chunk gains nothing from a one-worker pool — skip the ~100ms
     # fork/teardown entirely and run in-driver
     if len(chunks) <= 1:
-        return _serial(chunks[0]) if chunks else []
+        if not chunks:
+            return []
+        with tracer.span(
+            "map.serial", cat="engine", partitions=len(groups)
+        ):
+            return _serial(chunks[0])
 
-    with _FORK_LOCK:
+    with _FORK_LOCK, tracer.span(
+        "map.parallel",
+        cat="engine",
+        chunks=len(chunks),
+        workers=n_workers,
+        partitions=len(groups),
+    ):
         _FORK_STATE.clear()
         _FORK_STATE.update(
             pdf=pdf,
@@ -274,6 +333,9 @@ def run_partitions_forked(
             wrap_df=wrap_df,
             to_arrow=to_arrow,
             injector=injector,
+            # children inherit this by fork: worker spans parent onto the
+            # driver's map.parallel span so the tree stays connected
+            trace_parent=tracer.current_span_id(),
         )
         try:
             with _quiet_fork_warnings():
@@ -388,7 +450,7 @@ def _supervise(
                         del inflight[ci]
                         progressed = True
                         try:
-                            results[ci] = [_decode_blob(b) for b in ar.get()]
+                            results[ci] = _harvest_chunk(ar.get(), stats)
                             stats.inc("map.chunks_ok")
                         except Exception as ex:
                             fail(ci, ex)
@@ -420,9 +482,7 @@ def _supervise(
                         ar, _ = inflight.pop(ci)
                         if ar.ready():
                             try:
-                                results[ci] = [
-                                    _decode_blob(b) for b in ar.get()
-                                ]
+                                results[ci] = _harvest_chunk(ar.get(), stats)
                                 stats.inc("map.chunks_ok")
                             except Exception as ex:
                                 fail(ci, ex)

@@ -54,6 +54,7 @@ from ..parallel.mesh import (
 )
 from ..schema import Schema
 from .dataframe import JaxDataFrame, _DEVICE_DTYPES
+from ..obs import traced_verb
 from .._utils.jax_compat import shard_map
 
 
@@ -83,6 +84,7 @@ class JaxMapEngine(MapEngine):
     def execution_engine_constraint(self) -> type:
         return JaxExecutionEngine
 
+    @traced_verb("engine.transform")
     def map_dataframe(
         self,
         df: DataFrame,
@@ -639,6 +641,11 @@ class JaxExecutionEngine(ExecutionEngine):
 
         self._jit_cache: JitCache = JitCache()
         self._pipeline_stats = PipelineStats()
+        # unified observability surface (ISSUE 3): every stats object this
+        # engine owns lives in ONE registry behind engine.stats() /
+        # engine.reset_stats(); the legacy attributes below stay as shims
+        self.metrics.register("pipeline", lambda: self._pipeline_stats)
+        self.metrics.register("jit_cache", lambda: self._jit_cache)
 
     @property
     def mesh(self) -> Any:
@@ -648,12 +655,16 @@ class JaxExecutionEngine(ExecutionEngine):
     def pipeline_stats(self) -> Any:
         """Ingest-pipeline observability (``fugue_tpu/jax/pipeline.py``):
         chunks prefetched, producer-wait vs consumer-wait seconds, and the
-        measured overlap fraction, cumulative plus last run."""
+        measured overlap fraction, cumulative plus last run.
+
+        Shim over ``engine.metrics`` — prefer ``engine.stats()["pipeline"]``."""
         return self._pipeline_stats
 
     @property
     def jit_cache_stats(self) -> Dict[str, int]:
-        """Compile-cache hit/miss/entry counters for this engine."""
+        """Compile-cache hit/miss/entry counters for this engine.
+
+        Shim over ``engine.metrics`` — prefer ``engine.stats()["jit_cache"]``."""
         return self._jit_cache.stats()
 
     @property
@@ -678,6 +689,7 @@ class JaxExecutionEngine(ExecutionEngine):
     def get_current_parallelism(self) -> int:
         return num_row_shards(self._mesh)
 
+    @traced_verb("engine.to_df")
     def to_df(self, df: Any, schema: Any = None) -> DataFrame:
         if isinstance(df, JaxDataFrame):
             if schema is not None and df.schema != Schema(schema):
@@ -705,6 +717,7 @@ class JaxExecutionEngine(ExecutionEngine):
         return res
 
     # ---- distribution primitives ------------------------------------------
+    @traced_verb("engine.repartition")
     def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
         """Physically move rows between shards with an all-to-all exchange.
 
@@ -795,6 +808,7 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    @traced_verb("engine.broadcast")
     def broadcast(self, df: DataFrame) -> DataFrame:
         import jax
 
@@ -820,6 +834,7 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    @traced_verb("engine.persist")
     def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
         import jax
 
@@ -832,6 +847,7 @@ class JaxExecutionEngine(ExecutionEngine):
         return jdf
 
     # ---- relational ops ----------------------------------------------------
+    @traced_verb("engine.filter")
     def filter(self, df: DataFrame, condition: Any, _plan: Any = None) -> DataFrame:
         """Device filter: the condition becomes a validity mask — no rows
         move, downstream device ops and host conversion honor the mask.
@@ -919,6 +935,7 @@ class JaxExecutionEngine(ExecutionEngine):
     def _back(self, df: DataFrame) -> DataFrame:
         return self.to_df(df)
 
+    @traced_verb("engine.join")
     def join(self, df1, df2, how: str, on=None) -> DataFrame:
         """Hash joins run on device (``ops/join.py``): inner / left_outer /
         left_semi / left_anti, multi-key, unique OR duplicate right keys
@@ -1933,6 +1950,7 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    @traced_verb("engine.union")
     def union(self, df1, df2, distinct: bool = True) -> DataFrame:
         """Device union: per-shard concatenation of both frames' blocks in
         one ``shard_map``. Dictionary columns unify into one (re-sorted)
@@ -2097,6 +2115,7 @@ class JaxExecutionEngine(ExecutionEngine):
             and len(j.device_cols) > 0
         )
 
+    @traced_verb("engine.subtract")
     def subtract(self, df1, df2, distinct: bool = True) -> DataFrame:
         """``distinct=True`` lowers to a device ANTI join of the two
         distinct frames on ALL columns (the deduped right side satisfies
@@ -2112,6 +2131,7 @@ class JaxExecutionEngine(ExecutionEngine):
             self._host_engine.subtract(self._host(df1), self._host(df2), distinct=distinct)
         )
 
+    @traced_verb("engine.intersect")
     def intersect(self, df1, df2, distinct: bool = True) -> DataFrame:
         """``distinct=True`` lowers to a device SEMI join of the two
         distinct frames on ALL columns."""
@@ -2200,6 +2220,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 res[c] = arr.to_pandas()
         return res
 
+    @traced_verb("engine.distinct")
     def distinct(self, df: DataFrame) -> DataFrame:
         """Device distinct when every column is device-resident: the groupby
         kernel with a presence count — keys of the merged partials are the
@@ -2247,6 +2268,7 @@ class JaxExecutionEngine(ExecutionEngine):
             return self.to_df(PandasDataFrame(res[jdf.schema.names], jdf.schema))
         return self._back(self._host_engine.distinct(self._host(df)))
 
+    @traced_verb("engine.dropna")
     def dropna(self, df, how="any", thresh=None, subset=None) -> DataFrame:
         """All-device frames: NULL = NaN float, null-masked cell, or
         negative dictionary code — drop by extending the validity mask,
@@ -2318,6 +2340,7 @@ class JaxExecutionEngine(ExecutionEngine):
             self._host_engine.dropna(self._host(df), how=how, thresh=thresh, subset=subset)
         )
 
+    @traced_verb("engine.fillna")
     def fillna(self, df, value, subset=None) -> DataFrame:
         """All-device frames: fill NaN floats and null-masked numeric cells
         on device (filled masks clear). Fills targeting dictionary/datetime
@@ -2396,6 +2419,7 @@ class JaxExecutionEngine(ExecutionEngine):
             )
         return self._back(self._host_engine.fillna(self._host(df), value, subset=subset))
 
+    @traced_verb("engine.sample")
     def sample(self, df, n=None, frac=None, replace=False, seed=None) -> DataFrame:
         """frac-sampling on device: a Bernoulli mask ANDed into validity —
         zero data movement (n-sampling and replacement go host-side)."""
@@ -2444,6 +2468,7 @@ class JaxExecutionEngine(ExecutionEngine):
             self._host_engine.sample(self._host(df), n=n, frac=frac, replace=replace, seed=seed)
         )
 
+    @traced_verb("engine.take")
     def take(self, df, n, presort, na_position="last", partition_spec=None) -> DataFrame:
         """Global top-n by any number of device sort keys: per-shard
         lexicographic ``lax.sort`` takes each shard's first k rows, then an
@@ -2623,6 +2648,7 @@ class JaxExecutionEngine(ExecutionEngine):
         return df.as_local() if as_local else df
 
     # ---- compiled derived ops ---------------------------------------------
+    @traced_verb("engine.select")
     def select(
         self,
         df: DataFrame,
@@ -3018,6 +3044,7 @@ class JaxExecutionEngine(ExecutionEngine):
 
         return fin
 
+    @traced_verb("engine.aggregate")
     def aggregate(
         self,
         df: DataFrame,

@@ -206,6 +206,17 @@ class JitCache(dict):
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses, "entries": len(self)}
 
+    # MetricsRegistry source contract (see fugue_tpu/obs/registry.py)
+    def as_dict(self) -> Dict[str, int]:
+        return self.stats()
+
+    def reset(self) -> None:
+        """Zero the hit/miss counters. Compiled entries are KEPT — evicting
+        them would force recompiles, turning a stats reset into a perf
+        event; ``entries`` therefore survives a reset by design."""
+        self.hits = 0
+        self.misses = 0
+
 
 class _SerialChunks:
     """depth<=0 path: the same iterator/close() surface, no thread — the
@@ -375,16 +386,94 @@ def maybe_prefetch(
     return ChunkPrefetcher(iter(source), depth, stats=stats, verb=verb, injector=injector)
 
 
+class _TracedChunks:
+    """Per-chunk span wrapper over a (possibly prefetched) chunk iterator.
+
+    Span ``stream.chunk`` #n opens when chunk n is handed to the consumer
+    and closes when the consumer asks for chunk n+1 (or closes the
+    stream) — i.e. it measures the DOWNSTREAM processing of that chunk,
+    nested inside whatever engine-verb span is open on the consuming
+    thread. ``fetch_wait_ns`` records how long the consumer waited for the
+    chunk itself (producer-bound time). Spans also enter an XLA
+    ``TraceAnnotation`` so per-chunk device work lines up in a Perfetto
+    capture.
+    """
+
+    def __init__(self, inner: Any, verb: str, tracer: Any):
+        self._inner = inner
+        self._verb = verb
+        self._tracer = tracer
+        self._open: Any = None
+        self._i = 0
+
+    def __iter__(self) -> "_TracedChunks":
+        return self
+
+    def _end_open(self) -> None:
+        if self._open is not None:
+            self._open.__exit__(None, None, None)
+            self._open = None
+
+    def __next__(self) -> Any:
+        self._end_open()
+        t0 = time.perf_counter_ns()
+        item = next(self._inner)
+        sp = self._tracer.span(
+            "stream.chunk",
+            cat="stream",
+            annotate=True,
+            verb=self._verb,
+            chunk=self._i,
+            fetch_wait_ns=time.perf_counter_ns() - t0,
+            **_chunk_attrs(item),
+        )
+        sp.__enter__()
+        self._open = sp
+        self._i += 1
+        return item
+
+    def close(self) -> None:
+        self._end_open()
+        self._inner.close()
+
+
+def _chunk_attrs(item: Any) -> Dict[str, Any]:
+    """Cheap rows/bytes attributes for a chunk of any streaming shape
+    (pandas frame, arrow table, (n, device) tuple, LocalDataFrame)."""
+    try:
+        if isinstance(item, tuple) and len(item) > 0 and isinstance(item[0], int):
+            return {"rows": item[0]}
+        num_rows = getattr(item, "num_rows", None)  # pyarrow.Table
+        if isinstance(num_rows, int):
+            return {"rows": num_rows, "bytes": int(getattr(item, "nbytes", 0))}
+        if hasattr(item, "memory_usage") and hasattr(item, "__len__"):  # pandas
+            return {
+                "rows": len(item),
+                "bytes": int(item.memory_usage(index=False).sum()),
+            }
+        if hasattr(item, "count") and hasattr(item, "schema"):  # LocalDataFrame
+            return {"rows": int(item.count())}
+    except Exception:
+        pass
+    return {}
+
+
 def engine_prefetcher(
     engine: Any, source: Iterator[Any], verb: str
 ) -> Any:
-    """The streaming paths' one-liner: depth/stats/injector from ``engine``."""
+    """The streaming paths' one-liner: depth/stats/injector from ``engine``,
+    plus per-chunk trace spans when the global tracer is enabled."""
+    from ..obs import get_tracer
     from ..resilience import FaultInjector
 
-    return maybe_prefetch(
+    it = maybe_prefetch(
         source,
         prefetch_depth(engine.conf),
         stats=getattr(engine, "pipeline_stats", None),
         verb=verb,
         injector=FaultInjector.from_conf(engine.conf),
     )
+    tracer = get_tracer()
+    if tracer.enabled:
+        return _TracedChunks(it, verb, tracer)
+    return it

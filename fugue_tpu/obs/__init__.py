@@ -1,0 +1,42 @@
+"""Unified observability: hierarchical span tracing + one metrics registry.
+
+See ``docs/observability.md``. Quick start::
+
+    from fugue_tpu.obs import get_tracer
+    from fugue_tpu.obs.export import write_chrome_trace
+
+    get_tracer().enable()          # or conf fugue.tpu.trace.enabled=True
+    ...run workflows...
+    write_chrome_trace("/tmp/trace.json")   # load in Perfetto
+    print(engine.report())                  # top-N text report
+    engine.stats()                          # every registry as one dict
+    engine.reset_stats()                    # consistent reset across all
+"""
+
+from .export import (
+    render_report,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from .registry import MetricsRegistry
+from .tracer import (
+    NULL_SPAN,
+    Tracer,
+    configure_from_conf,
+    get_tracer,
+    traced_verb,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "Tracer",
+    "configure_from_conf",
+    "get_tracer",
+    "render_report",
+    "to_chrome_trace",
+    "traced_verb",
+    "validate_chrome_trace",
+    "write_chrome_trace",
+]

@@ -1,0 +1,165 @@
+"""Trace exporters: Chrome trace-event JSON (Perfetto / about:tracing) and
+a plain-text top-N report.
+
+The Chrome format is the `trace event format`_ "JSON object" flavor: a
+``{"traceEvents": [...]}`` envelope of complete (``"ph": "X"``) events
+with microsecond ``ts``/``dur``. Perfetto and chrome://tracing both load
+it directly; ``validate_chrome_trace`` is the CI gate (``make
+trace-smoke``) asserting an exported file actually parses as that shape.
+
+.. _trace event format:
+   https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+"""
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "validate_chrome_trace",
+    "render_report",
+]
+
+
+def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Convert tracer records (ns timestamps) to a Chrome trace-event dict."""
+    events: List[Dict[str, Any]] = []
+    pids = set()
+    for r in records:
+        pids.add(r["pid"])
+        events.append(
+            {
+                "name": r["name"],
+                "cat": r.get("cat", "host"),
+                "ph": "X",
+                "ts": r["ts"] / 1000.0,  # ns → µs
+                "dur": max(r["dur"], 0) / 1000.0,
+                "pid": r["pid"],
+                "tid": r.get("tid", 1),
+                "args": _jsonable(r.get("args", {})),
+            }
+        )
+    # metadata events name the process tracks (driver vs forked workers)
+    first = min(pids) if pids else None
+    for pid in sorted(pids):
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {
+                    "name": "fugue-tpu driver" if pid == first else f"fugue-tpu worker {pid}"
+                },
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _jsonable(args: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in args.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out
+
+
+def write_chrome_trace(
+    path: str, records: Optional[Iterable[Dict[str, Any]]] = None
+) -> str:
+    """Write the (or the global tracer's) records as Chrome trace JSON."""
+    if records is None:
+        from .tracer import get_tracer
+
+        records = get_tracer().records()
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(records), f)
+    return path
+
+
+def validate_chrome_trace(path: str) -> Dict[str, Any]:
+    """Assert ``path`` is valid trace-event JSON; returns summary counts.
+
+    Checks the envelope, the per-event required keys, and that durations/
+    timestamps are non-negative numbers — the properties Perfetto needs to
+    render the file at all.
+    """
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc, dict) and "traceEvents" in doc, (
+        f"{path}: expected a traceEvents envelope"
+    )
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and len(events) > 0, f"{path}: no events"
+    n_spans = 0
+    names = set()
+    for ev in events:
+        assert isinstance(ev, dict) and "ph" in ev and "name" in ev, ev
+        assert "pid" in ev, ev
+        if ev["ph"] == "X":
+            n_spans += 1
+            names.add(ev["name"])
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
+            assert "tid" in ev, ev
+    assert n_spans > 0, f"{path}: no complete ('X') span events"
+    return {"events": len(events), "spans": n_spans, "names": sorted(names)}
+
+
+def render_report(
+    records: List[Dict[str, Any]],
+    stats: Optional[Dict[str, Any]] = None,
+    top_n: int = 15,
+) -> str:
+    """Plain-text top-N report: spans grouped by name with count / total /
+    self / mean / max wall, plus the metrics registry dump."""
+    by_id = {r["id"]: r for r in records}
+    child_time: Dict[str, int] = {}
+    for r in records:
+        p = r.get("parent")
+        if p is not None and p in by_id:
+            child_time[p] = child_time.get(p, 0) + r["dur"]
+    agg: Dict[str, Dict[str, float]] = {}
+    for r in records:
+        a = agg.setdefault(
+            r["name"], {"count": 0, "total": 0, "self": 0, "max": 0}
+        )
+        a["count"] += 1
+        a["total"] += r["dur"]
+        a["self"] += max(r["dur"] - child_time.get(r["id"], 0), 0)
+        a["max"] = max(a["max"], r["dur"])
+    lines = ["== span report (top %d by total wall) ==" % top_n]
+    if not agg:
+        lines.append("(no spans recorded — is tracing enabled?)")
+    else:
+        lines.append(
+            f"{'span':<28}{'count':>8}{'total_ms':>12}{'self_ms':>12}"
+            f"{'mean_ms':>10}{'max_ms':>10}"
+        )
+        ranked = sorted(agg.items(), key=lambda kv: -kv[1]["total"])[:top_n]
+        for name, a in ranked:
+            lines.append(
+                f"{name:<28}{int(a['count']):>8}"
+                f"{a['total'] / 1e6:>12.3f}{a['self'] / 1e6:>12.3f}"
+                f"{a['total'] / a['count'] / 1e6:>10.3f}{a['max'] / 1e6:>10.3f}"
+            )
+    if stats:
+        lines.append("")
+        lines.append("== metrics ==")
+        for group, vals in stats.items():
+            lines.append(f"[{group}]")
+            if isinstance(vals, dict):
+                for k, v in sorted(vals.items()):
+                    if isinstance(v, dict):
+                        lines.append(f"  {k}: {json.dumps(v, sort_keys=True)}")
+                    else:
+                        lines.append(f"  {k}: {v}")
+            else:
+                lines.append(f"  {vals}")
+    return "\n".join(lines)

@@ -1,0 +1,76 @@
+"""MetricsRegistry — one surface over every stats object an engine owns.
+
+Before ISSUE 3 the engine exposed three disconnected ad-hoc stat objects
+(``engine.pipeline_stats``, ``engine.jit_cache_stats``,
+``engine.resilience_stats``) with inconsistent lifecycles (the first two
+were per-engine cumulative with no reset; resilience had ``reset()`` but
+nothing called it). The registry absorbs them behind one contract:
+
+- every source exposes ``as_dict()`` and ``reset()``;
+- ``engine.stats()`` → ``registry.as_dict()`` (all sources, one dict);
+- ``engine.reset_stats()`` → ``registry.reset()`` (every source, one
+  consistent reset);
+- per-run deltas: ``before = registry.snapshot()`` … run …
+  ``registry.delta(before)`` — what ``bench.py`` now records per case
+  instead of cumulative values.
+
+Sources register lazily (name → object or zero-arg provider) so engines
+can register ``lambda: self.resilience_stats`` without forcing creation.
+"""
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Union
+
+__all__ = ["MetricsRegistry"]
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Union[Any, Callable[[], Any]]] = {}
+
+    def register(self, name: str, source: Any) -> None:
+        """Register a stats source: any object with ``as_dict()`` and
+        ``reset()``, or a zero-arg callable returning one (resolved at
+        every read, so lazily-created sources work)."""
+        with self._lock:
+            self._sources[name] = source
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._sources)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            src = self._sources[name]
+        return src() if callable(src) else src
+
+    def as_dict(self) -> Dict[str, Dict[str, Any]]:
+        return {name: self.get(name).as_dict() for name in self.names()}
+
+    def reset(self) -> None:
+        for name in self.names():
+            self.get(name).reset()
+
+    # -- per-run snapshots ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Deep copy of the current values — take one before a run."""
+        return copy.deepcopy(self.as_dict())
+
+    def delta(self, before: Dict[str, Any]) -> Dict[str, Any]:
+        """Numeric difference current − ``before`` (recursive over nested
+        dicts; non-numeric leaves report their current value)."""
+        return _delta(self.as_dict(), before)
+
+
+def _delta(cur: Any, before: Any) -> Any:
+    if isinstance(cur, dict):
+        b = before if isinstance(before, dict) else {}
+        return {k: _delta(v, b.get(k)) for k, v in cur.items()}
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return cur
+    if isinstance(before, (int, float)) and not isinstance(before, bool):
+        d = cur - before
+        return round(d, 6) if isinstance(d, float) else d
+    return cur

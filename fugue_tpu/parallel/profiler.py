@@ -14,6 +14,13 @@ Usage::
 
 Conf-driven: setting ``fugue.tpu.profile.dir`` on an engine makes
 ``profiled_engine_context`` trace everything inside the context.
+
+Pairs with the host-side span tracer (``fugue_tpu/obs``, see
+``docs/observability.md``): with ``fugue.tpu.trace.enabled`` on, every
+engine-verb and streaming-chunk span also enters a
+``jax.profiler.TraceAnnotation`` of the same name, so a capture taken
+inside :func:`profile` shows the host span names on the XLA device
+timeline — the two trace sources line up in Perfetto.
 """
 
 from contextlib import contextmanager

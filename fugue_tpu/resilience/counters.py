@@ -13,6 +13,10 @@ dotted, grouped by layer:
 - ``map.quarantined_partitions`` partitions inside quarantined chunks
 - ``map.serial_fallbacks``     quarantined chunks that then succeeded serially
 - ``map.pool_rebuilds``        fresh pools forked after a wave was lost
+- ``map.worker_chunks``        chunk bodies completed INSIDE fork workers
+  (shipped home as counter deltas with each chunk result)
+- ``map.worker_partitions``    partitions executed inside fork workers
+- ``map.worker_rows_out``      rows produced inside fork workers
 - ``workflow.task_retries``    task bodies re-run under the task retry policy
 - ``workflow.checkpoint_replays`` tasks served from a StrongCheckpoint
   instead of recomputing
@@ -41,6 +45,13 @@ class ResilienceStats:
     def get(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def merge(self, delta: Dict[str, int]) -> None:
+        """Fold a counter delta (e.g. one shipped home from a forked map
+        worker with its chunk result) into this registry."""
+        with self._lock:
+            for name, n in delta.items():
+                self._counters[name] = self._counters.get(name, 0) + int(n)
 
     def as_dict(self) -> Dict[str, int]:
         with self._lock:

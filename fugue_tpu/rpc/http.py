@@ -116,25 +116,32 @@ class HttpRPCClient(RPCClient):
             conn.close()
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        from ..obs import get_tracer
+
         payload = base64.b64encode(cloudpickle.dumps((self._key, args, kwargs)))
         policy = self._policy
         attempts = 0
-        while True:
-            try:
-                (self._injector or NULL_INJECTOR).fire(SITE_RPC_REQUEST)
-                body = self._invoke_once(payload)
-                break
-            except Exception as ex:
-                attempts += 1
-                sent = getattr(ex, "_fugue_request_sent", False)
-                retryable = (self._idempotent or not sent) and policy.should_retry(
-                    classify_failure(ex), attempts
-                )
-                if not retryable:
-                    raise
-                if self._stats is not None:
-                    self._stats.inc("rpc.retries")
-                time.sleep(policy.delay(attempts, seed=self._key))
+        with get_tracer().span(
+            "rpc.invoke", cat="rpc", key=self._key, bytes_out=len(payload)
+        ) as sp:
+            while True:
+                try:
+                    (self._injector or NULL_INJECTOR).fire(SITE_RPC_REQUEST)
+                    body = self._invoke_once(payload)
+                    break
+                except Exception as ex:
+                    attempts += 1
+                    sent = getattr(ex, "_fugue_request_sent", False)
+                    retryable = (self._idempotent or not sent) and policy.should_retry(
+                        classify_failure(ex), attempts
+                    )
+                    if not retryable:
+                        sp.set(attempts=attempts)
+                        raise
+                    if self._stats is not None:
+                        self._stats.inc("rpc.retries")
+                    time.sleep(policy.delay(attempts, seed=self._key))
+            sp.set(attempts=attempts + 1, bytes_in=len(body))
         ok, result = cloudpickle.loads(base64.b64decode(body))
         if not ok:
             raise result
@@ -202,8 +209,11 @@ class HttpRPCServer(RPCServer):
                     key, args, kwargs = cloudpickle.loads(
                         base64.b64decode(self.rfile.read(length))
                     )
+                    from ..obs import get_tracer
+
                     try:
-                        result = (True, server.invoke(key, *args, **kwargs))
+                        with get_tracer().span("rpc.serve", cat="rpc", key=key):
+                            result = (True, server.invoke(key, *args, **kwargs))
                     except Exception as e:  # result is the exception itself
                         result = (False, e)
                     body = base64.b64encode(cloudpickle.dumps(result))

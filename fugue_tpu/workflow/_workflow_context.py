@@ -67,6 +67,12 @@ class FugueWorkflowContext:
         for t in tasks:
             for d in t.inputs:
                 self._consumers[id(d)] = self._consumers.get(id(d), 0) + 1
+        from ..obs import get_tracer
+
+        # capture the workflow.run span id on THIS thread: with concurrency
+        # > 1 tasks run on pool threads whose span stacks are empty, so the
+        # task spans parent onto it explicitly instead of detaching
+        self._trace_root = get_tracer().current_span_id()
         rpc_server = self._engine.rpc_server
         rpc_server.start()
         try:
@@ -125,45 +131,60 @@ class FugueWorkflowContext:
         replays from disk instead of recomputing. Deterministic (POISON)
         failures are never retried — the same inputs would fail the same
         way."""
+        from ..obs import get_tracer
+
         policy = self._task_policy
         attempts = 0
-        while True:
-            try:
-                self._run_task_once(task)
-                return
-            except Exception as ex:
-                cat = classify_failure(ex)
-                attempts += 1
-                if not policy.should_retry(cat, attempts):
-                    if task.defined_at and hasattr(ex, "add_note"):
-                        ex.add_note(
-                            f"[fugue-tpu] failing task defined at {task.defined_at}"
-                        )
-                    raise
-                self._engine.resilience_stats.inc("workflow.task_retries")
-                self._engine.log.warning(
-                    "task %s failed with %s [%s]; retry %d/%d",
-                    task.name or type(task).__name__,
-                    type(ex).__name__,
-                    cat.value,
-                    attempts,
-                    policy.max_attempts - 1,
-                )
-                time.sleep(policy.delay(attempts, seed=task.__uuid__()))
+        with get_tracer().span(
+            "workflow.task",
+            cat="workflow",
+            parent=getattr(self, "_trace_root", None),
+            task=task.name or type(task).__name__,
+        ) as sp:
+            while True:
+                try:
+                    self._run_task_once(task)
+                    sp.set(attempts=attempts + 1)
+                    return
+                except Exception as ex:
+                    cat = classify_failure(ex)
+                    attempts += 1
+                    if not policy.should_retry(cat, attempts):
+                        sp.set(attempts=attempts)
+                        if task.defined_at and hasattr(ex, "add_note"):
+                            ex.add_note(
+                                f"[fugue-tpu] failing task defined at {task.defined_at}"
+                            )
+                        raise
+                    self._engine.resilience_stats.inc("workflow.task_retries")
+                    self._engine.log.warning(
+                        "task %s failed with %s [%s]; retry %d/%d",
+                        task.name or type(task).__name__,
+                        type(ex).__name__,
+                        cat.value,
+                        attempts,
+                        policy.max_attempts - 1,
+                    )
+                    time.sleep(policy.delay(attempts, seed=task.__uuid__()))
 
     def _run_task_once(self, task: FugueTask) -> None:
+        from ..obs import get_tracer
+
         tid = task.__uuid__()
         cp = task.checkpoint
         if isinstance(cp, StrongCheckpoint):
             cp.set_id(tid)
             if cp.exists(self._checkpoint_path, tid):
                 self._engine.resilience_stats.inc("workflow.checkpoint_replays")
-                df = cp.load(self._checkpoint_path)
-                if task.broadcast_flag:
-                    df = self._engine.broadcast(df)
-                if task.yield_dataframe_handler is not None:
-                    task.yield_dataframe_handler(df)
-                self._results[id(task)] = df
+                with get_tracer().span(
+                    "task.checkpoint_replay", cat="workflow", task_uuid=tid
+                ):
+                    df = cp.load(self._checkpoint_path)
+                    if task.broadcast_flag:
+                        df = self._engine.broadcast(df)
+                    if task.yield_dataframe_handler is not None:
+                        task.yield_dataframe_handler(df)
+                    self._results[id(task)] = df
                 return
         inputs = [self._results[id(d)] for d in task.inputs]
         self._injector.fire(SITE_TASK_EXECUTE)

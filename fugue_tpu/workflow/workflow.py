@@ -895,14 +895,46 @@ class FugueWorkflow:
         ctx = FugueWorkflowContext(e)
         self._last_context = ctx
         self._apply_auto_persist(e)
+        from ..obs import get_tracer
+
+        tracer = get_tracer()
         try:
             with e._as_borrowed_context():
-                ctx.run(self._tasks)
+                with tracer.span(
+                    "workflow.run", cat="workflow", tasks=len(self._tasks)
+                ):
+                    ctx.run(self._tasks)
         except Exception as ex:
             from .._utils.exception import modify_traceback
 
             raise modify_traceback(ex, e.conf)
+        finally:
+            self._maybe_export_trace(e, tracer)
         return FugueWorkflowResult(self._yields)
+
+    def _maybe_export_trace(self, engine: Any, tracer: Any) -> None:
+        """Auto-export a Chrome trace after the run when the engine conf
+        sets ``fugue.tpu.trace.dir`` (one file per run, load in Perfetto)."""
+        from ..constants import FUGUE_TPU_CONF_TRACE_DIR
+
+        if not tracer.enabled:
+            return
+        trace_dir = engine.conf.get(FUGUE_TPU_CONF_TRACE_DIR, "")
+        if trace_dir == "":
+            return
+        import os
+        import uuid as _uuid
+
+        from ..obs import write_chrome_trace
+
+        try:
+            path = os.path.join(
+                trace_dir, f"fugue_trace_{_uuid.uuid4().hex[:8]}.json"
+            )
+            write_chrome_trace(path, tracer.records())
+            engine.log.info("workflow trace exported to %s", path)
+        except Exception as ex:  # export must never fail the run
+            engine.log.warning("trace export failed: %s", ex)
 
     def release_task_results(self) -> None:
         """Drop the per-task result frames held by the last run's context.
