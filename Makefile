@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs trace-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan trace-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -35,6 +35,12 @@ test-resilience:
 # trace export, disabled-path overhead guard, fork-boundary round trip
 test-obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/obs -q -m "not slow"
+
+# plan-optimizer suite (docs/plan.md): optimized-vs-unoptimized parity
+# (bit-identical), pruning-reaches-producer spies, fusion span shape,
+# UDF no-op guard, conf gates. Part of `make test` (tests/ includes it)
+test-plan:
+	JAX_PLATFORMS=cpu python -m pytest tests/plan -q -m "not slow"
 
 # end-to-end trace proof: run the traced smoke workflow, then assert the
 # exported file is valid Chrome trace-event JSON (Perfetto-loadable)

@@ -942,6 +942,61 @@ def _north_star() -> None:
     print(json.dumps(result))
 
 
+def _bench_plan_pruning(rows: int = 400_000, wide_cols: int = 28) -> dict:
+    """Wide-table column-pruning case (ISSUE 4): aggregate 2 of ~30
+    columns; the plan optimizer pushes the projection into ``to_df`` so
+    the other columns are never decoded or H2D-transferred. Reports
+    optimized vs ``fugue.tpu.plan.optimize=false`` wall time — the
+    acceptance bar is >= 1.5x."""
+    import numpy as _np
+    import pandas as _pd
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_PLAN_OPTIMIZE
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = _np.random.default_rng(7)
+    pdf = _pd.DataFrame(
+        {
+            "k": rng.integers(0, 64, rows),
+            "v": rng.random(rows),
+            **{f"x{i}": rng.random(rows) for i in range(wide_cols)},
+        }
+    )
+
+    def run(opt: bool) -> float:
+        eng = JaxExecutionEngine({FUGUE_TPU_CONF_PLAN_OPTIMIZE: opt})
+        best = None
+        for _ in range(3):  # first run pays jit compile; best-of-3
+            dag = FugueWorkflow()
+            r = (
+                dag.df(pdf)
+                .partition_by("k")
+                .aggregate(
+                    ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n")
+                )
+            )
+            r.yield_dataframe_as("r", as_local=True)
+            t0 = time.perf_counter()
+            dag.run(eng)
+            dt = time.perf_counter() - t0
+            assert len(dag.yields["r"].result.as_pandas()) == 64
+            best = dt if best is None else min(best, dt)
+        return best
+
+    opt_s = run(True)
+    unopt_s = run(False)
+    return {
+        "rows": rows,
+        "columns": wide_cols + 2,
+        "aggregated_columns": 2,
+        "optimized_s": round(opt_s, 4),
+        "unoptimized_s": round(unopt_s, 4),
+        "speedup": round(unopt_s / opt_s, 2),
+    }
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -1004,6 +1059,9 @@ def _smoke() -> None:
     r = _run_worker_best("agg", fallback_cpu=True, runs=runs)
     ratio = r["rps"] / host_rps
     regressed = bool(recorded_ratio) and ratio < threshold * recorded_ratio
+    # wide-table pruning case (ISSUE 4): smaller than the full bench's but
+    # the same shape; reported (and checked correct) on every smoke run
+    plan_case = _bench_plan_pruning(rows=200_000, wide_cols=28)
     print(
         json.dumps(
             {
@@ -1018,6 +1076,7 @@ def _smoke() -> None:
                 "threshold": threshold,
                 "regressed": regressed,
                 "correct": bool(r["ok"]),
+                "plan_pruning": plan_case,
                 "wall_s": round(time.perf_counter() - t0, 1),
             }
         )
@@ -1326,6 +1385,9 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     "per_case_stats": per_case_stats,
                     "dense_sum_backend_ab": ab,
                     "roofline": roofline,
+                    # plan optimizer (ISSUE 4): wide-table pruning case,
+                    # optimized vs fugue.tpu.plan.optimize=false
+                    "plan_pruning": _bench_plan_pruning(),
                     # most recent `bench.py --north-star` run (the literal
                     # 1B-row groupby-apply), if one has been captured
                     "north_star_1b": _load_north_star(),

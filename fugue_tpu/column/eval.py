@@ -486,6 +486,15 @@ def _fast_grouped_agg(
         plans.append((c.output_name, func, a.name, c.as_type))
     pieces: Dict[str, pd.Series] = {}
     for name, kind, src, as_type in plans:
+        if (
+            kind in ("MIN", "MAX")
+            and src is not None
+            and grouped.obj[src].dtype == object
+        ):
+            # cython groupby min/max raises on object columns holding None
+            # (str-vs-None comparison); the general per-group path below
+            # drops NULLs first — same semantics, just slower
+            return None
         if kind == "size":
             s = grouped.size()
         elif kind == "SUM":

@@ -179,6 +179,15 @@ def evaluate_jnp_3v(
     import jax.numpy as jnp
 
     def ev(e: ColumnExpr) -> Any:
+        # casts apply at EVERY node, not just the root: `CAST(x AS int) > 0`
+        # must compare the cast value (the fused-chain composer also relies
+        # on this when it inlines cast-carrying projections into predicates)
+        v, nl = _ev3(e)
+        if e.as_type is not None:
+            v = jnp.asarray(v).astype(pa_type_to_np_dtype(e.as_type))
+        return v, nl
+
+    def _ev3(e: ColumnExpr) -> Any:
         key = e.__uuid__()
         if key in dict_tables:
             name, table = dict_tables[key]
@@ -258,12 +267,7 @@ def evaluate_jnp_3v(
             raise NotImplementedError(f"function {e.func} not supported on device")
         raise NotImplementedError(f"can't evaluate {type(e)} on device")
 
-    v, nl = ev(expr)
-    if expr.as_type is not None:
-        import jax.numpy as jnp_
-
-        v = jnp_.asarray(v).astype(pa_type_to_np_dtype(expr.as_type))
-    return v, nl
+    return ev(expr)
 
 
 def plan_dict_lookups(

@@ -101,6 +101,22 @@ FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH = "fugue.tpu.stream.prefetch_depth"
 # out-of-range key raises (one-pass streams can't be re-scanned)
 FUGUE_TPU_CONF_STREAM_KEY_RANGE = "fugue.tpu.stream.key_range"
 
+# logical plan optimizer (fugue_tpu/plan, docs/plan.md): rewrites the task
+# DAG at workflow.run() time. The master switch gates all passes; each pass
+# can also be disabled individually. All default ON; every rewrite is
+# result-identical to the unoptimized path (tests/plan/test_optimizer.py).
+FUGUE_TPU_CONF_PLAN_OPTIMIZE = "fugue.tpu.plan.optimize"
+# column pruning: push projections into create/load/stream producers so
+# columns no downstream task reads are never decoded or H2D-transferred
+FUGUE_TPU_CONF_PLAN_PRUNE = "fugue.tpu.plan.prune"
+# filter pushdown: hoist filters through projections/renames/joins toward
+# the producer so invalid rows are masked before device work
+FUGUE_TPU_CONF_PLAN_PUSHDOWN = "fugue.tpu.plan.pushdown"
+# verb fusion: collapse adjacent select/filter/assign chains into one
+# FusedVerbs task (single jitted step on the jax engine; per-chunk on
+# streams)
+FUGUE_TPU_CONF_PLAN_FUSE = "fugue.tpu.plan.fuse"
+
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,

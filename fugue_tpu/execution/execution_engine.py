@@ -181,6 +181,7 @@ class ExecutionEngine(FugueEngineBase):
         self._compile_conf = ParamDict()
         self._rpc_server: Any = None
         self._resilience_stats: Any = None
+        self._plan_stats: Any = None
         self._metrics: Any = None
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
         # constructing an engine with tracing conf turns the tracer on
@@ -327,6 +328,7 @@ class ExecutionEngine(FugueEngineBase):
 
             reg = MetricsRegistry()
             reg.register("resilience", lambda: self.resilience_stats)
+            reg.register("plan", lambda: self.plan_stats)
             self._metrics = reg
         return self._metrics
 
@@ -362,6 +364,18 @@ class ExecutionEngine(FugueEngineBase):
 
             self._resilience_stats = ResilienceStats()
         return self._resilience_stats
+
+    @property
+    def plan_stats(self) -> Any:
+        """Cumulative logical-plan-optimizer counters for workflows run on
+        this engine (cols_pruned / filters_pushed / verbs_fused /
+        bytes_skipped). Alias of ``engine.metrics.get("plan")`` — prefer
+        ``engine.stats()["plan"]`` for reads."""
+        if getattr(self, "_plan_stats", None) is None:
+            from ..plan import PlanStats
+
+            self._plan_stats = PlanStats()
+        return self._plan_stats
 
     # ---- physical ops (abstract) ------------------------------------------
     @abstractmethod
@@ -514,6 +528,16 @@ class ExecutionEngine(FugueEngineBase):
             sel.append(replaced.pop(name) if name in replaced else col(name))
         sel.extend(replaced.values())
         return self.select(df, SelectColumns(*sel))
+
+    def fused_apply(self, df: DataFrame, steps: List[Any]) -> DataFrame:
+        """Execute a fused chain of row-local verbs (see
+        ``fugue_tpu/plan/fused.py``). The default interprets the steps
+        sequentially with this engine's own verbs — bit-identical to the
+        unfused task chain; engines may override with a compiled
+        single-step implementation."""
+        from ..plan.fused import apply_steps_engine
+
+        return apply_steps_engine(self, df, steps)
 
     def aggregate(
         self,

@@ -787,17 +787,45 @@ class JaxDataFrame(DataFrame):
             ),
         )
 
+    def _lazy_project(self, schema: Schema) -> Optional["JaxDataFrame"]:
+        """Column selection on a NOT-YET-INGESTED frame: select on the
+        pending source/arrow table (zero-copy) and stay lazy, so dropped
+        columns are never decoded or device_put — the contract the plan
+        optimizer's column pruning relies on (docs/plan.md)."""
+        if not self._has_pending():
+            return None
+        with self._pending_lock:
+            if not self._has_pending():
+                return None
+            if self._pending_src is not None and self._pending_tbl is None:
+                inner: DataFrame = self._pending_src[schema.names]
+            else:
+                inner = ArrowDataFrame(self._pending_table().select(schema.names))
+        return JaxDataFrame(
+            inner,
+            mesh=self._mesh,
+            ingest_cache=getattr(self, "_ingest_cache_opt", None),
+            ingest_prefetch_depth=getattr(self, "_ingest_prefetch_depth", None),
+            pipeline_stats=getattr(self, "_pipeline_stats", None),
+        )
+
     def _drop_cols(self, cols: List[str]) -> DataFrame:
-        self._ensure_device()
         schema = self.schema - cols
+        lazy = self._lazy_project(schema)
+        if lazy is not None:
+            return lazy
+        self._ensure_device()
         dc = {k: v for k, v in self._device_cols.items() if k in schema}
         keep_host = [n for n in schema.names if n not in dc]
         ht = self._host_tbl.select(keep_host) if len(keep_host) > 0 else None
         return self._with(schema, dc, ht)
 
     def _select_cols(self, cols: List[str]) -> DataFrame:
-        self._ensure_device()
         schema = self.schema.extract(cols)
+        lazy = self._lazy_project(schema)
+        if lazy is not None:
+            return lazy
+        self._ensure_device()
         dc = {k: v for k, v in self._device_cols.items() if k in schema}
         keep_host = [n for n in schema.names if n not in dc]
         ht = self._host_tbl.select(keep_host) if len(keep_host) > 0 else None

@@ -929,6 +929,166 @@ class JaxExecutionEngine(ExecutionEngine):
                 )
         return self._back(self._host_engine.filter(self._host(df), condition))
 
+    @traced_verb("engine.fused")
+    def fused_apply(self, df: DataFrame, steps: Any) -> DataFrame:
+        """Fused chain execution (``fugue_tpu/plan/fused.py``):
+
+        - one-pass streams apply the steps per chunk INSIDE the chunk
+          producer (rows filtered out are never H2D-transferred and the
+          stream stays out-of-core);
+        - fully-device frames compile the whole chain — the Kleene-AND of
+          every filter plus all projections — into ONE jitted step (no
+          intermediate device buffers, one kernel launch per chain);
+        - anything else falls back to sequential verb application, which
+          is exactly what the unfused chain would have run.
+        """
+        from .streaming import is_stream_frame, streaming_fused_steps
+
+        if is_stream_frame(df):
+            return streaming_fused_steps(self, df, steps)
+        jdf = self.to_df(df)
+        res = self._try_fused_device(jdf, steps)
+        if res is not None:
+            return res
+        return super().fused_apply(jdf, steps)
+
+    def _try_fused_device(self, jdf: DataFrame, steps: Any) -> Optional[DataFrame]:
+        """Single-jit execution of a composed chain, or None when any
+        step resists composition/device lowering (sequential fallback
+        keeps identical semantics)."""
+        from ..column.jax_eval import device_predicate_plan
+        from ..plan.fused import compose_steps
+
+        if (
+            not isinstance(jdf, JaxDataFrame)
+            or len(jdf.device_cols) == 0
+            or jdf.host_table is not None
+        ):
+            return None
+        composed = compose_steps(list(jdf.schema.names), steps)
+        if composed is None:
+            return None
+        pred, outputs = composed
+        passthrough_ids = {
+            id(c) for c in outputs if _is_passthrough(c, jdf.device_cols)
+        }
+        computed = [c for c in outputs if id(c) not in passthrough_ids]
+        plain_cols = {
+            k: v
+            for k, v in jdf.device_cols.items()
+            if k not in jdf.encodings and k not in jdf.null_masks
+        }
+        if not all(can_evaluate_on_device(c, plain_cols) for c in computed):
+            return None
+        plan = None
+        if pred is not None:
+            plan = device_predicate_plan(pred, jdf.device_cols, jdf.encodings)
+            if plan is None:
+                return None
+        import jax
+
+        tables, cond = plan if plan is not None else ({}, None)
+        uuids = tuple(sorted(tables.keys()))
+        names = {u: tables[u][0] for u in uuids}
+        code_cols = frozenset(
+            c for c, e in jdf.encodings.items() if e["kind"] == "dict"
+        )
+        cache_key = (
+            "fused",
+            "" if cond is None else cond.__uuid__(),
+            tuple(c.__uuid__() for c in computed),
+            jdf.mesh,
+            uuids,
+            code_cols,
+        )
+        if cache_key not in self._jit_cache:
+
+            def run(
+                cols: Dict[str, Any],
+                masks: Dict[str, Any],
+                tarrs: Any,
+                valid: Any,
+            ) -> Any:
+                import jax.numpy as jnp
+
+                from ..column.jax_eval import evaluate_jnp_3v
+
+                if cond is not None:
+                    dt = {u: (names[u], t) for u, t in zip(uuids, tarrs)}
+                    v, nl = evaluate_jnp_3v(cols, masks, dt, cond, code_cols)
+                    valid = (
+                        valid & jnp.asarray(v, dtype=bool) & jnp.logical_not(nl)
+                    )
+                outs = {}
+                for c in computed:
+                    v = evaluate_jnp(cols, c)
+                    if not hasattr(v, "shape") or getattr(v, "ndim", 0) == 0:
+                        n = next(iter(cols.values())).shape[0]
+                        v = jnp.full((n,), v)
+                    outs[c.output_name] = v
+                return outs, valid
+
+            self._jit_cache[cache_key] = jax.jit(run)
+        outs, new_valid = self._jit_cache[cache_key](
+            dict(jdf.device_cols),
+            dict(jdf.null_masks),
+            tuple(tables[u][1] for u in uuids),
+            jdf.device_valid_mask(),
+        )
+        out_cols: Dict[str, Any] = {}
+        out_enc: Dict[str, Any] = {}
+        out_masks: Dict[str, Any] = {}
+        fields = []
+        for c in outputs:
+            name = c.output_name
+            if id(c) in passthrough_ids:
+                src = c.name
+                out_cols[name] = jdf.device_cols[src]
+                if src in jdf.encodings:
+                    out_enc[name] = jdf.encodings[src]
+                if src in jdf.null_masks:
+                    out_masks[name] = jdf.null_masks[src]
+                fields.append(pa.field(name, jdf.schema[src].type))
+            else:
+                out_cols[name] = outs[name]
+                t = c.infer_type(jdf.schema)
+                fields.append(
+                    pa.field(
+                        name,
+                        t
+                        if t is not None
+                        else pa.from_numpy_dtype(
+                            np.asarray(outs[name]).dtype
+                        ),
+                    )
+                )
+        from ..column.expressions import _NamedColumnExpr as _Named
+
+        nan_cols: Optional[set] = None
+        if jdf._nan_cols is not None:
+            nan_cols = set()
+            for c in outputs:
+                if isinstance(c, _Named) and c.as_type is None:
+                    if c.name in jdf._nan_cols:
+                        nan_cols.add(c.output_name)
+                else:
+                    arr = out_cols[c.output_name]
+                    if np.issubdtype(np.dtype(arr.dtype), np.floating):
+                        nan_cols.add(c.output_name)
+        return JaxDataFrame(
+            mesh=self._mesh,
+            _internal=dict(
+                device_cols=out_cols,
+                host_tbl=None,
+                row_count=jdf._row_count if pred is None else -1,
+                valid_mask=jdf.valid_mask if pred is None else new_valid,
+                nan_cols=nan_cols,
+                encodings=out_enc,
+                null_masks=out_masks,
+                schema=Schema(fields),
+            ),
+        )
+
     def _host(self, df: DataFrame) -> DataFrame:
         return df.as_local_bounded() if isinstance(df, JaxDataFrame) else self._host_engine.to_df(df)
 
@@ -1782,7 +1942,7 @@ class JaxExecutionEngine(ExecutionEngine):
             g: Dict[Any, pd.DataFrame] = {}
             if len(p) > 0:
                 for kv, sub in p.groupby(keys, dropna=False, sort=False):
-                    kt = kv if isinstance(kv, tuple) else (kv,)
+                    kt = _null_safe_key(kv)
                     g[kt] = sub
                     if kt not in seen:
                         seen.add(kt)
@@ -3258,6 +3418,24 @@ def _allgather_dictionaries(
         sel = all_vals.take(pa.array(np.nonzero(all_tags == i)[0]))
         out[n] = _sorted_union_dictionary([sel])
     return out
+
+
+def _null_safe_key(kv: Any) -> tuple:
+    """Group-key tuple with every null (None/NaN/NaT) normalized to None.
+
+    NaN group keys break cross-frame alignment: since Python 3.10
+    ``hash(nan)`` is identity-based, so each frame's own NaN object forms
+    its OWN dict key and the two sides' NULL groups never pair up in
+    comap (observed as a full_outer zip splitting the NULL group)."""
+    kt = kv if isinstance(kv, tuple) else (kv,)
+    out = []
+    for v in kt:
+        try:
+            isna = pd.isna(v)
+        except Exception:
+            isna = False
+        out.append(None if isna is True else v)
+    return tuple(out)
 
 
 def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:

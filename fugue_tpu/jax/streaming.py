@@ -949,6 +949,34 @@ def streaming_take(
     return engine.to_df(PandasDataFrame(out, schema))
 
 
+def streaming_fused_steps(engine: Any, df: Any, steps: Any) -> DataFrame:
+    """Fused select/filter/assign chain applied INSIDE the chunk producer
+    of a one-pass stream (plan optimizer, docs/plan.md): each chunk runs
+    the chain with the engine's own verbs (device-eligible chunks take
+    the same device mask/projection path the materialized frame would
+    have taken — bit-identical results), and only surviving rows flow to
+    the downstream jitted step. The stream stays one-pass/out-of-core:
+    device working set is O(chunk), never O(dataset)."""
+    from ..dataframe import ArrayDataFrame
+    from ..plan.fused import apply_steps_engine
+
+    chunk_rows = engine.conf.get(
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS
+    )
+    # schema probe on an empty frame — same inference the chunks will use
+    out_schema = apply_steps_engine(
+        engine, ArrayDataFrame([], df.schema), steps
+    ).schema
+
+    def gen() -> Iterator[LocalDataFrame]:
+        for f in _iter_local_frames(df, chunk_rows):
+            out = apply_steps_engine(engine, f, steps)
+            if out.count() > 0:
+                yield out.as_local_bounded()
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+
+
 def streaming_distinct(engine: Any, df: Any) -> DataFrame:
     """DISTINCT over a one-pass stream: chunk-wise dedupe against the
     running distinct set — memory is O(distinct rows + chunk), independent

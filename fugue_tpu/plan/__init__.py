@@ -1,0 +1,28 @@
+"""Logical plan optimizer for the workflow DAG (docs/plan.md).
+
+Runs at ``workflow.run()`` time over the task graph, before execution:
+
+- **column pruning** — projections pushed into ``to_df``/load/stream
+  producers so unread columns are never decoded or H2D-transferred;
+- **filter pushdown** — filters hoisted through row-local verbs and
+  inner-join sides so invalid rows are masked at the producer;
+- **verb fusion** — adjacent select/filter/assign chains collapsed into
+  one jitted per-chunk step.
+
+Disable with ``fugue.tpu.plan.optimize=false`` (or per pass:
+``.prune`` / ``.pushdown`` / ``.fuse``). Every rewrite is
+result-identical to the unoptimized path.
+"""
+
+from .fused import FusedVerbs, apply_steps_engine, compose_steps
+from .optimizer import PlanReport, PlanStats, explain_tasks, optimize_tasks
+
+__all__ = [
+    "FusedVerbs",
+    "PlanReport",
+    "PlanStats",
+    "apply_steps_engine",
+    "compose_steps",
+    "explain_tasks",
+    "optimize_tasks",
+]

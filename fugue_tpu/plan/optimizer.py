@@ -1,0 +1,162 @@
+"""Plan optimizer orchestration: conf gates, report, metrics, explain.
+
+``optimize_tasks`` is the single entry point ``FugueWorkflow.run`` calls
+before execution. Everything is gated by ``fugue.tpu.plan.optimize``
+(default ON) with per-pass switches; the unoptimized path is always one
+conf key away, and the parity suite (``tests/plan/test_optimizer.py``)
+asserts both paths produce bit-identical results.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..constants import (
+    FUGUE_TPU_CONF_PLAN_FUSE,
+    FUGUE_TPU_CONF_PLAN_OPTIMIZE,
+    FUGUE_TPU_CONF_PLAN_PRUNE,
+    FUGUE_TPU_CONF_PLAN_PUSHDOWN,
+)
+from ..workflow._tasks import FugueTask
+from .ir import LNode, build_graph
+from .passes import emit, fuse_verbs, prune_columns, pushdown_filters
+
+__all__ = ["PlanReport", "PlanStats", "optimize_tasks", "explain_tasks"]
+
+
+class PlanStats:
+    """Engine-level optimizer counters (an ``engine.metrics`` source)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.runs = 0
+        self.cols_pruned = 0
+        self.filters_pushed = 0
+        self.verbs_fused = 0
+        self.bytes_skipped = 0
+
+    def absorb(self, report: "PlanReport") -> None:
+        self.runs += 1
+        self.cols_pruned += report.cols_pruned
+        self.filters_pushed += report.filters_pushed
+        self.verbs_fused += report.verbs_fused
+        self.bytes_skipped += report.bytes_skipped
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            "runs": self.runs,
+            "cols_pruned": self.cols_pruned,
+            "filters_pushed": self.filters_pushed,
+            "verbs_fused": self.verbs_fused,
+            "bytes_skipped": self.bytes_skipped,
+        }
+
+
+class PlanReport:
+    """What one optimization run did — rendered by ``workflow.explain()``
+    and attached (as attrs) to the ``plan.optimize`` span."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self.cols_pruned = 0
+        self.filters_pushed = 0
+        self.verbs_fused = 0
+        self.bytes_skipped = 0
+        self.notes: List[str] = []
+        self.before: List[str] = []
+        self.after: List[str] = []
+
+    def note(self, msg: str) -> None:
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def span_attrs(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "cols_pruned": self.cols_pruned,
+            "filters_pushed": self.filters_pushed,
+            "verbs_fused": self.verbs_fused,
+            "bytes_skipped": self.bytes_skipped,
+        }
+
+    @property
+    def changed(self) -> bool:
+        return (self.cols_pruned + self.filters_pushed + self.verbs_fused) > 0
+
+    def render(self) -> str:
+        lines = ["== logical plan =="]
+        lines.extend("  " + s for s in self.before)
+        if not self.enabled:
+            lines.append("== optimizer disabled (fugue.tpu.plan.optimize=false) ==")
+            return "\n".join(lines)
+        lines.append(
+            "== optimized plan (cols_pruned=%d filters_pushed=%d "
+            "verbs_fused=%d bytes_skipped~%d) =="
+            % (
+                self.cols_pruned,
+                self.filters_pushed,
+                self.verbs_fused,
+                self.bytes_skipped,
+            )
+        )
+        lines.extend("  " + s for s in self.after)
+        if self.notes:
+            lines.append("== notes ==")
+            lines.extend("  " + s for s in self.notes)
+        return "\n".join(lines)
+
+
+def _render_nodes(nodes: List[LNode]) -> List[str]:
+    idx = {id(n): i for i, n in enumerate(nodes)}
+    out = []
+    for i, n in enumerate(nodes):
+        ins = ",".join(f"t{idx[id(x)]}" for x in n.inputs if id(x) in idx)
+        label = n.kind
+        if n.task is not None:
+            label += f"<{type(n.task.extension).__name__}>"
+        ann = (" -- " + "; ".join(n.annotations)) if n.annotations else ""
+        pin = " [pinned]" if n.pinned else ""
+        out.append(f"t{i}: {label}({ins}){pin}{ann}")
+    return out
+
+
+def _flag(conf: Any, key: str, default: bool = True) -> bool:
+    try:
+        return bool(conf.get(key, default))
+    except Exception:
+        return default
+
+
+def optimize_tasks(
+    tasks: List[FugueTask], conf: Any, stats: Optional[PlanStats] = None
+) -> Tuple[List[FugueTask], Dict[int, FugueTask], PlanReport]:
+    """Rewrite the task DAG. Returns (tasks to execute, result-alias map
+    {id(original task): executed task}, report). With the optimizer off
+    the ORIGINAL list round-trips untouched."""
+    enabled = _flag(conf, FUGUE_TPU_CONF_PLAN_OPTIMIZE, True)
+    report = PlanReport(enabled)
+    if not enabled or len(tasks) == 0:
+        return tasks, {}, report
+    nodes = build_graph(tasks)
+    report.before = _render_nodes(nodes)
+    if _flag(conf, FUGUE_TPU_CONF_PLAN_PUSHDOWN, True):
+        pushdown_filters(nodes, report)
+    if _flag(conf, FUGUE_TPU_CONF_PLAN_PRUNE, True):
+        prune_columns(nodes, report)
+    if _flag(conf, FUGUE_TPU_CONF_PLAN_FUSE, True):
+        fuse_verbs(nodes, report)
+    report.after = _render_nodes(nodes)
+    if not report.changed:
+        return tasks, {}, report
+    new_tasks, aliases = emit(nodes)
+    if stats is not None:
+        stats.absorb(report)
+    return new_tasks, aliases, report
+
+
+def explain_tasks(tasks: List[FugueTask], conf: Any) -> str:
+    """Dry-run the optimizer and render the before/after plans."""
+    _, _, report = optimize_tasks(tasks, conf)
+    if not report.before:
+        report.before = _render_nodes(build_graph(tasks))
+    return report.render()

@@ -514,10 +514,25 @@ class WarehouseExecutionEngine(ExecutionEngine):
                 f"LEFT JOIN {a} AS a ON {on_clause}"
             )
         elif how_l == "fullouter":
-            sql = (
-                f"SELECT {_sel('a', coalesce_keys=True)} FROM {a} AS a "
-                f"FULL OUTER JOIN {b} AS b ON {on_clause}"
-            )
+            if self._profile.supports_full_outer_join:
+                sql = (
+                    f"SELECT {_sel('a', coalesce_keys=True)} FROM {a} AS a "
+                    f"FULL OUTER JOIN {b} AS b ON {on_clause}"
+                )
+            else:
+                # emulation for drivers without FULL OUTER JOIN (sqlite
+                # < 3.39): left join ∪ right rows with NO left match.
+                # ``a.rowid IS NULL`` (not a payload column) detects the
+                # no-match case even when every a-column is legitimately
+                # NULL; NULL-keyed b rows never match so they land in the
+                # anti part with their own key values
+                sql = (
+                    f"SELECT {_sel('a', coalesce_keys=True)} FROM {a} AS a "
+                    f"LEFT JOIN {b} AS b ON {on_clause} "
+                    f"UNION ALL "
+                    f"SELECT {_sel('b')} FROM {b} AS b "
+                    f"LEFT JOIN {a} AS a ON {on_clause} WHERE a.rowid IS NULL"
+                )
         elif how_l in ("semi", "leftsemi"):
             cond = " AND ".join(
                 f"b.{self.encode_name(k)} = a.{self.encode_name(k)}" for k in keys

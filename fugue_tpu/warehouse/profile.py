@@ -34,6 +34,12 @@ class WarehouseProfile:
     #: DB-API paramstyle: "qmark" (?) or "format" (%s)
     paramstyle: str = "qmark"
 
+    @property
+    def supports_full_outer_join(self) -> bool:
+        """Whether the driver executes FULL OUTER JOIN natively; when
+        False the engine emulates it (left join ∪ right-anti rows)."""
+        return True
+
     # -- identifiers / parameters ------------------------------------------
     def quote(self, name: str) -> str:
         return '"' + name.replace('"', '""') + '"'
@@ -102,6 +108,15 @@ class WarehouseProfile:
 class SQLiteProfile(WarehouseProfile):
     name = "sqlite"
     paramstyle = "qmark"
+
+    @property
+    def supports_full_outer_join(self) -> bool:
+        # FULL/RIGHT OUTER JOIN arrived in sqlite 3.39 (2022-06); older
+        # baked-in libs (e.g. 3.34) need the emulated form
+        import sqlite3
+
+        ver = tuple(int(x) for x in sqlite3.sqlite_version.split(".")[:2])
+        return ver >= (3, 39)
 
     _STORAGE: List[Tuple[Any, str]] = [
         (pa.types.is_boolean, "INTEGER"),

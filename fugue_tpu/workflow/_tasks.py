@@ -108,6 +108,29 @@ class FugueTask:
                     safe[k] = repr(v)
         return to_uuid(safe)
 
+    def clone_with(
+        self,
+        extension: Any = None,
+        params: Any = None,
+        input_tasks: Optional[List["FugueTask"]] = None,
+    ) -> "FugueTask":
+        """Shallow clone for the plan optimizer: same checkpoint/yield/
+        broadcast/name, optionally different extension/params/inputs, and
+        a fresh uuid (computed over the NEW params and inputs). The
+        original task is never mutated — its uuid, checkpoint identity
+        and handlers stay exactly as compiled."""
+        import copy
+
+        c = copy.copy(self)
+        if extension is not None:
+            c.extension = extension
+        if params is not None:
+            c.params = ParamDict(params)
+        if input_tasks is not None:
+            c.inputs = list(input_tasks)
+        c._uuid = None
+        return c
+
     def set_checkpoint(self, checkpoint: Checkpoint) -> None:
         assert_or_throw(
             checkpoint.is_null or self.has_output,

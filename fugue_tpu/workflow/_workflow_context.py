@@ -31,6 +31,7 @@ class FugueWorkflowContext:
         self._engine = execution_engine
         self._checkpoint_path = CheckpointPath(execution_engine)
         self._results: Dict[str, DataFrame] = {}
+        self._aliases: Dict[int, FugueTask] = {}
         # fault budgets span the whole run (an injected `error@1` fails one
         # task once, not once per retry attempt)
         self._injector = FaultInjector.from_conf(execution_engine.conf)
@@ -52,13 +53,23 @@ class FugueWorkflowContext:
         return self._checkpoint_path
 
     def get_result(self, task: FugueTask) -> DataFrame:
-        return self._results[id(task)]
+        t = self._aliases.get(id(task), task)
+        return self._results[id(t)]
 
     def has_result(self, task: FugueTask) -> bool:
-        return id(task) in self._results
+        t = self._aliases.get(id(task), task)
+        return id(t) in self._results
 
-    def run(self, tasks: List[FugueTask]) -> None:
+    def run(
+        self,
+        tasks: List[FugueTask],
+        result_aliases: Optional[Dict[int, FugueTask]] = None,
+    ) -> None:
         execution_id = str(_uuid.uuid4())
+        # plan-optimizer aliasing: the optimizer may execute CLONES of the
+        # compiled tasks (pruned creates, rewired filters, fused chains);
+        # get_result resolves an original task to its executed stand-in
+        self._aliases: Dict[int, FugueTask] = result_aliases or {}
         self._checkpoint_path.init_temp_path(execution_id)
         # fan-out map: a ONE-PASS (local unbounded) result consumed by more
         # than one downstream task must be materialized once, or the second

@@ -896,14 +896,21 @@ class FugueWorkflow:
         self._last_context = ctx
         self._apply_auto_persist(e)
         from ..obs import get_tracer
+        from ..plan import optimize_tasks
 
         tracer = get_tracer()
+        with tracer.span("plan.optimize", cat="plan", tasks=len(self._tasks)) as psp:
+            run_tasks, aliases, report = optimize_tasks(
+                self._tasks, e.conf, stats=e.plan_stats
+            )
+            psp.set(**report.span_attrs())
+        self._last_plan_report = report
         try:
             with e._as_borrowed_context():
                 with tracer.span(
-                    "workflow.run", cat="workflow", tasks=len(self._tasks)
+                    "workflow.run", cat="workflow", tasks=len(run_tasks)
                 ):
-                    ctx.run(self._tasks)
+                    ctx.run(run_tasks, result_aliases=aliases)
         except Exception as ex:
             from .._utils.exception import modify_traceback
 
@@ -935,6 +942,28 @@ class FugueWorkflow:
             engine.log.info("workflow trace exported to %s", path)
         except Exception as ex:  # export must never fail the run
             engine.log.warning("trace export failed: %s", ex)
+
+    def explain(self, conf: Any = None) -> str:
+        """Render what the plan optimizer (``fugue_tpu/plan``) would do to
+        this workflow's DAG: the logical plan, the optimized plan with
+        per-pass counters (cols_pruned / filters_pushed / verbs_fused /
+        bytes_skipped estimate), and any refusal notes. Dry-run only —
+        nothing executes. After a ``run()``, the report of the plan that
+        actually executed is also available via ``last_plan_report``."""
+        from ..constants import _FUGUE_GLOBAL_CONF
+        from ..plan import explain_tasks
+
+        merged = ParamDict(_FUGUE_GLOBAL_CONF)
+        merged.update(self._conf)
+        if conf is not None:
+            merged.update(ParamDict(conf))
+        return explain_tasks(self._tasks, merged)
+
+    @property
+    def last_plan_report(self) -> Any:
+        """The :class:`~fugue_tpu.plan.PlanReport` of the last ``run()``
+        (None before the first run)."""
+        return getattr(self, "_last_plan_report", None)
 
     def release_task_results(self) -> None:
         """Drop the per-task result frames held by the last run's context.

@@ -1,0 +1,490 @@
+"""Logical plan optimizer (``fugue_tpu/plan``, docs/plan.md) — ISSUE 4.
+
+The satellite checklist:
+
+- parity suite: bit-identical results optimized vs
+  ``fugue.tpu.plan.optimize=false`` across transform / filter / join /
+  aggregate / SQL workflows (bounded AND streaming inputs);
+- pruning-reaches-producer: the chunk producer / device ingest only ever
+  carries the demanded columns (spies on ``_chunk_columns`` and
+  ``JaxDataFrame._from_arrow``);
+- fusion span-shape: the fused chain runs as ONE ``engine.fused`` span
+  (no per-verb engine spans);
+- no-op guard: UDF transformers (column usage not inferable)
+  conservatively keep every column;
+- ``workflow.explain()`` report + per-pass conf gates + result aliasing.
+"""
+
+from typing import Dict
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import fugue_tpu.jax.streaming as streaming_mod
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_PLAN_FUSE,
+    FUGUE_TPU_CONF_PLAN_OPTIMIZE,
+    FUGUE_TPU_CONF_PLAN_PRUNE,
+    FUGUE_TPU_CONF_PLAN_PUSHDOWN,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.jax.dataframe import JaxDataFrame
+from fugue_tpu.obs import get_tracer
+
+
+def _frame(n=4000, cols=8, groups=16, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, groups, n),
+            "v": rng.random(n),
+            "w": rng.random(n),
+            "s": rng.choice(["a", "b", "c", None], n),
+            **{f"x{i}": rng.random(n) for i in range(cols)},
+        }
+    )
+
+
+def _stream(pdf: pd.DataFrame, step: int = 512):
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+def _run_pair(build, engine_conf=None, sort=None):
+    """Run the same workflow with the optimizer ON and OFF; assert
+    bit-identical results (values AND dtypes); return the ON frame."""
+    outs = []
+    for opt in (True, False):
+        conf = dict(engine_conf or {})
+        conf[FUGUE_TPU_CONF_PLAN_OPTIMIZE] = opt
+        eng = JaxExecutionEngine(conf)
+        dag = FugueWorkflow()
+        build(dag)
+        dag.run(eng)
+        res = dag.yields["r"].result.as_pandas()
+        if sort:
+            res = res.sort_values(sort).reset_index(drop=True)
+        outs.append(res)
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+    return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# parity suite
+# ---------------------------------------------------------------------------
+
+
+def test_parity_aggregate_wide():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("sv"), ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res = _run_pair(build, sort=["k"])
+    assert len(res) == 16
+
+
+def test_parity_filter_select_chain():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .rename({"v": "val"})
+            .filter(col("val") > 0.25)
+            .select(col("k"), col("val"), (col("val") * 2).alias("v2"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res = _run_pair(build)
+    assert list(res.columns) == ["k", "val", "v2"]
+    assert (res["val"] > 0.25).all()
+
+
+def test_parity_assign_drop_string_filter():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .assign(v3=col("v") * 3)
+            .drop(["x0", "x1"])
+            .filter(col("s") == "a")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res = _run_pair(build)
+    assert (res["s"] == "a").all()
+
+
+def test_parity_join_pushdown():
+    pdf = _frame()
+    dim = pd.DataFrame({"k": np.arange(16), "label": np.arange(16) * 1.0})
+
+    def build(dag):
+        j = dag.df(pdf).inner_join(dag.df(dim), on=["k"]).filter(col("v") > 0.8)
+        j.partition_by("k").aggregate(ff.count(col("v")).alias("n")).yield_dataframe_as(
+            "r", as_local=True
+        )
+
+    _run_pair(build, sort=["k"])
+
+
+def test_parity_transform_udf():
+    pdf = _frame(cols=4)
+
+    def add_one(df: pd.DataFrame) -> pd.DataFrame:
+        df = df.copy()
+        df["v"] = df["v"] + 1.0
+        return df[["k", "v"]]
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .transform(add_one, schema="k:long,v:double")
+            .filter(col("v") > 1.5)
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _run_pair(build)
+
+
+def test_parity_sql_workflow():
+    pdf = _frame(cols=2)
+
+    def build(dag):
+        a = dag.df(pdf)
+        dag.select(
+            "SELECT k, SUM(v) AS sv FROM ", a, " WHERE v > 0.2 GROUP BY k"
+        ).yield_dataframe_as("r", as_local=True)
+
+    _run_pair(build, sort=["k"])
+
+
+def test_parity_streaming_filter_aggregate():
+    pdf = _frame(cols=4)
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .filter(col("v") > 0.5)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("sv"), ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _run_pair(build, sort=["k"])
+
+
+def test_parity_native_engine():
+    """The optimizer is engine-agnostic: parity holds on the host engine."""
+    pdf = _frame(cols=3)
+    outs = []
+    for opt in (True, False):
+        eng = NativeExecutionEngine({FUGUE_TPU_CONF_PLAN_OPTIMIZE: opt})
+        dag = FugueWorkflow()
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.5)
+            .select(col("k"), col("v"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run(eng)
+        outs.append(dag.yields["r"].result.as_pandas())
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# pruning reaches the producer
+# ---------------------------------------------------------------------------
+
+
+def test_pruning_reaches_bounded_ingest(monkeypatch):
+    pdf = _frame(cols=20)
+    seen = []
+    orig = JaxDataFrame._from_arrow
+
+    def spy(self, tbl):
+        seen.append(list(tbl.column_names))
+        return orig(self, tbl)
+
+    monkeypatch.setattr(JaxDataFrame, "_from_arrow", spy)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    src = dag.df(pdf)
+    (
+        src.partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    assert len(dag.yields["r"].result.as_pandas()) == 16
+    # no ingested table ever carried the 20 x-columns
+    assert seen and all(set(cols) <= {"k", "v"} for cols in seen), seen
+    # the pruned source result is visible (aliased) and narrow
+    assert set(src.result.schema.names) == {"k", "v"}
+
+
+def test_pruning_reaches_chunk_producer(monkeypatch):
+    pdf = _frame(cols=12)
+    seen = []
+    orig = streaming_mod._chunk_columns
+
+    def spy(f, names):
+        seen.append(list(f.schema.names))
+        return orig(f, names)
+
+    monkeypatch.setattr(streaming_mod, "_chunk_columns", spy)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    (
+        dag.df(_stream(pdf))
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    assert len(dag.yields["r"].result.as_pandas()) == 16
+    # every chunk the streaming producer decoded was already pruned
+    assert seen and all(set(cols) <= {"k", "v"} for cols in seen), seen
+
+
+def test_noop_guard_udf_keeps_all_columns():
+    """Transformer column usage can't be inferred -> NO pruning."""
+    pdf = _frame(cols=6)
+
+    def ident(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    src = dag.df(pdf)
+    schema_str = ",".join(
+        f"{n}:{'str' if n == 's' else ('long' if n == 'k' else 'double')}"
+        for n in pdf.columns
+    )
+    src.transform(ident, schema=schema_str).yield_dataframe_as("r", as_local=True)
+    dag.run(eng)
+    assert set(src.result.schema.names) == set(pdf.columns)
+    assert dag.last_plan_report.cols_pruned == 0
+
+
+def test_pruning_keeps_one_column_for_row_count():
+    from fugue_tpu.column import lit
+
+    pdf = _frame(cols=3)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    src = dag.df(pdf)
+    (
+        src.aggregate(ff.count(lit(1)).alias("n"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    assert dag.yields["r"].result.as_pandas()["n"].iloc[0] == len(pdf)
+    assert len(src.result.schema.names) >= 1
+
+
+# ---------------------------------------------------------------------------
+# fusion: span shape + single-jit path
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_span_shape():
+    tr = get_tracer()
+    tr.clear()
+    tr.enable()
+    try:
+        pdf = _frame(cols=2)
+        eng = JaxExecutionEngine()
+        dag = FugueWorkflow()
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.25)
+            .select(col("k"), (col("v") * 2).alias("v2"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run(eng)
+        names = [r["name"] for r in tr.records()]
+        assert "plan.optimize" in names
+        assert "engine.fused" in names
+        # the fused chain replaced the separate verb executions
+        assert "engine.filter" not in names
+        assert "engine.select" not in names
+        plan_span = next(r for r in tr.records() if r["name"] == "plan.optimize")
+        assert plan_span["args"]["verbs_fused"] >= 2
+        assert plan_span["args"]["cols_pruned"] >= 1
+    finally:
+        tr.disable()
+        tr.clear()
+    # single-jit proof: one fused cache entry, no per-verb compilations
+    kinds = {k[0] for k in eng._jit_cache.keys()}
+    assert "fused" in kinds and "filter3v" not in kinds and "project" not in kinds
+
+
+def test_fused_sequential_fallback_matches():
+    """A chain with a host-only step (LIKE on strings after rename) still
+    fuses but runs the sequential engine-verb fallback — results equal."""
+    pdf = _frame(cols=2)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("s").is_null() | (col("v") > 0.1))
+            .select(col("k"), col("s"), col("v"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _run_pair(build)
+
+
+# ---------------------------------------------------------------------------
+# explain / conf gates / aliasing
+# ---------------------------------------------------------------------------
+
+
+def test_explain_report():
+    pdf = _frame(cols=5)
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .filter(col("v") > 0.5)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    text = dag.explain()
+    assert "== logical plan ==" in text
+    assert "== optimized plan" in text
+    assert "pruned" in text
+    disabled = dag.explain(conf={FUGUE_TPU_CONF_PLAN_OPTIMIZE: False})
+    assert "optimizer disabled" in disabled
+
+
+def test_per_pass_conf_gates():
+    pdf = _frame(cols=5)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.5)
+            .select(col("k"), col("v"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    for key, counter in (
+        (FUGUE_TPU_CONF_PLAN_PRUNE, "cols_pruned"),
+        (FUGUE_TPU_CONF_PLAN_FUSE, "verbs_fused"),
+    ):
+        eng = JaxExecutionEngine({key: False})
+        dag = FugueWorkflow()
+        build(dag)
+        dag.run(eng)
+        report = dag.last_plan_report
+        assert getattr(report, counter) == 0, key
+    eng = JaxExecutionEngine({FUGUE_TPU_CONF_PLAN_PUSHDOWN: False})
+    dag = FugueWorkflow()
+    build(dag)
+    dag.run(eng)
+    assert dag.last_plan_report.filters_pushed == 0
+
+
+def test_engine_plan_metrics():
+    pdf = _frame(cols=5)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    st = eng.stats()["plan"]
+    assert st["runs"] == 1
+    assert st["cols_pruned"] >= 5
+    assert st["bytes_skipped"] > 0
+    eng.reset_stats()
+    assert eng.stats()["plan"]["runs"] == 0
+
+
+def test_result_alias_final_and_source():
+    pdf = _frame(cols=4)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    src = dag.df(pdf)
+    final = src.filter(col("v") > 0.5).select(col("k"), col("v"))
+    final.yield_dataframe_as("r", as_local=True)
+    dag.run(eng)
+    # the fused tail aliases to the final handle
+    out = final.result.as_pandas()
+    assert list(out.columns) == ["k", "v"]
+    # the pruned create aliases to the source handle
+    assert set(src.result.schema.names) == {"k", "v"}
+
+
+def test_pinned_tasks_disable_rewrites():
+    """Checkpointed/broadcast tasks never get rewritten or fused away."""
+    pdf = _frame(cols=4)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    src = dag.df(pdf)
+    mid = src.filter(col("v") > 0.5).persist()  # weak checkpoint pins it
+    mid.select(col("k"), col("v")).yield_dataframe_as("r", as_local=True)
+    dag.run(eng)
+    assert dag.last_plan_report.verbs_fused == 0
+    # the persisted intermediate keeps its full width
+    assert set(mid.result.schema.names) == set(pdf.columns)
+
+
+def test_pushdown_rename_rewrites_condition():
+    from fugue_tpu.plan import optimize_tasks
+
+    pdf = _frame(cols=2)
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .rename({"v": "val"})
+        .filter(col("val") > 0.5)
+        .partition_by("k")
+        .aggregate(ff.sum(col("val")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    text = dag.explain()
+    assert "filters_pushed=1" in text
+
+
+def test_pushdown_refused_fillna_overlap():
+    pdf = _frame(cols=2)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .fillna(0.0, subset=["v"])
+            .filter(col("v") > 0.5)
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _run_pair(build)
+    dag = FugueWorkflow()
+    build(dag)
+    text = dag.explain()
+    assert "filters_pushed=0" in text
+    assert any("fillna" in n for n in (dag.explain().splitlines()))
