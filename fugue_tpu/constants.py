@@ -105,6 +105,10 @@ FUGUE_TPU_CONF_STREAM_KEY_RANGE = "fugue.tpu.stream.key_range"
 # DAG at workflow.run() time. The master switch gates all passes; each pass
 # can also be disabled individually. All default ON; every rewrite is
 # result-identical to the unoptimized path (tests/plan/test_optimizer.py).
+# plan.* keys are per-run compile switches: workflow.run() honors them from
+# engine conf, run conf AND workflow compile_conf, without writing the
+# compile_conf values back into a (possibly shared) engine's conf.
+FUGUE_TPU_CONF_PLAN_PREFIX = "fugue.tpu.plan."
 FUGUE_TPU_CONF_PLAN_OPTIMIZE = "fugue.tpu.plan.optimize"
 # column pruning: push projections into create/load/stream producers so
 # columns no downstream task reads are never decoded or H2D-transferred

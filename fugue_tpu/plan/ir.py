@@ -79,6 +79,7 @@ class LNode:
         "extension_override",
         "steps",
         "tail_origin",
+        "result_of",
         "annotations",
     )
 
@@ -92,6 +93,13 @@ class LNode:
         self.extension_override: Any = None
         self.steps: Optional[List[Tuple]] = None  # K_FUSED only
         self.tail_origin: Optional[FugueTask] = None  # K_FUSED only
+        # the ORIGINAL tasks whose result this node's output is provably
+        # identical to. Rewrites that reposition a node (filter pushdown)
+        # or collapse a chain (fusion) transfer this set to the node that
+        # now computes that value; a node left representing nothing means
+        # the original task's intermediate result is no longer computed
+        # anywhere (get_result raises a descriptive error for it).
+        self.result_of: List[FugueTask] = [] if task is None else [task]
         self.annotations: List[str] = []
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
@@ -196,7 +204,13 @@ def classify(task: FugueTask) -> LNode:
             return LNode(task, K_CREATE, info)
         if isinstance(ext, bc.Load):
             return LNode(
-                task, K_LOAD, {"columns": task.params.get_or_none("columns", object)}
+                task,
+                K_LOAD,
+                {
+                    "columns": task.params.get_or_none("columns", object),
+                    "path": task.params.get_or_none("path", object),
+                    "fmt": task.params.get("fmt", ""),
+                },
             )
         return LNode(task, K_CREATE_OPAQUE)
     if isinstance(ext, bp.SelectColumns):
@@ -359,7 +373,13 @@ def _node_schema(
                 return list(Schema(cols).names)
             except Exception:
                 return None
-        return None
+        # no explicit columns: sniff the file metadata (memoized — the
+        # pushdown loop re-runs inference many times)
+        if "sniffed_schema" not in n.info:
+            n.info["sniffed_schema"] = sniff_load_columns(
+                n.info.get("path"), n.info.get("fmt") or ""
+            )
+        return n.info["sniffed_schema"]
     if n.kind == K_PROJECT:
         return list(n.info["columns"])
     if n.kind == K_DROP:
@@ -414,6 +434,53 @@ def _node_schema(
     if n.kind == K_FUSED:
         return None  # no pass runs after fusion
     return None  # transform / opaque / output
+
+
+def sniff_load_columns(path: Any, fmt: str) -> Optional[List[str]]:
+    """Column names of a Load source, read from file METADATA only (no
+    row data is decoded). Restricted to plain parquet files: directory
+    datasets go through the sidecar/hive-restore path whose column order
+    and types change once an explicit column list is passed, and globs
+    may span files with differing schemas — both refuse with None."""
+    import os
+
+    if not isinstance(path, str):
+        return None
+    try:
+        from .._utils.io import FileParser
+
+        parser = FileParser(path, fmt or None)
+        if (
+            parser.file_format != "parquet"
+            or parser.has_glob
+            or os.path.isdir(path)
+        ):
+            return None
+        import pyarrow.parquet as pq
+
+        return list(pq.read_schema(path).names)
+    except Exception:
+        return None
+
+
+def estimate_load_bytes(path: Any, dropped: List[str]) -> int:
+    """Compressed bytes the pruned load will no longer read, from parquet
+    column-chunk metadata (0 when unknown)."""
+    try:
+        import pyarrow.parquet as pq
+
+        meta = pq.ParquetFile(path).metadata
+        total = 0
+        wanted = set(dropped)
+        for rg in range(meta.num_row_groups):
+            g = meta.row_group(rg)
+            for ci in range(g.num_columns):
+                c = g.column(ci)
+                if c.path_in_schema.split(".")[0] in wanted:
+                    total += int(c.total_compressed_size)
+        return total
+    except Exception:
+        return 0
 
 
 def _data_columns(data: Any) -> Optional[List[str]]:

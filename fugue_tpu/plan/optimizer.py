@@ -7,7 +7,7 @@ conf key away, and the parity suite (``tests/plan/test_optimizer.py``)
 asserts both paths produce bit-identical results.
 """
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..constants import (
     FUGUE_TPU_CONF_PLAN_FUSE,
@@ -129,14 +129,16 @@ def _flag(conf: Any, key: str, default: bool = True) -> bool:
 
 def optimize_tasks(
     tasks: List[FugueTask], conf: Any, stats: Optional[PlanStats] = None
-) -> Tuple[List[FugueTask], Dict[int, FugueTask], PlanReport]:
+) -> Tuple[List[FugueTask], Dict[int, FugueTask], Set[int], PlanReport]:
     """Rewrite the task DAG. Returns (tasks to execute, result-alias map
-    {id(original task): executed task}, report). With the optimizer off
+    {id(original task): executed task}, ids of original tasks whose
+    intermediate result is no longer computed anywhere (fused interiors,
+    producers a filter commuted past), report). With the optimizer off
     the ORIGINAL list round-trips untouched."""
     enabled = _flag(conf, FUGUE_TPU_CONF_PLAN_OPTIMIZE, True)
     report = PlanReport(enabled)
     if not enabled or len(tasks) == 0:
-        return tasks, {}, report
+        return tasks, {}, set(), report
     nodes = build_graph(tasks)
     report.before = _render_nodes(nodes)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_PUSHDOWN, True):
@@ -147,16 +149,22 @@ def optimize_tasks(
         fuse_verbs(nodes, report)
     report.after = _render_nodes(nodes)
     if not report.changed:
-        return tasks, {}, report
+        return tasks, {}, set(), report
     new_tasks, aliases = emit(nodes)
+    removed = {id(t) for t in tasks if id(t) not in aliases}
+    if removed:
+        report.note(
+            "%d intermediate result(s) optimized away; pin with "
+            "persist()/yield to keep them addressable" % len(removed)
+        )
     if stats is not None:
         stats.absorb(report)
-    return new_tasks, aliases, report
+    return new_tasks, aliases, removed, report
 
 
 def explain_tasks(tasks: List[FugueTask], conf: Any) -> str:
     """Dry-run the optimizer and render the before/after plans."""
-    _, _, report = optimize_tasks(tasks, conf)
+    _, _, _, report = optimize_tasks(tasks, conf)
     if not report.before:
         report.before = _render_nodes(build_graph(tasks))
     return report.render()

@@ -34,6 +34,7 @@ from .ir import (
     LNode,
     compute_demand,
     consumers_map,
+    estimate_load_bytes,
     expr_columns,
     infer_schemas,
 )
@@ -102,6 +103,11 @@ def _push_once(
         p.inputs = [f]
         for c in cons[id(f)]:
             c.inputs = [p if i is f else i for i in c.inputs]
+        # the pair's output now materializes at P (the new tail) and is
+        # identical to what F produced before the commute; P's own
+        # intermediate (and F's new, earlier one) are no longer computed
+        p.result_of = f.result_of
+        f.result_of = []
         # emission order follows dependencies, but keep the list sane
         fi, pi = nodes.index(f), nodes.index(p)
         if fi > pi:
@@ -185,6 +191,10 @@ def _push_once(
         p.inputs = new_inputs
         for c in cons[id(f)]:
             c.inputs = [p if i is f else i for i in c.inputs]
+        # same transfer as swap(): the join output now equals the original
+        # post-join filter result; the unfiltered join is gone
+        p.result_of = f.result_of
+        f.result_of = []
         fi, pi = nodes.index(f), nodes.index(p)
         if fi > pi:
             nodes[fi], nodes[pi] = nodes[pi], nodes[fi]
@@ -232,6 +242,9 @@ def prune_columns(nodes: List[LNode], report: Any) -> None:
                 continue
             n.param_override = dict(n.task.params)
             n.param_override["columns"] = keep
+            report.bytes_skipped += estimate_load_bytes(
+                n.info.get("path"), dropped
+            )
         else:
             n.extension_override = _PrunedCreator(n.task.extension, keep)
             report.bytes_skipped += _estimate_bytes(n.info.get("data"), dropped)
@@ -379,6 +392,9 @@ def fuse_verbs(nodes: List[LNode], report: Any) -> None:
         fused = LNode(None, K_FUSED)
         fused.steps = steps
         fused.tail_origin = tail.task
+        # the fused task's output IS the chain tail's output; interior
+        # results are fused away (their handles get a descriptive error)
+        fused.result_of = list(tail.result_of)
         fused.inputs = list(head.inputs)
         fused.annotations.append(
             "fused " + " | ".join(describe_step(s) for s in steps)
@@ -442,10 +458,12 @@ def emit(nodes: List[LNode]) -> Tuple[List[FugueTask], Dict[int, FugueTask]]:
             t = _emit_node(n, in_tasks)
             made[id(n)] = t
             tasks.append(t)
-            if n.task is not None:
-                aliases[id(n.task)] = t
-            elif n.tail_origin is not None:
-                aliases[id(n.tail_origin)] = t
+            # aliases follow RESULT identity, not node identity: a
+            # pushdown-repositioned filter's original handle resolves to
+            # the new chain tail (whose output is provably the same
+            # frame), never to the interior clone
+            for orig in n.result_of:
+                aliases[id(orig)] = t
             remaining.remove(n)
             progressed = True
         if not progressed:  # pragma: no cover - graph invariant

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from ..constants import FUGUE_CONF_WORKFLOW_CONCURRENCY
 from ..dataframe import DataFrame
-from ..exceptions import FugueWorkflowRuntimeError
+from ..exceptions import FugueWorkflowError, FugueWorkflowRuntimeError
 from ..execution.execution_engine import ExecutionEngine
 from ..resilience import (
     SITE_TASK_EXECUTE,
@@ -32,6 +32,7 @@ class FugueWorkflowContext:
         self._checkpoint_path = CheckpointPath(execution_engine)
         self._results: Dict[str, DataFrame] = {}
         self._aliases: Dict[int, FugueTask] = {}
+        self._removed: Set[int] = set()
         # fault budgets span the whole run (an injected `error@1` fails one
         # task once, not once per retry attempt)
         self._injector = FaultInjector.from_conf(execution_engine.conf)
@@ -54,6 +55,14 @@ class FugueWorkflowContext:
 
     def get_result(self, task: FugueTask) -> DataFrame:
         t = self._aliases.get(id(task), task)
+        if id(t) not in self._results and id(task) in self._removed:
+            raise FugueWorkflowError(
+                "this task's intermediate result was optimized away by the "
+                "plan optimizer (fused into a neighbor or repositioned by "
+                "filter pushdown); pin it with persist()/checkpoint()/"
+                "yield_dataframe_as(), or disable the optimizer with "
+                "fugue.tpu.plan.optimize=false"
+            )
         return self._results[id(t)]
 
     def has_result(self, task: FugueTask) -> bool:
@@ -64,12 +73,15 @@ class FugueWorkflowContext:
         self,
         tasks: List[FugueTask],
         result_aliases: Optional[Dict[int, FugueTask]] = None,
+        removed_results: Optional[Set[int]] = None,
     ) -> None:
         execution_id = str(_uuid.uuid4())
         # plan-optimizer aliasing: the optimizer may execute CLONES of the
         # compiled tasks (pruned creates, rewired filters, fused chains);
-        # get_result resolves an original task to its executed stand-in
+        # get_result resolves an original task to its executed stand-in,
+        # and raises a descriptive error for results the rewrites removed
         self._aliases: Dict[int, FugueTask] = result_aliases or {}
+        self._removed = removed_results or set()
         self._checkpoint_path.init_temp_path(execution_id)
         # fan-out map: a ONE-PASS (local unbounded) result consumed by more
         # than one downstream task must be materialized once, or the second

@@ -889,8 +889,18 @@ class FugueWorkflow:
     def run(self, engine: Any = None, conf: Any = None, **kwargs: Any) -> FugueWorkflowResult:
         infer_by = kwargs.pop("infer_by", None) or self._collect_raw_inputs()
         e = make_execution_engine(engine, conf, infer_by=infer_by, **kwargs)
+        from ..constants import FUGUE_TPU_CONF_PLAN_PREFIX
+
+        # the optimizer gate sees engine conf overlaid with this
+        # workflow's compile conf (same precedence explain() uses); plan.*
+        # compile switches stay per-workflow instead of being written into
+        # a possibly shared engine's conf, where they would leak into
+        # later runs of OTHER workflows on the same engine
+        plan_conf = ParamDict(e.conf)
         for k, v in self._conf.items():
-            e.conf[k] = v
+            plan_conf[k] = v
+            if not str(k).startswith(FUGUE_TPU_CONF_PLAN_PREFIX):
+                e.conf[k] = v
         self._last_engine = e
         ctx = FugueWorkflowContext(e)
         self._last_context = ctx
@@ -900,8 +910,8 @@ class FugueWorkflow:
 
         tracer = get_tracer()
         with tracer.span("plan.optimize", cat="plan", tasks=len(self._tasks)) as psp:
-            run_tasks, aliases, report = optimize_tasks(
-                self._tasks, e.conf, stats=e.plan_stats
+            run_tasks, aliases, removed, report = optimize_tasks(
+                self._tasks, plan_conf, stats=e.plan_stats
             )
             psp.set(**report.span_attrs())
         self._last_plan_report = report
@@ -910,7 +920,11 @@ class FugueWorkflow:
                 with tracer.span(
                     "workflow.run", cat="workflow", tasks=len(run_tasks)
                 ):
-                    ctx.run(run_tasks, result_aliases=aliases)
+                    ctx.run(
+                        run_tasks,
+                        result_aliases=aliases,
+                        removed_results=removed,
+                    )
         except Exception as ex:
             from .._utils.exception import modify_traceback
 

@@ -471,6 +471,108 @@ def test_pushdown_rename_rewrites_condition():
     assert "filters_pushed=1" in text
 
 
+def test_pushdown_rewritten_filter_result_is_correct():
+    """A pushdown-repositioned filter's handle must resolve to the new
+    chain TAIL (same frame as unoptimized), never to the interior clone
+    that filters before the verb it commuted past."""
+    pdf = pd.DataFrame({"a": [1.0, None, 3.0, 4.0], "b": [1, 2, 3, 4]})
+    dag0 = FugueWorkflow()
+    ref_h = dag0.df(pdf).dropna().filter(col("b") > 1)
+    dag0.run("native", {FUGUE_TPU_CONF_PLAN_OPTIMIZE: False})
+    ref = ref_h.result.as_pandas().reset_index(drop=True)
+
+    dag = FugueWorkflow()
+    mid = dag.df(pdf).dropna()
+    out = mid.filter(col("b") > 1)
+    dag.run("native")
+    assert dag.last_plan_report.filters_pushed == 1
+    pd.testing.assert_frame_equal(ref, out.result.as_pandas().reset_index(drop=True))
+    # the producer's own intermediate (dropna BEFORE the filter moved) is
+    # no longer computed anywhere: descriptive error, not silent wrong data
+    from fugue_tpu.exceptions import FugueWorkflowError
+
+    with pytest.raises(FugueWorkflowError, match="optimized away"):
+        mid.result
+
+
+def test_fused_interior_result_raises_descriptive():
+    """Accessing .result on an intermediate fused into a neighbor raises
+    a descriptive error (was: bare KeyError) while the tail still works."""
+    from fugue_tpu.exceptions import FugueWorkflowError
+
+    pdf = _frame(cols=2)
+    dag = FugueWorkflow()
+    mid = dag.df(pdf).filter(col("v") > 0.5)
+    tail = mid.select(col("k"), col("v"))
+    tail.yield_dataframe_as("r", as_local=True)
+    dag.run(JaxExecutionEngine())
+    assert dag.last_plan_report.verbs_fused >= 2
+    assert (tail.result.as_pandas()["v"] > 0.5).all()
+    with pytest.raises(FugueWorkflowError, match="optimized away"):
+        mid.result
+
+
+def test_load_pruning_pushes_columns_into_reader(tmp_path):
+    """A parquet Load with no explicit columns gets a columns override
+    from demand analysis (schema sniffed from file metadata) — parity
+    with the unoptimized path, fewer bytes read."""
+    import pyarrow.parquet as pq
+
+    pdf = _frame(n=1000, cols=10)
+    path = str(tmp_path / "wide.parquet")
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), path)
+    outs = []
+    for opt in (True, False):
+        dag = FugueWorkflow()
+        (
+            dag.load(path)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("sv"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run("native", {FUGUE_TPU_CONF_PLAN_OPTIMIZE: opt})
+        outs.append(
+            dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+        if opt:
+            assert dag.last_plan_report.cols_pruned >= 10
+            assert dag.last_plan_report.bytes_skipped > 0
+            assert any("pruned" in s for s in dag.last_plan_report.after)
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+    # explicit user columns are respected: no second pruning
+    dag = FugueWorkflow()
+    (
+        dag.load(path, columns=["k", "v", "w"])
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run("native")
+    assert all("load" not in n or "pruned" not in n for n in dag.last_plan_report.after)
+
+
+def test_compile_conf_gates_run_without_engine_leak():
+    """plan.* switches in FugueWorkflow(compile_conf=...) gate run() AND
+    explain() identically, and never leak into a shared engine's conf."""
+    pdf = _frame(cols=2)
+    eng = NativeExecutionEngine()
+    dag = FugueWorkflow(compile_conf={FUGUE_TPU_CONF_PLAN_OPTIMIZE: False})
+    dag.df(pdf).filter(col("v") > 0.5).select(col("k"), col("v")).yield_dataframe_as(
+        "r", as_local=True
+    )
+    dag.run(eng)
+    assert not dag.last_plan_report.enabled
+    assert "optimizer disabled" in dag.explain()
+    assert FUGUE_TPU_CONF_PLAN_OPTIMIZE not in eng.conf
+    # a later workflow on the SAME engine still optimizes
+    dag2 = FugueWorkflow()
+    dag2.df(pdf).filter(col("v") > 0.5).select(col("k"), col("v")).yield_dataframe_as(
+        "r", as_local=True
+    )
+    dag2.run(eng)
+    assert dag2.last_plan_report.enabled
+
+
 def test_pushdown_refused_fillna_overlap():
     pdf = _frame(cols=2)
 
