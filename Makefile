@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan trace-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-cache cache-clean trace-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -41,6 +41,18 @@ test-obs:
 # UDF no-op guard, conf gates. Part of `make test` (tests/ includes it)
 test-plan:
 	JAX_PLATFORMS=cpu python -m pytest tests/plan -q -m "not slow"
+
+# result-cache suite (docs/cache.md): cached-hit parity, invalidation
+# (mutated files / edited UDFs / partition specs), poisoned-subtree
+# refusal, publish races, torn artifacts, persist-across-restart
+test-cache:
+	JAX_PLATFORMS=cpu python -m pytest tests/cache -q -m "not slow"
+
+# wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
+# (defaults to $FUGUE_TPU_CACHE_DIR)
+cache-clean:
+	python -c "import os; from fugue_tpu.cache import clean_cache_dir; \
+	  print(clean_cache_dir('$(CACHE_DIR)' or os.environ.get('FUGUE_TPU_CACHE_DIR', '')))"
 
 # end-to-end trace proof: run the traced smoke workflow, then assert the
 # exported file is valid Chrome trace-event JSON (Perfetto-loadable)

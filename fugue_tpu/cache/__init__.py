@@ -1,0 +1,42 @@
+"""Content-addressed result cache: cross-run memoization keyed on
+canonical plan fingerprints (docs/cache.md).
+
+- :mod:`~fugue_tpu.cache.fingerprint` — canonical recursive hash per
+  post-optimization plan node; refusal (poisoning) over guessing.
+- :mod:`~fugue_tpu.cache.store` — in-process byte-budgeted LRU over live
+  frames backed by an on-disk parquet artifact store.
+- :mod:`~fugue_tpu.cache.planner` — cuts the DAG at the deepest cached
+  frontier so upstream producers are never executed.
+"""
+
+from .fingerprint import (
+    FP_VERSION,
+    FingerprintReport,
+    fingerprint_tasks,
+    non_deterministic,
+)
+from .planner import CachePlan, describe_cache, plan_cache
+from .store import (
+    ArtifactStore,
+    CacheStats,
+    MemoryLRU,
+    ResultCache,
+    clean_cache_dir,
+    estimate_df_bytes,
+)
+
+__all__ = [
+    "FP_VERSION",
+    "FingerprintReport",
+    "fingerprint_tasks",
+    "non_deterministic",
+    "CachePlan",
+    "plan_cache",
+    "describe_cache",
+    "ArtifactStore",
+    "CacheStats",
+    "MemoryLRU",
+    "ResultCache",
+    "clean_cache_dir",
+    "estimate_df_bytes",
+]

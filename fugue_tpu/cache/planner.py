@@ -1,0 +1,241 @@
+"""Cache-aware plan cut: decide, per run, which tasks are served from the
+result cache and which upstream tasks are therefore never executed.
+
+Reverse-topological walk over the POST-optimization task list:
+
+- roots (output sinks, pinned tasks — checkpoints/yields/broadcasts —
+  and dangling results) are always *needed*;
+- a needed task that the cache (or an existing deterministic
+  StrongCheckpoint) can resolve becomes a **frontier hit**: its result
+  is loaded, its inputs are NOT marked needed;
+- a needed task with no hit executes and marks its inputs needed;
+- everything never marked needed is **skipped entirely** — not decoded,
+  not transferred, no ``workflow.task`` span.
+
+Frontier loads happen eagerly at plan time: a torn artifact or an
+eviction race turns that task back into a miss and the cut is recomputed
+(the load failure propagates need upstream, which may itself hit). So by
+the time the graph runs, every hit already holds its frame.
+"""
+
+from typing import Any, Dict, List, Optional, Set
+
+from ..workflow._checkpoint import StrongCheckpoint
+from ..workflow._tasks import FugueTask, OutputTask
+
+__all__ = ["CachePlan", "plan_cache", "describe_cache"]
+
+
+class CachePlan:
+    """One run's cut: what hits, what executes, what is skipped."""
+
+    def __init__(self, fpr: Any) -> None:
+        self.fpr = fpr  # FingerprintReport
+        self.hits: Dict[int, Any] = {}  # id(task) -> loaded DataFrame
+        self.hit_tier: Dict[int, str] = {}
+        self.checkpoint_hits: Set[int] = set()
+        self.skipped: Set[int] = set()
+        self.executes: Set[int] = set()
+        self.bytes_skipped = 0
+
+    def fp(self, task: FugueTask) -> Optional[str]:
+        return self.fpr.fp(task)
+
+    def summary(self) -> Dict[str, int]:
+        return {
+            "hits": len(self.hits),
+            "checkpoint_hits": len(self.checkpoint_hits),
+            "skipped": len(self.skipped),
+            "executes": len(self.executes),
+            "bytes_skipped": self.bytes_skipped,
+        }
+
+
+def _checkpoint_available(task: FugueTask, checkpoint_path: Any) -> bool:
+    """Whether the task's own deterministic StrongCheckpoint can replay it
+    without inputs (the existing ``_run_task_once`` branch serves it; the
+    planner only uses this to skip its ancestors)."""
+    cp = task.checkpoint
+    if not isinstance(cp, StrongCheckpoint) or not cp.deterministic:
+        return False
+    try:
+        return cp.exists(checkpoint_path, task.__uuid__())
+    except Exception:
+        return False
+
+
+def _compute_cut(
+    tasks: List[FugueTask],
+    available: Any,
+    checkpoint_path: Any,
+) -> Dict[str, Any]:
+    """One reverse-topo pass; ``available(task) -> Optional[str]`` says
+    which cache tier could currently resolve the task."""
+    from ..plan.ir import task_pinned
+
+    consumers: Dict[int, int] = {}
+    for t in tasks:
+        for d in t.inputs:
+            consumers[id(d)] = consumers.get(id(d), 0) + 1
+    needed: Set[int] = set()
+    hits: Dict[int, str] = {}
+    cp_hits: Set[int] = set()
+    executes: Set[int] = set()
+    skipped: List[FugueTask] = []
+    for t in reversed(tasks):
+        is_root = (
+            isinstance(t, OutputTask)
+            or task_pinned(t)
+            or consumers.get(id(t), 0) == 0
+        )
+        if not (is_root or id(t) in needed):
+            skipped.append(t)
+            continue
+        if not isinstance(t, OutputTask):
+            if _checkpoint_available(t, checkpoint_path):
+                cp_hits.add(id(t))
+                continue  # replay branch needs no inputs
+            tier = available(t)
+            if tier is not None:
+                hits[id(t)] = tier
+                continue  # the cache needs no inputs either
+        executes.add(id(t))
+        for d in t.inputs:
+            needed.add(id(d))
+    return {
+        "hits": hits,
+        "cp_hits": cp_hits,
+        "executes": executes,
+        "skipped": skipped,
+    }
+
+
+def plan_cache(
+    tasks: List[FugueTask],
+    engine: Any,
+    cache: Any,
+    checkpoint_path: Any,
+) -> CachePlan:
+    """Fingerprint, cut, and eagerly load the frontier. Emits one
+    ``cache.lookup`` span per frontier decision (hit or miss) so a warm
+    run's trace shows exactly where the plan was cut."""
+    from ..obs import get_tracer
+    from .fingerprint import fingerprint_tasks
+
+    fpr = fingerprint_tasks(tasks, engine.conf, type(engine).__name__)
+    plan = CachePlan(fpr)
+    tracer = get_tracer()
+    blacklist: Set[str] = set()
+    looked_up: Set[int] = set()
+
+    def available(task: FugueTask) -> Optional[str]:
+        fp = fpr.fp(task)
+        if fp is None or fp in blacklist:
+            return None
+        return cache.contains(fp)
+
+    # the eager-load loop: a frontier load that fails (eviction race,
+    # torn artifact) blacklists that fingerprint and recomputes the cut
+    for _ in range(len(tasks) + 1):
+        cut = _compute_cut(tasks, available, checkpoint_path)
+        retry = False
+        for t in tasks:
+            if id(t) not in cut["hits"] or id(t) in plan.hits:
+                continue
+            fp = fpr.fp(t)
+            looked_up.add(id(t))
+            with tracer.span(
+                "cache.lookup",
+                cat="cache",
+                task=t.name or type(t.extension).__name__,
+                fp=(fp or "")[:12],
+            ) as sp:
+                loaded = cache.lookup(fp, engine)
+                if loaded is None:
+                    blacklist.add(fp)  # type: ignore[arg-type]
+                    sp.set(outcome="miss")
+                    retry = True
+                    break
+                df, tier, nbytes = loaded
+                plan.hits[id(t)] = df
+                plan.hit_tier[id(t)] = tier
+                sp.set(outcome="hit", tier=tier, bytes=nbytes)
+        if not retry:
+            break
+    # drop hits that a later recut decided not to use after all (their
+    # consumer's load failed and the consumer now executes: the hit frame
+    # is still valid and stays — it feeds the consumer directly)
+    plan.checkpoint_hits = cut["cp_hits"]
+    plan.executes = cut["executes"]
+    for t in cut["skipped"]:
+        plan.skipped.add(id(t))
+        plan.bytes_skipped += fpr.source_bytes.get(id(t), 0)
+    # misses among tasks that will execute but were fingerprintable:
+    # count them so hit-rate math works without a lookup side effect
+    for t in tasks:
+        if (
+            id(t) in plan.executes
+            and id(t) not in looked_up
+            and fpr.fp(t) is not None
+        ):
+            cache.stats.inc("misses")
+            cache.stats.inc("lookups")
+        if fpr.fp(t) is None and not isinstance(t, OutputTask):
+            cache.stats.inc("refusals")
+    cache.stats.inc("tasks_skipped", len(plan.skipped))
+    cache.stats.inc("bytes_skipped", plan.bytes_skipped)
+    return plan
+
+
+def describe_cache(
+    tasks: List[FugueTask],
+    conf: Any,
+    cache: Any = None,
+    checkpoint_path: Any = None,
+    engine_kind: str = "any",
+) -> List[str]:
+    """Render the would-be cut for ``workflow.explain()`` (dry run: probes
+    ``contains`` only, loads nothing, counts nothing). Fingerprints are
+    engine-partitioned, so hit/miss is only accurate when ``engine_kind``
+    names the engine class the run will use."""
+    from ..constants import FUGUE_TPU_CONF_CACHE_ENABLED
+    from .fingerprint import fingerprint_tasks
+    from .store import ResultCache
+
+    try:
+        enabled = bool(conf.get(FUGUE_TPU_CONF_CACHE_ENABLED, True))
+    except Exception:
+        enabled = True
+    if not enabled:
+        return ["== result cache disabled (fugue.tpu.cache.enabled=false) =="]
+    if cache is None:
+        cache = ResultCache(conf)
+    fpr = fingerprint_tasks(tasks, conf, engine_kind)
+
+    def available(task: FugueTask) -> Optional[str]:
+        fp = fpr.fp(task)
+        return None if fp is None else cache.contains(fp)
+
+    cut = _compute_cut(tasks, available, checkpoint_path)
+    skipped_ids = {id(t) for t in cut["skipped"]}
+    bytes_skipped = sum(fpr.source_bytes.get(i, 0) for i in skipped_ids)
+    scope = "" if engine_kind == "any" else f" for {engine_kind}"
+    lines = [
+        "== result cache%s (cut: %d hit, %d checkpoint, %d skipped upstream, "
+        "~%d source bytes never read) =="
+        % (scope, len(cut["hits"]), len(cut["cp_hits"]), len(skipped_ids), bytes_skipped)
+    ]
+    for i, t in enumerate(tasks):
+        fp = fpr.fp(t)
+        if id(t) in cut["hits"]:
+            status = f"HIT[{cut['hits'][id(t)]}] {fp[:12]}"
+        elif id(t) in cut["cp_hits"]:
+            status = "checkpoint replay"
+        elif id(t) in skipped_ids:
+            status = "skipped (downstream hit cuts the plan here)"
+        elif fp is None:
+            status = "uncacheable: " + fpr.reasons.get(id(t), "?")
+        else:
+            status = f"miss {fp[:12]}"
+        lines.append(f"  t{i}: {type(t.extension).__name__} -- {status}")
+    return lines

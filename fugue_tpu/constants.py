@@ -121,6 +121,30 @@ FUGUE_TPU_CONF_PLAN_PUSHDOWN = "fugue.tpu.plan.pushdown"
 # streams)
 FUGUE_TPU_CONF_PLAN_FUSE = "fugue.tpu.plan.fuse"
 
+# content-addressed result cache (fugue_tpu/cache, docs/cache.md): memoize
+# task outputs ACROSS runs, keyed on canonical post-optimization plan
+# fingerprints. Master switch (default ON — with no cache.dir the cache is
+# memory-only and scoped to one engine); =false is byte-for-byte the
+# pre-cache execution path.
+FUGUE_TPU_CONF_CACHE_ENABLED = "fugue.tpu.cache.enabled"
+# artifact-store directory (shared across processes; atomic publishes).
+# Empty/unset = no disk tier. The FUGUE_TPU_CACHE_DIR env var is the
+# fallback when the conf key is unset. An unwritable dir degrades the
+# cache to memory-only with a single warning.
+FUGUE_TPU_CONF_CACHE_DIR = "fugue.tpu.cache.dir"
+# byte budget of the in-process LRU over live result frames
+FUGUE_TPU_CONF_CACHE_MEM_BYTES = "fugue.tpu.cache.mem_bytes"
+# size cap of the on-disk artifact store; LRU-evicted past it
+FUGUE_TPU_CONF_CACHE_DISK_BYTES = "fugue.tpu.cache.disk_bytes"
+# frames larger than this are never written to the disk tier (still
+# memory-cached when they fit the mem budget)
+FUGUE_TPU_CONF_CACHE_MAX_ARTIFACT_BYTES = "fugue.tpu.cache.max_artifact_bytes"
+# CreateData tables above this are REFUSED (poisoned), not content-hashed
+FUGUE_TPU_CONF_CACHE_FINGERPRINT_MAX_BYTES = "fugue.tpu.cache.fingerprint_max_bytes"
+# free-form namespace mixed into every fingerprint: bump it to invalidate
+# all entries without deleting files
+FUGUE_TPU_CONF_CACHE_SALT = "fugue.tpu.cache.salt"
+
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,

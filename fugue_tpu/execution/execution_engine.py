@@ -329,6 +329,7 @@ class ExecutionEngine(FugueEngineBase):
             reg = MetricsRegistry()
             reg.register("resilience", lambda: self.resilience_stats)
             reg.register("plan", lambda: self.plan_stats)
+            reg.register("cache", lambda: self.result_cache.stats)
             self._metrics = reg
         return self._metrics
 
@@ -376,6 +377,21 @@ class ExecutionEngine(FugueEngineBase):
 
             self._plan_stats = PlanStats()
         return self._plan_stats
+
+    @property
+    def result_cache(self) -> Any:
+        """This engine's :class:`~fugue_tpu.cache.ResultCache` — the
+        cross-run memoization layer (``fugue_tpu/cache``, docs/cache.md).
+        The memory tier is scoped to this engine (device frames are laid
+        out for its mesh); the disk tier is shared by every engine whose
+        conf points at the same ``fugue.tpu.cache.dir``. Counters live in
+        ``engine.stats()["cache"]``; ``engine.reset_stats()`` zeroes them
+        without evicting entries (the ``JitCache.reset`` contract)."""
+        if getattr(self, "_result_cache", None) is None:
+            from ..cache import ResultCache
+
+            self._result_cache = ResultCache(self.conf, log=self.log)
+        return self._result_cache
 
     # ---- physical ops (abstract) ------------------------------------------
     @abstractmethod

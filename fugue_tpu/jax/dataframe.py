@@ -488,6 +488,33 @@ class JaxDataFrame(DataFrame):
         return self._null_masks
 
     @property
+    def device_nbytes(self) -> int:
+        """Resident byte footprint for cache/LRU accounting: device column
+        buffers (plus masks) when materialized, else the pending host
+        table's arrow bytes. Never forces ingestion."""
+        if self._has_pending():
+            with self._pending_lock:
+                tbl = getattr(self, "_pending_tbl", None)
+                if tbl is not None:
+                    return int(tbl.nbytes)
+                src = getattr(self, "_pending_src", None)
+                if src is not None:
+                    # estimate without forcing the arrow conversion
+                    try:
+                        return int(src.count()) * max(1, len(src.schema)) * 16
+                    except Exception:
+                        return 0
+            return 0
+        total = 0
+        for arr in (getattr(self, "_device_cols", None) or {}).values():
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        for arr in (getattr(self, "_null_masks", None) or {}).values():
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        if getattr(self, "_valid_mask", None) is not None:
+            total += int(getattr(self._valid_mask, "nbytes", 0) or 0)
+        return total
+
+    @property
     def has_encoded(self) -> bool:
         """True when any device column is not plainly-typed (encoded or
         masked) — device fast paths that assume plain semantics must gate

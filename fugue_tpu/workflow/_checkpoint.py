@@ -23,7 +23,9 @@ from ..execution.execution_engine import ExecutionEngine
 def _atomic_publish(tmp: str, final: str) -> None:
     """Atomically move a finished write into place. ``tmp`` may be a single
     parquet file or a partitioned directory; same-directory rename is atomic
-    on POSIX for both."""
+    on POSIX for both. Also the publish discipline of the result cache's
+    artifact store (``fugue_tpu/cache/store.py``), so every durable frame
+    in the system is either absent or complete — never torn."""
     if os.path.isdir(tmp):
         if os.path.isdir(final):
             shutil.rmtree(final)

@@ -33,6 +33,7 @@ class FugueWorkflowContext:
         self._results: Dict[str, DataFrame] = {}
         self._aliases: Dict[int, FugueTask] = {}
         self._removed: Set[int] = set()
+        self._cache_plan: Any = None
         # fault budgets span the whole run (an injected `error@1` fails one
         # task once, not once per retry attempt)
         self._injector = FaultInjector.from_conf(execution_engine.conf)
@@ -63,6 +64,19 @@ class FugueWorkflowContext:
                 "yield_dataframe_as(), or disable the optimizer with "
                 "fugue.tpu.plan.optimize=false"
             )
+        plan = getattr(self, "_cache_plan", None)
+        if (
+            id(t) not in self._results
+            and plan is not None
+            and id(t) in plan.skipped
+        ):
+            raise FugueWorkflowError(
+                "this task was never executed: a downstream result-cache "
+                "hit cut the plan above it (fugue_tpu/cache, docs/cache.md);"
+                " pin it with persist()/checkpoint()/yield_dataframe_as() to"
+                " keep it addressable, or disable the cache with "
+                "fugue.tpu.cache.enabled=false"
+            )
         return self._results[id(t)]
 
     def has_result(self, task: FugueTask) -> bool:
@@ -83,6 +97,19 @@ class FugueWorkflowContext:
         self._aliases: Dict[int, FugueTask] = result_aliases or {}
         self._removed = removed_results or set()
         self._checkpoint_path.init_temp_path(execution_id)
+        # result cache (fugue_tpu/cache): fingerprint the post-optimization
+        # DAG, cut it at the deepest cached frontier, and eagerly load the
+        # frontier frames; tasks upstream of the cut never run. Disabled
+        # (fugue.tpu.cache.enabled=false) this whole block is one boolean
+        # check and the run path is byte-for-byte the pre-cache one.
+        self._cache_plan = None
+        cache = self._engine.result_cache
+        if cache.enabled:
+            from ..cache import plan_cache
+
+            self._cache_plan = plan_cache(
+                tasks, self._engine, cache, self._checkpoint_path
+            )
         # fan-out map: a ONE-PASS (local unbounded) result consumed by more
         # than one downstream task must be materialized once, or the second
         # consumer would silently read an exhausted stream
@@ -112,12 +139,17 @@ class FugueWorkflowContext:
         frame with the stored one — here we shortcut the execute too when
         the task's own inputs are checkpoint hits or absent)."""
         concurrency = self._engine.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
+        plan = getattr(self, "_cache_plan", None)
+        cut = plan.skipped if plan is not None else set()
         if concurrency <= 1:
             for t in tasks:
-                self._run_task(t)
+                if id(t) not in cut:
+                    self._run_task(t)
             return
-        remaining = {id(t): t for t in tasks}
-        done: Set[int] = set()
+        remaining = {id(t): t for t in tasks if id(t) not in cut}
+        # skipped tasks count as done so their consumers' readiness checks
+        # pass (a consumer that needed them would not have been cut)
+        done: Set[int] = set(cut)
         running: Dict[Future, int] = {}
         first_error: List[BaseException] = []
         with ThreadPoolExecutor(max_workers=concurrency) as pool:
@@ -194,6 +226,7 @@ class FugueWorkflowContext:
         from ..obs import get_tracer
 
         tid = task.__uuid__()
+        plan = getattr(self, "_cache_plan", None)
         cp = task.checkpoint
         if isinstance(cp, StrongCheckpoint):
             cp.set_id(tid)
@@ -208,7 +241,22 @@ class FugueWorkflowContext:
                     if task.yield_dataframe_handler is not None:
                         task.yield_dataframe_handler(df)
                     self._results[id(task)] = df
+                # one artifact, two indexes: the replayed checkpoint file
+                # gets a cache ref so future runs also cut ABOVE this task
+                self._maybe_cache_publish(task, df)
                 return
+        if plan is not None and id(task) in plan.hits:
+            # served from the result cache: the frame is already loaded
+            # (plan time); checkpoint/broadcast/yield contracts still run
+            with get_tracer().span(
+                "task.cache_hit",
+                cat="cache",
+                task_uuid=tid,
+                tier=plan.hit_tier.get(id(task), ""),
+            ):
+                result = task.set_result(self, plan.hits[id(task)])
+                self._results[id(task)] = result
+            return
         inputs = [self._results[id(d)] for d in task.inputs]
         self._injector.fire(SITE_TASK_EXECUTE)
         result = task.execute(self, inputs)
@@ -224,3 +272,41 @@ class FugueWorkflowContext:
                 # materialization so every consumer sees all rows
                 result = result.as_local_bounded()
             self._results[id(task)] = result
+            self._maybe_cache_publish(task, result)
+
+    def _maybe_cache_publish(self, task: FugueTask, result: DataFrame) -> None:
+        """Publish a finished (bounded) result under its plan fingerprint.
+        A permanent StrongCheckpoint file is indexed by reference instead
+        of re-written — the cache never holds a second copy of an artifact
+        the checkpoint publisher already owns."""
+        plan = getattr(self, "_cache_plan", None)
+        if plan is None:
+            return
+        fp = plan.fp(task)
+        if fp is None:
+            return
+        if result.is_local and not result.is_bounded:
+            return  # publishing would consume a one-pass stream
+        from ..obs import get_tracer
+
+        ref = None
+        cp = task.checkpoint
+        if (
+            isinstance(cp, StrongCheckpoint)
+            and cp.storage_type == "file"
+            and cp.permanent
+        ):
+            try:
+                ref = cp._file_path(self._checkpoint_path)
+            except Exception:
+                ref = None
+        with get_tracer().span(
+            "cache.publish",
+            cat="cache",
+            task=task.name or type(task.extension).__name__,
+            fp=fp[:12],
+        ) as sp:
+            info = self._engine.result_cache.publish(
+                fp, result, self._engine, str(result.schema), ref_path=ref
+            )
+            sp.set(**info)

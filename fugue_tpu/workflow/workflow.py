@@ -957,27 +957,58 @@ class FugueWorkflow:
         except Exception as ex:  # export must never fail the run
             engine.log.warning("trace export failed: %s", ex)
 
-    def explain(self, conf: Any = None) -> str:
+    def explain(self, conf: Any = None, engine: Any = None) -> str:
         """Render what the plan optimizer (``fugue_tpu/plan``) would do to
-        this workflow's DAG: the logical plan, the optimized plan with
+        this workflow's DAG — the logical plan, the optimized plan with
         per-pass counters (cols_pruned / filters_pushed / verbs_fused /
-        bytes_skipped estimate), and any refusal notes. Dry-run only —
-        nothing executes. After a ``run()``, the report of the plan that
-        actually executed is also available via ``last_plan_report``."""
+        bytes_skipped estimate), and any refusal notes — followed by the
+        result cache's would-be cut over the optimized plan: which tasks
+        hit, which are uncacheable (and why), and which upstream producers
+        a warm run would skip entirely. Dry-run only — nothing executes.
+        Pass ``engine`` to consult that engine's live cache tiers (memory
+        + disk); without it only a conf-derived disk store is probed.
+        After a ``run()``, the report of the plan that actually executed
+        is also available via ``last_plan_report``."""
+        from ..cache import describe_cache
         from ..constants import _FUGUE_GLOBAL_CONF
-        from ..plan import explain_tasks
+        from ..plan import optimize_tasks
+        from ..plan.ir import build_graph
+        from ..plan.optimizer import _render_nodes
 
         merged = ParamDict(_FUGUE_GLOBAL_CONF)
+        if engine is not None:
+            merged.update(ParamDict(engine.conf))
         merged.update(self._conf)
         if conf is not None:
             merged.update(ParamDict(conf))
-        return explain_tasks(self._tasks, merged)
+        run_tasks, _, _, report = optimize_tasks(self._tasks, merged)
+        if not report.before:
+            report.before = _render_nodes(build_graph(self._tasks))
+        lines = [report.render()]
+        lines.extend(
+            describe_cache(
+                run_tasks,
+                merged,
+                cache=None if engine is None else engine.result_cache,
+                engine_kind="any" if engine is None else type(engine).__name__,
+            )
+        )
+        return "\n".join(lines)
 
     @property
     def last_plan_report(self) -> Any:
         """The :class:`~fugue_tpu.plan.PlanReport` of the last ``run()``
         (None before the first run)."""
         return getattr(self, "_last_plan_report", None)
+
+    @property
+    def last_cache_plan(self) -> Any:
+        """The :class:`~fugue_tpu.cache.CachePlan` of the last ``run()``:
+        fingerprints, frontier hits and the skipped-upstream set (None
+        before the first run or with the cache disabled)."""
+        if self._last_context is None:
+            return None
+        return getattr(self._last_context, "_cache_plan", None)
 
     def release_task_results(self) -> None:
         """Drop the per-task result frames held by the last run's context.

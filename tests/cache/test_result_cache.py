@@ -1,0 +1,817 @@
+"""Content-addressed result cache (``fugue_tpu/cache``, docs/cache.md) — ISSUE 5.
+
+The checklist:
+
+- bit-identical parity: every cached-hit workflow result equals the
+  uncached run across transform / filter / join / aggregate / SQL /
+  streaming paths, optimizer ON and OFF;
+- invalidation: mutated Load file, edited UDF source, changed
+  PartitionSpec, cache salt, optimizer-setting stability;
+- refusal (poisoning): non-deterministic markers, streams, seedless
+  sample — a refused node is a miss, never a wrong hit;
+- frontier cut: warm runs execute ZERO producer tasks upstream of the
+  cut (span absence + ``bytes_skipped``), interior results raise a
+  descriptive error;
+- durability: persist survives an engine restart via the artifact
+  store; torn artifacts fall back to recompute; a two-process publish
+  race leaves one valid artifact;
+- lifecycle: ``reset_stats`` zeroes counters without evicting entries;
+  disabled (`fugue.tpu.cache.enabled=false`) is the pre-cache path.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.cache import ResultCache, clean_cache_dir, non_deterministic
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_CACHE_DIR,
+    FUGUE_TPU_CONF_CACHE_ENABLED,
+    FUGUE_TPU_CONF_CACHE_SALT,
+    FUGUE_TPU_CONF_PLAN_OPTIMIZE,
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.exceptions import FugueWorkflowError
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.obs import get_tracer
+
+
+def _frame(n=3000, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 16, n),
+            "v": rng.random(n),
+            "w": rng.random(n),
+            "s": rng.choice(["a", "b", "c", None], n),
+        }
+    )
+
+
+def _stream(pdf: pd.DataFrame, step: int = 512):
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+def _run(build, conf, engine_cls=JaxExecutionEngine, engine=None, sort=None):
+    eng = engine if engine is not None else engine_cls(conf)
+    dag = FugueWorkflow()
+    build(dag)
+    dag.run(eng)
+    res = dag.yields["r"].result.as_pandas()
+    if sort:
+        res = res.sort_values(sort).reset_index(drop=True)
+    return res, eng, dag
+
+
+def _cache_stats(eng):
+    return eng.stats()["cache"]
+
+
+# ---------------------------------------------------------------------------
+# bit-identical parity: warm hit == cold run == cache-off run
+# ---------------------------------------------------------------------------
+
+
+def _parity_case(build, tmp_path, sort=None, engine_cls=JaxExecutionEngine):
+    """cold (publishes) -> warm on a FRESH engine (disk hit) -> reference
+    with the cache disabled; all three must be bit-identical, and with
+    the optimizer ON and OFF the warm result must not change."""
+    for opt in (True, False):
+        d = str(tmp_path / f"cache_opt_{opt}")
+        conf = {
+            FUGUE_TPU_CONF_CACHE_DIR: d,
+            FUGUE_TPU_CONF_PLAN_OPTIMIZE: opt,
+        }
+        off = dict(conf)
+        off[FUGUE_TPU_CONF_CACHE_ENABLED] = False
+        cold, ce, _ = _run(build, conf, engine_cls, sort=sort)
+        warm, we, _ = _run(build, conf, engine_cls, sort=sort)
+        ref, _, _ = _run(build, off, engine_cls, sort=sort)
+        assert _cache_stats(we)["hits_disk"] >= 1, _cache_stats(we)
+        pd.testing.assert_frame_equal(cold, warm)
+        pd.testing.assert_frame_equal(warm, ref)
+
+
+def test_parity_aggregate(tmp_path):
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _parity_case(build, tmp_path, sort=["k"])
+
+
+def test_parity_filter_select(tmp_path):
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.4)
+            .select(col("k"), col("v"), (col("v") * 2).alias("v2"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _parity_case(build, tmp_path)
+
+
+def test_parity_join(tmp_path):
+    left = _frame(800, seed=1)
+    right = pd.DataFrame({"k": np.arange(16), "label": [f"g{i}" for i in range(16)]})
+
+    def build(dag):
+        a = dag.df(left)
+        b = dag.df(right)
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+
+    _parity_case(build, tmp_path, sort=["k", "v"])
+
+
+def test_parity_transform_udf(tmp_path):
+    pdf = _frame(1000, seed=2)
+
+    # schema: *,v2:double
+    def demean(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(v2=df["v"] - df["v"].mean())
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .transform(demean)
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _parity_case(build, tmp_path, sort=["k", "v"])
+
+
+def test_parity_sql(tmp_path):
+    pdf = _frame(1200, seed=3)
+
+    def build(dag):
+        a = dag.df(pdf)
+        dag.select(
+            "SELECT k, SUM(v) AS s FROM", a, "GROUP BY k"
+        ).yield_dataframe_as("r", as_local=True)
+
+    _parity_case(build, tmp_path, sort=["k"])
+
+
+def test_parity_native_engine(tmp_path):
+    pdf = _frame(700, seed=4)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.avg(col("w")).alias("m"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _parity_case(build, tmp_path, sort=["k"], engine_cls=NativeExecutionEngine)
+
+
+def test_streaming_input_refuses_but_downstream_parity(tmp_path):
+    """A one-pass stream CreateData poisons its subtree (hashing would
+    consume it) — both runs recompute, results stay bit-identical, and
+    the refusal is counted."""
+    pdf = _frame(2000, seed=5)
+    d = str(tmp_path / "cache_stream")
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    conf = {
+        FUGUE_TPU_CONF_CACHE_DIR: d,
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 512,
+    }
+    cold, ce, _ = _run(build, conf, sort=["k"])
+    warm, we, _ = _run(build, conf, sort=["k"])
+    pd.testing.assert_frame_equal(cold, warm)
+    assert _cache_stats(we)["hits_disk"] == 0
+    assert _cache_stats(we)["refusals"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the frontier cut: producers upstream of a hit never run
+# ---------------------------------------------------------------------------
+
+
+def test_warm_run_skips_producers_zero_spans(tmp_path):
+    """Span absence + counters: the warm run records NO engine verbs and
+    NO workflow.task spans for the skipped Load/Filter producers, and
+    bytes_skipped covers >=90% of the source file."""
+    d = str(tmp_path / "cache")
+    src = str(tmp_path / "src.parquet")
+    rng = np.random.default_rng(7)
+    n = 50_000
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 32, n),
+                "v": rng.random(n),
+                **{f"x{i}": rng.random(n) for i in range(6)},
+            }
+        ),
+        src,
+    )
+
+    def build(dag):
+        (
+            dag.load(src)
+            .filter(col("v") > 0.25)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+    cold, _, _ = _run(build, conf, sort=["k"])
+    tr = get_tracer()
+    was = tr.enabled
+    tr.enable()
+    tr.clear()
+    try:
+        warm, we, dag = _run(build, conf, sort=["k"])
+        names = [r["name"] for r in tr.records()]
+    finally:
+        if not was:
+            tr.disable()
+        tr.clear()
+    pd.testing.assert_frame_equal(cold, warm)
+    # zero producer-side work: no load/filter/aggregate verbs, no chunk
+    # spans, one task span (the served hit); rehydration (engine.to_df of
+    # the small artifact) is the only engine activity allowed
+    producer_spans = [
+        n
+        for n in names
+        if n in ("engine.filter", "engine.aggregate", "stream.chunk")
+        or n.startswith("engine.load")
+    ]
+    assert producer_spans == [], names
+    assert names.count("workflow.task") == 1, names
+    assert "cache.lookup" in names and "task.cache_hit" in names, names
+    st = _cache_stats(we)
+    assert st["tasks_skipped"] == 2
+    assert st["bytes_skipped"] >= 0.9 * os.path.getsize(src)
+    plan = dag.last_cache_plan
+    assert plan.summary()["executes"] == 0
+
+
+def test_skipped_interior_result_raises_descriptive(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(500, seed=8)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def run_once():
+        eng = JaxExecutionEngine(conf)
+        dag = FugueWorkflow()
+        mid = dag.df(pdf).filter(col("v") > 0.5)
+        mid.partition_by("k").aggregate(ff.sum(col("v")).alias("s")).yield_dataframe_as(
+            "r", as_local=True
+        )
+        dag.run(eng)
+        return dag, mid
+
+    run_once()
+    dag, mid = run_once()  # warm: create+filter skipped
+    with pytest.raises(FugueWorkflowError, match="result-cache"):
+        _ = mid.result
+
+
+def test_explain_renders_cut_points(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(400, seed=9)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.1)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _, eng, _ = _run(build, conf)
+    dag = FugueWorkflow()
+    build(dag)
+    text = dag.explain(engine=eng)
+    assert "result cache" in text
+    assert "HIT[" in text
+    assert "skipped (downstream hit cuts the plan here)" in text
+
+
+# ---------------------------------------------------------------------------
+# invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_mutated_load_file_invalidates(tmp_path):
+    d = str(tmp_path / "cache")
+    src = str(tmp_path / "src.parquet")
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def write(seed):
+        rng = np.random.default_rng(seed)
+        pq.write_table(
+            pa.table({"k": rng.integers(0, 8, 2000), "v": rng.random(2000)}), src
+        )
+
+    def build(dag):
+        (
+            dag.load(src)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    write(0)
+    r1, _, _ = _run(build, conf, sort=["k"])
+    time.sleep(0.01)  # ensure a distinct mtime even on coarse filesystems
+    write(1)  # same path, new content (size and/or mtime change)
+    r2, e2, _ = _run(build, conf, sort=["k"])
+    assert _cache_stats(e2)["hits_disk"] == 0
+    assert not r1.equals(r2)
+    off = dict(conf)
+    off[FUGUE_TPU_CONF_CACHE_ENABLED] = False
+    ref, _, _ = _run(build, off, sort=["k"])
+    pd.testing.assert_frame_equal(r2, ref)
+
+
+def test_edited_udf_source_invalidates(tmp_path):
+    """Two UDFs with the SAME name/module but different bodies must not
+    share a fingerprint (the task-uuid layer, which only hashes
+    module+qualname, would false-hit here)."""
+    d = str(tmp_path / "cache")
+    pdf = _frame(600, seed=10)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def make_udf(version):
+        ns = {"pd": pd}
+        body = "+ 1.0" if version == 1 else "+ 2.0"
+        exec(
+            "def bump(df: pd.DataFrame) -> pd.DataFrame:\n"
+            f"    return df.assign(v=df['v'] {body})\n",
+            ns,
+        )
+        return ns["bump"]
+
+    def build_with(udf):
+        def build(dag):
+            (
+                dag.df(pdf)
+                .partition_by("k")
+                .transform(udf, schema="*")
+                .yield_dataframe_as("r", as_local=True)
+            )
+
+        return build
+
+    r1, _, _ = _run(build_with(make_udf(1)), conf, sort=["k", "v"])
+    r1b, e1b, d1b = _run(build_with(make_udf(1)), conf, sort=["k", "v"])
+    assert _cache_stats(e1b)["hits_disk"] >= 1  # same source: hit
+    assert d1b.last_cache_plan.summary()["executes"] == 0
+    pd.testing.assert_frame_equal(r1, r1b)
+    r2, _, d2 = _run(build_with(make_udf(2)), conf, sort=["k", "v"])
+    assert d2.last_cache_plan.summary()["executes"] >= 1  # edited: recompute
+    assert not r1.equals(r2)
+
+
+def test_closure_value_differentiates_udfs(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(400, seed=11)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def make(offset):
+        # schema: *
+        def shift(df: pd.DataFrame) -> pd.DataFrame:
+            return df.assign(v=df["v"] + offset)
+
+        return shift
+
+    def build_with(udf):
+        def build(dag):
+            dag.df(pdf).transform(udf, schema="*").yield_dataframe_as(
+                "r", as_local=True
+            )
+
+        return build
+
+    r1, _, _ = _run(build_with(make(1.0)), conf, sort=["k", "v"])
+    r2, _, d2 = _run(build_with(make(5.0)), conf, sort=["k", "v"])
+    assert d2.last_cache_plan.summary()["executes"] >= 1  # not served
+    assert not r1.equals(r2)
+
+
+def test_partition_spec_and_salt_invalidate(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(500, seed=12)
+
+    def build_by(key):
+        def build(dag):
+            (
+                dag.df(pdf)
+                .partition_by(key)
+                .aggregate(ff.count(col("v")).alias("n"))
+                .yield_dataframe_as("r", as_local=True)
+            )
+
+        return build
+
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+    _run(build_by("k"), conf)
+    _, _, d2 = _run(build_by("s"), conf)  # different PartitionSpec: miss
+    assert d2.last_cache_plan.summary()["executes"] >= 1
+    _, e3, d3 = _run(build_by("k"), conf)  # same spec: hit
+    assert _cache_stats(e3)["hits_disk"] >= 1
+    assert d3.last_cache_plan.summary()["executes"] == 0
+    salted = dict(conf)
+    salted[FUGUE_TPU_CONF_CACHE_SALT] = "v2"
+    _, e4, _ = _run(build_by("k"), salted)  # salt bump: global invalidation
+    assert _cache_stats(e4)["hits_disk"] == 0
+
+
+def test_optimizer_setting_stability(tmp_path):
+    """Fingerprints are computed over the POST-optimization plan: the
+    same setting twice -> warm hit; toggling the optimizer changes the
+    executed plan -> safe miss, and results stay identical either way."""
+    pdf = _frame(900, seed=13)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.3)
+            .select(col("k"), col("v"))
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    d = str(tmp_path / "cache")
+    on = {FUGUE_TPU_CONF_CACHE_DIR: d, FUGUE_TPU_CONF_PLAN_OPTIMIZE: True}
+    off = {FUGUE_TPU_CONF_CACHE_DIR: d, FUGUE_TPU_CONF_PLAN_OPTIMIZE: False}
+    r_on, _, _ = _run(build, on, sort=["k"])
+    r_on2, e2, _ = _run(build, on, sort=["k"])
+    assert _cache_stats(e2)["hits_disk"] >= 1  # stable across identical runs
+    r_off, _, _ = _run(build, off, sort=["k"])
+    pd.testing.assert_frame_equal(r_on, r_on2)
+    pd.testing.assert_frame_equal(r_on, r_off)
+
+
+# ---------------------------------------------------------------------------
+# refusal / poisoning
+# ---------------------------------------------------------------------------
+
+
+def test_non_deterministic_marker_poisons_subtree(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(300, seed=14)
+    calls = {"n": 0}
+
+    @non_deterministic
+    def jitter(df: pd.DataFrame) -> pd.DataFrame:
+        calls["n"] += 1
+        return df.assign(v=df["v"] + 0.0)
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .transform(jitter, schema="*")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+    _run(build, conf)
+    _, e2, d2 = _run(build, conf)
+    # the marked transform AND its downstream aggregate recompute
+    assert calls["n"] >= 2
+    st = _cache_stats(e2)
+    assert st["refusals"] >= 2  # transform + poisoned aggregate
+    assert d2.last_cache_plan.summary()["executes"] >= 2
+
+
+def test_seedless_sample_refuses(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(500, seed=15)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        dag.df(pdf).sample(frac=0.5).yield_dataframe_as("r", as_local=True)
+
+    _run(build, conf)
+    _, e2, d2 = _run(build, conf)
+    assert d2.last_cache_plan.summary()["executes"] >= 1  # sample reruns
+    assert _cache_stats(e2)["refusals"] >= 1
+
+    def build_seeded(dag):
+        dag.df(pdf).sample(frac=0.5, seed=42).yield_dataframe_as("r", as_local=True)
+
+    r1, _, _ = _run(build_seeded, conf)
+    r2, e4, d4 = _run(build_seeded, conf)
+    assert _cache_stats(e4)["hits_disk"] >= 1
+    assert d4.last_cache_plan.summary()["executes"] == 0
+    pd.testing.assert_frame_equal(r1, r2)
+
+
+# ---------------------------------------------------------------------------
+# durability: persist across restart, torn artifacts, publish races
+# ---------------------------------------------------------------------------
+
+
+def test_persist_survives_engine_restart(tmp_path):
+    """An explicit persist() publishes to the artifact store, so a FRESH
+    engine (a new process in production) serves it without recomputing."""
+    d = str(tmp_path / "cache")
+    pdf = _frame(800, seed=16)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.2)
+            .persist()
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    r1, _, _ = _run(build, conf, sort=["k"])
+    r2, e2, _ = _run(build, conf, sort=["k"])  # new engine = restart
+    assert _cache_stats(e2)["hits_disk"] >= 1
+    pd.testing.assert_frame_equal(r1, r2)
+
+
+def test_strong_checkpoint_single_artifact_two_indexes(tmp_path):
+    """A deterministic StrongCheckpoint file is INDEXED by the cache (a
+    ref), never copied: one artifact on disk, addressable both by task
+    uuid (checkpoint replay) and by fingerprint (memoization)."""
+    d = str(tmp_path / "cache")
+    cp = str(tmp_path / "checkpoints")
+    pdf = _frame(600, seed=17)
+    conf = {
+        FUGUE_TPU_CONF_CACHE_DIR: d,
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: cp,
+    }
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.4)
+            .deterministic_checkpoint()
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    r1, e1, _ = _run(build, conf, sort=["k"])
+    assert _cache_stats(e1)["links"] >= 1  # ref, not a copy
+    objs = os.path.join(d, "objs")
+    refs = [f for f in os.listdir(objs) if f.endswith(".ref.json")]
+    assert len(refs) >= 1
+    with open(os.path.join(objs, refs[0])) as f:
+        target = json.load(f)["path"]
+    assert os.path.dirname(os.path.abspath(target)) == os.path.abspath(cp)
+    r2, e2, _ = _run(build, conf, sort=["k"])
+    pd.testing.assert_frame_equal(r1, r2)
+
+
+def test_torn_artifact_falls_back_to_recompute(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(700, seed=18)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    r1, _, _ = _run(build, conf, sort=["k"])
+    objs = os.path.join(d, "objs")
+    for f in os.listdir(objs):
+        if f.endswith(".parquet"):
+            with open(os.path.join(objs, f), "r+b") as fh:  # tear every artifact
+                fh.truncate(16)
+    r2, e2, _ = _run(build, conf, sort=["k"])
+    pd.testing.assert_frame_equal(r1, r2)
+    assert _cache_stats(e2)["hits_disk"] == 0
+    # the torn files were removed; a third run republishes and hits again
+    r3, e3, _ = _run(build, conf, sort=["k"])
+    assert _cache_stats(e3)["hits_disk"] >= 1
+    pd.testing.assert_frame_equal(r1, r3)
+
+
+def _race_worker(args):
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_CACHE_DIR
+    from fugue_tpu.execution import NativeExecutionEngine
+
+    d, seed = args
+    rng = np.random.default_rng(0)  # SAME data in both processes
+    pdf = pd.DataFrame({"k": rng.integers(0, 8, 4000), "v": rng.random(4000)})
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_CACHE_DIR: d})
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    return dag.yields["r"].result.as_pandas().sort_values("k").values.tolist()
+
+
+def test_concurrent_two_process_publish_race(tmp_path):
+    """Two processes publishing the same fingerprints concurrently: both
+    succeed, the surviving artifacts are complete, and a warm third run
+    hits them."""
+    import multiprocessing as mp
+
+    d = str(tmp_path / "cache")
+    ctx = mp.get_context("fork")
+    with ctx.Pool(2) as pool:
+        outs = pool.map(_race_worker, [(d, 0), (d, 0)])
+    assert outs[0] == outs[1]
+    warm = _race_worker((d, 0))
+    assert warm == outs[0]
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_CACHE_DIR: d})
+    cache = eng.result_cache
+    objs = os.listdir(os.path.join(d, "objs"))
+    assert any(f.endswith(".parquet") for f in objs)
+    # every artifact loads cleanly
+    for f in objs:
+        if f.endswith(".parquet"):
+            assert cache.disk.load(f[: -len(".parquet")], eng) is not None
+
+
+# ---------------------------------------------------------------------------
+# lifecycle and the disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_reset_stats_zeroes_counters_keeps_entries(tmp_path):
+    """Mirrors the JitCache.reset contract: counters to zero, live
+    entries untouched — a reset must never turn into a perf event."""
+    d = str(tmp_path / "cache")
+    pdf = _frame(400, seed=19)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _, eng, _ = _run(build, conf)
+    assert _cache_stats(eng)["publishes"] >= 1
+    entries_before = _cache_stats(eng)["mem_entries"]
+    eng.reset_stats()
+    st = _cache_stats(eng)
+    assert st["publishes"] == 0 and st["lookups"] == 0
+    assert st["mem_entries"] == entries_before  # entries survive the reset
+    dag = FugueWorkflow()
+    build(dag)
+    dag.run(eng)  # memory-tier hit straight after the reset
+    assert _cache_stats(eng)["hits_mem"] >= 1
+
+
+def test_disabled_is_pre_cache_path(tmp_path):
+    pdf = _frame(500, seed=20)
+    conf = {FUGUE_TPU_CONF_CACHE_ENABLED: False}
+
+    def build(dag):
+        mid = dag.df(pdf).filter(col("v") > 0.5)
+        mid.partition_by("k").aggregate(ff.sum(col("v")).alias("s")).yield_dataframe_as(
+            "r", as_local=True
+        )
+        return mid
+
+    eng = JaxExecutionEngine(conf)
+    for _ in range(2):
+        dag = FugueWorkflow()
+        mid = build(dag)
+        dag.run(eng)
+        _ = mid.result  # interior results stay addressable
+    st = _cache_stats(eng)
+    assert all(
+        v in (0, False) for k, v in st.items() if k not in ("disk_enabled",)
+    ), st
+    assert dag.last_cache_plan is None
+
+
+def test_disabled_overhead_under_2_percent():
+    """The <2% contract, mirroring the tracer's disabled-path guard: with
+    the cache disabled the per-run cost is one enabled check at plan time
+    plus one plan-is-None check per task. Charge the measured worst-case
+    cost of both against a small workflow's wall."""
+    pdf = _frame(30_000, seed=21)
+    conf = {FUGUE_TPU_CONF_CACHE_ENABLED: False}
+    eng = JaxExecutionEngine(conf)
+    cache = eng.result_cache
+
+    def run():
+        dag = FugueWorkflow()
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run(eng)
+
+    run()  # warmup (jit)
+    t0 = time.perf_counter()
+    run()
+    wall = time.perf_counter() - t0
+    # worst-case disabled site: reading cache.enabled + a dict get
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if cache.enabled:
+            raise AssertionError
+    per_call = (time.perf_counter() - t0) / n
+    sites = 3 * 10  # 3 tasks, generously 10 checks each
+    assert per_call * sites < 0.02 * wall, (per_call, wall)
+
+
+def test_unwritable_dir_degrades_to_memory_only(tmp_path):
+    # a plain FILE at the conf'd path: makedirs fails even for root
+    # (chmod-based unwritability is invisible to a root test runner)
+    d = str(tmp_path / "ro")
+    with open(d, "w") as f:
+        f.write("not a directory")
+    pdf = _frame(300, seed=22)
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: d}
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    _, eng, _ = _run(build, conf, engine_cls=NativeExecutionEngine)
+    st = _cache_stats(eng)
+    assert st["disk_enabled"] is False  # degraded, not crashed
+    # memory tier still works on the same engine
+    dag = FugueWorkflow()
+    build(dag)
+    dag.run(eng)
+    assert _cache_stats(eng)["hits_mem"] >= 1
+
+
+def test_clean_cache_dir_helper(tmp_path):
+    d = str(tmp_path / "cache")
+    pdf = _frame(200, seed=23)
+
+    def build(dag):
+        dag.df(pdf).partition_by("k").aggregate(
+            ff.sum(col("v")).alias("s")
+        ).yield_dataframe_as("r", as_local=True)
+
+    _run(build, {FUGUE_TPU_CONF_CACHE_DIR: d}, engine_cls=NativeExecutionEngine)
+    assert any(f.endswith(".parquet") for f in os.listdir(os.path.join(d, "objs")))
+    msg = clean_cache_dir(d)
+    assert "removed" in msg
+    assert not os.path.isdir(os.path.join(d, "objs"))
+    assert "nothing cleaned" in clean_cache_dir("")

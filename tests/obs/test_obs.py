@@ -414,7 +414,7 @@ def test_engine_stats_surface_and_shims():
     )
     try:
         st = e.stats()
-        assert set(st) == {"resilience", "pipeline", "jit_cache", "plan"}
+        assert set(st) == {"resilience", "pipeline", "jit_cache", "plan", "cache"}
         # the deprecation shims delegate to the SAME objects the registry holds
         assert e.pipeline_stats is e.metrics.get("pipeline")
         assert e.resilience_stats is e.metrics.get("resilience")
