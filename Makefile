@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-cache cache-clean trace-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-cache cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -14,6 +14,7 @@ test:
 # recorded value (BENCH_SMOKE_BASELINE.json for this env, else BENCH_r05)
 bench-smoke:
 	python bench.py --smoke
+	-@python bench.py --compare BENCH_SMOKE_BASELINE.json  # non-blocking drift report
 
 # large-scale proofs (100M-row streaming, 100Mx1M join) — excluded from the
 # default run by addopts='-m "not slow"'; the explicit -m here overrides it
@@ -61,6 +62,14 @@ trace-smoke:
 	python -c "from fugue_tpu.obs import validate_chrome_trace; \
 	  s = validate_chrome_trace('/tmp/fugue_trace_smoke/trace.json'); \
 	  print('trace OK:', s['spans'], 'spans,', s['events'], 'events')"
+
+# live-telemetry round trip (docs/observability.md): run a small traced +
+# sampled streaming workflow with /metrics bound to the engine, scrape it
+# while the run is in flight, validate the Prometheus exposition, and
+# assert the exported trace carries device_bytes/overlap_fraction
+# Perfetto counter tracks
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python bench.py --telemetry-smoke /tmp/fugue_telemetry_smoke
 
 bench:
 	python bench.py

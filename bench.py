@@ -89,6 +89,11 @@ TUNED_PATH = os.path.join(REPO_ROOT, "fugue_tpu", "ops", "_tuned.json")
 # (each probe spawns a jax-importing subprocess — real contention on a
 # 1-core box that would skew the very numbers being measured)
 BENCH_LOCK = os.path.join(REPO_ROOT, ".bench_running.lock")
+# --smoke drops its result JSON here so `bench.py --compare <baseline>`
+# can diff a fresh run against a committed baseline without re-running
+SMOKE_LAST_PATH = os.environ.get(
+    "BENCH_SMOKE_LAST", "/tmp/fugue_bench_smoke_last.json"
+)
 
 
 class _bench_lock:
@@ -1194,26 +1199,28 @@ def _smoke() -> None:
     # result-cache cold/warm case (ISSUE 5): the warm run must skip >=90%
     # of producer bytes, execute zero producer tasks, and be >=3x faster
     cache_case = _bench_result_cache(rows=150_000, wide_cols=10)
-    print(
-        json.dumps(
-            {
-                "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
-                "value": round(r["rps"], 1),
-                "unit": "rows/s",
-                "vs_baseline": round(ratio, 3),
-                "baseline_rows_per_sec": round(host_rps, 1),
-                "baseline_source": baseline_source,
-                "recorded_rows_per_sec": recorded_rps,
-                "recorded_vs_baseline": recorded_ratio,
-                "threshold": threshold,
-                "regressed": regressed,
-                "correct": bool(r["ok"]),
-                "plan_pruning": plan_case,
-                "result_cache": cache_case,
-                "wall_s": round(time.perf_counter() - t0, 1),
-            }
-        )
-    )
+    result = {
+        "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
+        "value": round(r["rps"], 1),
+        "unit": "rows/s",
+        "vs_baseline": round(ratio, 3),
+        "baseline_rows_per_sec": round(host_rps, 1),
+        "baseline_source": baseline_source,
+        "recorded_rows_per_sec": recorded_rps,
+        "recorded_vs_baseline": recorded_ratio,
+        "threshold": threshold,
+        "regressed": regressed,
+        "correct": bool(r["ok"]),
+        "plan_pruning": plan_case,
+        "result_cache": cache_case,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    try:  # drop the result where --compare picks it up (best effort)
+        with open(SMOKE_LAST_PATH, "w") as f:
+            json.dump(result, f)
+    except Exception:
+        pass
+    print(json.dumps(result))
     if not r["ok"]:
         raise SystemExit(5)
     if regressed:
@@ -1291,6 +1298,245 @@ def _trace_smoke(trace_dir: str) -> None:
         if not was_enabled:
             tracer.disable()
         tracer.clear()
+
+
+def _collect_compare_metrics(d: Any, prefix: str = "") -> dict:
+    """Walk a bench-result dict collecting the comparable higher-is-better
+    metrics: every numeric ``value``/``vs_baseline`` leaf plus any
+    ``speedup*`` key, path-qualified (``plan_pruning.speedup...``)."""
+    out: dict = {}
+    if not isinstance(d, dict):
+        return out
+    for k, v in d.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_collect_compare_metrics(v, path))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            if k in ("value", "vs_baseline") or str(k).startswith("speedup"):
+                out[path] = float(v)
+    return out
+
+
+def _compare(baseline_path: str, current_path: Optional[str] = None) -> None:
+    """``bench.py --compare <baseline.json> [current.json]``: diff a bench
+    result against a committed baseline (BENCH_SMOKE_BASELINE.json / a
+    BENCH_r0N.json / any prior ``--smoke`` output — the current side
+    defaults to the last ``--smoke`` result) and exit non-zero with a
+    labeled report when any comparable metric dropped >20%
+    (``BENCH_COMPARE_THRESHOLD`` overrides the 0.8 ratio floor). Pure
+    JSON diff — nothing is re-run — so ``make bench-smoke`` wires it in
+    as a non-blocking report after the blocking gate, matching the
+    existing gate style (labeled failure, dedicated exit code, no stack
+    trace)."""
+    threshold = float(os.environ.get("BENCH_COMPARE_THRESHOLD", "0.8"))
+    current_path = current_path or SMOKE_LAST_PATH
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except Exception as ex:
+        print(f"--compare: cannot read baseline {baseline_path}: {ex}", file=sys.stderr)
+        raise SystemExit(2)
+    try:
+        with open(current_path) as f:
+            current = json.load(f)
+    except Exception as ex:
+        print(
+            f"--compare: cannot read current run {current_path}: {ex} "
+            "(run `python bench.py --smoke` first, or pass a result file)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    base = _collect_compare_metrics(baseline)
+    cur = _collect_compare_metrics(current)
+    regressions = []
+    compared = 0
+    for name in sorted(base):
+        if base[name] <= 0:
+            continue
+        if name not in cur:
+            print(f"compare {name}: baseline={base[name]:.4g} current=MISSING (skipped)")
+            continue
+        compared += 1
+        r = cur[name] / base[name]
+        tag = "  << REGRESSION (>20% drop)" if r < threshold else ""
+        if tag:
+            regressions.append({"metric": name, "baseline": base[name],
+                                "current": cur[name], "ratio": round(r, 3)})
+        print(
+            f"compare {name}: baseline={base[name]:.4g} current={cur[name]:.4g} "
+            f"ratio={r:.3f}{tag}"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "bench_compare",
+                "baseline": os.path.basename(baseline_path),
+                "current": os.path.basename(current_path),
+                "threshold": threshold,
+                "compared": compared,
+                "regressions": regressions,
+            }
+        )
+    )
+    if compared == 0:
+        print("--compare: no comparable metrics found", file=sys.stderr)
+        raise SystemExit(2)
+    if regressions:
+        raise SystemExit(8)
+
+
+def _telemetry_smoke(out_dir: str) -> None:
+    """``make telemetry-smoke``: the live-telemetry round-trip proof.
+
+    Runs one small traced+sampled streaming-aggregate workflow with an
+    HTTP server bound to the engine, scrapes ``GET /metrics`` while the
+    run is in flight (plus once after, deterministically), validates the
+    Prometheus exposition and that histogram counts match the recorded
+    spans, then exports the Chrome trace and asserts it carries Perfetto
+    counter tracks for device bytes and overlap_fraction."""
+    import threading as _threading
+    import urllib.request
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+        FUGUE_TPU_CONF_TELEMETRY_ENABLED,
+        FUGUE_TPU_CONF_TELEMETRY_INTERVAL,
+    )
+    from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.obs import (
+        get_sampler,
+        get_span_metrics,
+        get_tracer,
+        validate_chrome_trace,
+        validate_prometheus_text,
+        write_chrome_trace,
+    )
+    from fugue_tpu.rpc.http import HttpRPCServer
+
+    os.makedirs(out_dir, exist_ok=True)
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enable()
+    tracer.clear()
+    get_span_metrics().clear()
+    sampler = get_sampler()
+    sampler.clear()
+    rng = np.random.default_rng(11)
+    n = 60_000
+    step = 2048
+    tbl = pa.Table.from_pandas(
+        pd.DataFrame({"k": rng.integers(0, 128, n), "v": rng.random(n)}),
+        preserve_index=False,
+    )
+    stream = LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+    eng = JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: step,
+            FUGUE_TPU_CONF_TELEMETRY_ENABLED: True,
+            FUGUE_TPU_CONF_TELEMETRY_INTERVAL: 0.02,
+        }
+    )
+    server = HttpRPCServer(eng.conf)
+    eng.set_rpc_server(server)
+    server.start()
+    inflight: dict = {"scrapes": 0, "last": None}
+    done = _threading.Event()
+
+    def _scrape_loop() -> None:
+        url = f"http://{server.host}:{server.port}/metrics"
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    body = resp.read().decode()
+                if "fugue_tpu_span_latency_seconds_bucket" in body:
+                    inflight["scrapes"] += 1
+                    inflight["last"] = body
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    scraper = _threading.Thread(target=_scrape_loop, daemon=True)
+    try:
+        scraper.start()
+        dag = FugueWorkflow()
+        res = (
+            dag.df(stream)
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+        )
+        res.yield_dataframe_as("r", as_local=True)
+        dag.run(eng)
+        assert len(dag.yields["r"].result.as_pandas()) == 128
+        done.set()
+        scraper.join(timeout=5)
+        sampler.sample_once()  # deterministic: >=1 sample even on a fast box
+        # final scrape (always succeeds: server still bound and running)
+        import urllib.request as _ur
+
+        with _ur.urlopen(
+            f"http://{server.host}:{server.port}/metrics", timeout=5
+        ) as resp:
+            final = resp.read().decode()
+        prom = validate_prometheus_text(final)
+        assert "fugue_tpu_span_latency_seconds_bucket" in final, "no histograms"
+        assert 'span="stream.chunk"' in final and 'workflow="wf-' in final, (
+            "span/workflow labels missing from exposition"
+        )
+        assert "fugue_tpu_resource_device_bytes" in final, "no resource gauges"
+        with _ur.urlopen(
+            f"http://{server.host}:{server.port}/healthz", timeout=5
+        ) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+        # histogram counts must agree with the recorded spans
+        chunks = [r for r in tracer.records() if r["name"] == "stream.chunk"]
+        summary = get_span_metrics().summary()
+        assert summary["stream.chunk"]["count"] == len(chunks) > 0, summary.get(
+            "stream.chunk"
+        )
+        # trace round-trip: spans + resource counter tracks in one file
+        path = write_chrome_trace(os.path.join(out_dir, "trace.json"))
+        tsum = validate_chrome_trace(path)
+        assert "stream.chunk" in tsum["names"], tsum["names"]
+        assert tsum["counters"] > 0, "no counter-track events in trace"
+        for want in ("device_bytes", "overlap_fraction"):
+            assert want in tsum["counter_names"], (want, tsum["counter_names"])
+        print(
+            json.dumps(
+                {
+                    "metric": "telemetry_smoke",
+                    "trace": path,
+                    "inflight_scrapes": inflight["scrapes"],
+                    "prom_samples": prom["samples"],
+                    "histogram_series": prom["histogram_series"],
+                    "counter_tracks": tsum["counter_names"],
+                    "stream_chunk_p99_ms": summary["stream.chunk"]["p99_ms"],
+                    "spans": tsum["spans"],
+                }
+            )
+        )
+    finally:
+        done.set()
+        server.stop()
+        sampler.stop()
+        eng.stop_engine()
+        if not was_enabled:
+            tracer.disable()
+        tracer.clear()
+        get_span_metrics().clear()
+        sampler.clear()
 
 
 def main(strict_tpu: bool = False) -> None:
@@ -1620,6 +1866,15 @@ if __name__ == "__main__":
             if TRACE_DIR is not None:
                 _trace_smoke(TRACE_DIR)
             _smoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        if len(sys.argv) < 3:
+            print("--compare requires a baseline JSON path", file=sys.stderr)
+            raise SystemExit(2)
+        _compare(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--telemetry-smoke":
+        out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
+        with _bench_lock():
+            _telemetry_smoke(out)
     elif len(sys.argv) > 1 and sys.argv[1] == "--north-star":
         with _bench_lock():
             _north_star()

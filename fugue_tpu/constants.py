@@ -88,6 +88,24 @@ FUGUE_TPU_CONF_TRACE_DIR = "fugue.tpu.trace.dir"
 # span buffer cap; past it new spans are dropped (and counted as dropped)
 FUGUE_TPU_CONF_TRACE_MAX_SPANS = "fugue.tpu.trace.max_spans"
 
+# --- live telemetry (fugue_tpu/obs/sampler.py + /metrics; ISSUE 6) ---
+# master switch for the continuous resource sampler: a daemon thread
+# recording device bytes, host RSS, jit/result-cache occupancy and
+# pipeline overlap_fraction into a bounded ring buffer — exported as
+# Perfetto counter tracks and /metrics gauges. Default OFF; the
+# FUGUE_TPU_TELEMETRY env var overrides in both directions. Enabled
+# costs <2% (a handful of cheap probes per interval); disabled there is
+# no thread at all.
+FUGUE_TPU_CONF_TELEMETRY_ENABLED = "fugue.tpu.telemetry.enabled"
+# seconds between resource samples (default 0.25)
+FUGUE_TPU_CONF_TELEMETRY_INTERVAL = "fugue.tpu.telemetry.interval"
+# ring buffer capacity in samples (default 4096; oldest samples drop)
+FUGUE_TPU_CONF_TELEMETRY_RING = "fugue.tpu.telemetry.ring_size"
+# value of the `workflow` label attached to every span-histogram sample
+# during a run (default: a stable 8-hex hash of the workflow's task
+# uuids) — the per-tenant attribution key of the future serving layer
+FUGUE_TPU_CONF_TELEMETRY_WORKFLOW = "fugue.tpu.telemetry.workflow"
+
 # streaming (out-of-core) execution: rows per host->device chunk; the
 # device working set is O(chunk_rows x columns), NOT O(dataset)
 FUGUE_TPU_CONF_STREAM_CHUNK_ROWS = "fugue.tpu.stream.chunk_rows"

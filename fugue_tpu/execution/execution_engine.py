@@ -185,9 +185,13 @@ class ExecutionEngine(FugueEngineBase):
         self._metrics: Any = None
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
         # constructing an engine with tracing conf turns the tracer on
-        from ..obs import configure_from_conf
+        from ..obs import configure_from_conf, configure_sampler_from_conf
 
         configure_from_conf(self._conf)
+        # ditto for the continuous resource sampler (fugue.tpu.telemetry.*
+        # / FUGUE_TPU_TELEMETRY), plus this engine's occupancy probes
+        configure_sampler_from_conf(self._conf)
+        self._register_resource_probes()
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}"
@@ -311,10 +315,18 @@ class ExecutionEngine(FugueEngineBase):
             # conf-driven: "fugue.rpc.server" names the server class
             # (reference fugue/rpc/base.py:268); default is in-process
             self._rpc_server = make_rpc_server(self.conf)
+            self._bind_rpc_metrics(self._rpc_server)
         return self._rpc_server
 
     def set_rpc_server(self, server: Any) -> None:
         self._rpc_server = server
+        self._bind_rpc_metrics(server)
+
+    def _bind_rpc_metrics(self, server: Any) -> None:
+        # a server with exposure endpoints (HttpRPCServer's /metrics,
+        # /healthz, /stats) scrapes THIS engine's registry
+        if hasattr(server, "bind_engine"):
+            server.bind_engine(self)
 
     # ---- observability ----------------------------------------------------
     @property
@@ -324,14 +336,66 @@ class ExecutionEngine(FugueEngineBase):
         pipeline + jit_cache on the jax engine). The legacy
         ``engine.*_stats`` attributes delegate to the same objects."""
         if self._metrics is None:
-            from ..obs import MetricsRegistry
+            from ..obs import MetricsRegistry, get_sampler, get_span_metrics
 
             reg = MetricsRegistry()
             reg.register("resilience", lambda: self.resilience_stats)
             reg.register("plan", lambda: self.plan_stats)
             reg.register("cache", lambda: self.result_cache.stats)
+            # distribution + resource sources are process-global (like the
+            # tracer feeding them) but mounted here so engine.stats()
+            # carries them and engine.reset_stats() resets them under the
+            # keep-entries contract (series/probes stay registered,
+            # observations/ring zero)
+            reg.register("latency", get_span_metrics)
+            reg.register("telemetry", get_sampler)
             self._metrics = reg
         return self._metrics
+
+    def _register_resource_probes(self) -> None:
+        """Register this engine's occupancy probes on the global resource
+        sampler. Probes bind through a ``weakref`` — once the engine is
+        collected they raise :class:`~fugue_tpu.obs.sampler.ProbeGone`
+        and the sampler drops them; a newer engine's registration under
+        the same name simply replaces an older one's."""
+        import weakref
+
+        from ..obs import get_sampler
+
+        ref = weakref.ref(self)
+
+        def _bound(fn: Callable[["ExecutionEngine"], float]) -> Callable[[], float]:
+            def probe() -> float:
+                from ..obs.sampler import ProbeGone
+
+                e = ref()
+                if e is None:
+                    raise ProbeGone()
+                return fn(e)
+
+            return probe
+
+        sampler = get_sampler()
+        for name, fn in self._resource_probe_fns().items():
+            sampler.register_probe(name, _bound(fn))
+
+    def _resource_probe_fns(self) -> Dict[str, Callable[["ExecutionEngine"], float]]:
+        """Name → (engine → value) probe map; subclasses extend. Probes
+        must guard lazily-created attributes — they run later, on the
+        sampler thread, and must never force creation (reading occupancy
+        should not allocate the thing it measures)."""
+
+        def _rc(attr: str) -> Callable[["ExecutionEngine"], float]:
+            def fn(e: "ExecutionEngine") -> float:
+                rc = getattr(e, "_result_cache", None)
+                return float(getattr(rc.mem, attr)) if rc is not None else 0.0
+
+            return fn
+
+        return {
+            "result_cache_mem_bytes": _rc("bytes"),
+            "result_cache_mem_entries": _rc("entries"),
+        }
 
     def stats(self) -> Dict[str, Any]:
         """All registered stats as one dict — the unified replacement for
@@ -341,16 +405,24 @@ class ExecutionEngine(FugueEngineBase):
 
     def reset_stats(self) -> None:
         """Reset every registered stats source (consistent semantics:
-        counters to zero; the jit cache keeps its compiled entries but
-        zeroes its hit/miss counters)."""
+        counters to zero; entries kept — the jit cache keeps its compiled
+        entries, histogram families keep their registered series, the
+        sampler keeps its probes and keeps running; only the recorded
+        observations/ring samples zero)."""
         self.metrics.reset()
 
     def report(self, top_n: int = 15) -> str:
         """Plain-text observability report: top-N spans by total wall from
-        the global tracer, plus this engine's metrics."""
-        from ..obs import get_tracer, render_report
+        the global tracer — with p50/p95/p99 columns from the span-latency
+        histograms — plus this engine's metrics."""
+        from ..obs import get_span_metrics, get_tracer, render_report
 
-        return render_report(get_tracer().records(), self.stats(), top_n=top_n)
+        return render_report(
+            get_tracer().records(),
+            self.stats(),
+            top_n=top_n,
+            span_metrics=get_span_metrics(),
+        )
 
     @property
     def resilience_stats(self) -> Any:

@@ -156,12 +156,16 @@ def _run_chunk(part_ids: Any) -> Dict[str, Any]:
     otherwise invisible to the driver). Failed/killed chunks can't ship a
     delta — by design the payload rides the success path only.
     """
-    from ..obs import get_tracer
+    from ..obs import get_span_metrics, get_tracer
 
     st = _FORK_STATE
     injector: FaultInjector = st.get("injector", NULL_INJECTOR)
     tracer = get_tracer()
     mark = tracer.mark()
+    # histogram counterpart of the span mark: snapshot the (fork-inherited,
+    # copy-on-write) span-metric state so only THIS chunk's observations
+    # ship home as a mergeable delta
+    hist_mark = get_span_metrics().snapshot() if tracer.enabled else None
     counters: Dict[str, int] = {"map.worker_chunks": 1}
     rows_out = 0
     out: List[bytes] = []
@@ -200,13 +204,22 @@ def _run_chunk(part_ids: Any) -> Dict[str, Any]:
             out.append(sink.getvalue().to_pybytes())
         chunk_sp.set(rows_out=rows_out)
     counters["map.worker_rows_out"] = rows_out
-    return {"blobs": out, "counters": counters, "spans": tracer.take_since(mark)}
+    payload: Dict[str, Any] = {
+        "blobs": out,
+        "counters": counters,
+        "spans": tracer.take_since(mark),
+    }
+    if hist_mark is not None:
+        payload["hist"] = get_span_metrics().delta_since(hist_mark)
+    return payload
 
 
 def _harvest_chunk(payload: Any, stats: ResilienceStats) -> List[pa.Table]:
     """Driver side of the fork-boundary protocol: merge the worker's
     counter delta into the driver registry, ingest its spans into the
-    global tracer, and decode the arrow blobs."""
+    global tracer, merge its histogram delta into the span-metrics store
+    (label-keyed, never pid-keyed — associative across any worker order),
+    and decode the arrow blobs."""
     if isinstance(payload, dict):
         stats.merge(payload.get("counters", {}))
         spans = payload.get("spans")
@@ -214,6 +227,11 @@ def _harvest_chunk(payload: Any, stats: ResilienceStats) -> List[pa.Table]:
             from ..obs import get_tracer
 
             get_tracer().ingest(spans)
+        hist = payload.get("hist")
+        if hist:
+            from ..obs import get_span_metrics
+
+            get_span_metrics().merge(hist)
         blobs = payload["blobs"]
     else:  # defensive: pre-ISSUE-3 plain-list payload
         blobs = payload

@@ -647,6 +647,25 @@ class JaxExecutionEngine(ExecutionEngine):
         self.metrics.register("pipeline", lambda: self._pipeline_stats)
         self.metrics.register("jit_cache", lambda: self._jit_cache)
 
+    def _resource_probe_fns(self) -> Dict[str, Any]:
+        # jax-engine occupancy for the continuous resource sampler
+        # (ISSUE 6). Registered from the BASE constructor, before
+        # _jit_cache/_pipeline_stats exist — probes run later, on the
+        # sampler thread, so they guard with getattr.
+        probes = dict(super()._resource_probe_fns())
+
+        def _jit_entries(e: Any) -> float:
+            cache = getattr(e, "_jit_cache", None)
+            return float(len(cache)) if cache is not None else 0.0
+
+        def _overlap(e: Any) -> float:
+            ps = getattr(e, "_pipeline_stats", None)
+            return float(ps.as_dict()["overlap_fraction"]) if ps is not None else 0.0
+
+        probes["jit_cache_entries"] = _jit_entries
+        probes["overlap_fraction"] = _overlap
+        return probes
+
     @property
     def mesh(self) -> Any:
         return self._mesh

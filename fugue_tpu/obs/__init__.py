@@ -1,15 +1,18 @@
-"""Unified observability: hierarchical span tracing + one metrics registry.
+"""Unified observability: hierarchical span tracing, one metrics
+registry, live distribution metrics, and a continuous resource sampler.
 
 See ``docs/observability.md``. Quick start::
 
-    from fugue_tpu.obs import get_tracer
+    from fugue_tpu.obs import get_tracer, get_sampler
     from fugue_tpu.obs.export import write_chrome_trace
 
     get_tracer().enable()          # or conf fugue.tpu.trace.enabled=True
+    get_sampler().start()          # or conf fugue.tpu.telemetry.enabled=True
     ...run workflows...
-    write_chrome_trace("/tmp/trace.json")   # load in Perfetto
-    print(engine.report())                  # top-N text report
-    engine.stats()                          # every registry as one dict
+    write_chrome_trace("/tmp/trace.json")   # spans + resource counter tracks
+    print(engine.report())                  # top-N report w/ p50/p95/p99
+    engine.stats()["latency"]               # per-span latency distributions
+    to_prometheus_text(engine)              # what GET /metrics serves
     engine.reset_stats()                    # consistent reset across all
 """
 
@@ -19,7 +22,21 @@ from .export import (
     validate_chrome_trace,
     write_chrome_trace,
 )
+from .metrics import (
+    Histogram,
+    HistogramFamily,
+    SpanMetrics,
+    current_run_labels,
+    get_span_metrics,
+    run_labels,
+)
+from .prom import to_prometheus_text, validate_prometheus_text
 from .registry import MetricsRegistry
+from .sampler import (
+    ResourceSampler,
+    configure_sampler_from_conf,
+    get_sampler,
+)
 from .tracer import (
     NULL_SPAN,
     Tracer,
@@ -29,14 +46,25 @@ from .tracer import (
 )
 
 __all__ = [
+    "Histogram",
+    "HistogramFamily",
     "MetricsRegistry",
     "NULL_SPAN",
+    "ResourceSampler",
+    "SpanMetrics",
     "Tracer",
     "configure_from_conf",
+    "configure_sampler_from_conf",
+    "current_run_labels",
+    "get_sampler",
+    "get_span_metrics",
     "get_tracer",
     "render_report",
+    "run_labels",
     "to_chrome_trace",
+    "to_prometheus_text",
     "traced_verb",
     "validate_chrome_trace",
+    "validate_prometheus_text",
     "write_chrome_trace",
 ]

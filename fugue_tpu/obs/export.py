@@ -3,9 +3,13 @@ a plain-text top-N report.
 
 The Chrome format is the `trace event format`_ "JSON object" flavor: a
 ``{"traceEvents": [...]}`` envelope of complete (``"ph": "X"``) events
-with microsecond ``ts``/``dur``. Perfetto and chrome://tracing both load
-it directly; ``validate_chrome_trace`` is the CI gate (``make
-trace-smoke``) asserting an exported file actually parses as that shape.
+with microsecond ``ts``/``dur``. Resource-sampler series additionally
+export as counter (``"ph": "C"``) events — Perfetto renders each as a
+counter track (device bytes, host RSS, overlap_fraction, ...) directly
+under the span timeline, same clock. Perfetto and chrome://tracing both
+load it; ``validate_chrome_trace`` is the CI gate (``make trace-smoke``,
+``make telemetry-smoke``) asserting an exported file actually parses as
+that shape.
 
 .. _trace event format:
    https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
@@ -23,8 +27,17 @@ __all__ = [
 ]
 
 
-def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
-    """Convert tracer records (ns timestamps) to a Chrome trace-event dict."""
+def to_chrome_trace(
+    records: Iterable[Dict[str, Any]],
+    counters: Optional[Iterable[Any]] = None,
+) -> Dict[str, Any]:
+    """Convert tracer records (ns timestamps) to a Chrome trace-event dict.
+
+    ``counters`` is an optional resource-sampler series — an iterable of
+    ``(ts_ns, {name: value})`` samples (``ResourceSampler.series()``);
+    each name becomes one Perfetto counter track (``ph: "C"``) on the
+    driver process, sharing the spans' clock so resource curves render
+    directly under the span bars."""
     events: List[Dict[str, Any]] = []
     pids = set()
     for r in records:
@@ -41,6 +54,22 @@ def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                 "args": _jsonable(r.get("args", {})),
             }
         )
+    if counters:
+        cpid = os.getpid()
+        for ts, vals in counters:
+            for cname, v in vals.items():
+                events.append(
+                    {
+                        "name": cname,
+                        "cat": "resource",
+                        "ph": "C",
+                        "ts": ts / 1000.0,
+                        "pid": cpid,
+                        "tid": 0,
+                        "args": {"value": v},
+                    }
+                )
+        pids.add(cpid)
     # metadata events name the process tracks (driver vs forked workers)
     first = min(pids) if pids else None
     for pid in sorted(pids):
@@ -69,17 +98,26 @@ def _jsonable(args: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def write_chrome_trace(
-    path: str, records: Optional[Iterable[Dict[str, Any]]] = None
+    path: str,
+    records: Optional[Iterable[Dict[str, Any]]] = None,
+    counters: Optional[Iterable[Any]] = None,
 ) -> str:
-    """Write the (or the global tracer's) records as Chrome trace JSON."""
+    """Write the (or the global tracer's) records as Chrome trace JSON.
+    When ``counters`` is not given, the global resource sampler's ring is
+    included automatically — a sampled run exports its resource curves as
+    counter tracks with no extra plumbing."""
     if records is None:
         from .tracer import get_tracer
 
         records = get_tracer().records()
+    if counters is None:
+        from .sampler import get_sampler
+
+        counters = get_sampler().series()
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(records), f)
+        json.dump(to_chrome_trace(records, counters=counters), f)
     return path
 
 
@@ -98,7 +136,9 @@ def validate_chrome_trace(path: str) -> Dict[str, Any]:
     events = doc["traceEvents"]
     assert isinstance(events, list) and len(events) > 0, f"{path}: no events"
     n_spans = 0
+    n_counters = 0
     names = set()
+    counter_names = set()
     for ev in events:
         assert isinstance(ev, dict) and "ph" in ev and "name" in ev, ev
         assert "pid" in ev, ev
@@ -108,17 +148,42 @@ def validate_chrome_trace(path: str) -> Dict[str, Any]:
             assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
             assert "tid" in ev, ev
+        elif ev["ph"] == "C":
+            n_counters += 1
+            counter_names.add(ev["name"])
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
+            args = ev.get("args")
+            assert isinstance(args, dict) and args, ev
+            assert all(isinstance(v, (int, float)) for v in args.values()), ev
     assert n_spans > 0, f"{path}: no complete ('X') span events"
-    return {"events": len(events), "spans": n_spans, "names": sorted(names)}
+    return {
+        "events": len(events),
+        "spans": n_spans,
+        "names": sorted(names),
+        "counters": n_counters,
+        "counter_names": sorted(counter_names),
+    }
 
 
 def render_report(
     records: List[Dict[str, Any]],
     stats: Optional[Dict[str, Any]] = None,
     top_n: int = 15,
+    span_metrics: Any = None,
 ) -> str:
     """Plain-text top-N report: spans grouped by name with count / total /
-    self / mean / max wall, plus the metrics registry dump."""
+    self / mean / p50 / p95 / p99 / max wall, plus the metrics registry
+    dump. Quantiles come from the span-latency histograms (the global
+    :class:`~fugue_tpu.obs.metrics.SpanMetrics` store unless one is
+    passed); a span name with no histogram series prints ``-``."""
+    if span_metrics is None:
+        from .metrics import get_span_metrics
+
+        span_metrics = get_span_metrics()
+    try:
+        latency = span_metrics.summary()
+    except Exception:
+        latency = {}
     by_id = {r["id"]: r for r in records}
     child_time: Dict[str, int] = {}
     for r in records:
@@ -140,14 +205,22 @@ def render_report(
     else:
         lines.append(
             f"{'span':<28}{'count':>8}{'total_ms':>12}{'self_ms':>12}"
-            f"{'mean_ms':>10}{'max_ms':>10}"
+            f"{'mean_ms':>10}{'p50_ms':>10}{'p95_ms':>10}{'p99_ms':>10}"
+            f"{'max_ms':>10}"
         )
+
+        def q(name: str, key: str) -> str:
+            v = latency.get(name, {}).get(key)
+            return f"{v:>10.3f}" if isinstance(v, (int, float)) else f"{'-':>10}"
+
         ranked = sorted(agg.items(), key=lambda kv: -kv[1]["total"])[:top_n]
         for name, a in ranked:
             lines.append(
                 f"{name:<28}{int(a['count']):>8}"
                 f"{a['total'] / 1e6:>12.3f}{a['self'] / 1e6:>12.3f}"
-                f"{a['total'] / a['count'] / 1e6:>10.3f}{a['max'] / 1e6:>10.3f}"
+                f"{a['total'] / a['count'] / 1e6:>10.3f}"
+                f"{q(name, 'p50_ms')}{q(name, 'p95_ms')}{q(name, 'p99_ms')}"
+                f"{a['max'] / 1e6:>10.3f}"
             )
     if stats:
         lines.append("")

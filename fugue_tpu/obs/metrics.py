@@ -1,0 +1,435 @@
+"""Distribution metrics: bucketed histograms, labeled families, and the
+process-global span-metrics store (ISSUE 6 tentpole, piece 1).
+
+The PR 3 registry holds plain counters — enough for "how many", useless
+for "how long". This module adds the distribution substrate:
+
+- :class:`Histogram`: fixed exponential buckets with p50/p95/p99
+  estimation (Prometheus-style linear interpolation inside the bucket
+  containing the target rank, clamped to the observed min/max). The
+  internal state is a **mergeable encoding** — plain lists/numbers that
+  add associatively — so worker-recorded distributions ship across the
+  fork boundary and merge into the driver's without loss.
+- :class:`HistogramFamily`: one metric name fanned out over label sets
+  (``family.observe(v, span="engine.aggregate", run="ab12")``), the
+  attribution scheme a per-tenant serving layer reuses unchanged.
+- :class:`SpanMetrics`: the process-global store fed by the tracer at
+  every span close — every span name gets a latency distribution for
+  free, and ``rows``/``bytes`` span attrs feed throughput histograms.
+  Process-global like the tracer itself (one timeline, one metric
+  store); ``engine.stats()["latency"]`` reads it, ``engine.reset_stats()``
+  resets it under the keep-entries contract (series stay registered,
+  observations zero — the ``JitCache.reset`` rule).
+
+Run attribution: :func:`run_labels` is a module-global label context the
+workflow layer enters for the duration of a run; every observation made
+while it is active carries the ``workflow``/``run`` labels. Module-global
+(not thread-local) on purpose: pool threads and forked map workers
+inherit it, so worker samples attribute to the right run.
+"""
+
+import threading
+from bisect import bisect_left
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_LATENCY_BOUNDS",
+    "DEFAULT_SIZE_BOUNDS",
+    "Histogram",
+    "HistogramFamily",
+    "SpanMetrics",
+    "current_run_labels",
+    "get_span_metrics",
+    "run_labels",
+]
+
+# latency buckets (seconds): 1µs … ~134s, ×2 per bucket — 28 buckets plus
+# overflow covers a single jit dispatch through a full 1B-row pass
+DEFAULT_LATENCY_BOUNDS: Tuple[float, ...] = tuple(1e-6 * (2**i) for i in range(28))
+# size buckets (rows or bytes): 4 … ~1.1e12, ×4 per bucket
+DEFAULT_SIZE_BOUNDS: Tuple[float, ...] = tuple(float(4**i) for i in range(1, 21))
+
+
+class Histogram:
+    """Fixed-bucket histogram with quantile estimation and merge support.
+
+    ``counts[i]`` counts observations ``v <= bounds[i]`` (first matching
+    bucket); ``counts[-1]`` is the overflow bucket. ``encode()`` returns
+    the plain-data form that :meth:`merge` adds back in — counts, sum and
+    count add associatively, min/max combine via min/max, so merging is
+    order-independent across any number of workers.
+    """
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_LATENCY_BOUNDS):
+        self.bounds = tuple(bounds)
+        self._lock = threading.Lock()
+        self._zero()
+
+    def _zero(self) -> None:
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.counts[bisect_left(self.bounds, v)] += 1
+            self.sum += v
+            self.count += 1
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    # -- mergeable encoding --------------------------------------------------
+    def encode(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counts": list(self.counts),
+                "sum": self.sum,
+                "count": self.count,
+                "min": self.min,
+                "max": self.max,
+            }
+
+    def merge(self, enc: Dict[str, Any]) -> None:
+        """Add an encoded delta in. Associative and commutative: merging
+        worker A's delta then B's equals B's then A's equals observing
+        every value locally."""
+        if not enc or not enc.get("count"):
+            return
+        counts = enc["counts"]
+        with self._lock:
+            n = min(len(counts), len(self.counts))
+            for i in range(n):
+                self.counts[i] += counts[i]
+            self.sum += enc["sum"]
+            self.count += enc["count"]
+            for key, pick in (("min", min), ("max", max)):
+                v = enc.get(key)
+                if v is not None:
+                    cur = getattr(self, key)
+                    setattr(self, key, v if cur is None else pick(cur, v))
+
+    def subtract(self, enc: Dict[str, Any]) -> Dict[str, Any]:
+        """Current state minus an earlier :meth:`encode` — the
+        fork-boundary delta a worker ships home (its post-fork
+        observations only; the COW copy inherited at fork subtracts out)."""
+        cur = self.encode()
+        if not enc:
+            return cur
+        base = enc.get("counts", [])
+        counts = [
+            c - (base[i] if i < len(base) else 0) for i, c in enumerate(cur["counts"])
+        ]
+        return {
+            "counts": counts,
+            "sum": cur["sum"] - enc.get("sum", 0.0),
+            "count": cur["count"] - enc.get("count", 0),
+            # min/max of just-the-delta is unrecoverable from two encodes;
+            # the current values are a conservative superset (merging them
+            # home can only widen the driver's range to values it, or its
+            # fork parent, already saw)
+            "min": cur["min"],
+            "max": cur["max"],
+        }
+
+    # -- quantiles -----------------------------------------------------------
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile (0..1) by linear interpolation within
+        the bucket containing the target rank, clamped to the observed
+        [min, max] so estimates never leave the data's actual range."""
+        with self._lock:
+            if self.count == 0:
+                return None
+            target = max(min(q, 1.0), 0.0) * self.count
+            cum = 0
+            lo = 0.0
+            for i, c in enumerate(self.counts):
+                hi = (
+                    self.bounds[i]
+                    if i < len(self.bounds)
+                    else (self.max if self.max is not None else lo)
+                )
+                if cum + c >= target and c > 0:
+                    frac = (target - cum) / c
+                    est = lo + (hi - lo) * frac
+                    break
+                cum += c
+                lo = hi
+            else:
+                est = self.max if self.max is not None else 0.0
+            if self.min is not None:
+                est = max(est, self.min)
+            if self.max is not None:
+                est = min(est, self.max)
+            return est
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+    # -- registry source contract -------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        p = self.percentiles()
+        with self._lock:
+            out: Dict[str, Any] = {
+                "count": self.count,
+                "sum": round(self.sum, 9),
+                "min": self.min,
+                "max": self.max,
+                "mean": (self.sum / self.count) if self.count else None,
+            }
+        out.update(p)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._zero()
+
+
+def _labels_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class HistogramFamily:
+    """A labeled histogram family: one metric name, one series per label
+    set. The unit of Prometheus exposition (each series renders its own
+    ``_bucket``/``_sum``/``_count`` lines) and of fork-boundary transport
+    (encode/merge/delta operate per series, matched by labels — never by
+    pid, so two workers' series with equal labels merge additively)."""
+
+    def __init__(
+        self,
+        name: str,
+        bounds: Tuple[float, ...] = DEFAULT_LATENCY_BOUNDS,
+        help: str = "",
+    ):
+        self.name = name
+        self.bounds = tuple(bounds)
+        self.help = help or name
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], Histogram] = {}
+
+    def _get_or_create(self, key: Tuple[Tuple[str, str], ...]) -> Histogram:
+        with self._lock:
+            h = self._series.get(key)
+            if h is None:
+                h = Histogram(self.bounds)
+                self._series[key] = h
+            return h
+
+    def observe(self, value: float, **labels: Any) -> None:
+        self._get_or_create(_labels_key(labels)).observe(value)
+
+    def get(self, **labels: Any) -> Optional[Histogram]:
+        with self._lock:
+            return self._series.get(_labels_key(labels))
+
+    def series(self) -> List[Tuple[Dict[str, str], Histogram]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [(dict(k), h) for k, h in items]
+
+    # -- mergeable encoding (fork-boundary transport) ------------------------
+    def encode(self) -> List[Dict[str, Any]]:
+        return [
+            {"labels": labels, **h.encode()} for labels, h in self.series()
+        ]
+
+    def merge(self, encoded: List[Dict[str, Any]]) -> None:
+        for enc in encoded or []:
+            if enc.get("count"):
+                self._get_or_create(_labels_key(enc.get("labels", {}))).merge(enc)
+
+    def delta_since(self, snapshot: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        base = {
+            _labels_key(e.get("labels", {})): e for e in (snapshot or [])
+        }
+        out: List[Dict[str, Any]] = []
+        for labels, h in self.series():
+            d = h.subtract(base.get(_labels_key(labels), {}))
+            if d.get("count"):
+                out.append({"labels": labels, **d})
+        return out
+
+    # -- registry source contract -------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for labels, h in self.series():
+            if h.count == 0:
+                continue  # reset series stay registered but don't report
+            key = ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "_"
+            out[key] = h.as_dict()
+        return out
+
+    def reset(self) -> None:
+        """Zero every series' observations. Series stay REGISTERED — the
+        keep-entries contract (``JitCache.reset``): a stats reset must not
+        tear down the metric schema a scraper is watching."""
+        for _, h in self.series():
+            h.reset()
+
+    def clear(self) -> None:
+        """Drop every series (test isolation; NOT part of reset)."""
+        with self._lock:
+            self._series.clear()
+
+
+# --------------------------------------------------------------------------
+# run attribution labels
+# --------------------------------------------------------------------------
+
+_RUN_LABELS: Dict[str, str] = {}
+
+
+def current_run_labels() -> Dict[str, str]:
+    """The labels attached to every metric observation right now
+    (``workflow``/``run`` while a workflow run is active, else empty)."""
+    return _RUN_LABELS
+
+
+@contextmanager
+def run_labels(**labels: Any) -> Iterator[None]:
+    """Attach labels to every span-metric observation for the duration.
+    Module-global so pool threads and forked workers inherit it; nested
+    uses overlay (inner wins, outer restored on exit)."""
+    global _RUN_LABELS
+    prev = _RUN_LABELS
+    _RUN_LABELS = {**prev, **{str(k): str(v) for k, v in labels.items()}}
+    try:
+        yield
+    finally:
+        _RUN_LABELS = prev
+
+
+# --------------------------------------------------------------------------
+# the process-global span-metrics store
+# --------------------------------------------------------------------------
+
+
+class SpanMetrics:
+    """Latency/rows/bytes histogram families auto-fed at span close.
+
+    Every tracer record feeds ``span_latency_seconds`` (labels: ``span``
+    plus the current run labels); ``rows``/``rows_out`` span attrs feed
+    ``span_rows``; ``bytes``/``bytes_in``/``bytes_out`` feed
+    ``span_bytes``. The registry source contract (``as_dict``/``reset``)
+    makes it mount directly as ``engine.stats()["latency"]``.
+    """
+
+    def __init__(self) -> None:
+        self.latency = HistogramFamily(
+            "fugue_tpu_span_latency_seconds",
+            DEFAULT_LATENCY_BOUNDS,
+            help="wall-clock latency distribution per span name",
+        )
+        self.rows = HistogramFamily(
+            "fugue_tpu_span_rows",
+            DEFAULT_SIZE_BOUNDS,
+            help="rows processed per span (rows/rows_out attrs)",
+        )
+        self.bytes = HistogramFamily(
+            "fugue_tpu_span_bytes",
+            DEFAULT_SIZE_BOUNDS,
+            help="bytes moved per span (bytes/bytes_in/bytes_out attrs)",
+        )
+
+    def families(self) -> Tuple[HistogramFamily, ...]:
+        return (self.latency, self.rows, self.bytes)
+
+    def observe_record(self, rec: Dict[str, Any]) -> None:
+        """Feed one completed tracer record (called from ``Tracer._emit``
+        — i.e. only while tracing is enabled; the disabled path never
+        reaches here)."""
+        labels = {"span": rec["name"], **_RUN_LABELS}
+        self.latency.observe(max(rec.get("dur", 0), 0) / 1e9, **labels)
+        args = rec.get("args") or {}
+        rows = args.get("rows", args.get("rows_out"))
+        if isinstance(rows, (int, float)) and not isinstance(rows, bool):
+            self.rows.observe(rows, **labels)
+        nbytes = args.get("bytes")
+        if nbytes is None:
+            bi, bo = args.get("bytes_in"), args.get("bytes_out")
+            if bi is not None or bo is not None:
+                nbytes = (bi or 0) + (bo or 0)
+        if isinstance(nbytes, (int, float)) and not isinstance(nbytes, bool):
+            self.bytes.observe(nbytes, **labels)
+
+    # -- fork-boundary transport --------------------------------------------
+    def snapshot(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Full encode — a worker takes one at chunk start, ships
+        :meth:`delta_since` home with the chunk result."""
+        return {
+            "latency": self.latency.encode(),
+            "rows": self.rows.encode(),
+            "bytes": self.bytes.encode(),
+        }
+
+    def delta_since(
+        self, snap: Dict[str, List[Dict[str, Any]]]
+    ) -> Dict[str, List[Dict[str, Any]]]:
+        snap = snap or {}
+        out = {
+            "latency": self.latency.delta_since(snap.get("latency", [])),
+            "rows": self.rows.delta_since(snap.get("rows", [])),
+            "bytes": self.bytes.delta_since(snap.get("bytes", [])),
+        }
+        return {k: v for k, v in out.items() if v}
+
+    def merge(self, delta: Dict[str, List[Dict[str, Any]]]) -> None:
+        if not delta:
+            return
+        self.latency.merge(delta.get("latency", []))
+        self.rows.merge(delta.get("rows", []))
+        self.bytes.merge(delta.get("bytes", []))
+
+    # -- registry source contract (engine.stats()["latency"]) ----------------
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-SPAN-NAME latency summary, merged across run-label series:
+        ``{span: {count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms}}``."""
+        merged: Dict[str, Histogram] = {}
+        for labels, h in self.latency.series():
+            if h.count == 0:
+                continue
+            span = labels.get("span", "?")
+            agg = merged.get(span)
+            if agg is None:
+                agg = merged[span] = Histogram(self.latency.bounds)
+            agg.merge(h.encode())
+        out: Dict[str, Dict[str, Any]] = {}
+        for span, h in merged.items():
+            p = h.percentiles()
+            out[span] = {
+                "count": h.count,
+                "mean_ms": round(h.sum / h.count * 1e3, 6) if h.count else None,
+                "p50_ms": round(p["p50"] * 1e3, 6) if p["p50"] is not None else None,
+                "p95_ms": round(p["p95"] * 1e3, 6) if p["p95"] is not None else None,
+                "p99_ms": round(p["p99"] * 1e3, 6) if p["p99"] is not None else None,
+                "max_ms": round(h.max * 1e3, 6) if h.max is not None else None,
+            }
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return self.summary()
+
+    def reset(self) -> None:
+        for f in self.families():
+            f.reset()
+
+    def clear(self) -> None:
+        for f in self.families():
+            f.clear()
+
+
+_SPAN_METRICS = SpanMetrics()
+
+
+def get_span_metrics() -> SpanMetrics:
+    return _SPAN_METRICS

@@ -37,6 +37,25 @@ class MetricsRegistry:
         with self._lock:
             self._sources[name] = source
 
+    def family(self, name: str, bounds: Any = None, help: str = "") -> Any:
+        """Create-or-get a labeled :class:`~fugue_tpu.obs.metrics.HistogramFamily`
+        owned by this registry (registered as a source under ``name``, so
+        it shows in ``as_dict()``/``stats()`` and resets with
+        ``reset()``). The distribution-metric counterpart of
+        ``register()`` for plain counters."""
+        with self._lock:
+            src = self._sources.get(name)
+            if src is None:
+                from .metrics import DEFAULT_LATENCY_BOUNDS, HistogramFamily
+
+                src = HistogramFamily(
+                    name,
+                    bounds if bounds is not None else DEFAULT_LATENCY_BOUNDS,
+                    help=help,
+                )
+                self._sources[name] = src
+            return src
+
     def names(self) -> List[str]:
         with self._lock:
             return list(self._sources)

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .metrics import get_span_metrics
+
 __all__ = [
     "Tracer",
     "get_tracer",
@@ -175,6 +177,10 @@ class Tracer:
         return _SpanCtx(self, name, cat, annotate, parent, args)
 
     def _emit(self, rec: Dict[str, Any]) -> None:
+        # every span close feeds the latency/rows/bytes histograms — BEFORE
+        # the buffer-cap check: distributions must stay correct even when
+        # the span buffer saturates and drops the raw record
+        get_span_metrics().observe_record(rec)
         with self._lock:
             if len(self._records) >= self.max_spans:
                 self.dropped += 1
@@ -239,7 +245,13 @@ class Tracer:
             return list(self._records[mark:])
 
     def ingest(self, records: List[Dict[str, Any]]) -> None:
-        """Append records produced elsewhere (forked worker, remote)."""
+        """Append records produced elsewhere (forked worker, remote).
+
+        Deliberately does NOT feed the span histograms: the recording
+        process already fed its own at ``_emit`` time, and the fork
+        protocol ships those observations home as an explicit mergeable
+        histogram delta (``SpanMetrics.delta_since``) alongside the
+        spans — feeding here too would double-count."""
         if not records:
             return
         with self._lock:

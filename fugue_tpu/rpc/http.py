@@ -25,8 +25,11 @@ stateful callback could double-apply it.
 
 import base64
 import http.client
+import json
+import os
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -149,7 +152,20 @@ class HttpRPCClient(RPCClient):
 
 
 class HttpRPCServer(RPCServer):
-    """Stdlib HTTP RPC server (reference flask parity)."""
+    """Stdlib HTTP RPC server (reference flask parity) — doubling as the
+    engine's telemetry exposure surface (ISSUE 6): alongside the POST
+    ``/invoke`` callback channel it serves
+
+    - ``GET /metrics`` — Prometheus text exposition: labeled span-latency
+      /rows/bytes histograms, resource-sampler gauges, and the bound
+      engine's flattened counters (scrapeable while a run is in flight);
+    - ``GET /healthz`` — liveness JSON;
+    - ``GET /stats`` — one JSON snapshot (engine registry + latency
+      summary + sampler state + current run labels).
+
+    Bind an engine with :meth:`bind_engine` (the engine does this itself
+    when it creates or is handed the server); unbound, the global span
+    metrics and sampler still serve."""
 
     def __init__(self, conf: Any = None):
         super().__init__(conf)
@@ -174,6 +190,45 @@ class HttpRPCServer(RPCServer):
         self._stats = ResilienceStats()
         self._httpd: Any = None
         self._thread: Any = None
+        self._engine_ref: Any = None
+        self._started_at = time.time()
+
+    # -- telemetry binding ---------------------------------------------------
+    def bind_engine(self, engine: Any) -> None:
+        """Point /metrics and /stats at ``engine``'s registry (held weakly
+        — a collected engine silently unbinds)."""
+        self._engine_ref = weakref.ref(engine)
+
+    def _metrics_engine(self) -> Any:
+        return self._engine_ref() if self._engine_ref is not None else None
+
+    def _get_body(self, path: str) -> Optional[Any]:
+        """Build (content_type, body_bytes) for a telemetry GET route, or
+        None for an unknown path."""
+        if path == "/healthz":
+            payload = {
+                "status": "ok",
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._started_at, 3),
+            }
+            return "application/json", json.dumps(payload).encode()
+        if path == "/metrics":
+            from ..obs import to_prometheus_text
+
+            text = to_prometheus_text(engine=self._metrics_engine())
+            return "text/plain; version=0.0.4; charset=utf-8", text.encode()
+        if path == "/stats":
+            from ..obs import current_run_labels, get_sampler, get_span_metrics
+
+            eng = self._metrics_engine()
+            payload = {
+                "engine": eng.stats() if eng is not None else None,
+                "latency": get_span_metrics().summary(),
+                "telemetry": get_sampler().as_dict(),
+                "run_labels": dict(current_run_labels()),
+            }
+            return "application/json", json.dumps(payload, default=str).encode()
+        return None
 
     @property
     def host(self) -> str:
@@ -224,6 +279,26 @@ class HttpRPCServer(RPCServer):
                 except Exception:  # pragma: no cover - transport error
                     self.send_response(500)
                     self.end_headers()
+
+            def do_GET(self) -> None:  # noqa: N802 — telemetry routes
+                try:
+                    made = server._get_body(self.path.split("?", 1)[0])
+                    if made is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    ctype, body = made
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception:  # telemetry must never crash the server
+                    try:
+                        self.send_response(500)
+                        self.end_headers()
+                    except Exception:
+                        pass
 
             def log_message(self, *args: Any) -> None:  # silence
                 pass

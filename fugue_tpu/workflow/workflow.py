@@ -6,6 +6,7 @@ engine. ``WorkflowDataFrame`` mirrors the DataFrame API lazily and adds
 partitioning hints, checkpoints, yields, persist/broadcast and joins.
 """
 
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 from .._utils.assertion import assert_or_throw
@@ -915,10 +916,31 @@ class FugueWorkflow:
             )
             psp.set(**report.span_attrs())
         self._last_plan_report = report
+        # run attribution (ISSUE 6): while tracing is on, every span-metric
+        # sample this run produces carries workflow/run labels — the
+        # per-tenant attribution scheme the serving layer will reuse. The
+        # workflow label is a stable hash of the task uuids (same dag =>
+        # same label across runs) unless conf names one explicitly.
+        run_attrs: Dict[str, Any] = {}
+        run_ctx: Any = nullcontext()
+        if tracer.enabled:
+            import hashlib
+            import uuid as _uuid
+
+            from ..constants import FUGUE_TPU_CONF_TELEMETRY_WORKFLOW
+            from ..obs import run_labels as _run_labels
+
+            wf_label = str(
+                plan_conf.get(FUGUE_TPU_CONF_TELEMETRY_WORKFLOW, "")
+            ) or "wf-" + hashlib.sha1(
+                "|".join(t.__uuid__() for t in self._tasks).encode()
+            ).hexdigest()[:8]
+            run_attrs = {"workflow": wf_label, "run": _uuid.uuid4().hex[:8]}
+            run_ctx = _run_labels(**run_attrs)
         try:
             with e._as_borrowed_context():
-                with tracer.span(
-                    "workflow.run", cat="workflow", tasks=len(run_tasks)
+                with run_ctx, tracer.span(
+                    "workflow.run", cat="workflow", tasks=len(run_tasks), **run_attrs
                 ):
                     ctx.run(
                         run_tasks,

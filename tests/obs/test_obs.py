@@ -414,7 +414,15 @@ def test_engine_stats_surface_and_shims():
     )
     try:
         st = e.stats()
-        assert set(st) == {"resilience", "pipeline", "jit_cache", "plan", "cache"}
+        assert set(st) == {
+            "resilience",
+            "pipeline",
+            "jit_cache",
+            "plan",
+            "cache",
+            "latency",
+            "telemetry",
+        }
         # the deprecation shims delegate to the SAME objects the registry holds
         assert e.pipeline_stats is e.metrics.get("pipeline")
         assert e.resilience_stats is e.metrics.get("resilience")
