@@ -33,6 +33,7 @@ iterator with the identical interface — the A/B switch the parity tests
 use (results must be bit-identical either way).
 """
 
+import contextvars
 import queue
 import threading
 import time
@@ -278,8 +279,12 @@ class ChunkPrefetcher:
         self._finished = False
         self._recorded = False
         self._t0 = time.perf_counter()
+        # run the producer inside the consumer's context snapshot so any
+        # observability done on that thread keeps the run-attribution
+        # labels (contextvars don't cross thread starts on their own)
         self._thread = threading.Thread(
-            target=self._produce,
+            target=contextvars.copy_context().run,
+            args=(self._produce,),
             name=f"fugue-tpu-prefetch-{verb or 'chunks'}",
             daemon=True,
         )

@@ -26,6 +26,7 @@ from .metrics import (
     Histogram,
     HistogramFamily,
     SpanMetrics,
+    active_run_labels,
     current_run_labels,
     get_span_metrics,
     run_labels,
@@ -53,6 +54,7 @@ __all__ = [
     "ResourceSampler",
     "SpanMetrics",
     "Tracer",
+    "active_run_labels",
     "configure_from_conf",
     "configure_sampler_from_conf",
     "current_run_labels",

@@ -21,17 +21,25 @@ for "how long". This module adds the distribution substrate:
   resets it under the keep-entries contract (series stay registered,
   observations zero — the ``JitCache.reset`` rule).
 
-Run attribution: :func:`run_labels` is a module-global label context the
+Run attribution: :func:`run_labels` is a context-local label scope the
 workflow layer enters for the duration of a run; every observation made
-while it is active carries the ``workflow``/``run`` labels. Module-global
-(not thread-local) on purpose: pool threads and forked map workers
-inherit it, so worker samples attribute to the right run.
+while it is active carries the ``workflow``/``run`` labels. It is a
+:class:`contextvars.ContextVar`, so two runs executing concurrently in
+one process never see each other's labels; propagation to the places
+observations actually happen is explicit: the workflow task pool submits
+through ``contextvars.copy_context()``, the chunk prefetcher runs its
+producer inside the consumer's context snapshot, and forked map workers
+inherit the forking thread's context wholesale (``fork`` clones it —
+the pool is forked per map call, inside the run).
 """
 
+import contextvars
+import itertools
 import threading
 from bisect import bisect_left
+from collections import OrderedDict
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "DEFAULT_LATENCY_BOUNDS",
@@ -39,6 +47,7 @@ __all__ = [
     "Histogram",
     "HistogramFamily",
     "SpanMetrics",
+    "active_run_labels",
     "current_run_labels",
     "get_span_metrics",
     "run_labels",
@@ -49,6 +58,36 @@ __all__ = [
 DEFAULT_LATENCY_BOUNDS: Tuple[float, ...] = tuple(1e-6 * (2**i) for i in range(28))
 # size buckets (rows or bytes): 4 … ~1.1e12, ×4 per bucket
 DEFAULT_SIZE_BOUNDS: Tuple[float, ...] = tuple(float(4**i) for i in range(1, 21))
+
+
+def _quantile_from(
+    enc: Dict[str, Any], bounds: Tuple[float, ...], q: float
+) -> Optional[float]:
+    """Quantile estimate over an :meth:`Histogram.encode` snapshot: linear
+    interpolation inside the bucket containing the target rank, clamped to
+    the snapshot's [min, max]. Pure function of the snapshot, so every
+    field derived from one encode() is mutually consistent."""
+    count = enc["count"]
+    if not count:
+        return None
+    vmin, vmax = enc["min"], enc["max"]
+    target = max(min(q, 1.0), 0.0) * count
+    cum = 0
+    lo = 0.0
+    for i, c in enumerate(enc["counts"]):
+        hi = bounds[i] if i < len(bounds) else (vmax if vmax is not None else lo)
+        if cum + c >= target and c > 0:
+            est = lo + (hi - lo) * ((target - cum) / c)
+            break
+        cum += c
+        lo = hi
+    else:
+        est = vmax if vmax is not None else 0.0
+    if vmin is not None:
+        est = max(est, vmin)
+    if vmax is not None:
+        est = min(est, vmax)
+    return est
 
 
 class Histogram:
@@ -138,55 +177,36 @@ class Histogram:
         }
 
     # -- quantiles -----------------------------------------------------------
+    # All quantile/summary readers derive from ONE encode() snapshot (a
+    # single lock acquisition), so a reported p50/p95/p99 and the
+    # count/mean beside it always describe the same distribution even
+    # while observe() runs concurrently.
     def quantile(self, q: float) -> Optional[float]:
         """Estimate the q-quantile (0..1) by linear interpolation within
         the bucket containing the target rank, clamped to the observed
         [min, max] so estimates never leave the data's actual range."""
-        with self._lock:
-            if self.count == 0:
-                return None
-            target = max(min(q, 1.0), 0.0) * self.count
-            cum = 0
-            lo = 0.0
-            for i, c in enumerate(self.counts):
-                hi = (
-                    self.bounds[i]
-                    if i < len(self.bounds)
-                    else (self.max if self.max is not None else lo)
-                )
-                if cum + c >= target and c > 0:
-                    frac = (target - cum) / c
-                    est = lo + (hi - lo) * frac
-                    break
-                cum += c
-                lo = hi
-            else:
-                est = self.max if self.max is not None else 0.0
-            if self.min is not None:
-                est = max(est, self.min)
-            if self.max is not None:
-                est = min(est, self.max)
-            return est
+        return _quantile_from(self.encode(), self.bounds, q)
 
     def percentiles(self) -> Dict[str, Optional[float]]:
+        enc = self.encode()
         return {
-            "p50": self.quantile(0.50),
-            "p95": self.quantile(0.95),
-            "p99": self.quantile(0.99),
+            "p50": _quantile_from(enc, self.bounds, 0.50),
+            "p95": _quantile_from(enc, self.bounds, 0.95),
+            "p99": _quantile_from(enc, self.bounds, 0.99),
         }
 
     # -- registry source contract -------------------------------------------
     def as_dict(self) -> Dict[str, Any]:
-        p = self.percentiles()
-        with self._lock:
-            out: Dict[str, Any] = {
-                "count": self.count,
-                "sum": round(self.sum, 9),
-                "min": self.min,
-                "max": self.max,
-                "mean": (self.sum / self.count) if self.count else None,
-            }
-        out.update(p)
+        enc = self.encode()
+        out: Dict[str, Any] = {
+            "count": enc["count"],
+            "sum": round(enc["sum"], 9),
+            "min": enc["min"],
+            "max": enc["max"],
+            "mean": (enc["sum"] / enc["count"]) if enc["count"] else None,
+        }
+        for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            out[name] = _quantile_from(enc, self.bounds, q)
         return out
 
     def reset(self) -> None:
@@ -276,6 +296,17 @@ class HistogramFamily:
         for _, h in self.series():
             h.reset()
 
+    def prune(self, predicate: Callable[[Dict[str, str]], bool]) -> int:
+        """Drop every series whose label dict matches ``predicate``;
+        returns how many were dropped. Unlike :meth:`reset` this removes
+        the registration itself — the run-label rotation uses it to bound
+        per-run series cardinality (see :attr:`SpanMetrics.MAX_RUN_SERIES`)."""
+        with self._lock:
+            drop = [k for k in self._series if predicate(dict(k))]
+            for k in drop:
+                del self._series[k]
+        return len(drop)
+
     def clear(self) -> None:
         """Drop every series (test isolation; NOT part of reset)."""
         with self._lock:
@@ -286,27 +317,57 @@ class HistogramFamily:
 # run attribution labels
 # --------------------------------------------------------------------------
 
-_RUN_LABELS: Dict[str, str] = {}
+_RUN_LABELS_VAR: "contextvars.ContextVar[Dict[str, str]]" = contextvars.ContextVar(
+    "fugue_tpu_run_labels", default={}
+)
+# currently-entered label scopes, for introspection (/stats) from threads
+# outside any run context (e.g. the HTTP server); insertion-ordered so the
+# most recently entered run is last
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_RUNS: "OrderedDict[int, Dict[str, str]]" = OrderedDict()
+_ACTIVE_SEQ = itertools.count()
 
 
 def current_run_labels() -> Dict[str, str]:
-    """The labels attached to every metric observation right now
-    (``workflow``/``run`` while a workflow run is active, else empty)."""
-    return _RUN_LABELS
+    """The labels attached to metric observations made from the calling
+    context (``workflow``/``run`` inside a workflow run's context, else
+    empty). Context-local: concurrent runs each see their own."""
+    return dict(_RUN_LABELS_VAR.get())
+
+
+def active_run_labels() -> List[Dict[str, str]]:
+    """Label dicts of every :func:`run_labels` scope currently entered
+    anywhere in the process, oldest first — the cross-thread view a
+    telemetry endpoint reports when it is not itself inside a run."""
+    with _ACTIVE_LOCK:
+        return [dict(v) for v in _ACTIVE_RUNS.values()]
 
 
 @contextmanager
 def run_labels(**labels: Any) -> Iterator[None]:
     """Attach labels to every span-metric observation for the duration.
-    Module-global so pool threads and forked workers inherit it; nested
-    uses overlay (inner wins, outer restored on exit)."""
-    global _RUN_LABELS
-    prev = _RUN_LABELS
-    _RUN_LABELS = {**prev, **{str(k): str(v) for k, v in labels.items()}}
+
+    Context-local (:mod:`contextvars`): concurrent runs in one process
+    never cross-contaminate, and the token-based reset restores the right
+    outer scope even under non-LIFO exits. Nested uses overlay (inner
+    wins, outer restored on exit). Propagation is explicit where work
+    leaves this context: thread pools submit through
+    ``contextvars.copy_context()`` and forked workers inherit the forking
+    thread's context."""
+    merged = {
+        **_RUN_LABELS_VAR.get(),
+        **{str(k): str(v) for k, v in labels.items()},
+    }
+    token = _RUN_LABELS_VAR.set(merged)
+    key = next(_ACTIVE_SEQ)
+    with _ACTIVE_LOCK:
+        _ACTIVE_RUNS[key] = merged
     try:
         yield
     finally:
-        _RUN_LABELS = prev
+        _RUN_LABELS_VAR.reset(token)
+        with _ACTIVE_LOCK:
+            _ACTIVE_RUNS.pop(key, None)
 
 
 # --------------------------------------------------------------------------
@@ -322,9 +383,23 @@ class SpanMetrics:
     ``span_rows``; ``bytes``/``bytes_in``/``bytes_out`` feed
     ``span_bytes``. The registry source contract (``as_dict``/``reset``)
     makes it mount directly as ``engine.stats()["latency"]``.
+
+    Cardinality bound: the ``run`` label is fresh per workflow run, so a
+    long-lived process would otherwise accumulate one series per
+    (span x workflow x run) forever. Only the most recent
+    :attr:`MAX_RUN_SERIES` distinct ``run`` values keep their series;
+    when a newer run arrives, the oldest run's series are pruned from
+    every family (the per-SPAN summaries and Prometheus page stay
+    bounded; traces retain every run's spans untouched).
     """
 
+    #: distinct ``run`` label values whose series are retained (LRU by
+    #: first observation; older runs' series are pruned, not zeroed)
+    MAX_RUN_SERIES = 16
+
     def __init__(self) -> None:
+        self._runs_lock = threading.Lock()
+        self._runs: "OrderedDict[str, None]" = OrderedDict()
         self.latency = HistogramFamily(
             "fugue_tpu_span_latency_seconds",
             DEFAULT_LATENCY_BOUNDS,
@@ -344,11 +419,28 @@ class SpanMetrics:
     def families(self) -> Tuple[HistogramFamily, ...]:
         return (self.latency, self.rows, self.bytes)
 
+    def _note_run(self, run_id: str) -> None:
+        """Record that ``run_id`` is live; evict the oldest runs' series
+        once more than :attr:`MAX_RUN_SERIES` distinct ids have been seen."""
+        evict: List[str] = []
+        with self._runs_lock:
+            if run_id in self._runs:
+                self._runs.move_to_end(run_id)
+            else:
+                self._runs[run_id] = None
+                while len(self._runs) > self.MAX_RUN_SERIES:
+                    evict.append(self._runs.popitem(last=False)[0])
+        for old in evict:
+            for f in self.families():
+                f.prune(lambda labels, _old=old: labels.get("run") == _old)
+
     def observe_record(self, rec: Dict[str, Any]) -> None:
         """Feed one completed tracer record (called from ``Tracer._emit``
         — i.e. only while tracing is enabled; the disabled path never
         reaches here)."""
-        labels = {"span": rec["name"], **_RUN_LABELS}
+        labels = {"span": rec["name"], **_RUN_LABELS_VAR.get()}
+        if "run" in labels:
+            self._note_run(labels["run"])
         self.latency.observe(max(rec.get("dur", 0), 0) / 1e9, **labels)
         args = rec.get("args") or {}
         rows = args.get("rows", args.get("rows_out"))
@@ -386,6 +478,13 @@ class SpanMetrics:
     def merge(self, delta: Dict[str, List[Dict[str, Any]]]) -> None:
         if not delta:
             return
+        # worker deltas carry run labels too — count them against the same
+        # rotation window so merged series obey the cardinality bound
+        for encs in delta.values():
+            for enc in encs or []:
+                r = (enc.get("labels") or {}).get("run")
+                if r:
+                    self._note_run(r)
         self.latency.merge(delta.get("latency", []))
         self.rows.merge(delta.get("rows", []))
         self.bytes.merge(delta.get("bytes", []))
@@ -426,6 +525,8 @@ class SpanMetrics:
     def clear(self) -> None:
         for f in self.families():
             f.clear()
+        with self._runs_lock:
+            self._runs.clear()
 
 
 _SPAN_METRICS = SpanMetrics()

@@ -9,7 +9,8 @@ keep their full label sets (``span``/``workflow``/``run``) — the
 attribution a per-tenant serving layer reuses unchanged.
 
 ``validate_prometheus_text`` is the CI gate (``make telemetry-smoke``):
-it asserts the line grammar, label syntax, cumulative-bucket
+it asserts the line grammar, label syntax, that no name gets a second
+``# TYPE`` line and no (name, label-set) sample repeats, cumulative-bucket
 monotonicity, the ``+Inf`` bucket, and ``_count``/``+Inf`` agreement —
 the properties a scraper needs to ingest the page at all.
 
@@ -127,23 +128,29 @@ def to_prometheus_text(
     for family in span_metrics.families():
         _render_histogram_family(family, lines)
     last = sampler.last()
-    if last or sampler.running:
-        for k in sorted(last):
-            n = _name("fugue_tpu_resource", k)
-            lines.append(f"# HELP {n} resource sampler gauge {k}")
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {_num(float(last[k]))}")
-        meta = sampler.as_dict()
-        lines.append("# TYPE fugue_tpu_telemetry_samples gauge")
-        lines.append(f"fugue_tpu_telemetry_samples {meta['samples']}")
-        lines.append("# TYPE fugue_tpu_telemetry_running gauge")
-        lines.append(f"fugue_tpu_telemetry_running {1 if meta['running'] else 0}")
+    for k in sorted(last):
+        n = _name("fugue_tpu_resource", k)
+        lines.append(f"# HELP {n} resource sampler gauge {k}")
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_num(float(last[k]))}")
+    # sampler meta is emitted here unconditionally and ONLY here — the
+    # engine-stats flatten below skips the "telemetry" group so these
+    # names never appear twice on one page (a duplicate TYPE/sample makes
+    # Prometheus reject the whole scrape)
+    meta = sampler.as_dict()
+    lines.append("# TYPE fugue_tpu_telemetry_samples gauge")
+    lines.append(f"fugue_tpu_telemetry_samples {meta['samples']}")
+    lines.append("# TYPE fugue_tpu_telemetry_running gauge")
+    lines.append(f"fugue_tpu_telemetry_running {1 if meta['running'] else 0}")
     if engine is not None:
         flat: Dict[str, float] = {}
         try:
             for group, vals in engine.stats().items():
-                if group == "latency":
-                    continue  # already exposed as real histograms above
+                if group in ("latency", "telemetry"):
+                    # latency: already exposed as real histograms above;
+                    # telemetry: the sampler gauges + meta above are the
+                    # single source for those names
+                    continue
                 _flatten_numeric(vals, str(group), flat)
         except Exception:
             flat = {}
@@ -158,15 +165,29 @@ def validate_prometheus_text(text: str) -> Dict[str, Any]:
     """Assert ``text`` is scrapeable exposition; returns summary counts.
 
     Checks every sample line against the exposition grammar, label-pair
-    syntax, and for each histogram series: cumulative buckets
-    non-decreasing, a ``+Inf`` bucket present, and ``_count`` equal to
-    the ``+Inf`` bucket."""
+    syntax, that no metric name gets a second ``# TYPE`` line, that no
+    (name, label-set) sample appears twice (either duplicate makes a real
+    Prometheus scrape fail), and for each histogram series: cumulative
+    buckets non-decreasing, a ``+Inf`` bucket present, and ``_count``
+    equal to the ``+Inf`` bucket."""
     samples = 0
     names = set()
+    typed: Dict[str, int] = {}  # name -> lineno of its TYPE line
+    seen: Dict[Any, int] = {}  # (name, sorted labels) -> lineno
     # (base_name, labels-minus-le) -> {"buckets": [(le, v)], "count": v}
     hists: Dict[Any, Dict[str, Any]] = {}
     for lineno, line in enumerate(text.splitlines(), 1):
-        if not line.strip() or line.startswith("#"):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                tname = parts[2]
+                assert tname not in typed, (
+                    f"line {lineno}: duplicate TYPE for {tname} "
+                    f"(first at line {typed[tname]})"
+                )
+                typed[tname] = lineno
             continue
         m = _LINE_RE.match(line)
         assert m is not None, f"line {lineno} not valid exposition: {line!r}"
@@ -178,6 +199,12 @@ def validate_prometheus_text(text: str) -> Dict[str, Any]:
             rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
             assert rebuilt == body, f"line {lineno} bad labels: {labelstr!r}"
             labels = dict(matched)
+        ident = (name, tuple(sorted(labels.items())))
+        assert ident not in seen, (
+            f"line {lineno}: duplicate sample {name}{labelstr} "
+            f"(first at line {seen[ident]})"
+        )
+        seen[ident] = lineno
         samples += 1
         names.add(name)
         if name.endswith("_bucket") and "le" in labels:

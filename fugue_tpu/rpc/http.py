@@ -218,14 +218,19 @@ class HttpRPCServer(RPCServer):
             text = to_prometheus_text(engine=self._metrics_engine())
             return "text/plain; version=0.0.4; charset=utf-8", text.encode()
         if path == "/stats":
-            from ..obs import current_run_labels, get_sampler, get_span_metrics
+            from ..obs import active_run_labels, get_sampler, get_span_metrics
 
             eng = self._metrics_engine()
+            # run labels are context-local to the run's own threads; from
+            # the server thread report the scopes currently entered
+            # anywhere in the process (most recent under the legacy key)
+            active = active_run_labels()
             payload = {
                 "engine": eng.stats() if eng is not None else None,
                 "latency": get_span_metrics().summary(),
                 "telemetry": get_sampler().as_dict(),
-                "run_labels": dict(current_run_labels()),
+                "run_labels": active[-1] if active else {},
+                "active_runs": active,
             }
             return "application/json", json.dumps(payload, default=str).encode()
         return None

@@ -7,6 +7,7 @@ checkpoint-aware pruning: tasks whose deterministic checkpoint already exists
 load from storage and their exclusive ancestors are skipped (true resume).
 """
 
+import contextvars
 import time
 import uuid as _uuid
 from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
@@ -161,7 +162,14 @@ class FugueWorkflowContext:
                 ]
                 for t in ready:
                     del remaining[id(t)]
-                    running[pool.submit(self._run_task, t)] = id(t)
+                    # pool threads have no context of their own: submit
+                    # through a context copy so task spans (and anything
+                    # they fork) keep the run-attribution labels
+                    running[
+                        pool.submit(
+                            contextvars.copy_context().run, self._run_task, t
+                        )
+                    ] = id(t)
                 if not running:
                     if remaining:
                         raise FugueWorkflowRuntimeError("workflow graph has a cycle")

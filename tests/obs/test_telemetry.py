@@ -265,6 +265,60 @@ def test_workflow_run_gets_workflow_and_run_labels(tracer):
     assert "p50_ms" in txt and "p99_ms" in txt and "workflow.run" in txt
 
 
+def test_concurrent_run_labels_do_not_cross_contaminate(tracer):
+    """Two runs on different threads each label their own samples — the
+    context-local scope (and its token-based restore) never leaks one
+    run's labels into the other or leaves stale labels active after."""
+    sm = get_span_metrics()
+    barrier = threading.Barrier(2, timeout=10)
+
+    def one_run(run_id):
+        with run_labels(workflow="wfC", run=run_id):
+            barrier.wait()  # both label scopes active simultaneously
+            for _ in range(3):
+                with tracer.span("engine.z"):
+                    pass
+            barrier.wait()
+
+    threads = [
+        threading.Thread(target=one_run, args=(r,)) for r in ("rA", "rB")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    for r in ("rA", "rB"):
+        h = sm.latency.get(span="engine.z", workflow="wfC", run=r)
+        assert h is not None and h.count == 3, r
+    # no unlabeled or cross-labeled series, and no labels linger
+    assert sm.latency.get(span="engine.z") is None
+    from fugue_tpu.obs import active_run_labels, current_run_labels
+
+    assert current_run_labels() == {} and active_run_labels() == []
+
+
+def test_run_label_series_cardinality_is_bounded(tracer):
+    """A long-lived process must not accumulate one histogram series per
+    run forever: only the most recent MAX_RUN_SERIES run ids keep series."""
+    sm = get_span_metrics()
+    cap = sm.MAX_RUN_SERIES
+    n_runs = cap + 7
+    for i in range(n_runs):
+        with run_labels(workflow="wfR", run=f"run{i:04d}"):
+            with tracer.span("engine.r"):
+                pass
+    runs_kept = {
+        labels["run"]
+        for labels, _ in sm.latency.series()
+        if labels.get("workflow") == "wfR"
+    }
+    assert len(runs_kept) == cap
+    # the newest runs survive, the oldest were pruned
+    assert runs_kept == {f"run{i:04d}" for i in range(n_runs - cap, n_runs)}
+    # the per-span summary still reports (merged across surviving runs)
+    assert sm.summary()["engine.r"]["count"] == cap
+
+
 # ---------------------------------------------------------------------------
 # fork boundary: worker histogram deltas merge home
 # ---------------------------------------------------------------------------
@@ -480,6 +534,54 @@ def test_validate_prometheus_rejects_garbage():
         validate_prometheus_text("this is{not metrics\n")
     with pytest.raises(AssertionError):
         validate_prometheus_text("")  # no samples
+
+
+def test_validate_prometheus_rejects_duplicates():
+    # duplicate TYPE line — Prometheus's parser rejects the whole page
+    with pytest.raises(AssertionError, match="duplicate TYPE"):
+        validate_prometheus_text(
+            "# TYPE m gauge\nm 1\n# TYPE m gauge\nm 2\n"
+        )
+    # duplicate (name, label-set) sample
+    with pytest.raises(AssertionError, match="duplicate sample"):
+        validate_prometheus_text('m{a="x"} 1\nm{a="x"} 2\n')
+    # same name, different labels is fine
+    validate_prometheus_text('m{a="x"} 1\nm{a="y"} 2\n')
+
+
+def test_metrics_page_unique_with_engine_and_running_sampler(tracer, sampler):
+    """The exact configuration the PR advertises — engine bound AND the
+    sampler active — must render each telemetry meta series exactly once
+    (regression: the engine-stats flatten used to re-emit
+    fugue_tpu_telemetry_samples/_running with a second TYPE line)."""
+    e = JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_TELEMETRY_ENABLED: True,
+            FUGUE_TPU_CONF_TELEMETRY_INTERVAL: 0.01,
+            FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 2048,
+        }
+    )
+    try:
+        res = e.aggregate(
+            _stream(_frame(6000, 8, seed=6)),
+            PartitionSpec(by=["k"]),
+            [ff.sum(col("v")).alias("s")],
+        )
+        assert len(res.as_pandas()) == 8
+        sampler.sample_once()
+        text = to_prometheus_text(engine=e)
+    finally:
+        e.stop_engine()
+    validate_prometheus_text(text)  # now includes the duplicate gates
+    for name in ("fugue_tpu_telemetry_samples", "fugue_tpu_telemetry_running"):
+        sample_lines = [
+            ln for ln in text.splitlines() if ln.startswith(name + " ")
+        ]
+        type_lines = [
+            ln for ln in text.splitlines() if ln.startswith(f"# TYPE {name} ")
+        ]
+        assert len(sample_lines) == 1, sample_lines
+        assert len(type_lines) == 1, type_lines
 
 
 def test_http_endpoints_scrape_live_run(tracer, sampler):
