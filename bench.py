@@ -1122,6 +1122,112 @@ def _bench_result_cache(rows: int = 300_000, wide_cols: int = 10) -> dict:
             _shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def _bench_segment_lowering(
+    rows: int = 400_000, chunk: int = 16_384, groups: int = 64
+) -> dict:
+    """Lowered-segment case (ISSUE 7): a streaming (filter → project →
+    dense aggregate) pipeline with ``fugue.tpu.plan.lower_segments`` ON
+    vs OFF. Lowered, each raw chunk goes H2D once and ONE jitted
+    ``shard_map`` program (chain predicate + projection + dense-bucket
+    kernel + donated accumulator fold, cross-shard combine in-program)
+    advances the aggregate; unlowered, the fused chain runs per chunk
+    with a device roundtrip and the streaming aggregate re-ingests the
+    survivors. The acceptance bar is >= 1.3x on the cpu mesh smoke case
+    with exactly one ``segment:<fp>`` jit-cache entry per pipeline."""
+    import numpy as _np
+    import pandas as _pd
+    import pyarrow as _pa
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS,
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    )
+    from fugue_tpu.dataframe import (
+        ArrowDataFrame,
+        LocalDataFrameIterableDataFrame,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = _np.random.default_rng(13)
+    tbl = _pa.Table.from_pandas(
+        _pd.DataFrame(
+            {
+                "k": rng.integers(0, groups, rows),
+                "v": rng.random(rows),
+                "w": rng.random(rows),
+            }
+        ),
+        preserve_index=False,
+    )
+
+    def stream():
+        return LocalDataFrameIterableDataFrame(
+            (
+                ArrowDataFrame(tbl.slice(s, min(chunk, rows - s)))
+                for s in range(0, rows, chunk)
+            ),
+            schema=ArrowDataFrame(tbl).schema,
+        )
+
+    def run(lower: bool):
+        # cache OFF: best-of-3 must measure the engine, not memoization
+        eng = JaxExecutionEngine(
+            {
+                FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS: lower,
+                FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: chunk,
+                FUGUE_TPU_CONF_CACHE_ENABLED: False,
+            }
+        )
+        best = None
+        for _ in range(3):  # first run pays jit compile; best-of-3
+            dag = FugueWorkflow()
+            (
+                dag.df(stream())
+                .filter(col("v") > 0.2)
+                .select(col("k"), (col("v") * col("w")).alias("z"))
+                .partition_by("k")
+                .aggregate(
+                    ff.sum(col("z")).alias("s"),
+                    ff.count(col("z")).alias("n"),
+                    ff.avg(col("z")).alias("m"),
+                )
+                .yield_dataframe_as("r", as_local=True)
+            )
+            t0 = time.perf_counter()
+            dag.run(eng)
+            dt = time.perf_counter() - t0
+            assert len(dag.yields["r"].result.as_pandas()) == groups
+            best = dt if best is None else min(best, dt)
+        return best, eng
+
+    lowered_s, eng_on = run(True)
+    unlowered_s, _ = run(False)
+    seg_entries = eng_on._jit_cache.segment_entries()
+    plan_stats = eng_on.stats()["plan"]
+    speedup = unlowered_s / max(lowered_s, 1e-9)
+    return {
+        "rows": rows,
+        "chunk_rows": chunk,
+        "groups": groups,
+        "lowered_s": round(lowered_s, 4),
+        "unlowered_s": round(unlowered_s, 4),
+        "speedup": round(speedup, 2),
+        "segment_jit_entries": seg_entries,
+        "segments_executed": plan_stats["segments_executed"],
+        "segments_fallback": plan_stats["segments_fallback"],
+        "correct": bool(
+            len(seg_entries) == 1
+            and set(seg_entries.values()) == {1}
+            and plan_stats["segments_executed"] >= 1
+            and plan_stats["segments_fallback"] == 0
+            and speedup >= 1.3
+        ),
+    }
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -1199,6 +1305,10 @@ def _smoke() -> None:
     # result-cache cold/warm case (ISSUE 5): the warm run must skip >=90%
     # of producer bytes, execute zero producer tasks, and be >=3x faster
     cache_case = _bench_result_cache(rows=150_000, wide_cols=10)
+    # segment lowering (ISSUE 7): streaming fused-chain → dense aggregate,
+    # lowered (one SPMD program per chunk) vs lower_segments=off; must
+    # show >=1.3x with ONE segment jit-cache entry for the pipeline
+    segment_case = _bench_segment_lowering(rows=200_000)
     result = {
         "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
         "value": round(r["rps"], 1),
@@ -1213,6 +1323,7 @@ def _smoke() -> None:
         "correct": bool(r["ok"]),
         "plan_pruning": plan_case,
         "result_cache": cache_case,
+        "segment_lowering": segment_case,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     try:  # drop the result where --compare picks it up (best effort)
@@ -1227,6 +1338,8 @@ def _smoke() -> None:
         raise SystemExit(4)
     if not cache_case["correct"]:
         raise SystemExit(7)
+    if not segment_case["correct"]:
+        raise SystemExit(9)
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -1269,7 +1382,8 @@ def _trace_smoke(trace_dir: str) -> None:
         dag = FugueWorkflow()
         res = (
             dag.df(stream)
-            .partition_by("k")
+            .filter(col("v") >= 0.0)  # row-local chain → the aggregate
+            .partition_by("k")        # lowers into ONE plan.segment
             .aggregate(
                 ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n")
             )
@@ -1281,9 +1395,26 @@ def _trace_smoke(trace_dir: str) -> None:
         path = write_chrome_trace(os.path.join(trace_dir, "trace.json"), records)
         summary = validate_chrome_trace(path)
         names = set(summary["names"])
-        # the contract: nested workflow task → engine verb → streaming chunk
+        # the contract: nested workflow task → engine work → streaming chunk
         assert "workflow.task" in names and "stream.chunk" in names, names
         assert any(nm.startswith("engine.") for nm in names), names
+        # segment lowering ON (the default): the Perfetto export carries
+        # ONE plan.segment span wrapping the per-chunk spans — assert the
+        # stream.chunk records nest under it (ISSUE 7 trace-smoke gate)
+        assert "plan.segment" in names, names
+        by_id = {r["id"]: r for r in records}
+        seg_ids = {r["id"] for r in records if r["name"] == "plan.segment"}
+        chunk_recs = [r for r in records if r["name"] == "stream.chunk"]
+        assert len(chunk_recs) > 0, names
+        for c in chunk_recs:
+            anc = c.get("parent")
+            while anc is not None and anc in by_id and anc not in seg_ids:
+                anc = by_id[anc].get("parent")
+            assert anc in seg_ids, (
+                "stream.chunk span not nested under plan.segment",
+                c,
+            )
+        assert "engine.aggregate" not in names, names
         print(
             json.dumps(
                 {
@@ -1780,6 +1911,10 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # result cache (ISSUE 5): cold vs warm across fresh
                     # engines sharing one fugue.tpu.cache.dir
                     "result_cache": _bench_result_cache(),
+                    # segment lowering (ISSUE 7): streaming fused chain →
+                    # dense aggregate as ONE SPMD program per chunk,
+                    # lowered vs fugue.tpu.plan.lower_segments=false
+                    "segment_lowering": _bench_segment_lowering(),
                     # most recent `bench.py --north-star` run (the literal
                     # 1B-row groupby-apply), if one has been captured
                     "north_star_1b": _load_north_star(),

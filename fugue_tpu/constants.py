@@ -138,6 +138,13 @@ FUGUE_TPU_CONF_PLAN_PUSHDOWN = "fugue.tpu.plan.pushdown"
 # FusedVerbs task (single jitted step on the jax engine; per-chunk on
 # streams)
 FUGUE_TPU_CONF_PLAN_FUSE = "fugue.tpu.plan.fuse"
+# whole-plan SPMD segment lowering (docs/plan.md): after fusion, collapse
+# maximal device-resident segments — a row-local verb chain flowing into a
+# dense aggregate / take / distinct / broadcast-join probe — into ONE
+# LoweredSegment task the jax engine compiles to a single shard_map-
+# partitioned XLA program (per-segment fallback to the per-verb path on
+# any lowering refusal keeps results bit-identical)
+FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS = "fugue.tpu.plan.lower_segments"
 
 # content-addressed result cache (fugue_tpu/cache, docs/cache.md): memoize
 # task outputs ACROSS runs, keyed on canonical post-optimization plan

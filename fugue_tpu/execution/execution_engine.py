@@ -627,6 +627,27 @@ class ExecutionEngine(FugueEngineBase):
 
         return apply_steps_engine(self, df, steps)
 
+    def lowered_segment(
+        self,
+        dfs: List[DataFrame],
+        steps: List[Any],
+        terminal: Any,
+        partition_spec: Optional[PartitionSpec],
+        fingerprint: str = "",
+    ) -> DataFrame:
+        """Execute a device-resident plan segment (see
+        ``fugue_tpu/plan/lowering.py``): a row-local verb chain flowing
+        into a terminal aggregate / take / distinct / join. The default
+        interprets the segment per-verb — ``fused_apply`` then the
+        terminal with this engine's own verb, exactly what the unlowered
+        task pair runs; the jax engine overrides with a single compiled
+        SPMD program and falls back here on any lowering refusal."""
+        from ..plan.lowering import apply_terminal_engine
+
+        return apply_terminal_engine(
+            self, dfs, steps, tuple(terminal), partition_spec
+        )
+
     def aggregate(
         self,
         df: DataFrame,

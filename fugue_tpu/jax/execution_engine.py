@@ -1108,6 +1108,385 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
         )
 
+    def lowered_segment(
+        self,
+        dfs: List[DataFrame],
+        steps: Any,
+        terminal: Any,
+        partition_spec: Optional[PartitionSpec],
+        fingerprint: str = "",
+    ) -> DataFrame:
+        """Execute a lowered plan segment (``fugue_tpu/plan/lowering.py``)
+        as ONE compiled SPMD program where eligible:
+
+        - stream → fused chain → dense aggregate (the flagship): each raw
+          chunk goes H2D once and a single jitted ``shard_map`` program
+          runs predicate + projections + dense-bucket kernel (cross-shard
+          ``psum``/``pmin``/``pmax`` inlined as in-program collectives) +
+          donated accumulator fold — one jit-cache entry labeled
+          ``segment:<fingerprint>`` for the whole pipeline segment;
+        - device-resident frame → fused chain → dense aggregate: the
+          whole segment is one jitted program (chain + kernel + finish);
+        - stream → fused chain → take / distinct / broadcast-join probe:
+          the chain runs as one device program per chunk, survivors feed
+          the terminal's running buffer / probe.
+
+        Any refusal (non-composable step, host-only type, ineligible
+        aggregate plan, ...) falls back per segment to the per-verb path —
+        ``fused_apply`` + the terminal verb, bit-identical to the
+        unlowered task pair, same ``engine.<verb>`` spans. A lowered run
+        executes under ONE ``plan.segment`` span instead.
+        """
+        from ..obs import get_tracer
+
+        terminal = tuple(terminal)
+        runner = None
+        try:
+            runner = self._plan_lowered_segment(
+                dfs, list(steps), terminal, partition_spec, fingerprint
+            )
+        except Exception as ex:  # planning must never break execution
+            self.log.warning(
+                "segment lowering refused with an error (%s: %s); "
+                "falling back to the per-verb path",
+                type(ex).__name__,
+                ex,
+            )
+            runner = None
+        if runner is not None:
+            tracer = get_tracer()
+            with tracer.span(
+                "plan.segment",
+                cat="plan",
+                annotate=True,
+                segment=fingerprint,
+                terminal=terminal[0],
+                steps=len(steps),
+            ):
+                res = runner()
+            self.plan_stats.segments_executed += 1
+            return res
+        self.plan_stats.segments_fallback += 1
+        return super().lowered_segment(
+            dfs, steps, terminal, partition_spec, fingerprint=fingerprint
+        )
+
+    def _plan_lowered_segment(
+        self,
+        dfs: List[DataFrame],
+        steps: List[Any],
+        terminal: Tuple,
+        partition_spec: Optional[PartitionSpec],
+        fingerprint: str,
+    ) -> Optional[Callable[[], DataFrame]]:
+        """Phase-1 planning: return a zero-arg runner when the segment
+        lowers, None to fall back. Planning never consumes stream data."""
+        from .streaming import (
+            is_stream_frame,
+            plan_lowered_steps_stream,
+            plan_streaming_lowered_aggregate,
+            streaming_distinct,
+            streaming_take,
+        )
+
+        if len(steps) == 0:
+            return None
+        kind = terminal[0]
+        if kind == "aggregate":
+            keys = (
+                list(partition_spec.partition_by)
+                if partition_spec is not None
+                else []
+            )
+            agg_cols = list(terminal[1])
+            df = dfs[0]
+            if is_stream_frame(df):
+                return plan_streaming_lowered_aggregate(
+                    self, df, steps, keys, agg_cols, fingerprint
+                )
+            return self._plan_lowered_bounded_aggregate(
+                df, steps, keys, agg_cols, fingerprint
+            )
+        if kind == "take":
+            df = dfs[0]
+            if not is_stream_frame(df):
+                return None
+            mk = plan_lowered_steps_stream(self, df, steps, fingerprint)
+            if mk is None:
+                return None
+            return lambda: streaming_take(
+                self, mk(), terminal[1], terminal[2], terminal[3], partition_spec
+            )
+        if kind == "distinct":
+            df = dfs[0]
+            if not is_stream_frame(df):
+                return None
+            mk = plan_lowered_steps_stream(self, df, steps, fingerprint)
+            if mk is None:
+                return None
+            return lambda: streaming_distinct(self, mk())
+        if kind == "join":
+            probe = terminal[3]
+            df = dfs[probe]
+            build = dfs[1 - probe]
+            if not is_stream_frame(df) or is_stream_frame(build):
+                return None
+            mk = plan_lowered_steps_stream(self, df, steps, fingerprint)
+            if mk is None:
+                return None
+
+            def run_join() -> DataFrame:
+                ldf = mk()
+                d1, d2 = (ldf, build) if probe == 0 else (build, ldf)
+                return self.join(d1, d2, how=terminal[1], on=list(terminal[2]))
+
+            return run_join
+        return None
+
+    def _plan_lowered_bounded_aggregate(
+        self,
+        df: DataFrame,
+        steps: List[Any],
+        keys: List[str],
+        agg_cols: List[ColumnExpr],
+        fingerprint: str,
+    ) -> Optional[Callable[[], DataFrame]]:
+        """Lowered (chain → dense aggregate) over a fully device-resident
+        frame: predicate, projections, dense-bucket kernel (in-program
+        cross-shard collectives) and the on-device finish trace into ONE
+        jitted program — no intermediate frame, no host roundtrip.
+        Eligibility mirrors ``_try_dense_device_aggregate`` with the
+        chain's key/value sources required to be plain (un-encoded,
+        un-masked) columns or device-computable expressions over them."""
+        from ..column.jax_eval import device_predicate_plan
+        from ..plan.fused import compose_steps
+        from ..ops.segment import (
+            _DENSE_MAX_RANGE,
+            _DENSE_SUM_BACKEND,
+            _get_compiled_dense,
+            dense_buckets,
+        )
+        from .streaming import _np_dtype_of
+
+        if len(keys) != 1:
+            return None
+        jdf = self.to_df(df)
+        if (
+            not isinstance(jdf, JaxDataFrame)
+            or len(jdf.device_cols) == 0
+            or jdf.host_table is not None
+        ):
+            return None
+        composed = compose_steps(list(jdf.schema.names), steps)
+        if composed is None:
+            return None
+        pred, outputs = composed
+        outs_by_name = {e.output_name: e for e in outputs}
+        if len(outs_by_name) != len(outputs):
+            return None
+        plain_cols = {
+            k: v
+            for k, v in jdf.device_cols.items()
+            if k not in jdf.encodings and k not in jdf.null_masks
+        }
+        import jax
+        import jax.numpy as jnp
+
+        zcols = {
+            k: jnp.zeros((0,), dtype=np.dtype(v.dtype))
+            for k, v in plain_cols.items()
+        }
+        passthrough_ids = {
+            id(e) for e in outputs if _is_passthrough(e, jdf.device_cols)
+        }
+        fields: List[pa.Field] = []
+        out_np: Dict[str, np.dtype] = {}
+        for e in outputs:
+            name = e.output_name
+            if id(e) in passthrough_ids:
+                fields.append(pa.field(name, jdf.schema[e.name].type))
+                continue
+            if not can_evaluate_on_device(e, plain_cols):
+                return None
+            try:
+                arr = jnp.asarray(evaluate_jnp(zcols, e))
+            except Exception:
+                return None
+            out_np[name] = np.dtype(arr.dtype)
+            t = e.infer_type(jdf.schema)
+            fields.append(
+                pa.field(
+                    name, t if t is not None else pa.from_numpy_dtype(out_np[name])
+                )
+            )
+        probe_schema = Schema(fields)
+        empty = pa.Table.from_pylist([], schema=probe_schema.pa_schema)
+        try:
+            jdf0 = JaxDataFrame(ArrowDataFrame(empty), mesh=self._mesh)
+        except Exception:
+            return None
+        plan = _plan_device_agg(jdf0, keys, agg_cols)
+        if (
+            plan is None
+            or plan["virtual"]
+            or plan["dict_srcs"]
+            or plan["masked_srcs"]
+            or any(p.get("kind") not in ("pass", "avg") for p in plan["post"])
+        ):
+            return None
+        key = keys[0]
+        key_expr = outs_by_name.get(key)
+        from ..column.expressions import _NamedColumnExpr as _Named
+
+        if (
+            not isinstance(key_expr, _Named)
+            or key_expr.wildcard
+            or key_expr.as_type is not None
+        ):
+            return None
+        raw_key = key_expr.name
+        if raw_key not in plain_cols:
+            return None
+        key_np = np.dtype(jdf.device_cols[raw_key].dtype)
+        if key_np.kind not in ("i", "u"):
+            return None
+        srcs = sorted({s for _, _, s in plan["aggs"]})
+        actual: Dict[str, np.dtype] = {}
+        src_expr: Dict[str, Any] = {}
+        for s in srcs:
+            e = outs_by_name.get(s)
+            if e is None:
+                return None
+            if id(e) in passthrough_ids:
+                if e.name not in plain_cols:
+                    return None  # masked/encoded source would lose its NULLs
+                actual[s] = np.dtype(jdf.device_cols[e.name].dtype)
+            else:
+                actual[s] = out_np[s]
+            if actual[s].kind not in ("i", "u", "f"):
+                return None
+            src_expr[s] = e
+        del jdf0
+        # range over the RAW key column (pre-filter superset — correct,
+        # possibly more buckets; the cached frame probe pays once)
+        kmin, kmax = jdf.key_range(raw_key)
+        rng = kmax - kmin + 1
+        if not (0 < rng <= _DENSE_MAX_RANGE):
+            return None
+        predicted: Dict[str, np.dtype] = {
+            name: (np.dtype(np.int64) if agg == "count" else actual[src])
+            for name, agg, src in plan["aggs"]
+        }
+        spec_rows = _dense_finish_spec(plan, predicted)
+        if spec_rows is None:
+            return None
+        tables: Dict[str, Any] = {}
+        cond = None
+        if pred is not None:
+            pplan = device_predicate_plan(pred, jdf.device_cols, jdf.encodings)
+            if pplan is None:
+                return None
+            tables, cond = pplan
+        uuids = tuple(sorted(tables.keys()))
+        tnames = {u: tables[u][0] for u in uuids}
+        code_cols = frozenset(
+            c for c, e in jdf.encodings.items() if e["kind"] == "dict"
+        )
+        vidx = {s: i for i, s in enumerate(srcs)}
+        agg_sig = tuple(
+            (name, agg, vidx[src], actual[src].kind == "f")
+            for name, agg, src in plan["aggs"]
+        )
+        buckets = dense_buckets(rng)
+        kernel = _get_compiled_dense(self._mesh, buckets, agg_sig)
+        kmin_s = np.int64(kmin)
+        label = f"segment:{fingerprint or 'anon'}"
+        cache_key = (
+            label,
+            self._mesh,
+            buckets,
+            agg_sig,
+            spec_rows,
+            key_np.str,
+            kmin,
+            uuids,
+            code_cols,
+            _DENSE_SUM_BACKEND[0],
+        )
+
+        def run() -> DataFrame:
+            from ..column.jax_eval import evaluate_jnp as _ev
+            from ..column.jax_eval import evaluate_jnp_3v as _ev3
+
+            if cache_key not in self._jit_cache:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                arr_names = tuple(s[0] for s in agg_sig)
+                fin = self._make_dense_finish(
+                    buckets, arr_names, spec_rows, key_np.str
+                )
+
+                def prog(
+                    cols: Dict[str, Any],
+                    masks: Dict[str, Any],
+                    tarrs: Any,
+                    valid: Any,
+                ):
+                    import jax.numpy as _jnp
+
+                    if cond is not None:
+                        dt = {u: (tnames[u], t) for u, t in zip(uuids, tarrs)}
+                        pv, nl = _ev3(cols, masks, dt, cond, code_cols)
+                        valid = (
+                            valid
+                            & _jnp.asarray(pv, dtype=bool)
+                            & _jnp.logical_not(nl)
+                        )
+                    karr = cols[raw_key]
+                    vals = []
+                    for s in srcs:
+                        e = src_expr[s]
+                        if id(e) in passthrough_ids:
+                            a = cols[e.name]
+                        else:
+                            a = _ev(cols, e)
+                            if (
+                                not hasattr(a, "shape")
+                                or getattr(a, "ndim", 0) == 0
+                            ):
+                                a = _jnp.full((valid.shape[0],), a)
+                            a = _jnp.asarray(a).astype(actual[s])
+                        vals.append(a)
+                    outs = kernel(karr, kmin_s, *vals, valid)
+                    return fin(kmin_s, outs[0], *outs[1:])
+
+                self._jit_cache[cache_key] = jax.jit(
+                    prog,
+                    out_shardings=NamedSharding(self._mesh, P(ROW_AXIS)),
+                )
+            outs = self._jit_cache[cache_key](
+                dict(jdf.device_cols),
+                dict(jdf.null_masks),
+                tuple(tables[u][1] for u in uuids),
+                jdf.device_valid_mask(),
+            )
+            device_cols = {key: outs[0]}
+            for (_, name, _, _), arr in zip(spec_rows, outs[2:]):
+                device_cols[name] = arr
+            return JaxDataFrame(
+                mesh=self._mesh,
+                _internal=dict(
+                    device_cols=device_cols,
+                    host_tbl=None,
+                    row_count=-1,
+                    valid_mask=outs[1],
+                    schema=plan["schema"],
+                ),
+            )
+
+        return run
+
     def _host(self, df: DataFrame) -> DataFrame:
         return df.as_local_bounded() if isinstance(df, JaxDataFrame) else self._host_engine.to_df(df)
 
@@ -3069,11 +3448,6 @@ class JaxExecutionEngine(ExecutionEngine):
         if not (0 < rng <= _DENSE_MAX_RANGE):
             return None
 
-        def _jnp_dtype(tp: pa.DataType) -> Optional[np.dtype]:
-            if pa.types.is_integer(tp) or pa.types.is_floating(tp):
-                return np.dtype(tp.to_pandas_dtype())
-            return None
-
         # predict kernel output dtypes; bail on any cast a NULL could break
         predicted: Dict[str, np.dtype] = {}
         for name, agg, arr, _ in agg_entries:
@@ -3082,29 +3456,12 @@ class JaxExecutionEngine(ExecutionEngine):
                 if agg == "count"
                 else np.dtype(arr.dtype)
             )
-        key_dt = _jnp_dtype(self._field_type(jdf.schema, keys[0]))
+        key_dt = _np_numeric_dtype(self._field_type(jdf.schema, keys[0]))
         if key_dt is None:
             return None
-        spec_rows: List[Tuple[str, str, Tuple[str, ...], str]] = []
-        for p, field_name in zip(plan["post"], plan["schema"].names[1:]):
-            tgt = _jnp_dtype(self._field_type(plan["schema"], field_name))
-            if tgt is None:
-                return None
-            if p["kind"] == "avg":
-                ins = (f"{p['name']}__sum", f"{p['name']}__cnt")
-                src_dt = np.dtype(np.float64)
-            else:
-                ins = (p["name"],)
-                src_dt = predicted[p["name"]]
-            if src_dt.kind == "f" and tgt.kind != "f":
-                return None  # NaN (NULL) would not survive the cast
-            if src_dt.kind not in ("i", "u", "f") or tgt.kind not in (
-                "i",
-                "u",
-                "f",
-            ):
-                return None
-            spec_rows.append((p["kind"], p["name"], ins, tgt.str))
+        spec_rows = _dense_finish_spec(plan, predicted)
+        if spec_rows is None:
+            return None
         buckets = dense_buckets(rng)
         outs = self._run_dense_fused(
             jdf, keys[0], agg_entries, kmin, buckets, tuple(spec_rows), key_dt.str
@@ -3455,6 +3812,39 @@ def _null_safe_key(kv: Any) -> tuple:
             isna = False
         out.append(None if isna is True else v)
     return tuple(out)
+
+
+def _np_numeric_dtype(tp: pa.DataType) -> Optional[np.dtype]:
+    if pa.types.is_integer(tp) or pa.types.is_floating(tp):
+        return np.dtype(tp.to_pandas_dtype())
+    return None
+
+
+def _dense_finish_spec(
+    plan: dict, predicted: Dict[str, np.dtype]
+) -> Optional[Tuple[Tuple[str, str, Tuple[str, ...], str], ...]]:
+    """(kind, name, input table names, target dtype) rows driving the
+    on-device dense finish, or None when any declared-schema cast could
+    corrupt a NULL. ``predicted`` maps each kernel output name to its
+    actual table dtype. Factored out of the device-resident aggregate so
+    the lowered-segment program validates casts identically."""
+    spec_rows: List[Tuple[str, str, Tuple[str, ...], str]] = []
+    for p, field_name in zip(plan["post"], plan["schema"].names[1:]):
+        tgt = _np_numeric_dtype(plan["schema"][field_name].type)
+        if tgt is None:
+            return None
+        if p["kind"] == "avg":
+            ins: Tuple[str, ...] = (f"{p['name']}__sum", f"{p['name']}__cnt")
+            src_dt = np.dtype(np.float64)
+        else:
+            ins = (p["name"],)
+            src_dt = predicted[p["name"]]
+        if src_dt.kind == "f" and tgt.kind != "f":
+            return None  # NaN (NULL) would not survive the cast
+        if src_dt.kind not in ("i", "u", "f") or tgt.kind not in ("i", "u", "f"):
+            return None
+        spec_rows.append((p["kind"], p["name"], ins, tgt.str))
+    return tuple(spec_rows)
 
 
 def _is_passthrough(c: ColumnExpr, device_cols: Any) -> bool:

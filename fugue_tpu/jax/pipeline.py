@@ -189,6 +189,13 @@ class JitCache(dict):
     idiom, so counting at ``__contains__`` maps 1:1 onto "would this call
     have paid an XLA compile": absent = miss (a compile follows), present =
     hit. Exposed via ``engine.jit_cache_stats`` and ``bench.py`` extra.
+
+    Entries are labeled by their key's leading element (``by_label``):
+    per-verb programs carry the verb-ish tag they always did
+    (``filter3v`` / ``fused`` / ``stream_agg_step`` / ...), and a lowered
+    plan segment's single program carries ``segment:<fingerprint>`` — so
+    the "one jit entry per pipeline segment" claim is checkable from
+    ``engine.stats()["jit_cache"]`` alone, without poking at key tuples.
     """
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
@@ -204,8 +211,36 @@ class JitCache(dict):
             self.misses += 1
         return present
 
-    def stats(self) -> Dict[str, int]:
-        return {"hits": self.hits, "misses": self.misses, "entries": len(self)}
+    @staticmethod
+    def label_of(key: Any) -> str:
+        if isinstance(key, tuple) and len(key) > 0:
+            return str(key[0])
+        return str(key)
+
+    def by_label(self) -> Dict[str, int]:
+        """Entry count per label — segment entries keyed by their segment
+        fingerprint, never by the first verb they absorbed."""
+        out: Dict[str, int] = {}
+        for k in self.keys():
+            lab = self.label_of(k)
+            out[lab] = out.get(lab, 0) + 1
+        return out
+
+    def segment_entries(self) -> Dict[str, int]:
+        """Just the lowered-segment programs: {fingerprint: entry count}."""
+        return {
+            lab.split(":", 1)[1]: n
+            for lab, n in self.by_label().items()
+            if lab.startswith("segment:")
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "entries": len(self),
+            "by_label": self.by_label(),
+        }
 
     # MetricsRegistry source contract (see fugue_tpu/obs/registry.py)
     def as_dict(self) -> Dict[str, int]:

@@ -220,6 +220,113 @@ def _prefetched_pandas_chunks(
 # --------------------------------------------------------------------------
 
 
+def _fold_dense_acc(agg_sig: Tuple, acc: Tuple, outs: Tuple) -> Tuple:
+    """Merge one chunk's dense-kernel output tables into the running
+    device accumulators — the single fold used by the streaming aggregate
+    AND the lowered-segment program (they must stay in lockstep: NaN is
+    the merge identity for nullable floats, plain adds / min / max
+    otherwise)."""
+    import jax.numpy as jnp
+
+    new = [acc[0] + outs[0]]  # present counts: plain int add
+    for (name, agg, vi, nullable), a, b in zip(agg_sig, acc[1:], outs[1:]):
+        if agg == "count":
+            new.append(a + b)
+        elif agg == "sum":
+            if nullable:
+                # NaN marks an all-NULL (or absent) bucket in a chunk
+                # table — it is the merge identity
+                new.append(
+                    jnp.where(
+                        jnp.isnan(a),
+                        b,
+                        jnp.where(jnp.isnan(b), a, a + b),
+                    )
+                )
+            else:
+                new.append(a + b)
+        elif agg == "min":
+            new.append(jnp.fmin(a, b) if nullable else jnp.minimum(a, b))
+        elif agg == "max":
+            new.append(jnp.fmax(a, b) if nullable else jnp.maximum(a, b))
+        else:  # pragma: no cover - plan gates exclude others
+            raise AssertionError(agg)
+    return tuple(new)
+
+
+def _identity_dense_acc(
+    mesh: Any, buckets: int, agg_sig: Tuple, value_dtypes: List[np.dtype]
+) -> Tuple:
+    """Merge-identity accumulator tables, replicated on the mesh: folding
+    a chunk's kernel output into these yields exactly that output, so the
+    lowered-segment program needs ONE compiled step (no separate
+    first-chunk program — one jit-cache entry per segment)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    arrs: List[np.ndarray] = [np.zeros(buckets, dtype=np.int64)]  # present
+    for _, agg, vi, nullable in agg_sig:
+        dt = value_dtypes[vi]
+        if agg == "count":
+            arrs.append(np.zeros(buckets, dtype=np.int64))
+        elif agg == "sum":
+            arrs.append(
+                np.full(buckets, np.nan, dtype=dt)
+                if nullable
+                else np.zeros(buckets, dtype=dt)
+            )
+        elif agg == "min":
+            arrs.append(
+                np.full(buckets, np.nan, dtype=dt)
+                if nullable
+                else np.full(buckets, np.iinfo(dt).max, dtype=dt)
+            )
+        elif agg == "max":
+            arrs.append(
+                np.full(buckets, np.nan, dtype=dt)
+                if nullable
+                else np.full(buckets, np.iinfo(dt).min, dtype=dt)
+            )
+        else:  # pragma: no cover - plan gates exclude others
+            raise AssertionError(agg)
+    rep = NamedSharding(mesh, P())
+    return tuple(jax.device_put(a, rep) for a in arrs)
+
+
+def _finish_dense_host(
+    engine: Any,
+    acc: Tuple,
+    agg_sig: Tuple,
+    key: str,
+    key_np: np.dtype,
+    kmin: int,
+    plan: dict,
+    track: Optional[Callable[[], None]] = None,
+) -> DataFrame:
+    """ONE host transfer of the merged O(buckets) tables, then the host
+    finish (avg = sum/count, declared dtypes/order) — shared by the
+    streaming aggregate and the lowered-segment runner."""
+    import jax
+
+    for a in acc:
+        a.copy_to_host_async()
+    host = [np.asarray(jax.device_get(a)) for a in acc]
+    if track is not None:
+        track()
+    present = host[0]
+    (idx,) = np.nonzero(present > 0)
+    merged: Dict[str, Any] = {key: idx.astype(np.int64) + kmin}
+    for (name, _, _, _), table in zip(agg_sig, host[1:]):
+        merged[name] = table[idx]
+    mdf = pd.DataFrame(merged)
+    out = pd.DataFrame()
+    out[key] = mdf[key].astype(key_np)
+    for spec in plan["post"]:
+        out[spec["name"]] = spec["fn"](mdf)
+    return engine.to_df(PandasDataFrame(out, plan["schema"]))
+
+
 def _parse_key_range(conf: Any) -> Optional[Tuple[int, int]]:
     raw = conf.get_or_none(FUGUE_TPU_CONF_STREAM_KEY_RANGE, str)
     if raw is None or raw == "":
@@ -349,35 +456,8 @@ def streaming_dense_aggregate(
     if cache_key not in cache:
 
         def step(acc: Tuple[Any, ...], k: Any, valid: Any, *vals: Any):
-            import jax.numpy as jnp
-
             outs = kernel(k, kmin_s, *vals, valid)
-            new = [acc[0] + outs[0]]  # present counts: plain int add
-            for (name, agg, vi, nullable), a, b in zip(
-                agg_sig, acc[1:], outs[1:]
-            ):
-                if agg == "count":
-                    new.append(a + b)
-                elif agg == "sum":
-                    if nullable:
-                        # NaN marks an all-NULL (or absent) bucket in a
-                        # chunk table — it is the merge identity
-                        new.append(
-                            jnp.where(
-                                jnp.isnan(a),
-                                b,
-                                jnp.where(jnp.isnan(b), a, a + b),
-                            )
-                        )
-                    else:
-                        new.append(a + b)
-                elif agg == "min":
-                    new.append(jnp.fmin(a, b) if nullable else jnp.minimum(a, b))
-                elif agg == "max":
-                    new.append(jnp.fmax(a, b) if nullable else jnp.maximum(a, b))
-                else:  # pragma: no cover - plan gate excludes others
-                    raise AssertionError(agg)
-            return tuple(new)
+            return _fold_dense_acc(agg_sig, acc, outs)
 
         cache[cache_key] = jax.jit(step, donate_argnums=0)
     step_fn = cache[cache_key]
@@ -484,28 +564,497 @@ def streaming_dense_aggregate(
         chunks_it.close()
 
     # ONE host transfer: the merged tables (O(buckets), not O(rows))
-    for a in acc:
-        a.copy_to_host_async()
-    host = [np.asarray(jax.device_get(a)) for a in acc]
-    track()
+    res = _finish_dense_host(
+        engine, acc, agg_sig, key, key_np, kmin, plan, track=track
+    )
     global last_run_stats
     last_run_stats = dict(stats, verb="aggregate")
-    present = host[0]
-    (idx,) = np.nonzero(present > 0)
-    merged: Dict[str, Any] = {key: idx.astype(np.int64) + kmin}
-    for (name, _, _, _), table in zip(agg_sig, host[1:]):
-        merged[name] = table[idx]
-    mdf = pd.DataFrame(merged)
-    out = pd.DataFrame()
-    out[key] = mdf[key].astype(key_np)
-    for spec in plan["post"]:
-        out[spec["name"]] = spec["fn"](mdf)
-    return engine.to_df(PandasDataFrame(out, plan["schema"]))
+    return res
 
 
 # --------------------------------------------------------------------------
-# streaming broadcast-hash join
+# lowered plan segments over one-pass streams (fugue_tpu/plan/lowering.py)
 # --------------------------------------------------------------------------
+
+
+def _np_dtype_of(tp: pa.DataType) -> Optional[np.dtype]:
+    """Device-representable numpy dtype of an arrow type, else None."""
+    try:
+        if pa.types.is_boolean(tp):
+            return np.dtype(bool)
+        if pa.types.is_integer(tp) or pa.types.is_floating(tp):
+            return np.dtype(tp.to_pandas_dtype())
+    except Exception:
+        return None
+    return None
+
+
+def _plan_lowered_chain(schema: Schema, steps: Any) -> Optional[dict]:
+    """Schema-only composition of a fused step chain into its
+    single-program form over RAW stream columns.
+
+    Returns ``dict(pred, outputs, outs_by_name, need, in_np, out_np,
+    schema)`` — the (possibly rewritten) Kleene-AND predicate, the output
+    expressions, the input columns the program reads with their numpy
+    dtypes, the EXACT device dtype of every output (zero-row eager
+    probe), and the post-chain schema — or None when any step resists
+    composition or device lowering. Nothing here touches data: a one-pass
+    stream must not lose its head to a plan that then refuses."""
+    from ..column.jax_eval import (
+        can_evaluate_on_device,
+        device_predicate_plan,
+        evaluate_jnp,
+    )
+    from ..plan.fused import compose_steps
+    from ..plan.ir import ALL, expr_columns
+
+    composed = compose_steps(list(schema.names), steps)
+    if composed is None:
+        return None
+    pred, outputs = composed
+    need: set = set()
+    for e in outputs:
+        cols = expr_columns(e)
+        if cols is ALL:
+            return None
+        need |= cols
+    if pred is not None:
+        pcols = expr_columns(pred)
+        if pcols is ALL:
+            return None
+        need |= pcols
+    in_np: Dict[str, np.dtype] = {}
+    for name in sorted(need):
+        if name not in schema:
+            return None
+        dt = _np_dtype_of(schema[name].type)
+        if dt is None:
+            return None
+        in_np[name] = dt
+    cond = None
+    if pred is not None:
+        p = device_predicate_plan(pred, in_np, {})
+        if p is None:
+            return None
+        tables, cond = p
+        if tables:  # pragma: no cover - raw streams carry no dict columns
+            return None
+    if not all(can_evaluate_on_device(e, in_np) for e in outputs):
+        return None
+    import jax.numpy as jnp
+
+    zcols = {n: jnp.zeros((0,), dtype=in_np[n]) for n in sorted(need)}
+    out_np: Dict[str, np.dtype] = {}
+    outs_by_name: Dict[str, Any] = {}
+    fields: List[pa.Field] = []
+    for e in outputs:
+        name = e.output_name
+        if name == "" or name in outs_by_name:
+            return None
+        try:
+            arr = jnp.asarray(evaluate_jnp(zcols, e))
+        except Exception:
+            return None
+        out_np[name] = np.dtype(arr.dtype)
+        try:
+            tp = e.infer_type(schema)
+        except Exception:
+            tp = None
+        fields.append(
+            pa.field(name, tp if tp is not None else pa.from_numpy_dtype(out_np[name]))
+        )
+        outs_by_name[name] = e
+    return dict(
+        pred=cond,
+        outputs=list(outputs),
+        outs_by_name=outs_by_name,
+        need=sorted(need),
+        in_np=in_np,
+        out_np=out_np,
+        schema=Schema(fields),
+    )
+
+
+def plan_streaming_lowered_aggregate(
+    engine: Any,
+    df: Any,
+    steps: Any,
+    keys: List[str],
+    agg_cols: List[Any],
+    fingerprint: str,
+) -> Optional[Callable[[], DataFrame]]:
+    """Phase-1 (schema-only) eligibility for the flagship lowered segment:
+    a fused row-local chain flowing into a dense streaming aggregate.
+
+    Returns a zero-arg runner or None (caller falls back per-verb). The
+    runner consumes the one-pass stream: the producer thread decodes and
+    ``device_put``s each chunk's RAW needed columns ONCE, and a single
+    jitted ``shard_map``-partitioned program — chain predicate (3-valued)
+    + projections + dense-bucket kernel with in-program ``psum``/``pmin``/
+    ``pmax`` cross-shard combine + accumulator fold (donated) — advances
+    the device accumulators. Chunks never return to host between verbs;
+    the host sees only the final O(buckets) tables. Eligibility mirrors
+    the streaming dense aggregate (one plain int key, numeric un-encoded
+    values, sum/count/avg/min/max) plus: every step composes and lowers
+    to jnp, and the key passes through a raw input column. NOTE the key
+    range and NULL contract apply to the RAW chunks — rows the fused
+    filter would drop still count (the per-verb path filters first; set
+    ``fugue.tpu.stream.key_range`` when that distinction matters)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..column.expressions import _NamedColumnExpr
+    from ..column.jax_eval import evaluate_jnp, evaluate_jnp_3v
+    from ..ops.segment import (
+        _DENSE_MAX_RANGE,
+        _DENSE_SUM_BACKEND,
+        _get_compiled_dense,
+        dense_buckets,
+    )
+    from ..parallel.mesh import ROW_AXIS, num_row_shards, pad_rows
+    from .dataframe import JaxDataFrame
+    from .execution_engine import _plan_device_agg
+
+    if len(keys) != 1 or len(steps) == 0:
+        return None
+    chain = _plan_lowered_chain(Schema(df.schema), steps)
+    if chain is None:
+        return None
+    mesh = engine._mesh
+    empty = pa.Table.from_pylist([], schema=chain["schema"].pa_schema)
+    try:
+        jdf0 = JaxDataFrame(ArrowDataFrame(empty), mesh=mesh)
+    except Exception:
+        return None
+    plan = _plan_device_agg(jdf0, keys, agg_cols)
+    if (
+        plan is None
+        or plan["virtual"]
+        or plan["dict_srcs"]
+        or plan["masked_srcs"]
+        or any(p.get("kind") not in ("pass", "avg") for p in plan["post"])
+    ):
+        return None
+    key = keys[0]
+    key_expr = chain["outs_by_name"].get(key)
+    if (
+        not isinstance(key_expr, _NamedColumnExpr)
+        or key_expr.wildcard
+        or key_expr.as_type is not None
+    ):
+        return None  # the group key must pass through a raw input column
+    raw_key = key_expr.name
+    key_np = np.dtype(jdf0.device_cols[key].dtype)
+    if key_np.kind not in ("i", "u") or chain["in_np"][raw_key].kind not in ("i", "u"):
+        return None
+    srcs = sorted({s for _, _, s in plan["aggs"]})
+    src_np: Dict[str, np.dtype] = {}
+    src_expr: Dict[str, Any] = {}
+    for s in srcs:
+        e = chain["outs_by_name"].get(s)
+        if e is None:
+            return None
+        dt = np.dtype(jdf0.device_cols[s].dtype)
+        if dt.kind not in ("i", "u", "f"):
+            return None
+        src_np[s] = dt
+        src_expr[s] = e
+    del jdf0
+    key_range = _parse_key_range(engine.conf)
+    if key_range is not None and not (
+        0 < key_range[1] - key_range[0] + 1 <= _DENSE_MAX_RANGE
+    ):
+        return None  # declared range too wide for the dense plan
+    cond = chain["pred"]
+    needed: List[str] = chain["need"]
+    in_np: Dict[str, np.dtype] = chain["in_np"]
+    shards = num_row_shards(mesh)
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    capacity = pad_rows(max(chunk_rows, shards), shards)
+    vidx = {s: i for i, s in enumerate(srcs)}
+    # value columns dedupe by source; floats are ALWAYS NaN-aware (a later
+    # chunk may carry NaN where the first did not)
+    agg_sig = tuple(
+        (name, agg, vidx[src], src_np[src].kind == "f")
+        for name, agg, src in plan["aggs"]
+    )
+    label = f"segment:{fingerprint or 'anon'}"
+
+    def run() -> DataFrame:
+        # ---- the stream is consumed from here on; failures RAISE ------
+        frames = _rechunk(_iter_local_frames(df, chunk_rows), capacity)
+        try:
+            first = next(frames)
+        except StopIteration:
+            out0 = pd.DataFrame(
+                {n: pd.Series(dtype=object) for n in plan["schema"].names}
+            )
+            return engine.to_df(PandasDataFrame(out0, plan["schema"]))
+        n0, cols0, nulls0 = _chunk_columns(first, needed)
+        assert_or_throw(
+            nulls0[raw_key] == 0,
+            FugueInvalidOperation(
+                f"lowered segment: NULL in key column {raw_key!r}"
+            ),
+        )
+        probed = key_range is None
+        if probed:
+            kmin, kmax = int(cols0[raw_key].min()), int(cols0[raw_key].max())
+        else:
+            kmin, kmax = key_range
+        rng = kmax - kmin + 1
+        if not (0 < rng <= _DENSE_MAX_RANGE):
+            raise FugueInvalidOperation(
+                f"lowered segment: first-chunk RAW key range [{kmin},{kmax}] "
+                f"exceeds the dense plan bound ({_DENSE_MAX_RANGE}); set "
+                f"{FUGUE_TPU_CONF_STREAM_KEY_RANGE}, pre-bucket the key, or "
+                "disable fugue.tpu.plan.lower_segments"
+            )
+        buckets = dense_buckets(rng)
+        kernel = _get_compiled_dense(mesh, buckets, agg_sig)
+        sharding = NamedSharding(mesh, P(ROW_AXIS))
+        kmin_s = np.int64(kmin)
+        cache = engine._jit_cache
+        # kmin is baked into the traced step as a constant — it MUST key
+        # the cache (see the streaming aggregate's identical note)
+        cache_key = (
+            label, mesh, buckets, agg_sig, capacity, kmin, _DENSE_SUM_BACKEND[0]
+        )
+        if cache_key not in cache:
+
+            def seg_step(acc: Tuple[Any, ...], valid: Any, *arrs: Any):
+                import jax.numpy as jnp
+
+                cols = dict(zip(needed, arrs))
+                v = valid
+                if cond is not None:
+                    pv, nl = evaluate_jnp_3v(cols, {}, {}, cond, frozenset())
+                    v = v & jnp.asarray(pv, dtype=bool) & jnp.logical_not(nl)
+                karr = jnp.asarray(cols[raw_key]).astype(key_np)
+                vals = []
+                for s in srcs:
+                    a = evaluate_jnp(cols, src_expr[s])
+                    if not hasattr(a, "shape") or getattr(a, "ndim", 0) == 0:
+                        a = jnp.full((capacity,), a)
+                    vals.append(jnp.asarray(a).astype(src_np[s]))
+                outs = kernel(karr, kmin_s, *vals, v)
+                return _fold_dense_acc(agg_sig, acc, outs)
+
+            cache[cache_key] = jax.jit(seg_step, donate_argnums=0)
+        step_fn = cache[cache_key]
+        acc: Any = _identity_dense_acc(
+            mesh, buckets, agg_sig, [src_np[s] for s in srcs]
+        )
+        full_valid_dev: List[Any] = []
+
+        def _valid_for(n: int) -> Any:
+            if n == capacity:
+                if not full_valid_dev:
+                    full_valid_dev.append(
+                        jax.device_put(np.ones(capacity, dtype=bool), sharding)
+                    )
+                return full_valid_dev[0]
+            valid = np.zeros(capacity, dtype=bool)
+            valid[:n] = True
+            return valid
+
+        def put_chunk(n: int, cols: Dict[str, np.ndarray], nulls: Dict[str, int]):
+            assert_or_throw(
+                nulls[raw_key] == 0,
+                FugueInvalidOperation(
+                    f"lowered segment: NULL in key column {raw_key!r}"
+                ),
+            )
+            ck = cols[raw_key]
+            lo, hi = int(ck.min()), int(ck.max())
+            if lo < kmin or hi > kmax:
+                hint = (
+                    f"probed from the first RAW chunk as [{kmin},{kmax}]; "
+                    f"set {FUGUE_TPU_CONF_STREAM_KEY_RANGE}='lo,hi' to "
+                    "cover the full stream"
+                    if probed
+                    else f"conf {FUGUE_TPU_CONF_STREAM_KEY_RANGE} was "
+                    f"[{kmin},{kmax}]"
+                )
+                raise FugueInvalidOperation(
+                    f"lowered segment: key {raw_key!r} value outside range "
+                    f"([{lo},{hi}] seen): {hint}"
+                )
+            full = n == capacity
+            bufs = []
+            for name in needed:
+                dt = in_np[name]
+                if dt.kind != "f":
+                    assert_or_throw(
+                        nulls[name] == 0,
+                        FugueInvalidOperation(
+                            f"lowered segment: NULL in non-float column "
+                            f"{name!r} (RAW chunks feed the device program; "
+                            "rows the fused filter would drop still count)"
+                        ),
+                    )
+                if full:
+                    b = np.ascontiguousarray(cols[name].astype(dt, copy=False))
+                else:
+                    b = np.zeros(capacity, dtype=dt)
+                    b[:n] = cols[name].astype(dt, copy=False)
+                bufs.append(b)
+            vd = _valid_for(n)
+            put = jax.device_put([vd] + bufs, sharding)
+            return put[0], tuple(put[1:])
+
+        stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
+
+        def track() -> None:
+            stats["peak_device_bytes"] = max(
+                stats["peak_device_bytes"], _device_peak_bytes()
+            )
+
+        def produce() -> Iterator[Tuple[int, Any]]:
+            nonlocal cols0, nulls0, first
+            yield n0, put_chunk(n0, cols0, nulls0)
+            cols0 = nulls0 = first = None  # release the head chunk
+            for f in frames:
+                n, cols, nulls = _chunk_columns(f, needed)
+                yield n, put_chunk(n, cols, nulls)
+
+        # the ChunkPrefetcher feeds WHOLE segments: the producer thread
+        # decodes + H2Ds raw chunks while the consumer runs the one
+        # compiled program per chunk (ISSUE 7; docs/streaming.md)
+        from .pipeline import engine_prefetcher
+
+        chunks_it = engine_prefetcher(engine, produce(), label)
+        try:
+            for n, (vd, ad) in chunks_it:
+                acc = step_fn(acc, vd, *ad)
+                stats["chunks"] += 1
+                stats["rows"] += n
+                del vd, ad
+                track()
+        finally:
+            chunks_it.close()
+        res = _finish_dense_host(
+            engine, acc, agg_sig, key, key_np, kmin, plan, track=track
+        )
+        global last_run_stats
+        last_run_stats = dict(stats, verb=label)
+        return res
+
+    return run
+
+
+def plan_lowered_steps_stream(
+    engine: Any, df: Any, steps: Any, fingerprint: str
+) -> Optional[Callable[[], DataFrame]]:
+    """Phase-1 eligibility for a lowered chain feeding a host-buffered
+    terminal (take / distinct / broadcast-join probe).
+
+    Returns a factory producing a one-pass stream whose chunks each ran
+    ONE jitted device program (raw columns H2D once; predicate +
+    projections in a single dispatch; survivors compacted on host for
+    the terminal's running buffer), or None. A chunk that violates the
+    streaming NULL contract (NULL in a non-float column) degrades to the
+    per-verb path FOR THAT CHUNK — bit-identical, never an error."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..column.jax_eval import evaluate_jnp, evaluate_jnp_3v
+    from ..parallel.mesh import ROW_AXIS, num_row_shards, pad_rows
+
+    if len(steps) == 0:
+        return None
+    chain = _plan_lowered_chain(Schema(df.schema), steps)
+    if chain is None:
+        return None
+    out_schema: Schema = chain["schema"]
+    if any(_np_dtype_of(f.type) is None for f in out_schema.fields):
+        return None  # outputs must round-trip through numpy numerics
+    mesh = engine._mesh
+    shards = num_row_shards(mesh)
+    chunk_rows = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    capacity = pad_rows(max(chunk_rows, shards), shards)
+    cond = chain["pred"]
+    needed: List[str] = chain["need"]
+    in_np: Dict[str, np.dtype] = chain["in_np"]
+    out_np: Dict[str, np.dtype] = chain["out_np"]
+    outputs = chain["outputs"]
+    label = f"segment:{fingerprint or 'anon'}"
+    sharding = NamedSharding(mesh, P(ROW_AXIS))
+
+    def make_stream() -> DataFrame:
+        cache = engine._jit_cache
+        cache_key = (label, mesh, capacity, "chain")
+        if cache_key not in cache:
+
+            def seg_chunk(valid: Any, *arrs: Any):
+                import jax.numpy as jnp
+
+                cols = dict(zip(needed, arrs))
+                v = valid
+                if cond is not None:
+                    pv, nl = evaluate_jnp_3v(cols, {}, {}, cond, frozenset())
+                    v = v & jnp.asarray(pv, dtype=bool) & jnp.logical_not(nl)
+                outs = []
+                for e in outputs:
+                    a = evaluate_jnp(cols, e)
+                    if not hasattr(a, "shape") or getattr(a, "ndim", 0) == 0:
+                        a = jnp.full((capacity,), a)
+                    outs.append(
+                        jnp.asarray(a).astype(out_np[e.output_name])
+                    )
+                return v, tuple(outs)
+
+            cache[cache_key] = jax.jit(seg_chunk)
+        fn = cache[cache_key]
+
+        def gen() -> Iterator[LocalDataFrame]:
+            for f in _rechunk(_iter_local_frames(df, chunk_rows), capacity):
+                n, cols, nulls = _chunk_columns(f, needed)
+                if any(
+                    nulls[c] > 0 and in_np[c].kind != "f" for c in needed
+                ):
+                    # per-chunk graceful degradation: this chunk runs the
+                    # per-verb path (bit-identical), the stream continues
+                    from ..plan.fused import apply_steps_engine
+
+                    out = apply_steps_engine(engine, f, steps)
+                    if out.count() > 0:
+                        yield out.as_local_bounded()
+                    continue
+                full = n == capacity
+                bufs = []
+                for name in needed:
+                    dt = in_np[name]
+                    if full:
+                        b = np.ascontiguousarray(
+                            cols[name].astype(dt, copy=False)
+                        )
+                    else:
+                        b = np.zeros(capacity, dtype=dt)
+                        b[:n] = cols[name].astype(dt, copy=False)
+                    bufs.append(b)
+                valid = np.zeros(capacity, dtype=bool)
+                valid[:n] = True
+                put = jax.device_put([valid] + bufs, sharding)
+                v, outs = fn(put[0], *put[1:])
+                hv = np.asarray(jax.device_get(v))
+                (idx,) = np.nonzero(hv)
+                if len(idx) == 0:
+                    continue
+                data = {}
+                for fld, arr in zip(out_schema.fields, outs):
+                    data[fld.name] = np.asarray(jax.device_get(arr))[idx]
+                yield PandasDataFrame(pd.DataFrame(data), out_schema)
+
+        return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+
+    return make_stream
 
 
 def streaming_hash_join(

@@ -144,6 +144,7 @@ def to_prometheus_text(
     lines.append(f"fugue_tpu_telemetry_running {1 if meta['running'] else 0}")
     if engine is not None:
         flat: Dict[str, float] = {}
+        jit_labels: Dict[str, float] = {}
         try:
             for group, vals in engine.stats().items():
                 if group in ("latency", "telemetry"):
@@ -151,13 +152,34 @@ def to_prometheus_text(
                     # telemetry: the sampler gauges + meta above are the
                     # single source for those names
                     continue
+                if (
+                    group == "jit_cache"
+                    and isinstance(vals, dict)
+                    and isinstance(vals.get("by_label"), dict)
+                ):
+                    # per-program entry counts go out as ONE labeled gauge
+                    # family — flattening them would mint a new metric NAME
+                    # per compiled program (segment fingerprints are
+                    # content-addressed, so unbounded over a server's life)
+                    jit_labels = {
+                        str(k): float(v)
+                        for k, v in vals["by_label"].items()
+                        if isinstance(v, (int, float))
+                    }
+                    vals = {k: v for k, v in vals.items() if k != "by_label"}
                 _flatten_numeric(vals, str(group), flat)
         except Exception:
             flat = {}
+            jit_labels = {}
         for k in sorted(flat):
             n = _name("fugue_tpu", k)
             lines.append(f"# TYPE {n} gauge")
             lines.append(f"{n} {_num(flat[k])}")
+        if jit_labels:
+            n = "fugue_tpu_jit_cache_entries_by_label"
+            lines.append(f"# TYPE {n} gauge")
+            for k in sorted(jit_labels):
+                lines.append(f"{n}{_labels({'label': k})} {_num(jit_labels[k])}")
     return "\n".join(lines) + "\n"
 
 

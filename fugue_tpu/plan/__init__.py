@@ -7,22 +7,36 @@ Runs at ``workflow.run()`` time over the task graph, before execution:
 - **filter pushdown** — filters hoisted through row-local verbs and
   inner-join sides so invalid rows are masked at the producer;
 - **verb fusion** — adjacent select/filter/assign chains collapsed into
-  one jitted per-chunk step.
+  one jitted per-chunk step;
+- **segment lowering** — a fused chain flowing into a dense aggregate /
+  take / distinct / broadcast-join probe collapsed into ONE
+  ``shard_map``-partitioned SPMD program over the mesh (per-segment
+  fallback to the per-verb path on any refusal).
 
 Disable with ``fugue.tpu.plan.optimize=false`` (or per pass:
-``.prune`` / ``.pushdown`` / ``.fuse``). Every rewrite is
-result-identical to the unoptimized path.
+``.prune`` / ``.pushdown`` / ``.fuse`` / ``.lower_segments``). Every
+rewrite is result-identical to the unoptimized path.
 """
 
 from .fused import FusedVerbs, apply_steps_engine, compose_steps
+from .lowering import (
+    LoweredSegment,
+    apply_terminal_engine,
+    lower_segments,
+    segment_fingerprint,
+)
 from .optimizer import PlanReport, PlanStats, explain_tasks, optimize_tasks
 
 __all__ = [
     "FusedVerbs",
+    "LoweredSegment",
     "PlanReport",
     "PlanStats",
     "apply_steps_engine",
+    "apply_terminal_engine",
     "compose_steps",
     "explain_tasks",
+    "lower_segments",
     "optimize_tasks",
+    "segment_fingerprint",
 ]

@@ -59,9 +59,14 @@ K_TRANSFORM = "transform"  # UDF transformer: column usage unknowable
 K_OUTPUT = "output"  # sink
 K_OPAQUE = "opaque"  # anything else: zip, SQL, save_and_use, ...
 K_FUSED = "fused"  # synthesized by the fusion pass
+K_SEGMENT = "segment"  # synthesized by the segment-lowering pass
 
 # kinds whose row-local semantics allow fusion into one per-chunk step
 FUSABLE_KINDS = {K_PROJECT, K_DROP, K_RENAME, K_FILTER, K_SELECT, K_ASSIGN}
+
+# kinds a device-resident segment may terminate in (lowering.py): the verb
+# that consumes the fused row-local chain inside ONE compiled program
+SEGMENT_TERMINAL_KINDS = {K_AGGREGATE, K_TAKE, K_DISTINCT, K_JOIN}
 
 
 class LNode:
@@ -78,6 +83,7 @@ class LNode:
         "param_override",
         "extension_override",
         "steps",
+        "terminal",
         "tail_origin",
         "result_of",
         "annotations",
@@ -91,8 +97,9 @@ class LNode:
         self.pinned = False if task is None else task_pinned(task)
         self.param_override: Optional[dict] = None
         self.extension_override: Any = None
-        self.steps: Optional[List[Tuple]] = None  # K_FUSED only
-        self.tail_origin: Optional[FugueTask] = None  # K_FUSED only
+        self.steps: Optional[List[Tuple]] = None  # K_FUSED / K_SEGMENT
+        self.terminal: Optional[Tuple] = None  # K_SEGMENT only
+        self.tail_origin: Optional[FugueTask] = None  # K_FUSED / K_SEGMENT
         # the ORIGINAL tasks whose result this node's output is provably
         # identical to. Rewrites that reposition a node (filter pushdown)
         # or collapse a chain (fusion) transfer this set to the node that
@@ -431,8 +438,8 @@ def _node_schema(
         return list(s1) + [c for c in s2 if c not in s1]
     if n.kind == K_SETOP:
         return first
-    if n.kind == K_FUSED:
-        return None  # no pass runs after fusion
+    if n.kind in (K_FUSED, K_SEGMENT):
+        return None  # no pass runs after fusion/lowering
     return None  # transform / opaque / output
 
 
@@ -579,7 +586,7 @@ def input_requirements(
         if n.info["distinct"]:
             return [ALL for _ in n.inputs]
         return [d for _ in n.inputs]
-    if n.kind == K_FUSED:
+    if n.kind in (K_FUSED, K_SEGMENT):
         return [ALL for _ in n.inputs]
     # transform (UDF column usage unknowable), output sinks, opaque
     return [ALL for _ in n.inputs]

@@ -31,6 +31,7 @@ from .ir import (
     K_RENAME,
     K_SELECT,
     K_FUSED,
+    K_SEGMENT,
     LNode,
     compute_demand,
     consumers_map,
@@ -472,6 +473,32 @@ def emit(nodes: List[LNode]) -> Tuple[List[FugueTask], Dict[int, FugueTask]]:
 
 
 def _emit_node(n: LNode, in_tasks: List[FugueTask]) -> FugueTask:
+    if n.kind == K_SEGMENT:
+        from .lowering import LoweredSegment, segment_fingerprint
+
+        steps = list(n.steps or [])
+        terminal = tuple(n.terminal or ())
+        t = ProcessTask(
+            LoweredSegment(),
+            in_tasks,
+            params=dict(
+                steps=steps,
+                terminal=terminal,
+                fingerprint=segment_fingerprint(steps, terminal),
+            ),
+            partition_spec=(
+                None if n.tail_origin is None else n.tail_origin.partition_spec
+            ),
+        )
+        if n.tail_origin is not None:
+            t.name = n.tail_origin.name
+            t.broadcast_flag = n.tail_origin.broadcast_flag
+            if n.tail_origin.yield_dataframe_handler is not None:
+                t.set_yield_dataframe_handler(
+                    n.tail_origin.yield_dataframe_handler
+                )
+            t.defined_at = n.tail_origin.defined_at
+        return t
     if n.kind == K_FUSED:
         t = ProcessTask(
             FusedVerbs(),

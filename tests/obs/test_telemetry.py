@@ -519,6 +519,10 @@ def test_prometheus_exposition_valid_and_coherent(tracer, sampler):
     assert "fugue_tpu_span_latency_seconds_bucket" in summary["names"]
     assert "fugue_tpu_resource_host_rss_bytes" in summary["names"]
     assert "fugue_tpu_jit_cache_entries" in summary["names"]  # engine counters
+    # per-program jit entries are ONE labeled gauge family, never a new
+    # metric NAME per label (segment fingerprints would be unbounded)
+    assert "fugue_tpu_jit_cache_entries_by_label" in summary["names"]
+    assert not any("by_label_" in n for n in summary["names"]), summary["names"]
     # label values escape correctly and carry the span name
     assert 'span="engine.aggregate"' in text
     # histogram count line equals the recorded observations

@@ -1,0 +1,443 @@
+"""Segment lowering (``fugue_tpu/plan/lowering.py``, docs/plan.md) — ISSUE 7.
+
+The satellite checklist:
+
+- segment-boundary parity: bit-identical results for lowered vs
+  ``fugue.tpu.plan.lower_segments=false`` across filter/transform chains,
+  aggregates (bounded AND streaming), take, distinct, broadcast-join
+  probes, SQL workflows and the native engine;
+- refusal fallback: a UDF transformer breaks the chain (no segment
+  forms), a host-only chain / unlowerable predicate forms a segment that
+  falls back per-verb at execution — results AND engine-verb spans
+  identical to today, no ``plan.segment`` span;
+- span shape: a lowered segment runs under ONE ``plan.segment`` span
+  (replacing ``engine.fused``/``engine.aggregate``), ``stream.chunk``
+  spans nest under it, and the engine jit cache holds exactly ONE entry
+  labeled ``segment:<fingerprint>`` for the pipeline segment;
+- stats: ``engine.stats()["plan"]`` carries ``segments_lowered`` /
+  ``verbs_absorbed`` / ``segments_executed`` / ``segments_fallback``;
+  ``engine.stats()["jit_cache"]["by_label"]`` attributes entries by
+  segment fingerprint (not first-verb name);
+- conf gate + ``workflow.explain()`` rendering.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS,
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.obs import get_tracer
+
+CHUNK = 2048
+
+
+def _frame(n=20_000, groups=32, seed=0, strings=False) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    d = {
+        "k": rng.integers(0, groups, n),
+        "v": rng.random(n),
+        "w": rng.random(n),
+    }
+    if strings:
+        d["s"] = rng.choice(["a", "b", "c", None], n)
+    return pd.DataFrame(d)
+
+
+def _stream(pdf: pd.DataFrame, step: int = CHUNK):
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+def _run_pair(build, engine_conf=None, sort=None):
+    """Run the same workflow with segment lowering ON and OFF (optimizer
+    fully on both ways); assert bit-identical results; return the ON
+    engine and frame."""
+    outs = []
+    for lower in (True, False):
+        conf = dict(engine_conf or {})
+        conf[FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS] = lower
+        conf.setdefault(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, CHUNK)
+        eng = JaxExecutionEngine(conf)
+        dag = FugueWorkflow()
+        build(dag)
+        dag.run(eng)
+        res = dag.yields["r"].result.as_pandas()
+        if sort:
+            res = res.sort_values(sort).reset_index(drop=True)
+        outs.append((eng, res))
+    pd.testing.assert_frame_equal(outs[0][1], outs[1][1])
+    return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# parity: lowered vs lower_segments=false, gate toggled both ways
+# ---------------------------------------------------------------------------
+
+
+def test_parity_streaming_fused_aggregate():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .filter(col("v") > 0.25)
+            .select(col("k"), (col("v") * col("w")).alias("z"))
+            .partition_by("k")
+            .aggregate(
+                ff.sum(col("z")).alias("s"),
+                ff.count(col("z")).alias("n"),
+                ff.avg(col("z")).alias("m"),
+                ff.min(col("z")).alias("lo"),
+                ff.max(col("z")).alias("hi"),
+            )
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, res = _run_pair(build, sort=["k"])
+    assert len(res) == 32
+    st = eng.stats()["plan"]
+    assert st["segments_lowered"] == 1
+    assert st["segments_executed"] == 1 and st["segments_fallback"] == 0
+
+
+def test_parity_bounded_fused_aggregate():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.25)
+            .select(col("k"), (col("v") + col("w")).alias("z"))
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"), ff.count(col("z")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, res = _run_pair(build, sort=["k"])
+    assert len(res) == 32
+    assert eng.stats()["plan"]["segments_executed"] == 1
+
+
+def test_parity_streaming_take():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .filter(col("v") > 0.5)
+            .select(col("k"), col("v"))
+            .take(5, presort="v desc")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, res = _run_pair(build, sort=["v"])
+    assert len(res) == 5
+    assert eng.stats()["plan"]["segments_executed"] == 1
+
+
+def test_parity_streaming_distinct():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .select(col("k"), (col("v") > 0.5).alias("hi"))
+            .distinct()
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, res = _run_pair(build, sort=["k", "hi"])
+    assert len(res) == 64
+    assert eng.stats()["plan"]["segments_executed"] == 1
+
+
+def test_parity_broadcast_join_probe():
+    pdf = _frame()
+    dim = pd.DataFrame({"k": np.arange(32), "label_v": np.arange(32) * 1.5})
+
+    def build(dag):
+        d = dag.df(dim)
+        (
+            dag.df(_stream(pdf))
+            .filter(col("v") > 0.25)
+            .select(col("k"), col("v"))
+            .join(d, how="inner", on=["k"])
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, res = _run_pair(build, sort=["k", "v"])
+    assert set(res.columns) == {"k", "v", "label_v"}
+    assert eng.stats()["plan"]["segments_executed"] == 1
+
+
+def test_parity_sql_workflow():
+    pdf = _frame()
+
+    def build(dag):
+        a = dag.df(pdf)
+        dag.select(
+            "SELECT k, SUM(v) AS sv FROM ", a, " WHERE v > 0.2 GROUP BY k"
+        ).yield_dataframe_as("r", as_local=True)
+
+    _run_pair(build, sort=["k"])
+
+
+def test_parity_native_engine():
+    """LoweredSegment on a non-jax engine runs the base per-verb
+    interpretation — bit-identical to the unlowered pair."""
+    pdf = _frame()
+    outs = []
+    for lower in (True, False):
+        eng = NativeExecutionEngine({FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS: lower})
+        dag = FugueWorkflow()
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.25)
+            .select(col("k"), (col("v") * 2).alias("v2"))
+            .partition_by("k")
+            .aggregate(ff.sum(col("v2")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run(eng)
+        outs.append(
+            dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# refusal fallback
+# ---------------------------------------------------------------------------
+
+
+def test_refusal_udf_transformer_breaks_chain():
+    """A UDF transformer between the chain and the aggregate is not
+    row-local-composable: no segment forms, results identical."""
+    pdf = _frame()
+
+    def bump(df: pd.DataFrame) -> pd.DataFrame:
+        df = df.copy()
+        df["v"] = df["v"] + 1.0
+        return df
+
+    def build(dag):
+        (
+            dag.df(pdf)
+            .filter(col("v") > 0.25)
+            .transform(bump, schema="*")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, _ = _run_pair(build, sort=["k"])
+    assert eng.stats()["plan"]["segments_lowered"] == 0
+
+
+def test_refusal_host_only_chain_falls_back_with_identical_spans():
+    """A streaming chain carrying a string column can't lower to jnp: the
+    segment forms but execution falls back per-verb — results AND the
+    engine-verb span multiset match today's path, and no ``plan.segment``
+    span is emitted."""
+    pdf = _frame(strings=True)
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .filter(col("s").is_null() | (col("v") > 0.1))
+            .select(col("k"), col("s"), col("v"))
+            .partition_by("k")
+            .aggregate(ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    tr = get_tracer()
+    span_sets = {}
+    for lower in (True, False):
+        tr.clear()
+        tr.enable()
+        try:
+            eng = JaxExecutionEngine(
+                {
+                    FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS: lower,
+                    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: CHUNK,
+                }
+            )
+            dag = FugueWorkflow()
+            build(dag)
+            dag.run(eng)
+            res = dag.yields["r"].result.as_pandas().sort_values("k")
+            names = [r["name"] for r in tr.records()]
+        finally:
+            tr.disable()
+            tr.clear()
+        engine_spans = sorted(n for n in names if n.startswith("engine."))
+        span_sets[lower] = (res.reset_index(drop=True), engine_spans, names)
+        if lower:
+            assert eng.stats()["plan"]["segments_lowered"] == 1
+            assert eng.stats()["plan"]["segments_fallback"] == 1
+            assert eng.stats()["plan"]["segments_executed"] == 0
+    pd.testing.assert_frame_equal(span_sets[True][0], span_sets[False][0])
+    # the per-verb fallback produces the same engine-verb spans as today
+    assert "engine.fused" in span_sets[True][1]
+    assert "engine.aggregate" in span_sets[True][1]
+    assert span_sets[True][1] == span_sets[False][1]
+    assert "plan.segment" not in span_sets[True][2]
+
+
+def test_refusal_unlowerable_predicate_falls_back():
+    """LIKE has no jnp lowering on raw stream columns — per-verb fallback,
+    identical results."""
+    from fugue_tpu.column.expressions import _LikeExpr
+
+    pdf = _frame(strings=True)
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .filter(_LikeExpr(col("s"), "a%") | (col("v") > 0.9))
+            .select(col("k"), col("v"))
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    eng, _ = _run_pair(build, sort=["k"])
+    assert eng.stats()["plan"]["segments_fallback"] == 1
+
+
+# ---------------------------------------------------------------------------
+# span shape + single jit entry + chunk nesting
+# ---------------------------------------------------------------------------
+
+
+def test_span_shape_single_entry_and_chunk_nesting():
+    pdf = _frame()
+    tr = get_tracer()
+    tr.clear()
+    tr.enable()
+    try:
+        eng = JaxExecutionEngine({FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: CHUNK})
+        dag = FugueWorkflow()
+        (
+            dag.df(_stream(pdf))
+            .filter(col("v") > 0.25)
+            .select(col("k"), (col("v") * col("w")).alias("z"))
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"), ff.count(col("z")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        dag.run(eng)
+        records = tr.records()
+    finally:
+        tr.disable()
+        tr.clear()
+    names = [r["name"] for r in records]
+    # ONE plan.segment span replaces the per-verb engine spans
+    assert names.count("plan.segment") == 1
+    assert "engine.fused" not in names
+    assert "engine.aggregate" not in names
+    assert "engine.filter" not in names and "engine.select" not in names
+    # stream.chunk spans nest under plan.segment
+    by_id = {r["id"]: r for r in records}
+    seg_id = next(r["id"] for r in records if r["name"] == "plan.segment")
+    chunks = [r for r in records if r["name"] == "stream.chunk"]
+    assert len(chunks) > 1
+    for c in chunks:
+        anc = c.get("parent")
+        seen = set()
+        while anc is not None and anc in by_id and anc not in seen:
+            seen.add(anc)
+            if anc == seg_id:
+                break
+            anc = by_id[anc].get("parent")
+        assert anc == seg_id, f"stream.chunk not nested under plan.segment: {c}"
+    # single jit-cache entry for the whole pipeline segment, labeled by
+    # segment fingerprint — checkable from stats alone
+    jstats = eng.stats()["jit_cache"]
+    seg_labels = {
+        lab: n for lab, n in jstats["by_label"].items() if lab.startswith("segment:")
+    }
+    assert len(seg_labels) == 1 and set(seg_labels.values()) == {1}, jstats
+    assert eng._jit_cache.segment_entries() != {}
+    # and nothing else compiled for this workflow's hot path
+    assert jstats["entries"] == 1, jstats
+
+
+# ---------------------------------------------------------------------------
+# conf gate / explain / stats
+# ---------------------------------------------------------------------------
+
+
+def test_conf_gate_off_keeps_per_verb_plan():
+    pdf = _frame()
+    eng = JaxExecutionEngine({FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS: False})
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .filter(col("v") > 0.25)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    report = dag.last_plan_report
+    assert report.segments_lowered == 0
+    assert eng.stats()["plan"]["segments_lowered"] == 0
+    assert eng.stats()["plan"]["segments_executed"] == 0
+
+
+def test_explain_renders_segment():
+    pdf = _frame()
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .filter(col("v") > 0.5)
+        .select(col("k"), col("v"))
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("sv"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    text = dag.explain()
+    assert "lowered segment" in text
+    assert "segments_lowered=1" in text
+    off = dag.explain(conf={FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS: False})
+    assert "lowered segment" not in off
+
+
+def test_plan_stats_reset_contract():
+    pdf = _frame(n=2000)
+    eng = JaxExecutionEngine()
+    dag = FugueWorkflow()
+    (
+        dag.df(pdf)
+        .filter(col("v") > 0.5)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    st = eng.stats()["plan"]
+    assert st["segments_lowered"] == 1 and st["verbs_absorbed"] >= 2
+    assert st["segments_executed"] + st["segments_fallback"] == 1
+    eng.reset_stats()
+    st = eng.stats()["plan"]
+    assert st["segments_lowered"] == 0 and st["segments_executed"] == 0
+    # jit-cache entries survive the reset (keep-entries contract), labels
+    # included
+    assert eng.stats()["jit_cache"]["entries"] >= 1
