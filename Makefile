@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-shuffle cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -56,6 +56,13 @@ test-plan:
 # per segment, conf gate, explain rendering
 test-lowering:
 	JAX_PLATFORMS=cpu python -m pytest tests/plan/test_lowering.py -q -m "not slow"
+
+# out-of-core shuffle suite (docs/shuffle.md): in-device exchange tests
+# plus the spill path — spill-vs-legacy join parity (dup/NULL keys, all
+# hash-partitionable types), bounded peak_device_bytes at 10x the budget,
+# hash-repartition round trip, torn-spill recovery, conf gates
+test-shuffle:
+	JAX_PLATFORMS=cpu python -m pytest tests/jax_engine/test_shuffle.py -q -m "not slow"
 
 # result-cache suite (docs/cache.md): cached-hit parity, invalidation
 # (mutated files / edited UDFs / partition specs), poisoned-subtree

@@ -1228,6 +1228,88 @@ def _bench_segment_lowering(
     }
 
 
+def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> dict:
+    """Out-of-core spill-shuffle join case (ISSUE 8): BOTH sides >=10x the
+    device byte budget, joined bucket-at-a-time through the on-disk hash
+    partitioner (``fugue_tpu/shuffle/``). The gate: completes with the
+    measured ``peak_device_bytes`` UNDER the budget, output bit-identical
+    to the host oracle, and exactly ZERO broadcast-strategy joins in the
+    ``engine.join`` span attrs (the whole point is that nothing was ever
+    resident at once)."""
+    import numpy as _np
+    import pandas as _pd
+
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.obs import get_tracer
+
+    rng = _np.random.default_rng(8)
+    kmax = rows * 3  # mostly 1:1 matches with some dups — realistic equi-join
+    left = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "a": rng.normal(size=rows)}
+    )
+    right = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "b": rng.normal(size=rows)}
+    )
+    side_bytes = int(left.memory_usage(index=False).sum())
+    eng = JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget_bytes,
+            FUGUE_TPU_CONF_CACHE_ENABLED: False,
+        }
+    )
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    mark = tracer.mark()
+    tracer.enable()
+    try:
+        t0 = time.perf_counter()
+        res = eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"])
+        got = res.as_arrow().replace_schema_metadata(None).to_pandas()
+        wall = time.perf_counter() - t0
+        join_strategies = [
+            r["args"].get("strategy")
+            for r in tracer.take_since(mark)
+            if r["name"] == "engine.join"
+        ]
+    finally:
+        if not was_enabled:
+            tracer.disable()
+    st = eng.stats()["shuffle"]
+    cols = list(got.columns)
+    got = got.sort_values(cols).reset_index(drop=True)
+    oracle = left.merge(right, on="k")[cols].sort_values(cols).reset_index(drop=True)
+    parity = bool(got.equals(oracle.astype(got.dtypes.to_dict())))
+    broadcast_joins = sum(1 for s in join_strategies if s == "broadcast")
+    peak = int(st["peak_device_bytes"])
+    return {
+        "rows_per_side": rows,
+        "side_bytes": side_bytes,
+        "device_budget_bytes": budget_bytes,
+        "side_over_budget": round(side_bytes / budget_bytes, 2),
+        "rows_out": int(len(got)),
+        "wall_s": round(wall, 2),
+        "rows_per_sec": round(2 * rows / max(wall, 1e-9), 1),
+        "peak_device_bytes": peak,
+        "peak_over_budget": round(peak / budget_bytes, 3),
+        "bytes_spilled": int(st["bytes_spilled"]),
+        "buckets": int(st["buckets"]),
+        "join_strategies": join_strategies,
+        "broadcast_joins": broadcast_joins,
+        "parity": parity,
+        "correct": bool(
+            side_bytes >= 10 * budget_bytes
+            and 0 < peak < budget_bytes
+            and parity
+            and broadcast_joins == 0
+            and st["joins_spill"] >= 1
+        ),
+    }
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -1309,6 +1391,10 @@ def _smoke() -> None:
     # lowered (one SPMD program per chunk) vs lower_segments=off; must
     # show >=1.3x with ONE segment jit-cache entry for the pipeline
     segment_case = _bench_segment_lowering(rows=200_000)
+    # out-of-core spill shuffle (ISSUE 8): both join sides >=10x a 1MiB
+    # device budget; must finish under budget, bit-identical to the host
+    # oracle, with zero broadcast-strategy joins
+    shuffle_case = _bench_shuffle_join(budget_bytes=1 << 20, rows=700_000)
     result = {
         "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
         "value": round(r["rps"], 1),
@@ -1324,6 +1410,7 @@ def _smoke() -> None:
         "plan_pruning": plan_case,
         "result_cache": cache_case,
         "segment_lowering": segment_case,
+        "shuffle_join": shuffle_case,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     try:  # drop the result where --compare picks it up (best effort)
@@ -1340,6 +1427,8 @@ def _smoke() -> None:
         raise SystemExit(7)
     if not segment_case["correct"]:
         raise SystemExit(9)
+    if not shuffle_case["correct"]:
+        raise SystemExit(10)
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -1915,6 +2004,10 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # dense aggregate as ONE SPMD program per chunk,
                     # lowered vs fugue.tpu.plan.lower_segments=false
                     "segment_lowering": _bench_segment_lowering(),
+                    # out-of-core spill shuffle (ISSUE 8): both join sides
+                    # >=10x an 8MiB device budget, joined bucket-at-a-time
+                    # from on-disk hash buckets under the budget
+                    "shuffle_join": _bench_shuffle_join(),
                     # most recent `bench.py --north-star` run (the literal
                     # 1B-row groupby-apply), if one has been captured
                     "north_star_1b": _load_north_star(),

@@ -170,6 +170,30 @@ FUGUE_TPU_CONF_CACHE_FINGERPRINT_MAX_BYTES = "fugue.tpu.cache.fingerprint_max_by
 # all entries without deleting files
 FUGUE_TPU_CONF_CACHE_SALT = "fugue.tpu.cache.salt"
 
+# out-of-core hash shuffle (fugue_tpu/shuffle, docs/shuffle.md): spill
+# key-hash buckets to disk, then join/repartition bucket-at-a-time so
+# inputs FAR past device memory complete under a bounded device working
+# set. Master switch (default ON; =false restores the pre-shuffle ladder:
+# broadcast / in-device copartition / host fallback).
+FUGUE_TPU_CONF_SHUFFLE_ENABLED = "fugue.tpu.shuffle.enabled"
+# spill-file directory; unset = <tempdir>/fugue_tpu_shuffle. Each shuffle
+# creates a unique subdirectory, removed on success AND on failure.
+FUGUE_TPU_CONF_SHUFFLE_DIR = "fugue.tpu.shuffle.dir"
+# explicit bucket count P (0 = auto from size estimate / bucket_bytes)
+FUGUE_TPU_CONF_SHUFFLE_BUCKETS = "fugue.tpu.shuffle.buckets"
+# target on-disk bytes per bucket when auto-sizing P; each bucket pair
+# must fit the device budget TOGETHER with the join's intermediates, so
+# keep this a small fraction (default 1/32) of device_budget_bytes
+FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES = "fugue.tpu.shuffle.bucket_bytes"
+# the device byte budget joins must stay under: size estimates past it
+# pick the spill-shuffle strategy. 0/unset = auto (device memory stats
+# when the backend reports them, else half of host MemTotal).
+FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET = "fugue.tpu.shuffle.device_budget_bytes"
+# right sides at or under this row count use the broadcast join strategy
+# (default: ops/join.py MAX_BROADCAST_ROWS). Conf-driven so deployments
+# can trade replication memory against exchange latency per mesh.
+FUGUE_TPU_CONF_JOIN_BROADCAST_MAX_ROWS = "fugue.tpu.join.broadcast_max_rows"
+
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,

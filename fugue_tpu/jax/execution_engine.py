@@ -641,11 +641,19 @@ class JaxExecutionEngine(ExecutionEngine):
 
         self._jit_cache: JitCache = JitCache()
         self._pipeline_stats = PipelineStats()
+        from ..shuffle.stats import ShuffleStats
+
+        # out-of-core hash shuffle (ISSUE 8): spill counters + the live
+        # spill-dir set the resource sampler probes
+        self._shuffle_stats = ShuffleStats()
+        self._active_spill_dirs: set = set()
+        self._last_join_strategy: Optional[str] = None
         # unified observability surface (ISSUE 3): every stats object this
         # engine owns lives in ONE registry behind engine.stats() /
         # engine.reset_stats(); the legacy attributes below stay as shims
         self.metrics.register("pipeline", lambda: self._pipeline_stats)
         self.metrics.register("jit_cache", lambda: self._jit_cache)
+        self.metrics.register("shuffle", lambda: self._shuffle_stats)
 
     def _resource_probe_fns(self) -> Dict[str, Any]:
         # jax-engine occupancy for the continuous resource sampler
@@ -662,8 +670,17 @@ class JaxExecutionEngine(ExecutionEngine):
             ps = getattr(e, "_pipeline_stats", None)
             return float(ps.as_dict()["overlap_fraction"]) if ps is not None else 0.0
 
+        def _spill_bytes(e: Any) -> float:
+            dirs = getattr(e, "_active_spill_dirs", None)
+            if not dirs:
+                return 0.0
+            from ..shuffle.partitioner import spill_dir_bytes
+
+            return float(spill_dir_bytes(dirs))
+
         probes["jit_cache_entries"] = _jit_entries
         probes["overlap_fraction"] = _overlap
+        probes["shuffle_spill_bytes"] = _spill_bytes
         return probes
 
     @property
@@ -753,15 +770,44 @@ class JaxExecutionEngine(ExecutionEngine):
 
         if partition_spec is None or partition_spec.empty:
             return df
-        jdf = self.to_df(df)
         algo = partition_spec.algo
         by = list(partition_spec.partition_by)
-        if algo == "coarse":
-            return jdf
         if algo == "":
             algo = "hash" if len(by) > 0 else "even"
         if algo == "hash" and len(by) == 0:
             algo = "even"
+        if algo == "hash":
+            # out-of-core layout (ISSUE 8): a one-pass stream, or a
+            # bounded frame whose estimate exceeds the device budget,
+            # hash-partitions through the on-disk spill partitioner —
+            # every key ends up in exactly ONE chunk of the result
+            # stream, so arbitrarily large PartitionSpec maps stay
+            # key-complete without ever being device-resident at once
+            from ..shuffle.strategy import (
+                device_budget_bytes,
+                estimate_frame_bytes,
+                shuffle_enabled,
+            )
+            from .streaming import is_stream_frame
+
+            if shuffle_enabled(self.conf):
+                streaming = is_stream_frame(df)
+                est = None if streaming else estimate_frame_bytes(df)
+                if streaming or (
+                    est is not None and est > device_budget_bytes(self.conf)
+                ):
+                    from ..shuffle.join import spill_repartition
+
+                    try:
+                        num = int(partition_spec.num_partitions or "0")
+                    except ValueError:
+                        num = 0
+                    res = spill_repartition(self, df, by, num=num)
+                    if res is not None:
+                        return res
+        jdf = self.to_df(df)
+        if algo == "coarse":
+            return jdf
         device_ok = (
             isinstance(jdf, JaxDataFrame)
             and len(jdf.device_cols) > 0
@@ -1493,30 +1539,73 @@ class JaxExecutionEngine(ExecutionEngine):
     def _back(self, df: DataFrame) -> DataFrame:
         return self.to_df(df)
 
-    @traced_verb("engine.join")
     def join(self, df1, df2, how: str, on=None) -> DataFrame:
         """Hash joins run on device (``ops/join.py``): inner / left_outer /
         left_semi / left_anti, multi-key, unique OR duplicate right keys
-        (the 1:N/N:M expansion kernel), with a broadcast strategy for small
-        right sides and a shuffle (co-partition + shard-local probe)
-        strategy for large×large. right_outer mirrors left_outer;
-        full_outer composes left_outer ∪ NULL-extended anti; cross runs
-        through the expansion kernel on a constant key. Host fallback:
-        host-resident frames, keys the preparers can't align, and
-        expansions past the per-shard slot budget."""
+        (the 1:N/N:M expansion kernel). Strategy ladder (docs/shuffle.md),
+        decided from size estimates + conf by ``shuffle.strategy``:
+        **broadcast** for small right sides, **copartition** (in-device
+        all-to-all + shard-local probe) when both sides fit the device
+        budget at once, **shuffle_spill** (on-disk hash buckets joined
+        one pair at a time, ``fugue_tpu/shuffle/``) past it — the chosen
+        strategy is an attr on the ``engine.join`` span. right_outer
+        mirrors left_outer; full_outer composes left_outer ∪ NULL-extended
+        anti; cross runs through the expansion kernel on a constant key.
+        Host fallback: host-resident frames, keys the preparers can't
+        align, and expansions past the per-shard slot budget."""
+        from ..obs import get_tracer
+
+        with get_tracer().span("engine.join", cat="engine", annotate=True) as sp:
+            return self._join_impl(df1, df2, how, on, sp)
+
+    def _join_impl(self, df1, df2, how: str, on, sp) -> DataFrame:
         from ..dataframe.utils import parse_join_type
+        from ..shuffle.strategy import (
+            choose_join_strategy,
+            estimate_frame_bytes,
+            estimate_frame_rows,
+            shuffle_enabled,
+        )
         from .streaming import is_stream_frame, streaming_hash_join
 
+        self._last_join_strategy = None
         if is_stream_frame(df1) or is_stream_frame(df2):
-            # one-pass input: bounded-memory broadcast-hash join; ineligible
-            # plans materialize the stream below (the only remaining option)
+            # one-pass input: bounded-memory broadcast-hash join first
             res = streaming_hash_join(self, df1, df2, how, on)
             if res is not None:
+                sp.set(strategy="stream")
                 return res
+            if shuffle_enabled(self.conf):
+                # the spill shuffle consumes the stream chunk-by-chunk
+                # too — materializing (the unbounded-memory hazard) is
+                # now the LAST resort, not the only remaining option
+                from ..shuffle.join import shuffle_spill_join
+
+                res = shuffle_spill_join(self, df1, df2, how, on)
+                if res is not None:
+                    sp.set(
+                        strategy="shuffle_spill",
+                        reason="stream ineligible for the streaming join plan",
+                    )
+                    return res
             self.log.warning(
                 "streaming join ineligible for this plan; materializing "
                 "the stream"
             )
+        else:
+            dec = choose_join_strategy(
+                self.conf,
+                estimate_frame_bytes(df1),
+                estimate_frame_bytes(df2),
+                estimate_frame_rows(df2),
+            )
+            if dec.strategy == "shuffle_spill" and shuffle_enabled(self.conf):
+                from ..shuffle.join import shuffle_spill_join
+
+                res = shuffle_spill_join(self, df1, df2, how, on)
+                if res is not None:
+                    sp.set(strategy="shuffle_spill", reason=dec.reason)
+                    return res
         jt = parse_join_type(how)
         if jt in ("inner", "left_outer", "left_semi", "left_anti"):
             kernel_how = {
@@ -1527,6 +1616,7 @@ class JaxExecutionEngine(ExecutionEngine):
             }[jt]
             res = self._join_device(df1, df2, kernel_how, on)
             if res is not None:
+                sp.set(strategy=self._last_join_strategy or "device")
                 return res
         elif jt == "right_outer":
             # mirrored left_outer, columns re-ordered to the contract schema
@@ -1539,15 +1629,19 @@ class JaxExecutionEngine(ExecutionEngine):
                 )
                 if list(res.schema.names) != out_schema.names:
                     res = res[out_schema.names]  # type: ignore[index]
+                sp.set(strategy=self._last_join_strategy or "device")
                 return res
         elif jt == "full_outer":
             res = self._full_outer_device(df1, df2, on)
             if res is not None:
+                sp.set(strategy=self._last_join_strategy or "device")
                 return res
         elif jt == "cross":
             res = self._cross_device(df1, df2)
             if res is not None:
+                sp.set(strategy="broadcast")
                 return res
+        sp.set(strategy="host")
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
 
     def _full_outer_device(self, df1, df2, on) -> Optional[DataFrame]:
@@ -1647,7 +1741,8 @@ class JaxExecutionEngine(ExecutionEngine):
         key (every left row matches every right row)."""
         import jax
 
-        from ..ops.join import MAX_BROADCAST_ROWS, device_expand_join
+        from ..ops.join import device_expand_join
+        from ..shuffle.strategy import broadcast_max_rows
 
         j1, j2 = self.to_df(df1), self.to_df(df2)
         if not (
@@ -1660,7 +1755,7 @@ class JaxExecutionEngine(ExecutionEngine):
         ):
             return None
         n_right = next(iter(j2.device_cols.values())).shape[0]
-        if n_right > MAX_BROADCAST_ROWS:
+        if n_right > broadcast_max_rows(self.conf):
             return None
         if any(c in j1.schema for c in j2.schema.names):
             return None  # overlapping names — host handles the error
@@ -1864,7 +1959,7 @@ class JaxExecutionEngine(ExecutionEngine):
     def _join_device(self, df1, df2, kernel_how: str, on) -> Optional[DataFrame]:
         """Try the device hash join; None → host fallback."""
         from ..dataframe.utils import get_join_schemas
-        from ..ops.join import MAX_BROADCAST_ROWS, device_hash_join
+        from ..ops.join import device_hash_join
 
         if not (isinstance(df1, DataFrame) and isinstance(df2, DataFrame)):
             return None
@@ -1939,11 +2034,14 @@ class JaxExecutionEngine(ExecutionEngine):
                 right_entries.append(
                     (f"{mp}{v}", j2.null_masks[v], True)
                 )
+        from ..shuffle.strategy import broadcast_max_rows
+
         n_right = next(iter(j2.device_cols.values())).shape[0]
         encodings: Dict[str, Any] = {}
         null_masks: Dict[str, Any] = {}
-        if n_right <= MAX_BROADCAST_ROWS:
+        if n_right <= broadcast_max_rows(self.conf):
             strategy = "broadcast"
+            self._last_join_strategy = "broadcast"
             rep = replicated_sharding(self._mesh)
             right_entries = [
                 (n, jax.device_put(a, rep), f) for n, a, f in right_entries
@@ -1961,6 +2059,7 @@ class JaxExecutionEngine(ExecutionEngine):
             null_masks = dict(j1.null_masks)
         else:
             strategy = "shuffle"
+            self._last_join_strategy = "copartition"
             if j1.host_table is not None:
                 return None  # rows move; host columns can't follow
             left_cols = dict(j1.device_cols)

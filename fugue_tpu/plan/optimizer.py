@@ -17,7 +17,23 @@ from ..constants import (
     FUGUE_TPU_CONF_PLAN_PUSHDOWN,
 )
 from ..workflow._tasks import FugueTask
-from .ir import LNode, build_graph
+from .ir import (
+    K_CREATE,
+    K_DISTINCT,
+    K_DROP,
+    K_DROPNA,
+    K_FILLNA,
+    K_FILTER,
+    K_JOIN,
+    K_LOAD,
+    K_PROJECT,
+    K_RENAME,
+    K_SAMPLE,
+    K_SELECT,
+    K_TAKE,
+    LNode,
+    build_graph,
+)
 from .lowering import lower_segments
 from .passes import emit, fuse_verbs, prune_columns, pushdown_filters
 
@@ -155,6 +171,125 @@ def _flag(conf: Any, key: str, default: bool = True) -> bool:
         return default
 
 
+# kinds whose output is never larger than their (first) input — a size
+# estimate can flow through them toward the nearest create/load source
+_SIZE_PASSTHROUGH_KINDS = {
+    K_PROJECT,
+    K_DROP,
+    K_RENAME,
+    K_FILTER,
+    K_SELECT,
+    K_DISTINCT,
+    K_DROPNA,
+    K_FILLNA,
+    K_SAMPLE,
+    K_TAKE,
+}
+
+
+def _estimate_node_size(
+    n: LNode, memo: Dict[int, Tuple[Optional[int], Optional[int], bool]]
+) -> Tuple[Optional[int], Optional[int], bool]:
+    """Static (bytes, rows, is_stream) upper-bound estimate for one plan
+    node: concrete create data and parquet load metadata are the ground
+    sources; row-shrinking verbs pass the estimate through; everything
+    else is unknown (None) — the runtime decision re-checks live sizes."""
+    if id(n) in memo:
+        return memo[id(n)]
+    est: Tuple[Optional[int], Optional[int], bool] = (None, None, False)
+    if n.kind == K_CREATE:
+        data = n.info.get("data")
+        if n.info.get("is_stream"):
+            est = (None, None, True)
+        else:
+            try:
+                import pandas as pd
+                import pyarrow as pa
+
+                from ..dataframe import DataFrame
+                from ..shuffle.strategy import (
+                    estimate_frame_bytes,
+                    estimate_frame_rows,
+                )
+
+                if isinstance(data, pa.Table):
+                    est = (int(data.nbytes), int(data.num_rows), False)
+                elif isinstance(data, pd.DataFrame):
+                    est = (
+                        int(data.memory_usage(index=False, deep=False).sum()),
+                        int(len(data)),
+                        False,
+                    )
+                elif isinstance(data, DataFrame):
+                    est = (
+                        estimate_frame_bytes(data),
+                        estimate_frame_rows(data),
+                        False,
+                    )
+                elif isinstance(data, list):
+                    est = (None, len(data), False)
+            except Exception:
+                est = (None, None, False)
+    elif n.kind == K_LOAD:
+        path, fmt = n.info.get("path"), n.info.get("fmt") or ""
+        try:
+            from .._utils.io import FileParser
+
+            if isinstance(path, str) and FileParser(
+                path, fmt or None
+            ).file_format == "parquet":
+                import pyarrow.parquet as pq
+
+                meta = pq.ParquetFile(path).metadata
+                nbytes = sum(
+                    meta.row_group(i).total_byte_size
+                    for i in range(meta.num_row_groups)
+                )
+                est = (int(nbytes), int(meta.num_rows), False)
+        except Exception:
+            est = (None, None, False)
+    elif n.kind in _SIZE_PASSTHROUGH_KINDS and len(n.inputs) >= 1:
+        est = _estimate_node_size(n.inputs[0], memo)
+    memo[id(n)] = est
+    return est
+
+
+def annotate_join_strategies(
+    nodes: List[LNode], conf: Any, report: "PlanReport"
+) -> None:
+    """Annotate every join node with the strategy the engine's ladder
+    (``fugue_tpu/shuffle/strategy.py`` — the SAME decision function) will
+    pick for the plan-time size estimates, and note it in the report so
+    ``workflow.explain()`` shows broadcast / copartition / shuffle_spill
+    before anything runs. Annotation only — no rewrite, no task cloning;
+    the runtime decision over live frame sizes stays authoritative."""
+    from ..shuffle.strategy import choose_join_strategy
+
+    memo: Dict[int, Tuple[Optional[int], Optional[int], bool]] = {}
+    idx = {id(n): i for i, n in enumerate(nodes)}
+    for n in nodes:
+        if n.kind != K_JOIN or len(n.inputs) != 2:
+            continue
+        how = n.info.get("how", "")
+        lb, _lr, ls = _estimate_node_size(n.inputs[0], memo)
+        rb, rr, rs = _estimate_node_size(n.inputs[1], memo)
+        if how == "cross":
+            strategy, reason = "broadcast", "cross join (constant-key expansion)"
+        elif ls or rs:
+            strategy, reason = (
+                "stream",
+                "one-pass side: streaming join plan, spill shuffle if ineligible",
+            )
+        else:
+            dec = choose_join_strategy(conf, lb, rb, rr)
+            strategy, reason = dec.strategy, dec.reason
+        n.annotations.append(f"strategy={strategy}")
+        report.note(
+            "join t%d (%s): strategy=%s -- %s"
+            % (idx[id(n)], how, strategy, reason)
+        )
+
+
 def optimize_tasks(
     tasks: List[FugueTask], conf: Any, stats: Optional[PlanStats] = None
 ) -> Tuple[List[FugueTask], Dict[int, FugueTask], Set[int], PlanReport]:
@@ -169,6 +304,7 @@ def optimize_tasks(
         return tasks, {}, set(), report
     nodes = build_graph(tasks)
     report.before = _render_nodes(nodes)
+    annotate_join_strategies(nodes, conf, report)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_PUSHDOWN, True):
         pushdown_filters(nodes, report)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_PRUNE, True):

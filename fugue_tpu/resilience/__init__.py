@@ -12,6 +12,7 @@ from .fault import (
     SITE_MAP_CHUNK,
     SITE_MAP_DISPATCH,
     SITE_RPC_REQUEST,
+    SITE_SHUFFLE_SPILL,
     SITE_STREAM_CHUNK,
     SITE_TASK_EXECUTE,
     FaultInjector,
@@ -36,6 +37,7 @@ __all__ = [
     "SITE_TASK_EXECUTE",
     "SITE_RPC_REQUEST",
     "SITE_CHECKPOINT_SAVE",
+    "SITE_SHUFFLE_SPILL",
     "SITE_STREAM_CHUNK",
     "RetryPolicy",
     "Deadline",

@@ -55,6 +55,7 @@ __all__ = [
     "SITE_RPC_REQUEST",
     "SITE_CHECKPOINT_SAVE",
     "SITE_STREAM_CHUNK",
+    "SITE_SHUFFLE_SPILL",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -67,6 +68,11 @@ SITE_CHECKPOINT_SAVE = "checkpoint.save"
 # here is the poison-chunk scenario: it must propagate to the consumer
 # with its traceback and must never deadlock the bounded queue
 SITE_STREAM_CHUNK = "stream.chunk"
+# inside the shuffle spill partitioner, between a bucket file's write and
+# its atomic publish rename (fugue_tpu/shuffle/partitioner.py) — `error`
+# here leaves that one bucket unpublished; the reader recovers it by
+# repartitioning ONLY that bucket from a replayable source
+SITE_SHUFFLE_SPILL = "shuffle.spill"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

@@ -1,0 +1,60 @@
+"""Out-of-core hash shuffle (docs/shuffle.md): spill-partitioned
+repartition and joins past device memory.
+
+- :mod:`.partitioner` — streaming hash partitioner: any input, chunk by
+  chunk, into P atomically-published arrow IPC bucket files; torn-bucket
+  detection + single-bucket recovery.
+- :mod:`.join` — bucket-at-a-time spill joins over the existing device
+  kernels, and spill-based hash repartition.
+- :mod:`.strategy` — the ONE broadcast/copartition/shuffle_spill decision
+  rule, shared by plan time (``workflow.explain()``) and run time
+  (``engine.join``).
+- :mod:`.stats` — ``engine.stats()["shuffle"]`` counters.
+"""
+
+from .partitioner import (
+    SpilledSide,
+    bucket_ids,
+    canonical_key_kinds,
+    new_spill_dir,
+    remove_spill_dir,
+    spill_dir_bytes,
+    spill_partition,
+)
+from .join import shuffle_spill_join, spill_repartition
+from .stats import ShuffleStats
+from .strategy import (
+    JoinDecision,
+    broadcast_max_rows,
+    bucket_count,
+    choose_join_strategy,
+    device_budget_bytes,
+    estimate_frame_bytes,
+    estimate_frame_rows,
+    shuffle_enabled,
+    spill_dir_root,
+    target_bucket_bytes,
+)
+
+__all__ = [
+    "SpilledSide",
+    "bucket_ids",
+    "canonical_key_kinds",
+    "new_spill_dir",
+    "remove_spill_dir",
+    "spill_dir_bytes",
+    "spill_partition",
+    "shuffle_spill_join",
+    "spill_repartition",
+    "ShuffleStats",
+    "JoinDecision",
+    "broadcast_max_rows",
+    "bucket_count",
+    "choose_join_strategy",
+    "device_budget_bytes",
+    "estimate_frame_bytes",
+    "estimate_frame_rows",
+    "shuffle_enabled",
+    "spill_dir_root",
+    "target_bucket_bytes",
+]

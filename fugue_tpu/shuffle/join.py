@@ -1,0 +1,392 @@
+"""Bucket-at-a-time spill-shuffle join and repartition.
+
+Both sides stream through :mod:`fugue_tpu.shuffle.partitioner` into P
+on-disk buckets keyed by the SAME normalized key hash, then buckets join
+one pair at a time: load bucket i of both sides, run the existing device
+join kernels (``ops/join.py``) on it, pull the result back to host, free
+the device arrays, move on. Peak device bytes = one bucket pair + the
+join's intermediates — independent of input size, so joins where BOTH
+sides exceed device memory by 10×+ complete under a bounded
+``peak_device_bytes`` (the round-5 STATUS gap / ROADMAP item 3; the
+staged-exchange design of arXiv:2112.01075 and the partitioned-exchange
+patterns of arXiv:2209.06146).
+
+Correctness: rows are hash-partitioned on the join key, so every key
+lives in exactly ONE bucket pair and ``⋃ᵢ join(Lᵢ, Rᵢ) = join(L, R)``
+(up to row order) for every hash-partitionable join type —
+inner/left_outer/semi/anti directly, right_outer by mirroring,
+full_outer by the engine's left_outer ∪ NULL-extended-anti composition,
+all PER BUCKET. NULL keys hash to a fixed bucket and keep SQL semantics
+inside it (they never match; outer joins keep them). Cross joins cannot
+hash-partition and refuse.
+
+Every bucket table is padded to one per-side capacity (the max bucket
+row count) before ingest, with the frame's tail-validity marking the pad
+rows invalid — so ALL bucket joins share ONE compiled kernel instead of
+recompiling per bucket shape.
+
+The output is a one-pass stream of per-bucket result chunks; the spill
+directory is removed when the stream is exhausted, errors, or is
+abandoned (GeneratorExit) — and on any failure during partitioning.
+"""
+
+import os
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..dataframe import (
+    ArrowDataFrame,
+    DataFrame,
+    LocalDataFrameIterableDataFrame,
+)
+from ..resilience import FaultInjector
+from ..schema import Schema
+from .partitioner import (
+    SpilledSide,
+    bucket_ids,
+    canonical_key_kinds,
+    new_spill_dir,
+    remove_spill_dir,
+    spill_partition,
+)
+from .strategy import (
+    bucket_count,
+    estimate_frame_bytes,
+    spill_dir_root,
+)
+
+__all__ = ["shuffle_spill_join", "spill_repartition"]
+
+
+def _chunk_rows(engine: Any) -> int:
+    from ..constants import FUGUE_TPU_CONF_STREAM_CHUNK_ROWS
+    from ..jax.streaming import DEFAULT_CHUNK_ROWS
+
+    return int(engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS))
+
+
+def _arrow_chunk_factory(
+    engine: Any, df: DataFrame
+) -> Callable[[], Iterator[pa.Table]]:
+    """A (re-)iterable arrow-chunk view of any frame. For one-pass
+    streams the factory is single-shot by nature — the caller records
+    that by passing ``replay=None`` to the partitioner."""
+    rows = _chunk_rows(engine)
+
+    def gen() -> Iterator[pa.Table]:
+        from ..jax.streaming import _closing, _iter_local_frames
+        from ..jax.pipeline import engine_prefetcher
+
+        chunks = engine_prefetcher(
+            engine,
+            (f.as_arrow() for f in _iter_local_frames(df, rows)),
+            "shuffle",
+        )
+        yield from _closing(chunks)
+
+    return gen
+
+
+def _track_spill_dir(engine: Any, d: str, add: bool) -> None:
+    dirs = getattr(engine, "_active_spill_dirs", None)
+    if dirs is not None:
+        (dirs.add if add else dirs.discard)(d)
+
+
+def _spill_side(
+    engine: Any,
+    df: DataFrame,
+    side: str,
+    keys: List[str],
+    kinds: List[str],
+    n_buckets: int,
+    spill_dir: str,
+    injector: FaultInjector,
+    parent_span: Optional[str],
+) -> SpilledSide:
+    from ..jax.streaming import is_stream_frame
+    from ..obs import get_tracer
+
+    stats = getattr(engine, "_shuffle_stats", None)
+    factory = _arrow_chunk_factory(engine, df)
+    replay = None if is_stream_frame(df) else factory
+    pa_schema = Schema(df.schema).pa_schema
+    with get_tracer().span(
+        "shuffle.partition", cat="shuffle", parent=parent_span, side=side
+    ) as sp:
+        spilled = spill_partition(
+            factory(),
+            pa_schema,
+            keys,
+            kinds,
+            n_buckets,
+            spill_dir,
+            side,
+            injector=injector,
+            stats=stats,
+            replay=replay,
+        )
+        sp.set(
+            rows=spilled.rows,
+            buckets=sum(1 for r in spilled.bucket_rows if r > 0),
+            bytes=spilled.bytes_spilled,
+        )
+    return spilled
+
+
+def _ingest_padded(engine: Any, tbl: pa.Table, cap: int) -> Any:
+    """Device-ingest a bucket table padded to the join-wide capacity so
+    every bucket shares one compiled kernel. Pad rows repeat row 0 (any
+    valid-for-the-dtypes content works) and sit past ``row_count`` — the
+    frame's tail-validity marks them invalid everywhere downstream."""
+    from ..jax.dataframe import JaxDataFrame
+
+    n = tbl.num_rows
+    padded = tbl
+    if n < cap:
+        filler = tbl.take(pa.array(np.zeros(cap - n, dtype=np.int64)))
+        padded = pa.concat_tables([tbl, filler]).combine_chunks()
+    jdf = engine.to_df(ArrowDataFrame(padded))
+    _ = jdf.device_cols  # force ingestion NOW (peak accounting is per bucket)
+    if n == padded.num_rows:
+        return jdf
+    return JaxDataFrame(
+        mesh=engine._mesh,
+        _internal=dict(
+            device_cols=dict(jdf.device_cols),
+            host_tbl=jdf.host_table,
+            row_count=n,
+            valid_mask=None,
+            nan_cols=jdf._nan_cols,
+            encodings=dict(jdf.encodings),
+            null_masks=dict(jdf.null_masks),
+            schema=jdf.schema,
+        ),
+    )
+
+
+def _to_out_table(res: Any, out_schema: Schema) -> pa.Table:
+    """Normalize one bucket's join result to the stream's output schema
+    (device and host bucket paths must emit interchangeable chunks)."""
+    tbl = res.as_arrow() if isinstance(res, DataFrame) else res
+    if list(tbl.schema.names) != list(out_schema.names):
+        tbl = tbl.select(list(out_schema.names))
+    if tbl.schema != out_schema.pa_schema:
+        tbl = tbl.cast(out_schema.pa_schema)
+    return tbl
+
+
+def _host_bucket_join(
+    engine: Any,
+    lt: Optional[pa.Table],
+    rt: Optional[pa.Table],
+    l_schema: pa.Schema,
+    r_schema: pa.Schema,
+    jt: str,
+    on: Any,
+) -> Any:
+    """The per-bucket catch-all: dtypes the device kernels refuse, and
+    buckets where one side is empty (outer-join NULL extension with exact
+    dtype semantics). The host engine is the oracle — per-bucket results
+    stay bit-compatible with a whole-frame host join."""
+    host = engine._host_engine
+    ldf = ArrowDataFrame(lt if lt is not None else l_schema.empty_table())
+    rdf = ArrowDataFrame(rt if rt is not None else r_schema.empty_table())
+    return host.join(host.to_df(ldf), host.to_df(rdf), how=jt, on=on)
+
+
+def _device_bucket_join(
+    engine: Any,
+    jl: Any,
+    jr: Any,
+    jt: str,
+    on: Any,
+    out_schema: Schema,
+) -> Optional[Any]:
+    """One bucket pair through the existing device kernels; None → the
+    caller reruns the bucket on the host engine."""
+    if jt in ("inner", "left_outer", "left_semi", "left_anti"):
+        kernel_how = {
+            "inner": "inner",
+            "left_outer": "left_outer",
+            "left_semi": "semi",
+            "left_anti": "anti",
+        }[jt]
+        return engine._join_device(jl, jr, kernel_how, on)
+    if jt == "right_outer":
+        res = engine._join_device(jr, jl, "left_outer", on)
+        if res is not None and list(res.schema.names) != list(out_schema.names):
+            res = res[list(out_schema.names)]
+        return res
+    if jt == "full_outer":
+        return engine._full_outer_device(jl, jr, on)
+    return None
+
+
+def shuffle_spill_join(
+    engine: Any, df1: DataFrame, df2: DataFrame, how: str, on: Any = None
+) -> Optional[DataFrame]:
+    """Spill-partition both sides and join bucket-at-a-time. Returns a
+    one-pass stream of result chunks, or None when the join can't
+    hash-partition (cross join, unhashable key types, keyless) — the
+    caller falls back to the legacy ladder."""
+    from ..dataframe.utils import get_join_schemas, parse_join_type
+    from ..jax.streaming import _device_peak_bytes
+    from ..obs import get_tracer
+
+    jt = parse_join_type(how)
+    if jt == "cross":
+        return None
+    try:
+        key_schema, out_schema = get_join_schemas(df1, df2, how=jt, on=on)
+    except Exception:
+        return None
+    keys = list(key_schema.names)
+    if len(keys) == 0:
+        return None
+    kinds = canonical_key_kinds(df1.schema, df2.schema, keys)
+    if kinds is None:
+        return None
+    conf = engine.conf
+    est1, est2 = estimate_frame_bytes(df1), estimate_frame_bytes(df2)
+    est = max(est1 or 0, est2 or 0) or None
+    n_buckets = bucket_count(conf, est)
+    root = spill_dir_root(conf)
+    os.makedirs(root, exist_ok=True)
+    spill_dir = new_spill_dir(root)
+    _track_spill_dir(engine, spill_dir, True)
+    stats = getattr(engine, "_shuffle_stats", None)
+    injector = FaultInjector.from_conf(conf)
+    tracer = get_tracer()
+    parent = tracer.current_span_id()
+    try:
+        left = _spill_side(
+            engine, df1, "left", keys, kinds, n_buckets, spill_dir, injector, parent
+        )
+        right = _spill_side(
+            engine, df2, "right", keys, kinds, n_buckets, spill_dir, injector, parent
+        )
+    except BaseException:
+        _track_spill_dir(engine, spill_dir, False)
+        remove_spill_dir(spill_dir)
+        if stats is not None:
+            stats.inc("spill_dirs_cleaned")
+        raise
+    if stats is not None:
+        stats.inc("joins_spill")
+    l_schema = Schema(df1.schema).pa_schema
+    r_schema = Schema(df2.schema).pa_schema
+    cap_l = max(left.max_bucket_rows, 1)
+    cap_r = max(right.max_bucket_rows, 1)
+
+    def gen() -> Iterator[Any]:
+        run = {"chunks": 0, "rows": 0, "peak_device_bytes": 0, "buckets": n_buckets}
+        try:
+            for i in range(n_buckets):
+                with tracer.span(
+                    "shuffle.bucket", cat="shuffle", parent=parent, bucket=i
+                ) as sp:
+                    lt = left.read_bucket(i, stats)
+                    rt = right.read_bucket(i, stats)
+                    if lt is None and rt is None:
+                        continue
+                    res: Optional[Any] = None
+                    if lt is not None and rt is not None:
+                        jl = _ingest_padded(engine, lt, cap_l)
+                        jr = _ingest_padded(engine, rt, cap_r)
+                        res = _device_bucket_join(engine, jl, jr, jt, on, out_schema)
+                        if res is None:
+                            jl = jr = None
+                            res = _host_bucket_join(
+                                engine, lt, rt, l_schema, r_schema, jt, on
+                            )
+                    elif jt in ("inner", "left_semi"):
+                        continue  # one side empty ⇒ no matches, no output
+                    else:
+                        res = _host_bucket_join(
+                            engine, lt, rt, l_schema, r_schema, jt, on
+                        )
+                    out = _to_out_table(res, out_schema)
+                    # peak while the bucket pair + result are still live —
+                    # the honest high-water mark for this bucket
+                    run["peak_device_bytes"] = max(
+                        run["peak_device_bytes"], _device_peak_bytes()
+                    )
+                    res = jl = jr = None  # free device refs before the next bucket
+                    if stats is not None:
+                        stats.inc("bucket_joins")
+                        stats.inc("bucket_rows_out", out.num_rows)
+                        stats.peak(run["peak_device_bytes"])
+                    sp.set(
+                        rows_left=0 if lt is None else lt.num_rows,
+                        rows_right=0 if rt is None else rt.num_rows,
+                        rows_out=out.num_rows,
+                    )
+                run["chunks"] += 1
+                run["rows"] += out.num_rows
+                if out.num_rows > 0:
+                    yield ArrowDataFrame(out)
+        finally:
+            _track_spill_dir(engine, spill_dir, False)
+            remove_spill_dir(spill_dir)
+            if stats is not None:
+                stats.inc("spill_dirs_cleaned")
+            from ..jax import streaming as _streaming
+
+            _streaming.last_run_stats = dict(run, verb="shuffle_join")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+
+
+def spill_repartition(
+    engine: Any, df: DataFrame, by: List[str], num: int = 0
+) -> Optional[DataFrame]:
+    """Hash-repartition through the spill partitioner: the result is a
+    one-pass stream where every key lives in exactly ONE chunk (bucket) —
+    the out-of-core physical layout behind arbitrarily large
+    ``PartitionSpec`` maps. None → key types the partitioner can't hash."""
+    from ..obs import get_tracer
+
+    kinds = canonical_key_kinds(df.schema, df.schema, by)
+    if kinds is None or len(by) == 0:
+        return None
+    conf = engine.conf
+    n_buckets = int(num) if num and num > 0 else bucket_count(
+        conf, estimate_frame_bytes(df)
+    )
+    root = spill_dir_root(conf)
+    os.makedirs(root, exist_ok=True)
+    spill_dir = new_spill_dir(root)
+    _track_spill_dir(engine, spill_dir, True)
+    stats = getattr(engine, "_shuffle_stats", None)
+    injector = FaultInjector.from_conf(conf)
+    parent = get_tracer().current_span_id()
+    try:
+        side = _spill_side(
+            engine, df, "part", by, kinds, n_buckets, spill_dir, injector, parent
+        )
+    except BaseException:
+        _track_spill_dir(engine, spill_dir, False)
+        remove_spill_dir(spill_dir)
+        if stats is not None:
+            stats.inc("spill_dirs_cleaned")
+        raise
+    if stats is not None:
+        stats.inc("repartitions_spill")
+    schema = Schema(df.schema)
+
+    def gen() -> Iterator[Any]:
+        try:
+            for i in range(n_buckets):
+                tbl = side.read_bucket(i, stats)
+                if tbl is not None and tbl.num_rows > 0:
+                    yield ArrowDataFrame(tbl)
+        finally:
+            _track_spill_dir(engine, spill_dir, False)
+            remove_spill_dir(spill_dir)
+            if stats is not None:
+                stats.inc("spill_dirs_cleaned")
+
+    return LocalDataFrameIterableDataFrame(gen(), schema=schema)

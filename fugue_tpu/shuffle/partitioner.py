@@ -1,0 +1,357 @@
+"""Streaming hash partitioner: route rows by key hash into P on-disk
+bucket spill files, one pass, bounded host memory, zero device bytes.
+
+Any input — bounded frame, parquet load, one-pass stream — is consumed
+chunk-by-chunk (the PR 2 ``engine_prefetcher`` overlaps decode with the
+spill writes). Each chunk's key columns are normalized to a canonical
+dtype shared by BOTH join sides (so ``int64 5`` and ``float64 5.0``
+co-bucket exactly like they match by value in the join kernels), hashed
+with ``pd.util.hash_pandas_object`` (deterministic across processes),
+and the chunk is split with arrow ``take`` — schema preserved bit-for-bit
+— onto per-bucket arrow IPC stream writers.
+
+Publish discipline: every bucket writes to ``<name>.tmp`` and is
+atomically renamed on completion (the cache store's
+``_atomic_publish``), so a bucket file either doesn't exist or is
+complete. A missing, truncated, or corrupt bucket is detected at read
+time (full IPC decode + row-count check against the partitioner's own
+ledger) and recovered by repartitioning ONLY that bucket from the
+source — possible whenever the source is replayable (anything but a
+one-pass stream). The ``shuffle.spill`` FaultInjector site fires between
+each bucket's write and its publish.
+"""
+
+import os
+import shutil
+import uuid as _uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from ..exceptions import FugueTPUError
+from ..resilience import SITE_SHUFFLE_SPILL, FaultInjector
+from ..workflow._checkpoint import _atomic_publish, _best_effort_remove
+
+__all__ = [
+    "canonical_key_kinds",
+    "bucket_ids",
+    "SpilledSide",
+    "spill_partition",
+    "new_spill_dir",
+    "remove_spill_dir",
+    "spill_dir_bytes",
+]
+
+
+# ---------------------------------------------------------------------------
+# key normalization + hashing
+# ---------------------------------------------------------------------------
+
+def _kind_of(tp: pa.DataType) -> Optional[str]:
+    if pa.types.is_dictionary(tp):
+        tp = tp.value_type
+    if pa.types.is_floating(tp):
+        return "f"
+    if pa.types.is_integer(tp) or pa.types.is_boolean(tp):
+        return "i"
+    if pa.types.is_string(tp) or pa.types.is_large_string(tp):
+        return "s"
+    if pa.types.is_timestamp(tp) or pa.types.is_date(tp):
+        return "t"
+    return None
+
+
+def canonical_key_kinds(
+    schema1: Any, schema2: Any, keys: List[str]
+) -> Optional[List[str]]:
+    """Per key column, the canonical hash dtype BOTH sides normalize to
+    before hashing — equal-by-value keys must co-bucket even across
+    dtypes (int64 ⋈ float64 matches by value in the join kernels). None
+    = a key type the partitioner can't hash (decimal, binary, nested):
+    the caller refuses and the legacy ladder handles the join."""
+    kinds: List[str] = []
+    for k in keys:
+        k1, k2 = _kind_of(schema1[k].type), _kind_of(schema2[k].type)
+        if k1 is None or k2 is None:
+            return None
+        if k1 == k2:
+            kinds.append("f" if k1 == "f" else k1)
+        elif {k1, k2} <= {"i", "f"}:
+            kinds.append("f")  # value-equality across int/float via float64
+        else:
+            return None  # string vs numeric etc. — no value equality
+    return kinds
+
+
+def _normalize_key(col: pa.ChunkedArray, kind: str) -> pd.Series:
+    """One key column → canonical pandas Series with NULLs filled to a
+    fixed value (NULL keys never match, they only need a deterministic
+    bucket)."""
+    s = col.to_pandas()
+    if kind == "f":
+        s = pd.to_numeric(s, errors="coerce").astype(np.float64)
+        return s.fillna(0.0)
+    if kind == "i":
+        # nullable ints arrive as Int64/object; uint64 wraps into int64
+        # deterministically on both sides (bucketing needs consistency,
+        # not order)
+        s = s.fillna(0)
+        return s.astype(np.int64, errors="ignore").astype(np.int64)
+    if kind == "t":
+        s = pd.to_datetime(s)
+        try:
+            s = s.dt.tz_localize(None)
+        except (AttributeError, TypeError):
+            pass
+        v = s.astype("int64", errors="ignore")
+        if v.dtype != np.int64:  # NaT-bearing — view through float64
+            return pd.to_numeric(v, errors="coerce").fillna(0.0).astype(np.float64)
+        return v
+    # strings
+    return s.astype("object").where(~s.isna(), "").astype(str)
+
+
+def bucket_ids(
+    tbl: pa.Table, keys: List[str], kinds: List[str], n_buckets: int
+) -> np.ndarray:
+    """Per-row bucket id for one chunk (uint64 hash of the normalized key
+    frame, mod P). Deterministic across processes and chunk boundaries."""
+    norm = pd.DataFrame(
+        {k: _normalize_key(tbl.column(k), kind) for k, kind in zip(keys, kinds)}
+    )
+    h = pd.util.hash_pandas_object(norm, index=False).to_numpy()
+    return (h % np.uint64(n_buckets)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# spill directories
+# ---------------------------------------------------------------------------
+
+def new_spill_dir(root: str) -> str:
+    d = os.path.join(root, f"shuffle-{os.getpid()}-{_uuid.uuid4().hex[:12]}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def remove_spill_dir(path: str) -> None:
+    try:
+        shutil.rmtree(path)
+    except OSError:
+        pass
+
+
+def spill_dir_bytes(paths: Any) -> int:
+    """Live on-disk bytes across a set of spill dirs (the sampler probe)."""
+    total = 0
+    for d in list(paths):
+        try:
+            for name in os.listdir(d):
+                try:
+                    total += os.path.getsize(os.path.join(d, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the spilled representation of one join side
+# ---------------------------------------------------------------------------
+
+class SpilledSide:
+    """P published bucket files plus the ledger needed to read them back
+    safely (expected per-bucket row counts) and to recover a damaged one
+    (the replay factory, when the source can be re-iterated)."""
+
+    def __init__(
+        self,
+        spill_dir: str,
+        side: str,
+        pa_schema: pa.Schema,
+        keys: List[str],
+        kinds: List[str],
+        n_buckets: int,
+        bucket_rows: List[int],
+        bytes_spilled: int,
+        replay: Optional[Callable[[], Iterator[pa.Table]]],
+    ):
+        self.spill_dir = spill_dir
+        self.side = side
+        self.pa_schema = pa_schema
+        self.keys = keys
+        self.kinds = kinds
+        self.n_buckets = n_buckets
+        self.bucket_rows = bucket_rows
+        self.bytes_spilled = bytes_spilled
+        self.replay = replay
+
+    def path(self, i: int) -> str:
+        return os.path.join(self.spill_dir, f"{self.side}_{i:05d}.arrow")
+
+    @property
+    def rows(self) -> int:
+        return sum(self.bucket_rows)
+
+    @property
+    def max_bucket_rows(self) -> int:
+        return max(self.bucket_rows) if self.bucket_rows else 0
+
+    def read_bucket(self, i: int, stats: Any = None) -> Optional[pa.Table]:
+        """Bucket ``i`` fully decoded (torn files can't parse), validated
+        against the ledger row count; a missing/corrupt bucket is deleted
+        and repartitioned from the source — only that bucket."""
+        expected = self.bucket_rows[i]
+        if expected == 0:
+            return None
+        path = self.path(i)
+        tbl: Optional[pa.Table] = None
+        if os.path.exists(path):
+            try:
+                with pa.ipc.open_stream(path) as reader:
+                    tbl = reader.read_all()
+                if tbl.num_rows != expected:
+                    tbl = None
+            except Exception:
+                tbl = None
+        if tbl is None:
+            _best_effort_remove(path)
+            tbl = self._recover_bucket(i)
+            if stats is not None:
+                stats.inc("bucket_recoveries")
+        return tbl
+
+    def _recover_bucket(self, i: int) -> pa.Table:
+        if self.replay is None:
+            raise FugueTPUError(
+                f"shuffle spill bucket {self.side}_{i} is torn or missing and "
+                "the source is a one-pass stream (not replayable); re-run the "
+                "join or materialize the input first"
+            )
+        parts: List[pa.Table] = []
+        for tbl in self.replay():
+            ids = bucket_ids(tbl, self.keys, self.kinds, self.n_buckets)
+            (sel,) = np.nonzero(ids == i)
+            if len(sel) > 0:
+                parts.append(tbl.take(pa.array(sel, type=pa.int64())))
+        got = (
+            pa.concat_tables(parts)
+            if parts
+            else self.pa_schema.empty_table()
+        )
+        if got.num_rows != self.bucket_rows[i]:
+            raise FugueTPUError(
+                f"shuffle bucket {self.side}_{i} recovery produced "
+                f"{got.num_rows} rows, ledger expects {self.bucket_rows[i]} "
+                "(source changed between spill and recovery)"
+            )
+        # re-publish so later readers (and retries) see a complete file
+        tmp = self.path(i) + ".tmp"
+        with pa.OSFile(tmp, "wb") as sink:
+            with pa.ipc.new_stream(sink, self.pa_schema) as writer:
+                writer.write_table(got)
+        _atomic_publish(tmp, self.path(i))
+        return got
+
+
+# ---------------------------------------------------------------------------
+# the one-pass spill
+# ---------------------------------------------------------------------------
+
+def spill_partition(
+    chunks: Iterator[pa.Table],
+    pa_schema: pa.Schema,
+    keys: List[str],
+    kinds: List[str],
+    n_buckets: int,
+    spill_dir: str,
+    side: str,
+    injector: Optional[FaultInjector] = None,
+    stats: Any = None,
+    replay: Optional[Callable[[], Iterator[pa.Table]]] = None,
+) -> SpilledSide:
+    """Consume ``chunks`` once, routing rows into ``n_buckets`` spill
+    files under ``spill_dir``. Buckets a fault rule tears stay
+    unpublished — the reader repairs them lazily via ``read_bucket``."""
+    writers: Dict[int, Any] = {}
+    sinks: Dict[int, Any] = {}
+    bucket_rows = [0] * n_buckets
+    n_chunks = 0
+
+    def _writer(i: int) -> Any:
+        w = writers.get(i)
+        if w is None:
+            sink = pa.OSFile(
+                os.path.join(spill_dir, f"{side}_{i:05d}.arrow.tmp"), "wb"
+            )
+            sinks[i] = sink
+            w = pa.ipc.new_stream(sink, pa_schema)
+            writers[i] = w
+        return w
+
+    try:
+        for tbl in chunks:
+            if tbl.num_rows == 0:
+                continue
+            n_chunks += 1
+            if tbl.schema != pa_schema:
+                tbl = tbl.cast(pa_schema)
+            ids = bucket_ids(tbl, keys, kinds, n_buckets)
+            order = np.argsort(ids, kind="stable")
+            sorted_ids = ids[order]
+            bounds = np.searchsorted(
+                sorted_ids, np.arange(n_buckets + 1), side="left"
+            )
+            for i in range(n_buckets):
+                lo, hi = bounds[i], bounds[i + 1]
+                if lo == hi:
+                    continue
+                part = tbl.take(pa.array(order[lo:hi], type=pa.int64()))
+                _writer(i).write_table(part)
+                bucket_rows[i] += int(hi - lo)
+    finally:
+        for w in writers.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        for s in sinks.values():
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    bytes_spilled = 0
+    for i in writers:
+        tmp = os.path.join(spill_dir, f"{side}_{i:05d}.arrow.tmp")
+        final = os.path.join(spill_dir, f"{side}_{i:05d}.arrow")
+        try:
+            if injector is not None:
+                injector.fire(SITE_SHUFFLE_SPILL)
+            _atomic_publish(tmp, final)
+            bytes_spilled += os.path.getsize(final)
+        except Exception:
+            # an injected (or real) publish failure tears ONLY this
+            # bucket; the reader recovers it from the replayable source
+            _best_effort_remove(tmp)
+            if stats is not None:
+                stats.inc("spill_faults")
+    if stats is not None:
+        stats.inc("partitions")
+        stats.inc("chunks", n_chunks)
+        stats.inc("rows_spilled", sum(bucket_rows))
+        stats.inc("bytes_spilled", bytes_spilled)
+        stats.inc("buckets", len(writers))
+    return SpilledSide(
+        spill_dir,
+        side,
+        pa_schema,
+        keys,
+        kinds,
+        n_buckets,
+        bucket_rows,
+        bytes_spilled,
+        replay,
+    )

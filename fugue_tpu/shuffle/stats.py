@@ -1,0 +1,60 @@
+"""Shuffle counters — an ``engine.metrics`` source (``engine.stats()["shuffle"]``).
+
+Follows the system-wide reset contract (``JitCache.reset``): counters
+zero, nothing structural is dropped. ``peak_device_bytes`` is a
+high-water gauge (max over bucket joins since the last reset) — the
+proof artifact that bucket-at-a-time execution really bounds the device
+working set.
+"""
+
+import threading
+from typing import Dict
+
+__all__ = ["ShuffleStats"]
+
+_COUNTERS = (
+    "partitions",  # sides spilled to buckets
+    "chunks",  # input chunks consumed by the partitioner
+    "rows_spilled",
+    "bytes_spilled",  # on-disk bucket bytes written
+    "buckets",  # bucket files published
+    "bucket_joins",  # bucket pairs joined
+    "bucket_rows_out",
+    "bucket_recoveries",  # torn/corrupt/missing buckets repartitioned
+    "spill_faults",  # injected shuffle.spill faults absorbed
+    "spill_dirs_cleaned",
+    "joins_spill",  # joins executed with the spill-shuffle strategy
+    "repartitions_spill",
+)
+
+
+class ShuffleStats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + int(n)
+
+    def peak(self, nbytes: int) -> None:
+        with self._lock:
+            if nbytes > self._peak:
+                self._peak = int(nbytes)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            if name == "peak_device_bytes":
+                return self._peak
+            return self._c.get(name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            out = {k: self._c.get(k, 0) for k in _COUNTERS}
+            out["peak_device_bytes"] = self._peak
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c: Dict[str, int] = {}
+            self._peak = 0

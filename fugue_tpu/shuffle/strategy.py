@@ -1,0 +1,223 @@
+"""Join-strategy selection: ONE decision function shared by plan time
+and run time.
+
+The ladder (docs/shuffle.md):
+
+- ``broadcast`` — right side replicated to every device; cheapest when it
+  fits (``fugue.tpu.join.broadcast_max_rows`` rows AND under the device
+  budget).
+- ``copartition`` — both sides device-resident at once, co-partitioned by
+  key hash with the in-device all-to-all, probed shard-locally.
+- ``shuffle_spill`` — neither bound holds: both sides stream through the
+  on-disk hash partitioner (``fugue_tpu/shuffle/partitioner.py``) and
+  matching buckets join one pair at a time under the device budget.
+
+The plan optimizer calls :func:`choose_join_strategy` with schema+file
+size estimates and records the choice in ``PlanReport`` /
+``workflow.explain()``; ``engine.join`` calls it again with live frame
+sizes — the runtime decision is authoritative, the plan note is the
+explainable prediction, and both can never disagree about the RULE
+because there is only one implementation.
+"""
+
+from typing import Any, NamedTuple, Optional
+
+from ..constants import (
+    FUGUE_TPU_CONF_JOIN_BROADCAST_MAX_ROWS,
+    FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES,
+    FUGUE_TPU_CONF_SHUFFLE_BUCKETS,
+    FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+    FUGUE_TPU_CONF_SHUFFLE_DIR,
+    FUGUE_TPU_CONF_SHUFFLE_ENABLED,
+)
+
+__all__ = [
+    "JoinDecision",
+    "broadcast_max_rows",
+    "shuffle_enabled",
+    "spill_dir_root",
+    "device_budget_bytes",
+    "target_bucket_bytes",
+    "bucket_count",
+    "estimate_frame_bytes",
+    "estimate_frame_rows",
+    "choose_join_strategy",
+]
+
+DEFAULT_BUCKET_BYTES = 1 << 26  # 64 MiB on disk per bucket
+MAX_BUCKETS = 4096
+
+
+class JoinDecision(NamedTuple):
+    strategy: str  # broadcast | copartition | shuffle_spill
+    reason: str
+
+
+def _conf_get(conf: Any, key: str, default: Any) -> Any:
+    if conf is None:
+        return default
+    try:
+        return conf.get(key, default)
+    except Exception:
+        return default
+
+
+def broadcast_max_rows(conf: Any) -> int:
+    """Conf-driven broadcast threshold (default: the historical
+    ``ops/join.py MAX_BROADCAST_ROWS`` constant)."""
+    from ..ops.join import MAX_BROADCAST_ROWS
+
+    return int(_conf_get(conf, FUGUE_TPU_CONF_JOIN_BROADCAST_MAX_ROWS, MAX_BROADCAST_ROWS))
+
+
+def shuffle_enabled(conf: Any) -> bool:
+    return bool(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_ENABLED, True))
+
+
+def spill_dir_root(conf: Any) -> str:
+    import os
+    import tempfile
+
+    d = str(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_DIR, "") or "")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(), "fugue_tpu_shuffle")
+    return d
+
+
+def _auto_device_budget() -> int:
+    """Best-effort device byte budget when none is configured: the
+    backend's reported memory limit, else half of host MemTotal (CPU
+    "devices" are host RAM)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024 // 2
+    except Exception:
+        pass
+    return 1 << 34  # 16 GiB — conservative fallback
+
+
+def device_budget_bytes(conf: Any) -> int:
+    b = int(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET, 0) or 0)
+    return b if b > 0 else _auto_device_budget()
+
+
+def target_bucket_bytes(conf: Any) -> int:
+    t = int(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES, 0) or 0)
+    if t > 0:
+        return t
+    # a bucket PAIR plus join intermediates (pow2-padded hash tables,
+    # expansion output for duplicate keys) must fit the budget TOGETHER —
+    # measured ~8-14x one bucket's bytes for dup-heavy joins, so default
+    # to 1/32 of the budget, floored so tiny budgets stay practical
+    return max(1 << 16, min(DEFAULT_BUCKET_BYTES, device_budget_bytes(conf) // 32))
+
+
+def bucket_count(conf: Any, est_bytes: Optional[int]) -> int:
+    """P for one shuffle: explicit conf wins; else size/target, bounded;
+    16 when the size is unknowable (one-pass streams)."""
+    p = int(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_BUCKETS, 0) or 0)
+    if p > 0:
+        return min(p, MAX_BUCKETS)
+    if not est_bytes or est_bytes <= 0:
+        return 16
+    return max(1, min(MAX_BUCKETS, -(-est_bytes // target_bucket_bytes(conf))))
+
+
+def estimate_frame_bytes(df: Any) -> Optional[int]:
+    """Cheap host-side byte estimate of a frame; None = unknowable
+    without consuming it (one-pass streams). Never materializes."""
+    try:
+        nb = getattr(df, "device_nbytes", None)
+        if nb is not None:
+            total = int(nb)
+            has_pending = getattr(df, "_has_pending", None)
+            if has_pending is None or not has_pending():
+                # host-resident residual columns — but ONLY once the frame
+                # is already ingested: the host_table property of a pending
+                # frame forces ingestion (the very device residency this
+                # estimate exists to avoid)
+                try:
+                    host_tbl = getattr(df, "_host_tbl", None)
+                    if host_tbl is not None:
+                        total += int(host_tbl.nbytes)
+                except Exception:
+                    pass
+            return total
+    except Exception:
+        pass
+    for attr in ("native",):
+        native = getattr(df, attr, None)
+        if native is None:
+            continue
+        try:
+            import pandas as pd
+            import pyarrow as pa
+
+            if isinstance(native, pa.Table):
+                return int(native.nbytes)
+            if isinstance(native, pd.DataFrame):
+                return int(native.memory_usage(index=False, deep=False).sum())
+        except Exception:
+            pass
+    return None
+
+
+def estimate_frame_rows(df: Any) -> Optional[int]:
+    try:
+        if getattr(df, "is_bounded", False):
+            return int(df.count())
+    except Exception:
+        pass
+    return None
+
+
+def choose_join_strategy(
+    conf: Any,
+    est_left_bytes: Optional[int],
+    est_right_bytes: Optional[int],
+    est_right_rows: Optional[int],
+    streaming: bool = False,
+) -> JoinDecision:
+    """The one strategy rule. Unknown estimates choose conservatively:
+    an unknown BOUNDED side is assumed to fit (runtime re-checks with the
+    real size); a one-pass stream (``streaming=True``) with no eligible
+    streaming plan can only spill — materializing it is the unbounded-
+    memory hazard this subsystem removes."""
+    budget = device_budget_bytes(conf)
+    bmax = broadcast_max_rows(conf)
+    if not shuffle_enabled(conf):
+        if est_right_rows is not None and est_right_rows <= bmax:
+            return JoinDecision("broadcast", f"right ~{est_right_rows} rows <= {bmax}")
+        return JoinDecision("copartition", "shuffle disabled (fugue.tpu.shuffle.enabled=false)")
+    if streaming:
+        return JoinDecision(
+            "shuffle_spill", "one-pass stream with no eligible streaming join plan"
+        )
+    r_fits_bc = (
+        est_right_rows is not None
+        and est_right_rows <= bmax
+        and (est_right_bytes is None or est_right_bytes <= budget)
+    )
+    if r_fits_bc:
+        return JoinDecision(
+            "broadcast", f"right ~{est_right_rows} rows <= broadcast_max_rows {bmax}"
+        )
+    both = (est_left_bytes or 0) + (est_right_bytes or 0)
+    if (est_left_bytes is None and est_right_bytes is None) or both <= budget:
+        return JoinDecision(
+            "copartition", f"both sides ~{both}B fit device budget {budget}B"
+        )
+    return JoinDecision(
+        "shuffle_spill", f"sides ~{both}B exceed device budget {budget}B"
+    )

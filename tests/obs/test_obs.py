@@ -420,6 +420,7 @@ def test_engine_stats_surface_and_shims():
             "jit_cache",
             "plan",
             "cache",
+            "shuffle",
             "latency",
             "telemetry",
         }
