@@ -671,12 +671,17 @@ class JaxExecutionEngine(ExecutionEngine):
             return float(ps.as_dict()["overlap_fraction"]) if ps is not None else 0.0
 
         def _spill_bytes(e: Any) -> float:
-            dirs = getattr(e, "_active_spill_dirs", None)
-            if not dirs:
-                return 0.0
-            from ..shuffle.partitioner import spill_dir_bytes
+            # runs on the sampler thread while joins mutate the spill-dir
+            # set — never let a race break the whole resource sampler
+            try:
+                dirs = getattr(e, "_active_spill_dirs", None)
+                if not dirs:
+                    return 0.0
+                from ..shuffle.partitioner import spill_dir_bytes
 
-            return float(spill_dir_bytes(dirs))
+                return float(spill_dir_bytes(dirs))
+            except Exception:
+                return 0.0
 
         probes["jit_cache_entries"] = _jit_entries
         probes["overlap_fraction"] = _overlap

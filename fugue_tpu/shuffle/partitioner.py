@@ -92,7 +92,10 @@ def _normalize_key(col: pa.ChunkedArray, kind: str) -> pd.Series:
     s = col.to_pandas()
     if kind == "f":
         s = pd.to_numeric(s, errors="coerce").astype(np.float64)
-        return s.fillna(0.0)
+        # + 0.0 canonicalizes -0.0 → +0.0 (IEEE): the hash sees float bit
+        # patterns, but the join kernels match 0.0 == -0.0 by value, so
+        # both must land in the same bucket
+        return s.fillna(0.0) + 0.0
     if kind == "i":
         # nullable ints arrive as Int64/object; uint64 wraps into int64
         # deterministically on both sides (bucketing needs consistency,
@@ -102,7 +105,10 @@ def _normalize_key(col: pa.ChunkedArray, kind: str) -> pd.Series:
     if kind == "t":
         s = pd.to_datetime(s)
         try:
-            s = s.dt.tz_localize(None)
+            # tz-aware → the UTC instant, so equal instants co-bucket even
+            # when the two sides carry different timezones; tz-naive
+            # raises TypeError and keeps its wall-clock int64 view
+            s = s.dt.tz_convert("UTC").dt.tz_localize(None)
         except (AttributeError, TypeError):
             pass
         v = s.astype("int64", errors="ignore")
@@ -143,9 +149,20 @@ def remove_spill_dir(path: str) -> None:
 
 
 def spill_dir_bytes(paths: Any) -> int:
-    """Live on-disk bytes across a set of spill dirs (the sampler probe)."""
+    """Live on-disk bytes across a set of spill dirs (the sampler probe).
+
+    ``paths`` may be the engine's live spill-dir set, mutated by
+    join/repartition threads while the sampler iterates — snapshot it,
+    retrying once if a concurrent add/discard races the copy."""
+    dirs: Tuple[str, ...] = ()
+    for _ in range(2):
+        try:
+            dirs = tuple(paths)
+            break
+        except RuntimeError:
+            continue
     total = 0
-    for d in list(paths):
+    for d in dirs:
         try:
             for name in os.listdir(d):
                 try:
@@ -232,6 +249,8 @@ class SpilledSide:
             )
         parts: List[pa.Table] = []
         for tbl in self.replay():
+            if tbl.schema != self.pa_schema:
+                tbl = tbl.cast(self.pa_schema)
             ids = bucket_ids(tbl, self.keys, self.kinds, self.n_buckets)
             (sel,) = np.nonzero(ids == i)
             if len(sel) > 0:

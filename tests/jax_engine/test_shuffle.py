@@ -460,3 +460,105 @@ def test_shuffle_stats_reset_and_probe(tmp_path):
     eng.reset_stats()
     st = eng.stats()["shuffle"]
     assert all(v == 0 for v in st.values()), st
+
+
+def test_negative_zero_keys_cobucket_and_join(tmp_path):
+    """0.0 and -0.0 compare equal in the join kernels, so they must hash
+    into the same bucket (regression: bit-pattern hashing split them and
+    the spill join silently dropped their matches)."""
+    from fugue_tpu.constants import FUGUE_TPU_CONF_SHUFFLE_BUCKETS
+    from fugue_tpu.shuffle.partitioner import bucket_ids
+
+    pz = pa.Table.from_pandas(pd.DataFrame({"k": [0.0]}), preserve_index=False)
+    nz = pa.Table.from_pandas(pd.DataFrame({"k": [-0.0]}), preserve_index=False)
+    assert (bucket_ids(pz, ["k"], ["f"], 64) == bucket_ids(nz, ["k"], ["f"], 64)).all()
+    # the end-to-end repro: every key matches, so both paths return 3 rows
+    left = pd.DataFrame({"k": [0.0, 1.0, 2.0], "a": [1, 2, 3]})
+    right = pd.DataFrame({"k": [-0.0, 1.0, 2.0], "b": [4, 5, 6]})
+    eng = _spill_engine(tmp_path, budget=1, **{FUGUE_TPU_CONF_SHUFFLE_BUCKETS: 8})
+    got = _norm(eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"]))
+    assert eng.stats()["shuffle"]["joins_spill"] == 1
+    off = JaxExecutionEngine({FUGUE_TPU_CONF_SHUFFLE_ENABLED: False})
+    ref = _norm(off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"]))
+    assert len(got) == 3
+    pd.testing.assert_frame_equal(got, ref[list(got.columns)])
+
+
+def test_tz_aware_keys_cobucket_across_timezones():
+    """Equal instants carried in different timezones must co-bucket (the
+    hash sees the UTC instant, not local wall-clock time); tz-naive keys
+    keep their wall-clock int64 view."""
+    from fugue_tpu.shuffle.partitioner import bucket_ids
+
+    utc = pd.DataFrame(
+        {"k": pd.to_datetime(["2026-01-01 00:00", "2026-06-01 12:34"]).tz_localize("UTC")}
+    )
+    est = utc.assign(k=utc["k"].dt.tz_convert("US/Eastern"))
+    tu = pa.Table.from_pandas(utc, preserve_index=False)
+    te = pa.Table.from_pandas(est, preserve_index=False)
+    assert (bucket_ids(tu, ["k"], ["t"], 64) == bucket_ids(te, ["k"], ["t"], 64)).all()
+    naive = pa.Table.from_pandas(
+        pd.DataFrame({"k": pd.to_datetime(["2026-01-01", "2026-06-01"])}),
+        preserve_index=False,
+    )
+    ids = bucket_ids(naive, ["k"], ["t"], 64)
+    assert len(ids) == 2 and (ids >= 0).all()
+
+
+def test_recovery_casts_replayed_chunks(tmp_path):
+    """Bucket recovery must apply the same schema cast as the main spill
+    path — a replay source whose chunks need casting (int32 -> int64)
+    otherwise breaks exactly the resilience path it backs."""
+    from fugue_tpu.shuffle.partitioner import (
+        new_spill_dir,
+        remove_spill_dir,
+        spill_partition,
+    )
+
+    pdf = pd.DataFrame(
+        {
+            "k": (np.arange(50) % 5).astype(np.int32),
+            "v": np.arange(50, dtype=np.float32),
+        }
+    )
+    raw = pa.Table.from_pandas(pdf, preserve_index=False)
+    schema = pa.schema([("k", pa.int64()), ("v", pa.float64())])
+    d = new_spill_dir(str(tmp_path))
+    side = spill_partition(
+        iter([raw]), schema, ["k"], ["i"], 4, d, "left", replay=lambda: iter([raw])
+    )
+    i = next(i for i, r in enumerate(side.bucket_rows) if r > 0)
+    with open(side.path(i), "r+b") as f:
+        f.truncate(10)  # torn IPC prefix
+    tbl = side.read_bucket(i)
+    assert tbl.schema == schema and tbl.num_rows == side.bucket_rows[i]
+    remove_spill_dir(d)
+
+
+def test_spill_dir_bytes_tolerates_concurrent_mutation(tmp_path):
+    """The sampler probe iterates the engine's LIVE spill-dir set while
+    join threads mutate it: a raced snapshot retries, a persistently
+    racing one reports 0 instead of breaking the sampler."""
+    from fugue_tpu.shuffle.partitioner import new_spill_dir, spill_dir_bytes
+
+    d = new_spill_dir(str(tmp_path))
+    with open(os.path.join(d, "x.arrow"), "wb") as f:
+        f.write(b"abcd")
+
+    class FlakyOnce:
+        def __init__(self, items):
+            self.items, self.raised = items, False
+
+        def __iter__(self):
+            if not self.raised:
+                self.raised = True
+                raise RuntimeError("Set changed size during iteration")
+            return iter(self.items)
+
+    assert spill_dir_bytes(FlakyOnce([d])) == 4
+
+    class AlwaysRacing:
+        def __iter__(self):
+            raise RuntimeError("Set changed size during iteration")
+
+    assert spill_dir_bytes(AlwaysRacing()) == 0
