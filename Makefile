@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-shuffle cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -66,9 +66,19 @@ test-shuffle:
 
 # result-cache suite (docs/cache.md): cached-hit parity, invalidation
 # (mutated files / edited UDFs / partition specs), poisoned-subtree
-# refusal, publish races, torn artifacts, persist-across-restart
+# refusal, publish races, torn artifacts, persist-across-restart — plus
+# the partition-level delta suite (test-delta below)
 test-cache:
 	JAX_PLATFORMS=cpu python -m pytest tests/cache -q -m "not slow"
+
+# partition-level incremental recompute suite (docs/cache.md "Incremental
+# recompute"): grown-source delta parity across fused-chain / filter /
+# dense-aggregate shapes × jax/native engines × optimizer on/off, the
+# refusal ladder (changed contents, reordered partitions, non-row-local
+# verbs), grown single-file append detection, manifest/eviction
+# consistency, two-process append races, persist of delta-merged frames
+test-delta:
+	JAX_PLATFORMS=cpu python -m pytest tests/cache/test_delta_cache.py -q -m "not slow"
 
 # wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
 # (defaults to $FUGUE_TPU_CACHE_DIR)

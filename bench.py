@@ -1122,6 +1122,124 @@ def _bench_result_cache(rows: int = 300_000, wide_cols: int = 10) -> dict:
             _shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def _bench_delta_cache(files: int = 40, rows_per_file: int = 50_000) -> dict:
+    """Partition-level delta recompute case (ISSUE 9): a parquet DIRECTORY
+    of N equal partitions feeds load → filter → dense aggregate
+    (sum/count/avg) — the repeat-with-small-delta shape of the streaming-
+    aggregate north star. The cold run publishes the partition manifest +
+    partial accumulator. Then, twice, ONE new partition (~1/N of rows) is
+    appended and a LONG-LIVED engine warm-runs the same workflow: the
+    first delta pays the one-time jit traces for the delta-sized shapes,
+    the second is the steady state a serving process actually sees. The
+    gated run (the second delta) must serve every old partition from
+    cache (``bytes_skipped_delta`` >= 95% of the current producer bytes),
+    recompute ONLY the new partition, match the cache-off rerun
+    bit-for-bit, and beat it by >= 3x."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    import numpy as _np
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_CACHE_DIR
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    cache_dir = _tempfile.mkdtemp(prefix="fugue_bench_delta_cache_")
+    src_dir = _tempfile.mkdtemp(prefix="fugue_bench_delta_src_")
+    rng = _np.random.default_rng(17)
+
+    def write_part(i: int) -> None:
+        # integer-valued floats: every fold order sums exactly, so the
+        # bit-identity assertion is meaningful rather than lucky
+        _pq.write_table(
+            _pa.table(
+                {
+                    "k": rng.integers(0, 64, rows_per_file).astype("int64"),
+                    "v": rng.integers(0, 1000, rows_per_file).astype("float64"),
+                }
+            ),
+            os.path.join(src_dir, f"part_{i:04d}.parquet"),
+        )
+
+    for i in range(files):
+        write_part(i)
+
+    def run(engine: Any = None, extra: Optional[dict] = None) -> tuple:
+        conf = {
+            FUGUE_TPU_CONF_CACHE_DIR: cache_dir,
+            "fugue.tpu.cache.enabled": True,
+        }
+        conf.update(extra or {})
+        eng = engine if engine is not None else JaxExecutionEngine(conf)
+        eng.reset_stats()
+        dag = FugueWorkflow()
+        (
+            dag.load(src_dir, fmt="parquet")
+            .filter(col("v") > 100)
+            .partition_by("k")
+            .aggregate(
+                ff.sum(col("v")).alias("s"),
+                ff.count(col("v")).alias("n"),
+                ff.avg(col("v")).alias("m"),
+            )
+            .yield_dataframe_as("r", as_local=True)
+        )
+        t0 = time.perf_counter()
+        dag.run(eng)
+        dt = time.perf_counter() - t0
+        res = dag.yields["r"].result.as_pandas().reset_index(drop=True)
+        return dt, res, eng.stats()["cache"], eng
+
+    try:
+        # cold: a different process/engine originally produced the cache
+        cold_s, _cold_res, _, _ = run()
+        write_part(files)
+        # first delta on the long-lived serving engine: real work plus the
+        # one-time jit traces for the delta-sized program shapes
+        warm1_s, _w1, _st1, serving = run()
+        write_part(files + 1)
+        # steady state: the shape every subsequent append takes
+        warm_s, warm_res, warm_stats, _ = run(engine=serving)
+        off_s, off_res, _, _ = run(extra={"fugue.tpu.cache.enabled": False})
+        producer_bytes = sum(
+            os.path.getsize(os.path.join(src_dir, f))
+            for f in os.listdir(src_dir)
+        )
+        skip_fraction = warm_stats["bytes_skipped_delta"] / max(1, producer_bytes)
+        identical = bool(warm_res.equals(off_res))
+        speedup = off_s / max(warm_s, 1e-9)
+        return {
+            "files": files + 2,
+            "rows": (files + 2) * rows_per_file,
+            "producer_bytes": producer_bytes,
+            "cold_s": round(cold_s, 4),
+            "first_delta_s": round(warm1_s, 4),
+            "warm_s": round(warm_s, 4),
+            "cache_off_s": round(off_s, 4),
+            "speedup_vs_off": round(speedup, 2),
+            "partial_hits": warm_stats["partial_hits"],
+            "delta_partitions": warm_stats["delta_partitions"],
+            "delta_partitions_fresh": warm_stats["delta_partitions_fresh"],
+            "bytes_skipped_delta": warm_stats["bytes_skipped_delta"],
+            "skip_fraction_delta": round(skip_fraction, 4),
+            "bit_identical": identical,
+            "correct": bool(
+                identical
+                and skip_fraction >= 0.95
+                and warm_stats["partial_hits"] >= 1
+                and warm_stats["delta_partitions_fresh"] == 1
+                and warm_stats["delta_partitions"] == files + 1
+                and speedup >= 3.0
+            ),
+        }
+    finally:
+        _shutil.rmtree(src_dir, ignore_errors=True)
+        _shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def _bench_segment_lowering(
     rows: int = 400_000, chunk: int = 16_384, groups: int = 64
 ) -> dict:
@@ -1387,6 +1505,12 @@ def _smoke() -> None:
     # result-cache cold/warm case (ISSUE 5): the warm run must skip >=90%
     # of producer bytes, execute zero producer tasks, and be >=3x faster
     cache_case = _bench_result_cache(rows=150_000, wide_cols=10)
+    # partition-level delta recompute (ISSUE 9): append ONE partition
+    # (~2% here, 1% in the full case) to a loaded directory; the warm run
+    # must skip >=95% of producer bytes via the partition manifest,
+    # recompute only the new partition, stay bit-identical, and be >=3x
+    # faster than the cache-off rerun
+    delta_case = _bench_delta_cache(files=30, rows_per_file=40_000)
     # segment lowering (ISSUE 7): streaming fused-chain → dense aggregate,
     # lowered (one SPMD program per chunk) vs lower_segments=off; must
     # show >=1.3x with ONE segment jit-cache entry for the pipeline
@@ -1409,6 +1533,7 @@ def _smoke() -> None:
         "correct": bool(r["ok"]),
         "plan_pruning": plan_case,
         "result_cache": cache_case,
+        "delta_cache": delta_case,
         "segment_lowering": segment_case,
         "shuffle_join": shuffle_case,
         "wall_s": round(time.perf_counter() - t0, 1),
@@ -1429,6 +1554,8 @@ def _smoke() -> None:
         raise SystemExit(9)
     if not shuffle_case["correct"]:
         raise SystemExit(10)
+    if not delta_case["correct"]:
+        raise SystemExit(11)
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -1716,6 +1843,15 @@ def _telemetry_smoke(out_dir: str) -> None:
             "span/workflow labels missing from exposition"
         )
         assert "fugue_tpu_resource_device_bytes" in final, "no resource gauges"
+        # delta-cache counters (ISSUE 9) flatten through the same
+        # engine.stats()["cache"] path — the exposition must carry them
+        # (and validate_prometheus_text above proves it stays well-formed)
+        for want in (
+            "fugue_tpu_cache_partial_hits",
+            "fugue_tpu_cache_delta_partitions",
+            "fugue_tpu_cache_bytes_skipped_delta",
+        ):
+            assert want in final, f"{want} missing from /metrics exposition"
         with _ur.urlopen(
             f"http://{server.host}:{server.port}/healthz", timeout=5
         ) as resp:
@@ -2000,6 +2136,10 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # result cache (ISSUE 5): cold vs warm across fresh
                     # engines sharing one fugue.tpu.cache.dir
                     "result_cache": _bench_result_cache(),
+                    # partition-level delta recompute (ISSUE 9): append 1%
+                    # of rows as one new partition; the warm run serves
+                    # the rest from the partition manifest
+                    "delta_cache": _bench_delta_cache(),
                     # segment lowering (ISSUE 7): streaming fused chain →
                     # dense aggregate as ONE SPMD program per chunk,
                     # lowered vs fugue.tpu.plan.lower_segments=false

@@ -7,8 +7,18 @@ canonical plan fingerprints (docs/cache.md).
   frames backed by an on-disk parquet artifact store.
 - :mod:`~fugue_tpu.cache.planner` — cuts the DAG at the deepest cached
   frontier so upstream producers are never executed.
+- :mod:`~fugue_tpu.cache.delta` — partition-level incremental recompute:
+  a warm run over a GROWN Load source recomputes only the new partitions
+  and merges with the cached result / partial accumulator.
 """
 
+from .delta import (
+    DeltaHit,
+    DeltaTemplate,
+    build_delta_templates,
+    execute_delta,
+    match_manifest,
+)
 from .fingerprint import (
     FP_VERSION,
     FingerprintReport,
@@ -39,4 +49,9 @@ __all__ = [
     "ResultCache",
     "clean_cache_dir",
     "estimate_df_bytes",
+    "DeltaHit",
+    "DeltaTemplate",
+    "build_delta_templates",
+    "match_manifest",
+    "execute_delta",
 ]

@@ -50,7 +50,9 @@ __all__ = [
 
 # bump to invalidate every existing cache entry on a semantic change to
 # the engine or the fingerprint algorithm itself
-FP_VERSION = "fugue-tpu-cache-v1"
+# v2: fused/segment tasks classify under their own kinds (K_FUSED /
+# K_SEGMENT instead of opaque) so delta keys can see chain structure
+FP_VERSION = "fugue-tpu-cache-v2"
 
 _NON_DETERMINISTIC_ATTR = "__fugue_non_deterministic__"
 
@@ -83,9 +85,18 @@ class FingerprintReport:
         self.fps: Dict[int, Optional[str]] = {}
         self.reasons: Dict[int, str] = {}
         self.source_bytes: Dict[int, int] = {}
+        # delta keys: the same recursive hash with every Load's per-file
+        # list replaced by its PATH — the identity of "this chain over
+        # this source, whatever files it currently holds". Keys the
+        # partition manifests of fugue_tpu/cache/delta.py; None wherever
+        # the full fingerprint refused.
+        self.delta_fps: Dict[int, Optional[str]] = {}
 
     def fp(self, task: FugueTask) -> Optional[str]:
         return self.fps.get(id(task))
+
+    def delta_fp(self, task: FugueTask) -> Optional[str]:
+        return self.delta_fps.get(id(task))
 
 
 def fingerprint_tasks(
@@ -134,17 +145,21 @@ def fingerprint_tasks(
             rep.fps[id(task)] = None
             rep.reasons[id(task)] = "poisoned by unfingerprintable input"
             continue
+        in_delta = [rep.delta_fps.get(id(d)) for d in task.inputs]
         try:
-            rep.fps[id(task)] = _task_fp(
+            fp, dfp = _task_fp(
                 task,
                 node.kind,
                 in_fps,  # type: ignore[arg-type]
+                None if any(f is None for f in in_delta) else in_delta,
                 schemas.get(id(node)),
                 salt,
                 engine_kind,
                 max_bytes,
                 rep,
             )
+            rep.fps[id(task)] = fp
+            rep.delta_fps[id(task)] = dfp
         except _Refused as r:
             rep.fps[id(task)] = None
             rep.reasons[id(task)] = r.reason
@@ -163,12 +178,18 @@ def _task_fp(
     task: FugueTask,
     kind: str,
     in_fps: List[str],
+    in_delta_fps: Optional[List[str]],
     schema_names: Optional[List[str]],
     salt: str,
     engine_kind: str,
     max_bytes: int,
     rep: FingerprintReport,
-) -> str:
+) -> "Any":
+    """(full fingerprint, delta key or None). The delta key differs from
+    the full fingerprint in exactly one way: every Load source hashes by
+    its PATH instead of its per-file (path, size, mtime) list, and inputs
+    chain delta keys instead of full fingerprints — so a grown directory
+    keeps its delta key while its full fingerprint changes."""
     from ..extensions._builtins import creators as bc
     from ..plan.ir import K_SAMPLE
     from ..plan.passes import _PrunedCreator
@@ -185,7 +206,7 @@ def _task_fp(
         type(ext), _NON_DETERMINISTIC_ATTR, False
     ):
         raise _Refused("extension marked non-deterministic")
-    parts: List[Any] = [
+    common: List[Any] = [
         FP_VERSION,
         engine_kind,
         salt,
@@ -193,21 +214,33 @@ def _task_fp(
         kind,
         wrapper_cols,
         task.partition_spec,
-        in_fps,
         schema_names,
         _extension_fp(ext),
     ]
+    parts: List[Any] = list(common) + [in_fps]
+    delta_parts: Optional[List[Any]] = (
+        None if in_delta_fps is None else list(common) + [in_delta_fps]
+    )
+
+    def both(token: Any) -> None:
+        parts.append(token)
+        if delta_parts is not None:
+            delta_parts.append(token)
+
     if isinstance(task, CreateTask):
         if isinstance(ext, bc.Load):
             parts.append(_load_fp(task, rep))
-            # non-source params (fmt/columns/kwargs) still matter
-            parts.append(_params_fp(task, max_bytes, skip=("path",)))
+            if delta_parts is not None:
+                delta_parts.append(
+                    ("delta-source", task.params.get_or_none("path", object))
+                )
+            both(_params_fp(task, max_bytes, skip=("path",)))
         elif isinstance(ext, bc.CreateData):
             data = task.params.get_or_none("data", object)
             digest, nbytes = _data_fp(data, max_bytes)
             rep.source_bytes[id(task)] = nbytes
-            parts.append(digest)
-            parts.append(_params_fp(task, max_bytes, skip=("data",)))
+            both(digest)
+            both(_params_fp(task, max_bytes, skip=("data",)))
         else:
             # arbitrary creators read the OUTSIDE WORLD (files, services,
             # RNGs) — Load and CreateData are the content-addressable
@@ -219,7 +252,7 @@ def _task_fp(
     elif kind == K_SAMPLE:
         if task.params.get_or_none("seed", int) is None:
             raise _Refused("sample without an explicit seed")
-        parts.append(_params_fp(task, max_bytes))
+        both(_params_fp(task, max_bytes))
     else:
         from ..extensions._builtins import processors as bp
 
@@ -228,15 +261,18 @@ def _task_fp(
         if isinstance(ext, bp.RunTransformer):
             if task.params.get_or_none("callback", object) is not None:
                 raise _Refused("transformer uses an RPC callback")
-            parts.append(_udf_fp(task.params.get_or_throw("transformer", object)))
-            parts.append(
-                _params_fp(task, max_bytes, skip=("transformer", "callback"))
-            )
+            both(_udf_fp(task.params.get_or_throw("transformer", object)))
+            both(_params_fp(task, max_bytes, skip=("transformer", "callback")))
         else:
-            parts.append(_params_fp(task, max_bytes))
+            both(_params_fp(task, max_bytes))
     h = md5()
     _feed_safe(h, parts, max_bytes)
-    return h.hexdigest()
+    dfp: Optional[str] = None
+    if delta_parts is not None:
+        dh = md5()
+        _feed_safe(dh, ["delta"] + delta_parts, max_bytes)
+        dfp = dh.hexdigest()
+    return h.hexdigest(), dfp
 
 
 def _extension_fp(ext: Any) -> str:

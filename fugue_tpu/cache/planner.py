@@ -37,6 +37,11 @@ class CachePlan:
         self.skipped: Set[int] = set()
         self.executes: Set[int] = set()
         self.bytes_skipped = 0
+        # partition-level delta recompute (fugue_tpu/cache/delta.py):
+        # tasks served as cached-partitions + fresh-partitions merges
+        self.delta_hits: Dict[int, Any] = {}  # id(task) -> DeltaHit
+        self.delta_templates: Dict[int, Any] = {}
+        self.delta_reasons: Dict[int, str] = {}
 
     def fp(self, task: FugueTask) -> Optional[str]:
         return self.fpr.fp(task)
@@ -48,6 +53,13 @@ class CachePlan:
             "skipped": len(self.skipped),
             "executes": len(self.executes),
             "bytes_skipped": self.bytes_skipped,
+            "delta_hits": len(self.delta_hits),
+            "delta_partitions": sum(
+                h.matched_parts for h in self.delta_hits.values()
+            ),
+            "bytes_skipped_delta": sum(
+                h.bytes_matched for h in self.delta_hits.values()
+            ),
         }
 
 
@@ -120,6 +132,7 @@ def plan_cache(
     ``cache.lookup`` span per frontier decision (hit or miss) so a warm
     run's trace shows exactly where the plan was cut."""
     from ..obs import get_tracer
+    from .delta import _DeltaRefused, build_delta_templates, match_manifest
     from .fingerprint import fingerprint_tasks
 
     fpr = fingerprint_tasks(tasks, engine.conf, type(engine).__name__)
@@ -127,12 +140,35 @@ def plan_cache(
     tracer = get_tracer()
     blacklist: Set[str] = set()
     looked_up: Set[int] = set()
+    delta_on = cache.enabled and cache.delta_enabled
+    if delta_on:
+        plan.delta_templates, plan.delta_reasons = build_delta_templates(
+            tasks, fpr
+        )
+    delta_offers: Dict[int, Any] = {}
+    delta_blacklist: Set[int] = set()
 
     def available(task: FugueTask) -> Optional[str]:
         fp = fpr.fp(task)
         if fp is None or fp in blacklist:
             return None
-        return cache.contains(fp)
+        tier = cache.contains(fp)
+        if tier is not None:
+            return tier
+        if id(task) in delta_offers:
+            return "delta"
+        if delta_on and id(task) not in delta_blacklist:
+            tpl = plan.delta_templates.get(id(task))
+            if tpl is not None:
+                try:
+                    delta_offers[id(task)] = match_manifest(tpl, cache)
+                    return "delta"
+                except _DeltaRefused as r:
+                    plan.delta_reasons[id(task)] = r.reason
+                    delta_blacklist.add(id(task))
+                    if r.had_manifest:
+                        cache.stats.inc("delta_refusals")
+        return None
 
     # the eager-load loop: a frontier load that fails (eviction race,
     # torn artifact) blacklists that fingerprint and recomputes the cut
@@ -140,7 +176,47 @@ def plan_cache(
         cut = _compute_cut(tasks, available, checkpoint_path)
         retry = False
         for t in tasks:
-            if id(t) not in cut["hits"] or id(t) in plan.hits:
+            if id(t) not in cut["hits"]:
+                continue
+            if cut["hits"][id(t)] == "delta":
+                if id(t) in plan.delta_hits:
+                    continue
+                hit = delta_offers[id(t)]
+                looked_up.add(id(t))
+                with tracer.span(
+                    "cache.lookup",
+                    cat="cache",
+                    task=t.name or type(t.extension).__name__,
+                    fp=(fpr.fp(t) or "")[:12],
+                ) as sp:
+                    frames = []
+                    for afp in hit.artifact_fps:
+                        loaded = cache.lookup(afp, engine)
+                        if loaded is None:
+                            break
+                        frames.append(loaded[0])
+                    if len(frames) != len(hit.artifact_fps):
+                        # an artifact evaporated under us: this manifest is
+                        # stale — invalidate it alone and recut without it
+                        cache.drop_manifest(hit.template.delta_key)
+                        delta_offers.pop(id(t), None)
+                        delta_blacklist.add(id(t))
+                        plan.delta_reasons[id(t)] = (
+                            "cached partition artifact evicted (manifest "
+                            "entry invalidated)"
+                        )
+                        sp.set(outcome="delta_miss")
+                        retry = True
+                        break
+                    hit.cached_frames = frames
+                    plan.delta_hits[id(t)] = hit
+                    sp.set(
+                        outcome="delta",
+                        partitions=f"{hit.matched_parts}/{hit.total_parts}",
+                        bytes_skipped=hit.bytes_matched,
+                    )
+                continue
+            if id(t) in plan.hits:
                 continue
             fp = fpr.fp(t)
             looked_up.add(id(t))
@@ -167,9 +243,20 @@ def plan_cache(
     # is still valid and stays — it feeds the consumer directly)
     plan.checkpoint_hits = cut["cp_hits"]
     plan.executes = cut["executes"]
+    # a delta hit that a recut no longer uses must not keep its frames
+    plan.delta_hits = {
+        i: h for i, h in plan.delta_hits.items() if cut["hits"].get(i) == "delta"
+    }
+    for i in plan.delta_hits:
+        plan.hit_tier[i] = "delta"
     for t in cut["skipped"]:
         plan.skipped.add(id(t))
         plan.bytes_skipped += fpr.source_bytes.get(id(t), 0)
+    # the Load under a delta hit is "skipped" but its NEW partitions are
+    # re-read — count only the bytes the delta actually avoids
+    for h in plan.delta_hits.values():
+        if id(h.template.load_task) in plan.skipped:
+            plan.bytes_skipped = max(0, plan.bytes_skipped - h.bytes_fresh)
     # misses among tasks that will execute but were fingerprintable:
     # count them so hit-rate math works without a lookup side effect
     for t in tasks:
@@ -182,6 +269,13 @@ def plan_cache(
             cache.stats.inc("lookups")
         if fpr.fp(t) is None and not isinstance(t, OutputTask):
             cache.stats.inc("refusals")
+    for h in plan.delta_hits.values():
+        cache.stats.inc("partial_hits")
+        cache.stats.inc("delta_partitions", h.matched_parts)
+        cache.stats.inc(
+            "delta_partitions_fresh", max(1, len(h.new_files))
+        )
+        cache.stats.inc("bytes_skipped_delta", h.bytes_matched)
     cache.stats.inc("tasks_skipped", len(plan.skipped))
     cache.stats.inc("bytes_skipped", plan.bytes_skipped)
     return plan
@@ -211,10 +305,33 @@ def describe_cache(
     if cache is None:
         cache = ResultCache(conf)
     fpr = fingerprint_tasks(tasks, conf, engine_kind)
+    from .delta import _DeltaRefused, build_delta_templates, match_manifest
+
+    delta_on = cache.enabled and cache.delta_enabled
+    templates: Dict[int, Any] = {}
+    delta_reasons: Dict[int, str] = {}
+    if delta_on:
+        templates, delta_reasons = build_delta_templates(tasks, fpr)
+    delta_offers: Dict[int, Any] = {}
 
     def available(task: FugueTask) -> Optional[str]:
         fp = fpr.fp(task)
-        return None if fp is None else cache.contains(fp)
+        if fp is None:
+            return None
+        tier = cache.contains(fp)
+        if tier is not None:
+            return tier
+        if id(task) in delta_offers:
+            return "delta"
+        tpl = templates.get(id(task))
+        if tpl is not None and id(task) not in delta_reasons:
+            try:
+                # dry run: probe only, never repair/delete stale manifests
+                delta_offers[id(task)] = match_manifest(tpl, cache, repair=False)
+                return "delta"
+            except _DeltaRefused as r:
+                delta_reasons[id(task)] = r.reason
+        return None
 
     cut = _compute_cut(tasks, available, checkpoint_path)
     skipped_ids = {id(t) for t in cut["skipped"]}
@@ -228,7 +345,15 @@ def describe_cache(
     for i, t in enumerate(tasks):
         fp = fpr.fp(t)
         if id(t) in cut["hits"]:
-            status = f"HIT[{cut['hits'][id(t)]}] {fp[:12]}"
+            if cut["hits"][id(t)] == "delta":
+                h = delta_offers[id(t)]
+                status = (
+                    f"DELTA[{h.matched_parts}/{h.total_parts} partitions] "
+                    f"{h.template.delta_key[:12]} (~{h.bytes_matched} source "
+                    "bytes served from cache; only new partitions recompute)"
+                )
+            else:
+                status = f"HIT[{cut['hits'][id(t)]}] {fp[:12]}"
         elif id(t) in cut["cp_hits"]:
             status = "checkpoint replay"
         elif id(t) in skipped_ids:
@@ -237,5 +362,8 @@ def describe_cache(
             status = "uncacheable: " + fpr.reasons.get(id(t), "?")
         else:
             status = f"miss {fp[:12]}"
+            why = delta_reasons.get(id(t))
+            if why is not None and delta_on:
+                status += f" (delta: {why})"
         lines.append(f"  t{i}: {type(t.extension).__name__} -- {status}")
     return lines

@@ -57,6 +57,15 @@ _COUNTERS = (
     "bytes_published",
     "bytes_skipped",
     "tasks_skipped",
+    # partition-level delta recompute (docs/cache.md "Incremental
+    # recompute"): a partial hit serves the cached part of a grown source
+    # and recomputes only the delta partitions
+    "partial_hits",
+    "delta_partitions",
+    "delta_partitions_fresh",
+    "bytes_skipped_delta",
+    "delta_refusals",
+    "manifest_publishes",
 )
 
 
@@ -150,12 +159,17 @@ class MemoryLRU:
 class ArtifactStore:
     """Content-addressed parquet artifacts under ``<dir>/objs``."""
 
-    def __init__(self, path: str, cap_bytes: int, log: Any = None):
+    def __init__(
+        self, path: str, cap_bytes: int, log: Any = None, cap_entries: int = 0
+    ):
         self.root = path
         self.objs = os.path.join(path, "objs")
+        self.manifests = os.path.join(path, "manifests")
         self.cap = int(cap_bytes)
+        self.cap_entries = int(cap_entries)
         self._log = log
         os.makedirs(self.objs, exist_ok=True)
+        os.makedirs(self.manifests, exist_ok=True)
 
     # -- paths ---------------------------------------------------------------
     def _obj(self, fp: str) -> str:
@@ -166,6 +180,34 @@ class ArtifactStore:
 
     def _ref(self, fp: str) -> str:
         return os.path.join(self.objs, fp + ".ref.json")
+
+    def _manifest(self, key: str) -> str:
+        return os.path.join(self.manifests, key + ".manifest.json")
+
+    # -- delta manifests -----------------------------------------------------
+    def load_manifest(self, key: str) -> Optional[Dict[str, Any]]:
+        """The partition manifest published under a delta key, or None. A
+        torn/corrupt manifest is deleted and reads as absent (a delta miss
+        degrades to whole-task recompute, never a wrong hit)."""
+        path = self._manifest(key)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:
+            _best_effort_remove(path)
+            return None
+
+    def publish_manifest(self, key: str, payload: Dict[str, Any]) -> None:
+        """Atomic last-writer-wins: two processes publishing the manifest
+        of the same grown source write identical content by construction
+        (segment artifacts are content-addressed), so either winner is
+        complete and correct."""
+        self._write_json(self._manifest(key), payload)
+
+    def remove_manifest(self, key: str) -> None:
+        _best_effort_remove(self._manifest(key))
 
     # -- read side -----------------------------------------------------------
     def exists(self, fp: str) -> bool:
@@ -256,9 +298,14 @@ class ArtifactStore:
 
     # -- eviction ------------------------------------------------------------
     def evict_to_cap(self) -> int:
-        """Drop least-recently-used artifacts until under the size cap.
-        Raced deletions are fine: the loser's remove is a no-op."""
-        if self.cap <= 0:
+        """Drop least-recently-used artifacts until under BOTH the size
+        cap and the entry-count cap (per-partition delta artifacts
+        multiply small files, so bytes alone don't bound inode pressure).
+        Raced deletions are fine: the loser's remove is a no-op. Manifests
+        referencing an evicted artifact are invalidated LAZILY — the next
+        delta match sees the missing artifact, deletes the stale manifest
+        and degrades that one chain to whole-task recompute."""
+        if self.cap <= 0 and self.cap_entries <= 0:
             return 0
         entries: List[Tuple[float, int, str]] = []
         total = 0
@@ -277,18 +324,24 @@ class ArtifactStore:
             entries.append((st.st_mtime, int(st.st_size), p[: -len(".parquet")]))
             total += int(st.st_size)
         evicted = 0
+        count = len(entries)
         for _mt, size, base in sorted(entries):
-            if total <= self.cap:
+            over_bytes = self.cap > 0 and total > self.cap
+            over_count = self.cap_entries > 0 and count > self.cap_entries
+            if not (over_bytes or over_count):
                 break
             _best_effort_remove(base + ".parquet")
             _best_effort_remove(base + ".meta.json")
             total -= size
+            count -= 1
             evicted += 1
         return evicted
 
     def clear(self) -> None:
         shutil.rmtree(self.objs, ignore_errors=True)
+        shutil.rmtree(self.manifests, ignore_errors=True)
         os.makedirs(self.objs, exist_ok=True)
+        os.makedirs(self.manifests, exist_ok=True)
 
 
 class ResultCache:
@@ -296,8 +349,10 @@ class ResultCache:
 
     def __init__(self, conf: Any, log: Any = None):
         from ..constants import (
+            FUGUE_TPU_CONF_CACHE_DELTA_ENABLED,
             FUGUE_TPU_CONF_CACHE_DIR,
             FUGUE_TPU_CONF_CACHE_DISK_BYTES,
+            FUGUE_TPU_CONF_CACHE_DISK_MAX_ENTRIES,
             FUGUE_TPU_CONF_CACHE_ENABLED,
             FUGUE_TPU_CONF_CACHE_MAX_ARTIFACT_BYTES,
             FUGUE_TPU_CONF_CACHE_MEM_BYTES,
@@ -311,19 +366,25 @@ class ResultCache:
 
         self._log = log
         self.enabled = bool(_get(FUGUE_TPU_CONF_CACHE_ENABLED, True))
+        self.delta_enabled = bool(_get(FUGUE_TPU_CONF_CACHE_DELTA_ENABLED, True))
         self.max_artifact_bytes = int(
             _get(FUGUE_TPU_CONF_CACHE_MAX_ARTIFACT_BYTES, 256 * 1024 * 1024)
         )
         self.mem = MemoryLRU(int(_get(FUGUE_TPU_CONF_CACHE_MEM_BYTES, 256 * 1024 * 1024)))
         self.stats = CacheStats(self)
         self.disk: Optional[ArtifactStore] = None
+        # in-process manifest tier: delta recompute works memory-only too
+        # (same-engine warm runs); the disk copy is the cross-process one
+        self._manifest_lock = threading.Lock()
+        self._mem_manifests: Dict[str, Dict[str, Any]] = {}
         cache_dir = str(
             _get(FUGUE_TPU_CONF_CACHE_DIR, "") or os.environ.get("FUGUE_TPU_CACHE_DIR", "")
         )
         if self.enabled and cache_dir:
             cap = int(_get(FUGUE_TPU_CONF_CACHE_DISK_BYTES, 4 * 1024 * 1024 * 1024))
+            cap_entries = int(_get(FUGUE_TPU_CONF_CACHE_DISK_MAX_ENTRIES, 65536))
             try:
-                store = ArtifactStore(cache_dir, cap, log=log)
+                store = ArtifactStore(cache_dir, cap, log=log, cap_entries=cap_entries)
                 probe = os.path.join(store.objs, f".probe_{_uuid.uuid4().hex}")
                 with open(probe, "w") as f:
                     f.write("ok")
@@ -413,8 +474,47 @@ class ResultCache:
                 )
         return out
 
+    # -- delta manifests -----------------------------------------------------
+    def get_manifest(self, key: str) -> Optional[Dict[str, Any]]:
+        """Freshest manifest for a delta key: the in-process copy when this
+        engine published it, else the shared disk copy."""
+        if not self.enabled or not self.delta_enabled:
+            return None
+        with self._manifest_lock:
+            m = self._mem_manifests.get(key)
+        if m is not None:
+            return m
+        if self.disk is not None:
+            return self.disk.load_manifest(key)
+        return None
+
+    def put_manifest(self, key: str, payload: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        with self._manifest_lock:
+            self._mem_manifests[key] = payload
+        if self.disk is not None:
+            try:
+                self.disk.publish_manifest(key, payload)
+            except Exception as ex:  # publishing must never fail the run
+                if self._log is not None:
+                    self._log.warning(
+                        "delta manifest publish of %s failed: %s", key[:12], ex
+                    )
+        self.stats.inc("manifest_publishes")
+
+    def drop_manifest(self, key: str) -> None:
+        """A stale manifest (evicted/changed artifacts) invalidates ONLY
+        itself — the rest of the cache stays serviceable."""
+        with self._manifest_lock:
+            self._mem_manifests.pop(key, None)
+        if self.disk is not None:
+            self.disk.remove_manifest(key)
+
     def clear(self) -> None:
         self.mem.clear()
+        with self._manifest_lock:
+            self._mem_manifests.clear()
         if self.disk is not None:
             self.disk.clear()
 
@@ -472,4 +572,8 @@ def clean_cache_dir(path: str) -> str:
         return f"{path} holds no result-cache artifacts; nothing cleaned"
     n = len([f for f in os.listdir(objs) if not f.startswith(".")])
     shutil.rmtree(objs, ignore_errors=True)
+    manifests = os.path.join(path, "manifests")
+    if os.path.isdir(manifests):
+        n += len([f for f in os.listdir(manifests) if not f.startswith(".")])
+        shutil.rmtree(manifests, ignore_errors=True)
     return f"removed {n} artifact file(s) from {objs}"

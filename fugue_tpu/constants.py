@@ -169,6 +169,15 @@ FUGUE_TPU_CONF_CACHE_FINGERPRINT_MAX_BYTES = "fugue.tpu.cache.fingerprint_max_by
 # free-form namespace mixed into every fingerprint: bump it to invalidate
 # all entries without deleting files
 FUGUE_TPU_CONF_CACHE_SALT = "fugue.tpu.cache.salt"
+# partition-level incremental recompute (docs/cache.md "Incremental
+# recompute"): a warm run over a GROWN Load source recomputes only the new
+# partitions and merges with the cached result/partial accumulator.
+# Default ON; =false restores the all-or-nothing whole-task cache.
+FUGUE_TPU_CONF_CACHE_DELTA_ENABLED = "fugue.tpu.cache.delta.enabled"
+# artifact-COUNT cap of the on-disk store (per-partition delta artifacts
+# multiply small files; bytes alone don't bound inode pressure). mtime-LRU
+# evicted past it, alongside the disk_bytes cap. 0 = unlimited.
+FUGUE_TPU_CONF_CACHE_DISK_MAX_ENTRIES = "fugue.tpu.cache.disk_max_entries"
 
 # out-of-core hash shuffle (fugue_tpu/shuffle, docs/shuffle.md): spill
 # key-hash buckets to disk, then join/repartition bucket-at-a-time so

@@ -68,6 +68,27 @@ FUSABLE_KINDS = {K_PROJECT, K_DROP, K_RENAME, K_FILTER, K_SELECT, K_ASSIGN}
 # that consumes the fused row-local chain inside ONE compiled program
 SEGMENT_TERMINAL_KINDS = {K_AGGREGATE, K_TAKE, K_DISTINCT, K_JOIN}
 
+# kinds whose output rows each depend on exactly ONE input row — the
+# precondition for partition-level delta recompute (fugue_tpu/cache/delta):
+# f(old ++ new) == f(old) ++ f(new). dropna/fillna are row-local but not
+# fusable (they have no per-chunk step form); distinct/take/sample are NOT
+# (row identity / position spans partitions).
+DELTA_ROW_LOCAL_KINDS = FUSABLE_KINDS | {K_DROPNA, K_FILLNA, K_FUSED}
+
+
+def node_delta_row_local(n: "LNode") -> bool:
+    """Whether this node provably computes each output row from one input
+    row (delta recompute may split its input at any partition boundary).
+    Mirrors the fusion pass's K_SELECT guard: an aggregating / distinct /
+    HAVING select reads the whole frame."""
+    if n.kind not in DELTA_ROW_LOCAL_KINDS:
+        return False
+    if n.kind == K_SELECT:
+        sc = n.info["columns"]
+        if sc.has_agg or sc.is_distinct or n.info.get("having") is not None:
+            return False
+    return True
+
 
 class LNode:
     """One logical node. ``task`` is the originating FugueTask (None for
@@ -200,6 +221,25 @@ def classify(task: FugueTask) -> LNode:
     ext = task.extension
     if isinstance(task, OutputTask):
         return LNode(task, K_OUTPUT)
+    # synthesized optimizer tasks (a post-optimization list may be
+    # re-classified by the cache fingerprint/delta layer): recover their
+    # logical kind from the carried params instead of falling to opaque
+    from .fused import FusedVerbs
+    from .lowering import LoweredSegment
+
+    if isinstance(ext, FusedVerbs):
+        return LNode(
+            task, K_FUSED, {"steps": list(task.params.get("steps", []))}
+        )
+    if isinstance(ext, LoweredSegment):
+        return LNode(
+            task,
+            K_SEGMENT,
+            {
+                "steps": list(task.params.get("steps", [])),
+                "terminal": tuple(task.params.get_or_throw("terminal", object)),
+            },
+        )
     if isinstance(task, CreateTask):
         if isinstance(ext, bc.CreateData):
             data = task.params.get_or_none("data", object)

@@ -37,7 +37,13 @@ from .ir import (
 from .lowering import lower_segments
 from .passes import emit, fuse_verbs, prune_columns, pushdown_filters
 
-__all__ = ["PlanReport", "PlanStats", "optimize_tasks", "explain_tasks"]
+__all__ = [
+    "PlanReport",
+    "PlanStats",
+    "optimize_tasks",
+    "explain_tasks",
+    "annotate_delta_eligibility",
+]
 
 
 class PlanStats:
@@ -290,6 +296,62 @@ def annotate_join_strategies(
         )
 
 
+def annotate_delta_eligibility(nodes: List[LNode], report: "PlanReport") -> None:
+    """Mark every verb the partition-level delta cache
+    (``fugue_tpu/cache/delta.py``) can serve incrementally: row-local
+    verbs split at any partition boundary; sum/count/avg/min/max
+    aggregates maintain a partial accumulator. Everything unmarked routes
+    through the PR 5 all-or-nothing path — ``workflow.explain()``'s cache
+    section shows the per-task refusal reason."""
+    from .ir import node_delta_row_local
+
+    marked = 0
+    for n in nodes:
+        try:
+            if n.kind == K_LOAD:
+                n.annotations.append("delta:source")
+            elif node_delta_row_local(n):
+                n.annotations.append("delta:row-local")
+            elif n.kind in ("aggregate", "segment"):
+                from ..cache.delta import _DeltaRefused, parse_agg_spec
+
+                # a segment synthesized THIS pass keeps its terminal/task
+                # on node attributes; a re-classified segment task carries
+                # them in info/params
+                origin = n.task if n.task is not None else n.tail_origin
+                if n.kind == "segment":
+                    terminal = n.info.get("terminal") or n.terminal or ("?",)
+                    if terminal[0] != "aggregate":
+                        continue
+                    cols = list(terminal[1])
+                else:
+                    cols = list(
+                        origin.params.get("columns", [])
+                        if origin is not None
+                        else []
+                    )
+                keys = (
+                    list(origin.partition_spec.partition_by)
+                    if origin is not None
+                    else []
+                )
+                try:
+                    parse_agg_spec(keys, cols)
+                except _DeltaRefused:
+                    continue
+                n.annotations.append("delta:accumulator")
+            else:
+                continue
+            marked += 1
+        except Exception:  # annotation must never fail planning
+            continue
+    if marked:
+        report.note(
+            "%d verb(s) delta-eligible (partition-level incremental "
+            "recompute, docs/cache.md)" % marked
+        )
+
+
 def optimize_tasks(
     tasks: List[FugueTask], conf: Any, stats: Optional[PlanStats] = None
 ) -> Tuple[List[FugueTask], Dict[int, FugueTask], Set[int], PlanReport]:
@@ -313,6 +375,7 @@ def optimize_tasks(
         fuse_verbs(nodes, report)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS, True):
         lower_segments(nodes, report)
+    annotate_delta_eligibility(nodes, report)
     report.after = _render_nodes(nodes)
     if not report.changed:
         return tasks, {}, set(), report

@@ -265,6 +265,28 @@ class FugueWorkflowContext:
                 result = task.set_result(self, plan.hits[id(task)])
                 self._results[id(task)] = result
             return
+        if plan is not None and id(task) in plan.delta_hits:
+            # partition-level delta recompute (fugue_tpu/cache/delta.py):
+            # cached partitions were eager-loaded at plan time; only the
+            # NEW partitions stream through the chain here, then merge
+            from ..cache.delta import execute_delta
+
+            hit = plan.delta_hits[id(task)]
+            with get_tracer().span(
+                "task.delta_recompute",
+                cat="cache",
+                task_uuid=tid,
+                partitions=f"{hit.matched_parts}/{hit.total_parts}",
+                bytes_skipped=hit.bytes_matched,
+            ):
+                df = execute_delta(self, task, hit)
+                result = task.set_result(self, df)
+                self._results[id(task)] = result
+            # publishes the MERGED result under the new full fingerprint
+            # (a later exact-match run takes the whole-task fast path) and
+            # appends the fresh segment / partial to the manifest
+            self._maybe_cache_publish(task, result, delta_hit=hit)
+            return
         inputs = [self._results[id(d)] for d in task.inputs]
         self._injector.fire(SITE_TASK_EXECUTE)
         result = task.execute(self, inputs)
@@ -280,13 +302,22 @@ class FugueWorkflowContext:
                 # materialization so every consumer sees all rows
                 result = result.as_local_bounded()
             self._results[id(task)] = result
-            self._maybe_cache_publish(task, result)
+            self._maybe_cache_publish(task, result, inputs=inputs)
 
-    def _maybe_cache_publish(self, task: FugueTask, result: DataFrame) -> None:
+    def _maybe_cache_publish(
+        self,
+        task: FugueTask,
+        result: DataFrame,
+        inputs: Optional[List[DataFrame]] = None,
+        delta_hit: Any = None,
+    ) -> None:
         """Publish a finished (bounded) result under its plan fingerprint.
         A permanent StrongCheckpoint file is indexed by reference instead
         of re-written — the cache never holds a second copy of an artifact
-        the checkpoint publisher already owns."""
+        the checkpoint publisher already owns. Delta-eligible tasks
+        (``fugue_tpu/cache/delta.py``) additionally maintain their source
+        partition manifest so the NEXT grown-source run recomputes only
+        its delta."""
         plan = getattr(self, "_cache_plan", None)
         if plan is None:
             return
@@ -318,3 +349,6 @@ class FugueWorkflowContext:
                 fp, result, self._engine, str(result.schema), ref_path=ref
             )
             sp.set(**info)
+        from ..cache.delta import publish_manifest_after
+
+        publish_manifest_after(self, task, result, inputs=inputs, hit=delta_hit)
