@@ -691,6 +691,84 @@ def test_concurrent_two_process_publish_race(tmp_path):
             assert cache.disk.load(f[: -len(".parquet")], eng) is not None
 
 
+def _delta_race_worker(args):
+    import os
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_CACHE_DIR
+    from fugue_tpu.execution import NativeExecutionEngine
+
+    d, src = args
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_CACHE_DIR: d})
+    dag = FugueWorkflow()
+    (
+        dag.load(src, fmt="parquet")
+        .filter(col("v") > 10)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"), ff.avg(col("v")).alias("m"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    st = eng.stats()["cache"]
+    return (
+        dag.yields["r"].result.as_pandas().values.tolist(),
+        st["partial_hits"],
+    )
+
+
+def test_concurrent_two_process_append_race(tmp_path):
+    """ISSUE 9 satellite: two engines warm-run the SAME grown directory
+    concurrently. Both must succeed via the atomic publish (the fresh
+    delta artifacts are content-addressed, so both processes compute the
+    same ids and the rename dedupes), results are identical, and the
+    store ends with exactly one artifact per fingerprint — no torn or
+    duplicate files."""
+    import multiprocessing as mp
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = str(tmp_path / "cache")
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+
+    def write_part(i):
+        rng = np.random.default_rng(100 + i)
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 8, 1500).astype("int64"),
+                    "v": rng.integers(0, 100, 1500).astype("float64"),
+                }
+            ),
+            os.path.join(src, f"p_{i:02d}.parquet"),
+        )
+
+    for i in range(3):
+        write_part(i)
+    cold, _ = _delta_race_worker((d, src))  # publishes the manifest
+    write_part(3)  # grow
+    ctx = mp.get_context("fork")
+    with ctx.Pool(2) as pool:
+        outs = pool.map(_delta_race_worker, [(d, src), (d, src)])
+    (r1, ph1), (r2, ph2) = outs
+    assert r1 == r2
+    assert ph1 >= 1 and ph2 >= 1  # both actually took the delta path
+    # one artifact per fingerprint, every one complete, no temp leftovers
+    objs = os.listdir(os.path.join(d, "objs"))
+    assert not any("__tmp" in f for f in objs)
+    fps = [f[: -len(".parquet")] for f in objs if f.endswith(".parquet")]
+    assert len(fps) == len(set(fps))
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_CACHE_DIR: d})
+    for fp in fps:
+        assert eng.result_cache.disk.load(fp, eng) is not None
+    # a third, exact-match run takes the plain whole-task hit
+    warm, ph3 = _delta_race_worker((d, src))
+    assert warm == r1 and ph3 == 0
+
+
 # ---------------------------------------------------------------------------
 # lifecycle and the disabled path
 # ---------------------------------------------------------------------------
