@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle cache-clean trace-smoke telemetry-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve cache-clean trace-smoke telemetry-smoke serve-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -8,6 +8,7 @@ install:
 test:
 	python -m pytest tests/ -q
 	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
+	-@$(MAKE) --no-print-directory serve-smoke  # serving gate; non-blocking here
 
 # downsized perf gate (≤~30s): device-aggregate worker only, fails when the
 # oracle-normalized groupby_aggregate vs_baseline drops >20% below the
@@ -79,6 +80,22 @@ test-cache:
 # consistency, two-process append races, persist of delta-merged frames
 test-delta:
 	JAX_PLATFORMS=cpu python -m pytest tests/cache/test_delta_cache.py -q -m "not slow"
+
+# multi-tenant serving suite (docs/serving.md): admission queue + tenant
+# budgets + priority aging, plan-fingerprint single-flight (one shared
+# execution, cancel-safe waiters), the /serve/* RPC surface with
+# idempotency keys, /healthz-vs-/readyz split, and the shared-engine
+# concurrency regression hammer (bit-identical results, coherent counters)
+test-serve:
+	JAX_PLATFORMS=cpu python -m pytest tests/serve -q -m "not slow"
+
+# serving load gate (ISSUE 10 acceptance, exit 12): 8 concurrent clients
+# × 4 tenants × mixed workloads (cached hit / broadcast join / streaming
+# aggregate / delta append) through ONE EngineServer — zero failed
+# submissions, dedup_hits >= 1 with shared executions, per-tenant
+# p50/p99 + rows/s, results bit-identical to serial cache-off runs
+serve-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-smoke
 
 # wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
 # (defaults to $FUGUE_TPU_CACHE_DIR)

@@ -1428,6 +1428,300 @@ def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> d
     }
 
 
+def _bench_serve_load(
+    clients: int = 8, rounds: int = 2, rows: int = 48_000, parts: int = 12
+) -> dict:
+    """Multi-tenant serving load driver (ISSUE 10): N concurrent client
+    threads × 4 tenants drive MIXED workloads — a shared cached-hit
+    aggregate, a per-tenant broadcast join, a streaming aggregate
+    (unfingerprintable: always executes), and a delta-append aggregate
+    over a parquet directory that GROWS one partition per round — through
+    ONE long-lived :class:`~fugue_tpu.serve.EngineServer` on one jax
+    engine with the result cache on. Each client pipelines its round's
+    submissions (submit all, then collect all), so identical plans from
+    different sessions land in flight together and the single-flight
+    dedup actually fires.
+
+    The gate (``--serve-smoke``, exit 12): ZERO failed submissions,
+    ``dedup_hits >= 1`` with strictly fewer executions than submissions,
+    per-tenant p50/p99 latency + rows/s reported, and every served
+    result bit-identical to a serial single-client run of the same
+    workload on a fresh cache-off engine."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading as _threading
+
+    import numpy as _np
+    import pandas as _pd
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import FUGUE_TPU_CONF_CACHE_DIR
+    from fugue_tpu.dataframe import (
+        ArrowDataFrame,
+        LocalDataFrameIterableDataFrame,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.serve import EngineServer
+
+    tenants = [f"t{i}" for i in range(4)]
+    cache_dir = _tempfile.mkdtemp(prefix="fugue_bench_serve_cache_")
+    src_dir = _tempfile.mkdtemp(prefix="fugue_bench_serve_src_")
+    delta_dir = _tempfile.mkdtemp(prefix="fugue_bench_serve_delta_")
+    rng = _np.random.default_rng(23)
+    rows_per_part = max(1, rows // parts)
+
+    def write_part(d: str, i: int) -> None:
+        # integer-valued floats: every fold order sums exactly (the
+        # bit-identity oracle of the delta/result-cache cases)
+        _pq.write_table(
+            _pa.table(
+                {
+                    "k": rng.integers(0, 64, rows_per_part).astype("int64"),
+                    "v": rng.integers(0, 1000, rows_per_part).astype("float64"),
+                }
+            ),
+            os.path.join(d, f"part_{i:04d}.parquet"),
+        )
+
+    for i in range(parts):
+        write_part(src_dir, i)
+        write_part(delta_dir, i)
+    delta_parts = [parts]  # grows one partition per round
+
+    join_rows, stream_rows = 24_000, 24_000
+
+    def _agg(node: Any) -> Any:
+        return node.partition_by("k").aggregate(
+            ff.sum(col("v")).alias("s"),
+            ff.count(col("v")).alias("n"),
+            ff.avg(col("v")).alias("m"),
+        )
+
+    def wl_cached() -> FugueWorkflow:
+        dag = FugueWorkflow()
+        _agg(
+            dag.load(src_dir, fmt="parquet").filter(col("v") > 100)
+        ).yield_dataframe_as("r", as_local=True)
+        return dag
+
+    def wl_delta() -> FugueWorkflow:
+        dag = FugueWorkflow()
+        _agg(
+            dag.load(delta_dir, fmt="parquet").filter(col("v") > 100)
+        ).yield_dataframe_as("r", as_local=True)
+        return dag
+
+    def _join_frames(t: int) -> tuple:
+        left = _pd.DataFrame(
+            {
+                "k": _np.arange(join_rows) % 64,
+                "v": ((_np.arange(join_rows) * 13 + t) % 1000).astype("float64"),
+            }
+        )
+        right = _pd.DataFrame(
+            {"k": _np.arange(64), "w": ((_np.arange(64) * 7 + t) % 100).astype("float64")}
+        )
+        return left, right
+
+    def wl_join(t: int) -> FugueWorkflow:
+        left, right = _join_frames(t)
+        dag = FugueWorkflow()
+        joined = dag.df(left).inner_join(dag.df(right))
+        (
+            joined.partition_by("k")
+            .aggregate(
+                ff.sum(col("v")).alias("s"),
+                ff.sum(col("w")).alias("sw"),
+                ff.count(col("v")).alias("n"),
+            )
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    def _stream_table(t: int) -> Any:
+        return _pa.table(
+            {
+                "k": (_np.arange(stream_rows) * 11 + t) % 32,
+                "v": ((_np.arange(stream_rows) * 17 + t) % 1000).astype("float64"),
+            }
+        )
+
+    def wl_stream(t: int) -> FugueWorkflow:
+        tbl = _stream_table(t)
+        step = 8192
+        stream = LocalDataFrameIterableDataFrame(
+            (
+                ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+                for s in range(0, tbl.num_rows, step)
+            ),
+            schema=ArrowDataFrame(tbl).schema,
+        )
+        dag = FugueWorkflow()
+        _agg(dag.df(stream).filter(col("v") > 100)).yield_dataframe_as(
+            "r", as_local=True
+        )
+        return dag
+
+    def _workloads(t: int) -> list:
+        return [
+            ("cached", wl_cached, rows),
+            ("join", lambda: wl_join(t), join_rows),
+            ("stream", lambda: wl_stream(t), stream_rows),
+            ("delta", wl_delta, delta_parts[0] * rows_per_part),
+        ]
+
+    def _serial_oracle(factory: Any) -> _pd.DataFrame:
+        """Serial single-client run: fresh engine, cache OFF."""
+        eng = JaxExecutionEngine({"fugue.tpu.cache.enabled": False})
+        dag = factory()
+        dag.run(eng)
+        return (
+            dag.yields["r"].result.as_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+
+    server_engine = JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_CACHE_DIR: cache_dir,
+            "fugue.tpu.cache.enabled": True,
+            "fugue.tpu.serve.max_concurrent": 2,
+            "fugue.tpu.serve.queue_depth": clients * 8,
+        }
+    )
+    lock = _threading.Lock()
+    records: list = []  # (tenant, workload, latency_s, rows, identical)
+    failures: list = []
+
+    try:
+        with EngineServer(server_engine) as server:
+            for rnd in range(rounds):
+                if rnd > 0:
+                    write_part(delta_dir, delta_parts[0])
+                    delta_parts[0] += 1
+                # serial oracles for this round's source state
+                oracles = {"cached": _serial_oracle(wl_cached), "delta": _serial_oracle(wl_delta)}
+                for ti in range(len(tenants)):
+                    oracles[f"join{ti}"] = _serial_oracle(lambda: wl_join(ti))
+                    oracles[f"stream{ti}"] = _serial_oracle(lambda: wl_stream(ti))
+
+                def client(i: int) -> None:
+                    tenant_i = i % len(tenants)
+                    tenant = tenants[tenant_i]
+                    try:
+                        # pipeline: submit everything, then collect — the
+                        # overlap that makes cross-session dedup real
+                        pending = []
+                        for name, factory, n in _workloads(tenant_i):
+                            t0 = time.perf_counter()
+                            sub = server.submit(factory, tenant=tenant)
+                            pending.append((name, n, t0, sub))
+                        for name, n, t0, sub in pending:
+                            res = sub.result(timeout=600)
+                            dt = time.perf_counter() - t0
+                            okey = (
+                                name
+                                if name in ("cached", "delta")
+                                else f"{name}{tenant_i}"
+                            )
+                            df = (
+                                res.yields["r"].result.as_pandas()
+                                .sort_values("k")
+                                .reset_index(drop=True)
+                            )
+                            identical = bool(df.equals(oracles[okey]))
+                            with lock:
+                                records.append((tenant, name, dt, n, identical))
+                    except Exception as ex:
+                        with lock:
+                            failures.append(f"client{i}: {type(ex).__name__}: {ex}")
+
+                t_round = time.perf_counter()
+                threads = [
+                    _threading.Thread(target=client, args=(i,))
+                    for i in range(clients)
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                if rnd == 0:
+                    cold_round_s = time.perf_counter() - t_round
+                else:
+                    warm_round_s = time.perf_counter() - t_round
+        stats = server.stats()
+    finally:
+        _shutil.rmtree(cache_dir, ignore_errors=True)
+        _shutil.rmtree(src_dir, ignore_errors=True)
+        _shutil.rmtree(delta_dir, ignore_errors=True)
+
+    def _pct(vals: list, q: float) -> float:
+        return float(_np.percentile(_np.array(vals), q)) if vals else 0.0
+
+    per_tenant: dict = {}
+    for tenant in tenants:
+        lats = [r[2] for r in records if r[0] == tenant]
+        rws = sum(r[3] for r in records if r[0] == tenant)
+        wall = sum(lats)
+        per_tenant[tenant] = {
+            "submissions": len(lats),
+            "p50_s": round(_pct(lats, 50), 4),
+            "p99_s": round(_pct(lats, 99), 4),
+            "rows_per_sec": round(rws / max(wall, 1e-9), 1),
+        }
+    all_lats = [r[2] for r in records]
+    total_rows = sum(r[3] for r in records)
+    total_wall = (cold_round_s if rounds == 1 else cold_round_s + warm_round_s)
+    expected = clients * rounds * 4
+    identical_all = bool(records) and all(r[4] for r in records)
+    correct = bool(
+        not failures
+        and len(records) == expected
+        and identical_all
+        and stats["failed"] == 0
+        and stats["dedup_hits"] >= 1
+        and stats["executions"] < stats["submitted"]
+    )
+    return {
+        "metric": "serve_load_rows_per_sec",
+        "value": round(total_rows / max(total_wall, 1e-9), 1),
+        "unit": "rows/s",
+        "clients": clients,
+        "tenants": len(tenants),
+        "rounds": rounds,
+        "submissions": stats["submitted"],
+        "completed_submissions": len(records),
+        "failed_submissions": len(failures) + stats["failed"],
+        "failures": failures[:5],
+        "executions": stats["executions"],
+        "dedup_hits": stats["dedup_hits"],
+        "peak_queue_depth": stats["peak_queue_depth"],
+        "cold_round_s": round(cold_round_s, 3),
+        "warm_round_s": round(warm_round_s, 3) if rounds > 1 else None,
+        "p50_s": round(_pct(all_lats, 50), 4),
+        "p99_s": round(_pct(all_lats, 99), 4),
+        "per_tenant": per_tenant,
+        "bit_identical": identical_all,
+        "correct": correct,
+    }
+
+
+def _serve_smoke() -> None:
+    """``make serve-smoke``: the ISSUE 10 acceptance gate — >= 8
+    concurrent clients × mixed workloads through one EngineServer with
+    zero failed submissions, >= 1 dedup hit with strictly shared
+    executions, per-tenant p50/p99 + rows/s reported, results
+    bit-identical to serial runs. Exit 12 on any violation (the next
+    code after the 9/10/11 segment/shuffle/delta gates)."""
+    case = _bench_serve_load()
+    print(json.dumps({"metric": "serve_smoke", "serve_load": case}))
+    if not case["correct"]:
+        raise SystemExit(12)
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -2148,6 +2442,10 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # >=10x an 8MiB device budget, joined bucket-at-a-time
                     # from on-disk hash buckets under the budget
                     "shuffle_join": _bench_shuffle_join(),
+                    # multi-tenant serving (ISSUE 10): 8 clients × 4
+                    # tenants × mixed workloads through one EngineServer
+                    # with in-flight dedup, per-tenant p50/p99 + rows/s
+                    "serve_load": _bench_serve_load(),
                     # most recent `bench.py --north-star` run (the literal
                     # 1B-row groupby-apply), if one has been captured
                     "north_star_1b": _load_north_star(),
@@ -2239,6 +2537,9 @@ if __name__ == "__main__":
             print("--compare requires a baseline JSON path", file=sys.stderr)
             raise SystemExit(2)
         _compare(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-smoke":
+        with _bench_lock():
+            _serve_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "--telemetry-smoke":
         out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
         with _bench_lock():

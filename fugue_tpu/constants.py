@@ -203,6 +203,36 @@ FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET = "fugue.tpu.shuffle.device_budget_bytes"
 # can trade replication memory against exchange latency per mesh.
 FUGUE_TPU_CONF_JOIN_BROADCAST_MAX_ROWS = "fugue.tpu.join.broadcast_max_rows"
 
+# --- multi-tenant serving layer (fugue_tpu/serve, docs/serving.md) ---
+# concurrent workflow executions one EngineServer runs at a time (its
+# worker-thread pool size); everything past it waits in the admission queue
+FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT = "fugue.tpu.serve.max_concurrent"
+# admission queue capacity: submissions past it are REJECTED (the /readyz
+# readiness endpoint reports "overloaded" with a 503 before that happens,
+# so a load balancer can shed first)
+FUGUE_TPU_CONF_SERVE_QUEUE_DEPTH = "fugue.tpu.serve.queue_depth"
+# priority for submissions that don't name one (lower = sooner; ties FIFO)
+FUGUE_TPU_CONF_SERVE_DEFAULT_PRIORITY = "fugue.tpu.serve.default_priority"
+# starvation guard: a queued execution's effective priority improves by
+# one level per aging_s seconds waited, so FIFO-within-priority can never
+# starve the lowest level under a steady high-priority stream. 0 disables.
+FUGUE_TPU_CONF_SERVE_AGING_S = "fugue.tpu.serve.aging_s"
+# bytes charged against a tenant's budget per admitted submission when
+# the submission doesn't declare its own reserve_bytes (replaced by the
+# measured result bytes once the run finishes — live accounting)
+FUGUE_TPU_CONF_SERVE_RESERVE_BYTES = "fugue.tpu.serve.reserve_bytes"
+# how many completed submissions the server retains for result pickup
+# (oldest evicted past it; their tenant byte charge releases on eviction)
+FUGUE_TPU_CONF_SERVE_RETAIN = "fugue.tpu.serve.retain"
+# per-tenant overlays: fugue.tpu.serve.tenant.<id>.priority (scheduling
+# default), fugue.tpu.serve.tenant.<id>.budget_bytes (admission gate:
+# live charged bytes + the new reserve must stay under it; 0 = unlimited),
+# and fugue.tpu.serve.tenant.<id>.conf.<key> (per-run conf overlay —
+# restricted to fugue.tpu.plan.* compile switches, which are per-workflow
+# by design; other keys would leak into the shared engine conf and are
+# dropped with a warning)
+FUGUE_TPU_CONF_SERVE_TENANT_PREFIX = "fugue.tpu.serve.tenant."
+
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,

@@ -50,6 +50,13 @@ from .rpc import (
     make_rpc_server,
     to_rpc_handler,
 )
+from .serve import (
+    EngineServer,
+    ServeHttpClient,
+    ServeRejected,
+    Submission,
+    SubmissionCanceled,
+)
 from .sql.dialect import DialectProfile, register_dialect
 from .warehouse.profile import WarehouseProfile
 from .workflow._workflow_context import FugueWorkflowContext

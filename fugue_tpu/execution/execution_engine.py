@@ -183,6 +183,7 @@ class ExecutionEngine(FugueEngineBase):
         self._resilience_stats: Any = None
         self._plan_stats: Any = None
         self._metrics: Any = None
+        self._active_runs = 0
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
         # constructing an engine with tracing conf turns the tracer on
         from ..obs import configure_from_conf, configure_sampler_from_conf
@@ -215,14 +216,21 @@ class ExecutionEngine(FugueEngineBase):
 
     @property
     def map_engine(self) -> MapEngine:
+        # lazy singletons double-checked under the engine lock (ISSUE 10
+        # audit): two concurrent sessions' first touch must not build two
+        # sub-engines and split state between them
         if self._map_engine is None:
-            self._map_engine = self.create_default_map_engine()
+            with self._rlock:
+                if self._map_engine is None:
+                    self._map_engine = self.create_default_map_engine()
         return self._map_engine
 
     @property
     def sql_engine(self) -> SQLEngine:
         if self._sql_engine is None:
-            self._sql_engine = self.create_default_sql_engine()
+            with self._rlock:
+                if self._sql_engine is None:
+                    self._sql_engine = self.create_default_sql_engine()
         return self._sql_engine
 
     def set_sql_engine(self, engine: "SQLEngine") -> None:
@@ -306,16 +314,35 @@ class ExecutionEngine(FugueEngineBase):
     def stop_engine(self) -> None:
         """Subclass hook for resource cleanup."""
 
+    # ---- concurrent-run accounting (ISSUE 10) -----------------------------
+    @property
+    def active_runs(self) -> int:
+        """How many ``workflow.run`` graphs are executing on this engine
+        RIGHT NOW — the serving layer's readiness/occupancy gauge."""
+        with self._rlock:
+            return self._active_runs
+
+    def _run_started(self) -> None:
+        with self._rlock:
+            self._active_runs += 1
+
+    def _run_finished(self) -> None:
+        with self._rlock:
+            self._active_runs = max(0, self._active_runs - 1)
+
     # ---- rpc server binding (set by workflow context) ---------------------
     @property
     def rpc_server(self) -> Any:
         if self._rpc_server is None:
-            from ..rpc.base import make_rpc_server
+            with self._rlock:
+                if self._rpc_server is None:
+                    from ..rpc.base import make_rpc_server
 
-            # conf-driven: "fugue.rpc.server" names the server class
-            # (reference fugue/rpc/base.py:268); default is in-process
-            self._rpc_server = make_rpc_server(self.conf)
-            self._bind_rpc_metrics(self._rpc_server)
+                    # conf-driven: "fugue.rpc.server" names the server class
+                    # (reference fugue/rpc/base.py:268); default is in-process
+                    server = make_rpc_server(self.conf)
+                    self._bind_rpc_metrics(server)
+                    self._rpc_server = server
         return self._rpc_server
 
     def set_rpc_server(self, server: Any) -> None:
@@ -336,20 +363,22 @@ class ExecutionEngine(FugueEngineBase):
         pipeline + jit_cache on the jax engine). The legacy
         ``engine.*_stats`` attributes delegate to the same objects."""
         if self._metrics is None:
-            from ..obs import MetricsRegistry, get_sampler, get_span_metrics
+            with self._rlock:
+                if self._metrics is None:
+                    from ..obs import MetricsRegistry, get_sampler, get_span_metrics
 
-            reg = MetricsRegistry()
-            reg.register("resilience", lambda: self.resilience_stats)
-            reg.register("plan", lambda: self.plan_stats)
-            reg.register("cache", lambda: self.result_cache.stats)
-            # distribution + resource sources are process-global (like the
-            # tracer feeding them) but mounted here so engine.stats()
-            # carries them and engine.reset_stats() resets them under the
-            # keep-entries contract (series/probes stay registered,
-            # observations/ring zero)
-            reg.register("latency", get_span_metrics)
-            reg.register("telemetry", get_sampler)
-            self._metrics = reg
+                    reg = MetricsRegistry()
+                    reg.register("resilience", lambda: self.resilience_stats)
+                    reg.register("plan", lambda: self.plan_stats)
+                    reg.register("cache", lambda: self.result_cache.stats)
+                    # distribution + resource sources are process-global (like
+                    # the tracer feeding them) but mounted here so
+                    # engine.stats() carries them and engine.reset_stats()
+                    # resets them under the keep-entries contract (series/
+                    # probes stay registered, observations/ring zero)
+                    reg.register("latency", get_span_metrics)
+                    reg.register("telemetry", get_sampler)
+                    self._metrics = reg
         return self._metrics
 
     def _register_resource_probes(self) -> None:
@@ -433,9 +462,11 @@ class ExecutionEngine(FugueEngineBase):
         Kept as a stable alias of ``engine.metrics.get("resilience")`` —
         prefer ``engine.stats()["resilience"]`` for reads."""
         if self._resilience_stats is None:
-            from ..resilience import ResilienceStats
+            with self._rlock:
+                if self._resilience_stats is None:
+                    from ..resilience import ResilienceStats
 
-            self._resilience_stats = ResilienceStats()
+                    self._resilience_stats = ResilienceStats()
         return self._resilience_stats
 
     @property
@@ -445,9 +476,11 @@ class ExecutionEngine(FugueEngineBase):
         bytes_skipped). Alias of ``engine.metrics.get("plan")`` — prefer
         ``engine.stats()["plan"]`` for reads."""
         if getattr(self, "_plan_stats", None) is None:
-            from ..plan import PlanStats
+            with self._rlock:
+                if getattr(self, "_plan_stats", None) is None:
+                    from ..plan import PlanStats
 
-            self._plan_stats = PlanStats()
+                    self._plan_stats = PlanStats()
         return self._plan_stats
 
     @property
@@ -460,9 +493,11 @@ class ExecutionEngine(FugueEngineBase):
         ``engine.stats()["cache"]``; ``engine.reset_stats()`` zeroes them
         without evicting entries (the ``JitCache.reset`` contract)."""
         if getattr(self, "_result_cache", None) is None:
-            from ..cache import ResultCache
+            with self._rlock:
+                if getattr(self, "_result_cache", None) is None:
+                    from ..cache import ResultCache
 
-            self._result_cache = ResultCache(self.conf, log=self.log)
+                    self._result_cache = ResultCache(self.conf, log=self.log)
         return self._result_cache
 
     # ---- physical ops (abstract) ------------------------------------------

@@ -1215,9 +1215,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 steps=len(steps),
             ):
                 res = runner()
-            self.plan_stats.segments_executed += 1
+            self.plan_stats.inc("segments_executed")
             return res
-        self.plan_stats.segments_fallback += 1
+        self.plan_stats.inc("segments_fallback")
         return super().lowered_segment(
             dfs, steps, terminal, partition_spec, fingerprint=fingerprint
         )

@@ -196,19 +196,31 @@ class JitCache(dict):
     plan segment's single program carries ``segment:<fingerprint>`` — so
     the "one jit entry per pipeline segment" claim is checkable from
     ``engine.stats()["jit_cache"]`` alone, without poking at key tuples.
+
+    Concurrency (the ISSUE 10 shared-engine audit): the hit/miss
+    counters are read-modify-write and were losing updates under
+    concurrent sessions — they increment under a narrow lock now. The
+    ``key not in cache → cache[key] = jit(...)`` call-site idiom itself
+    stays lock-free by design: two sessions racing the same key both
+    compile the IDENTICAL program and the second dict insert harmlessly
+    replaces the first (a one-off duplicate compile, never a wrong
+    result); serializing every compile behind a cache-wide lock would
+    make one tenant's 30s XLA compile block every other tenant's hits.
     """
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         super().__init__(*args, **kwargs)
+        self._count_lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def __contains__(self, key: Any) -> bool:
         present = super().__contains__(key)
-        if present:
-            self.hits += 1
-        else:
-            self.misses += 1
+        with self._count_lock:
+            if present:
+                self.hits += 1
+            else:
+                self.misses += 1
         return present
 
     @staticmethod
@@ -221,7 +233,9 @@ class JitCache(dict):
         """Entry count per label — segment entries keyed by their segment
         fingerprint, never by the first verb they absorbed."""
         out: Dict[str, int] = {}
-        for k in self.keys():
+        # list() snapshots the keys: a concurrent session's insert must
+        # not blow up a scrape mid-iteration
+        for k in list(self.keys()):
             lab = self.label_of(k)
             out[lab] = out.get(lab, 0) + 1
         return out
@@ -235,9 +249,11 @@ class JitCache(dict):
         }
 
     def stats(self) -> Dict[str, Any]:
+        with self._count_lock:
+            hits, misses = self.hits, self.misses
         return {
-            "hits": self.hits,
-            "misses": self.misses,
+            "hits": hits,
+            "misses": misses,
             "entries": len(self),
             "by_label": self.by_label(),
         }
@@ -250,8 +266,9 @@ class JitCache(dict):
         """Zero the hit/miss counters. Compiled entries are KEPT — evicting
         them would force recompiles, turning a stats reset into a perf
         event; ``entries`` therefore survives a reset by design."""
-        self.hits = 0
-        self.misses = 0
+        with self._count_lock:
+            self.hits = 0
+            self.misses = 0
 
 
 class _SerialChunks:

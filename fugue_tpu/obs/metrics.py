@@ -390,16 +390,26 @@ class SpanMetrics:
     :attr:`MAX_RUN_SERIES` distinct ``run`` values keep their series;
     when a newer run arrives, the oldest run's series are pruned from
     every family (the per-SPAN summaries and Prometheus page stay
-    bounded; traces retain every run's spans untouched).
+    bounded; traces retain every run's spans untouched). The serving
+    layer's ``tenant`` label (ISSUE 10) rides the same rotation with its
+    own, larger window (:attr:`MAX_TENANT_SERIES`): tenant ids are
+    client-supplied, so an unbounded id stream must age out the same way
+    run ids do.
     """
 
     #: distinct ``run`` label values whose series are retained (LRU by
     #: first observation; older runs' series are pruned, not zeroed)
     MAX_RUN_SERIES = 16
+    #: distinct ``tenant`` label values retained — larger than the run
+    #: window (tenants are long-lived identities, runs are ephemeral)
+    MAX_TENANT_SERIES = 32
 
     def __init__(self) -> None:
         self._runs_lock = threading.Lock()
-        self._runs: "OrderedDict[str, None]" = OrderedDict()
+        self._label_lru: Dict[str, "OrderedDict[str, None]"] = {
+            "run": OrderedDict(),
+            "tenant": OrderedDict(),
+        }
         self.latency = HistogramFamily(
             "fugue_tpu_span_latency_seconds",
             DEFAULT_LATENCY_BOUNDS,
@@ -419,28 +429,39 @@ class SpanMetrics:
     def families(self) -> Tuple[HistogramFamily, ...]:
         return (self.latency, self.rows, self.bytes)
 
-    def _note_run(self, run_id: str) -> None:
-        """Record that ``run_id`` is live; evict the oldest runs' series
-        once more than :attr:`MAX_RUN_SERIES` distinct ids have been seen."""
+    def _label_cap(self, label: str) -> int:
+        return self.MAX_TENANT_SERIES if label == "tenant" else self.MAX_RUN_SERIES
+
+    def _note_label(self, label: str, value: str) -> None:
+        """Record that ``value`` is a live id for ``label``; evict the
+        oldest ids' series once more than the label's window has been
+        seen. (``_note_run`` generalized for the tenant label.)"""
+        lru = self._label_lru[label]
         evict: List[str] = []
         with self._runs_lock:
-            if run_id in self._runs:
-                self._runs.move_to_end(run_id)
+            if value in lru:
+                lru.move_to_end(value)
             else:
-                self._runs[run_id] = None
-                while len(self._runs) > self.MAX_RUN_SERIES:
-                    evict.append(self._runs.popitem(last=False)[0])
+                lru[value] = None
+                while len(lru) > self._label_cap(label):
+                    evict.append(lru.popitem(last=False)[0])
         for old in evict:
             for f in self.families():
-                f.prune(lambda labels, _old=old: labels.get("run") == _old)
+                f.prune(
+                    lambda labels, _old=old, _l=label: labels.get(_l) == _old
+                )
+
+    def _note_run(self, run_id: str) -> None:
+        self._note_label("run", run_id)
 
     def observe_record(self, rec: Dict[str, Any]) -> None:
         """Feed one completed tracer record (called from ``Tracer._emit``
         — i.e. only while tracing is enabled; the disabled path never
         reaches here)."""
         labels = {"span": rec["name"], **_RUN_LABELS_VAR.get()}
-        if "run" in labels:
-            self._note_run(labels["run"])
+        for rotated in ("run", "tenant"):
+            if rotated in labels:
+                self._note_label(rotated, labels[rotated])
         self.latency.observe(max(rec.get("dur", 0), 0) / 1e9, **labels)
         args = rec.get("args") or {}
         rows = args.get("rows", args.get("rows_out"))
@@ -478,13 +499,15 @@ class SpanMetrics:
     def merge(self, delta: Dict[str, List[Dict[str, Any]]]) -> None:
         if not delta:
             return
-        # worker deltas carry run labels too — count them against the same
-        # rotation window so merged series obey the cardinality bound
+        # worker deltas carry run/tenant labels too — count them against
+        # the same rotation windows so merged series obey the bound
         for encs in delta.values():
             for enc in encs or []:
-                r = (enc.get("labels") or {}).get("run")
-                if r:
-                    self._note_run(r)
+                lab = enc.get("labels") or {}
+                for rotated in ("run", "tenant"):
+                    v = lab.get(rotated)
+                    if v:
+                        self._note_label(rotated, v)
         self.latency.merge(delta.get("latency", []))
         self.rows.merge(delta.get("rows", []))
         self.bytes.merge(delta.get("bytes", []))
@@ -526,7 +549,8 @@ class SpanMetrics:
         for f in self.families():
             f.clear()
         with self._runs_lock:
-            self._runs.clear()
+            for lru in self._label_lru.values():
+                lru.clear()
 
 
 _SPAN_METRICS = SpanMetrics()

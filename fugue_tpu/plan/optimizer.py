@@ -7,6 +7,7 @@ conf key away, and the parity suite (``tests/plan/test_optimizer.py``)
 asserts both paths produce bit-identical results.
 """
 
+import threading
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..constants import (
@@ -47,47 +48,59 @@ __all__ = [
 
 
 class PlanStats:
-    """Engine-level optimizer counters (an ``engine.metrics`` source)."""
+    """Engine-level optimizer counters (an ``engine.metrics`` source).
+
+    Thread-safe since ISSUE 10: concurrent serving runs ``absorb``/
+    ``inc`` from many sessions on one engine — bare ``+=`` was losing
+    updates. Same narrow-lock pattern as ``CacheStats``/``ShuffleStats``."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
-        self.runs = 0
-        self.cols_pruned = 0
-        self.filters_pushed = 0
-        self.verbs_fused = 0
-        self.bytes_skipped = 0
-        self.segments_lowered = 0
-        self.verbs_absorbed = 0
-        # execution-side counters (incremented by engine.lowered_segment):
-        # a lowered segment ran as ONE compiled program / fell back to the
-        # per-verb path — together they make the "one program per segment"
-        # claim checkable from stats alone
-        self.segments_executed = 0
-        self.segments_fallback = 0
+        with self._lock:
+            self.runs = 0
+            self.cols_pruned = 0
+            self.filters_pushed = 0
+            self.verbs_fused = 0
+            self.bytes_skipped = 0
+            self.segments_lowered = 0
+            self.verbs_absorbed = 0
+            # execution-side counters (via ``inc`` from engine.lowered_segment):
+            # a lowered segment ran as ONE compiled program / fell back to the
+            # per-verb path — together they make the "one program per segment"
+            # claim checkable from stats alone
+            self.segments_executed = 0
+            self.segments_fallback = 0
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
 
     def absorb(self, report: "PlanReport") -> None:
-        self.runs += 1
-        self.cols_pruned += report.cols_pruned
-        self.filters_pushed += report.filters_pushed
-        self.verbs_fused += report.verbs_fused
-        self.bytes_skipped += report.bytes_skipped
-        self.segments_lowered += report.segments_lowered
-        self.verbs_absorbed += report.verbs_absorbed
+        with self._lock:
+            self.runs += 1
+            self.cols_pruned += report.cols_pruned
+            self.filters_pushed += report.filters_pushed
+            self.verbs_fused += report.verbs_fused
+            self.bytes_skipped += report.bytes_skipped
+            self.segments_lowered += report.segments_lowered
+            self.verbs_absorbed += report.verbs_absorbed
 
     def as_dict(self) -> Dict[str, int]:
-        return {
-            "runs": self.runs,
-            "cols_pruned": self.cols_pruned,
-            "filters_pushed": self.filters_pushed,
-            "verbs_fused": self.verbs_fused,
-            "bytes_skipped": self.bytes_skipped,
-            "segments_lowered": self.segments_lowered,
-            "verbs_absorbed": self.verbs_absorbed,
-            "segments_executed": self.segments_executed,
-            "segments_fallback": self.segments_fallback,
-        }
+        with self._lock:
+            return {
+                "runs": self.runs,
+                "cols_pruned": self.cols_pruned,
+                "filters_pushed": self.filters_pushed,
+                "verbs_fused": self.verbs_fused,
+                "bytes_skipped": self.bytes_skipped,
+                "segments_lowered": self.segments_lowered,
+                "verbs_absorbed": self.verbs_absorbed,
+                "segments_executed": self.segments_executed,
+                "segments_fallback": self.segments_fallback,
+            }
 
 
 class PlanReport:

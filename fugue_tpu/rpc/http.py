@@ -153,19 +153,30 @@ class HttpRPCClient(RPCClient):
 
 class HttpRPCServer(RPCServer):
     """Stdlib HTTP RPC server (reference flask parity) — doubling as the
-    engine's telemetry exposure surface (ISSUE 6): alongside the POST
-    ``/invoke`` callback channel it serves
+    engine's telemetry exposure surface (ISSUE 6) and the serving layer's
+    network front end (ISSUE 10): alongside the POST ``/invoke`` callback
+    channel it serves
 
     - ``GET /metrics`` — Prometheus text exposition: labeled span-latency
       /rows/bytes histograms, resource-sampler gauges, and the bound
       engine's flattened counters (scrapeable while a run is in flight);
-    - ``GET /healthz`` — liveness JSON;
+    - ``GET /healthz`` — liveness JSON (process up; NEVER load-aware —
+      a load balancer must not restart a merely busy server);
+    - ``GET /readyz`` — readiness: queue depth/capacity and active runs
+      of the bound :class:`~fugue_tpu.serve.EngineServer`; answers 503
+      with the same JSON shape when the admission queue is full, so
+      traffic sheds at the balancer before the server rejects;
     - ``GET /stats`` — one JSON snapshot (engine registry + latency
-      summary + sampler state + current run labels).
+      summary + sampler state + current run labels + serve stats);
+    - ``POST /serve/submit``, ``GET /serve/poll``, ``GET /serve/result``,
+      ``POST /serve/cancel`` — the remote session surface over a bound
+      EngineServer (see docs/serving.md; idempotency keys make submit
+      safe under the retry policy).
 
     Bind an engine with :meth:`bind_engine` (the engine does this itself
-    when it creates or is handed the server); unbound, the global span
-    metrics and sampler still serve."""
+    when it creates or is handed the server) and a serving front end with
+    :meth:`bind_serve`; unbound, the global span metrics and sampler
+    still serve and the serve routes answer 404."""
 
     def __init__(self, conf: Any = None):
         super().__init__(conf)
@@ -191,6 +202,7 @@ class HttpRPCServer(RPCServer):
         self._httpd: Any = None
         self._thread: Any = None
         self._engine_ref: Any = None
+        self._serve_ref: Any = None
         self._started_at = time.time()
 
     # -- telemetry binding ---------------------------------------------------
@@ -199,28 +211,42 @@ class HttpRPCServer(RPCServer):
         — a collected engine silently unbinds)."""
         self._engine_ref = weakref.ref(engine)
 
+    def bind_serve(self, server: Any) -> None:
+        """Point the /serve/* routes and /readyz at an
+        :class:`~fugue_tpu.serve.EngineServer` (held weakly)."""
+        self._serve_ref = weakref.ref(server)
+
     def _metrics_engine(self) -> Any:
         return self._engine_ref() if self._engine_ref is not None else None
 
-    def _get_body(self, path: str) -> Optional[Any]:
-        """Build (content_type, body_bytes) for a telemetry GET route, or
+    def _serve_server(self) -> Any:
+        return self._serve_ref() if self._serve_ref is not None else None
+
+    def _get_body(self, path: str, query: str = "") -> Optional[Any]:
+        """Build (status, content_type, body_bytes) for a GET route, or
         None for an unknown path."""
         if path == "/healthz":
+            # the LIVENESS contract: process up + uptime, nothing else —
+            # never made load-aware (that's /readyz), or a busy-but-
+            # healthy server would get restarted by its balancer
             payload = {
                 "status": "ok",
                 "pid": os.getpid(),
                 "uptime_s": round(time.time() - self._started_at, 3),
             }
-            return "application/json", json.dumps(payload).encode()
+            return 200, "application/json", json.dumps(payload).encode()
+        if path == "/readyz":
+            return self._readyz()
         if path == "/metrics":
             from ..obs import to_prometheus_text
 
             text = to_prometheus_text(engine=self._metrics_engine())
-            return "text/plain; version=0.0.4; charset=utf-8", text.encode()
+            return 200, "text/plain; version=0.0.4; charset=utf-8", text.encode()
         if path == "/stats":
             from ..obs import active_run_labels, get_sampler, get_span_metrics
 
             eng = self._metrics_engine()
+            srv = self._serve_server()
             # run labels are context-local to the run's own threads; from
             # the server thread report the scopes currently entered
             # anywhere in the process (most recent under the legacy key)
@@ -231,9 +257,140 @@ class HttpRPCServer(RPCServer):
                 "telemetry": get_sampler().as_dict(),
                 "run_labels": active[-1] if active else {},
                 "active_runs": active,
+                "serve": srv.stats() if srv is not None else None,
             }
-            return "application/json", json.dumps(payload, default=str).encode()
+            return 200, "application/json", json.dumps(payload, default=str).encode()
+        if path == "/serve/poll":
+            return self._serve_poll(query)
+        if path == "/serve/result":
+            return self._serve_result(query)
         return None
+
+    # -- serving routes (ISSUE 10; see docs/serving.md) ----------------------
+    def _readyz(self) -> Any:
+        srv = self._serve_server()
+        if srv is None:
+            # no serving front end bound: readiness degrades to liveness
+            payload = {"status": "ready", "serve_bound": False}
+            return 200, "application/json", json.dumps(payload).encode()
+        st = srv.stats()
+        full = st["queue_depth"] >= st["queue_capacity"] or not srv.running
+        payload = {
+            "status": "overloaded" if full else "ready",
+            "serve_bound": True,
+            "accepting": bool(srv.running),
+            "queue_depth": st["queue_depth"],
+            "queue_capacity": st["queue_capacity"],
+            "queue_free": max(0, st["queue_capacity"] - st["queue_depth"]),
+            "active_runs": st["active_runs"],
+            "max_concurrent": st["max_concurrent"],
+        }
+        # 503 on full: the shape a load balancer sheds on — BEFORE the
+        # admission queue starts rejecting sessions outright
+        return (503 if full else 200), "application/json", json.dumps(payload).encode()
+
+    @staticmethod
+    def _query_id(query: str) -> Optional[str]:
+        from urllib.parse import parse_qs
+
+        vals = parse_qs(query).get("id")
+        return vals[0] if vals else None
+
+    def _serve_sub(self, query: str) -> Any:
+        srv = self._serve_server()
+        if srv is None:
+            return None, (404, "application/json", b'{"error": "no serve bound"}')
+        sid = self._query_id(query)
+        sub = srv.get(sid) if sid else None
+        if sub is None:
+            return None, (
+                404,
+                "application/json",
+                json.dumps({"error": f"unknown submission {sid!r}"}).encode(),
+            )
+        return sub, None
+
+    def _sub_payload(self, sub: Any) -> dict:
+        out = {
+            "id": sub.id,
+            "status": sub.status,
+            "tenant": sub.tenant,
+            "priority": sub.priority,
+            "deduped": sub.deduped,
+            "queue_wait_s": sub.queue_wait_s,
+            "run_s": sub.run_s,
+        }
+        err = sub._execution.error if sub._execution is not None else None
+        if sub.status == "failed" and err is not None:
+            out["error"] = f"{type(err).__name__}: {err}"
+        return out
+
+    def _serve_poll(self, query: str) -> Any:
+        sub, err = self._serve_sub(query)
+        if err is not None:
+            return err
+        return 200, "application/json", json.dumps(self._sub_payload(sub)).encode()
+
+    def _serve_result(self, query: str) -> Any:
+        """The result channel: yielded frames as host pandas (cloudpickle
+        over the wire — device frames are laid out for THIS process's
+        mesh and never serialize). 202 + status JSON while pending."""
+        sub, err = self._serve_sub(query)
+        if err is not None:
+            return err
+        if sub.status in ("queued", "running"):
+            return 202, "application/json", json.dumps(self._sub_payload(sub)).encode()
+        try:
+            res = sub.result(timeout=0)
+            frames = {}
+            for name, y in res.yields.items():
+                df = getattr(y, "result", None)
+                frames[name] = df.as_pandas() if df is not None else None
+            body = (True, frames)
+        except Exception as e:
+            body = (False, e)
+        return (
+            200,
+            "application/octet-stream",
+            base64.b64encode(cloudpickle.dumps(body)),
+        )
+
+    def _serve_submit(self, raw: bytes) -> Any:
+        srv = self._serve_server()
+        if srv is None:
+            return 404, "application/json", b'{"error": "no serve bound"}'
+        from ..serve import ServeRejected
+
+        req = cloudpickle.loads(base64.b64decode(raw))
+        try:
+            sub = srv.submit(
+                req["dag"],
+                tenant=req.get("tenant", "default"),
+                priority=req.get("priority"),
+                idempotency_key=req.get("idempotency_key"),
+                reserve_bytes=req.get("reserve_bytes"),
+            )
+        except ServeRejected as e:
+            # 429-style shed: the reason travels; the client raises it
+            payload = {"rejected": e.reason, "error": str(e)}
+            return 429, "application/json", json.dumps(payload).encode()
+        return 200, "application/json", json.dumps(self._sub_payload(sub)).encode()
+
+    def _serve_cancel(self, raw: bytes) -> Any:
+        srv = self._serve_server()
+        if srv is None:
+            return 404, "application/json", b'{"error": "no serve bound"}'
+        req = json.loads(raw.decode() or "{}")
+        sub = srv.get(str(req.get("id", "")))
+        if sub is None:
+            return (
+                404,
+                "application/json",
+                json.dumps({"error": f"unknown submission {req.get('id')!r}"}).encode(),
+            )
+        changed = sub.cancel()
+        payload = dict(self._sub_payload(sub), canceled=changed)
+        return 200, "application/json", json.dumps(payload).encode()
 
     @property
     def host(self) -> str:
@@ -263,41 +420,48 @@ class HttpRPCServer(RPCServer):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, ctype: str, body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self) -> None:  # noqa: N802
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
-                    key, args, kwargs = cloudpickle.loads(
-                        base64.b64decode(self.rfile.read(length))
-                    )
+                    raw = self.rfile.read(length)
+                    path = self.path.split("?", 1)[0]
                     from ..obs import get_tracer
 
+                    if path == "/serve/submit":
+                        with get_tracer().span("rpc.serve_submit", cat="rpc"):
+                            self._reply(*server._serve_submit(raw))
+                        return
+                    if path == "/serve/cancel":
+                        self._reply(*server._serve_cancel(raw))
+                        return
+                    key, args, kwargs = cloudpickle.loads(base64.b64decode(raw))
                     try:
                         with get_tracer().span("rpc.serve", cat="rpc", key=key):
                             result = (True, server.invoke(key, *args, **kwargs))
                     except Exception as e:  # result is the exception itself
                         result = (False, e)
                     body = base64.b64encode(cloudpickle.dumps(result))
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(200, "application/octet-stream", body)
                 except Exception:  # pragma: no cover - transport error
                     self.send_response(500)
                     self.end_headers()
 
-            def do_GET(self) -> None:  # noqa: N802 — telemetry routes
+            def do_GET(self) -> None:  # noqa: N802 — telemetry/serve routes
                 try:
-                    made = server._get_body(self.path.split("?", 1)[0])
+                    path, _, query = self.path.partition("?")
+                    made = server._get_body(path, query)
                     if made is None:
                         self.send_response(404)
                         self.end_headers()
                         return
-                    ctype, body = made
-                    self.send_response(200)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(*made)
                 except Exception:  # telemetry must never crash the server
                     try:
                         self.send_response(500)

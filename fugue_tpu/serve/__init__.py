@@ -1,0 +1,39 @@
+"""Multi-tenant serving layer: a long-lived engine server for concurrent
+workflows (docs/serving.md).
+
+Quick start::
+
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.serve import EngineServer
+
+    eng = JaxExecutionEngine({"fugue.tpu.serve.max_concurrent": 4})
+    with EngineServer(eng) as server:
+        sub = server.submit(build_dag, tenant="acme", priority=3)
+        frames = sub.result().yields
+
+Over HTTP (the ``rpc/http.py`` surface)::
+
+    server.engine.rpc_server.bind_serve(server)   # + start the http server
+    client = ServeHttpClient(host, port)
+    sid = client.submit(build_dag, tenant="acme", idempotency_key="req-1")
+    frames = client.result(sid, timeout=60)
+"""
+
+from .client import ServeHttpClient
+from .dedup import submission_key
+from .server import EngineServer, ServeRejected, Submission, SubmissionCanceled
+from .stats import ServeStats
+from .tenant import TenantAccounts, TenantPolicy, tenant_policy
+
+__all__ = [
+    "EngineServer",
+    "ServeHttpClient",
+    "ServeRejected",
+    "ServeStats",
+    "Submission",
+    "SubmissionCanceled",
+    "TenantAccounts",
+    "TenantPolicy",
+    "submission_key",
+    "tenant_policy",
+]

@@ -1,0 +1,167 @@
+"""Remote session client for the /serve/* HTTP surface.
+
+A thin, dependency-free counterpart of :class:`~fugue_tpu.rpc.http.HttpRPCClient`:
+submissions ride POST with cloudpickled payloads, polls/results ride GET.
+Retry semantics follow the rpc/http.py idempotency rule — a submit is
+only blindly re-sent when it carries an ``idempotency_key`` (the server
+then maps the resend onto the SAME submission), otherwise only
+failures-before-send retry.
+"""
+
+import base64
+import http.client
+import json
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ..resilience import RetryPolicy, classify_failure
+from .server import ServeRejected
+
+__all__ = ["ServeHttpClient"]
+
+
+class ServeHttpClient:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_timeout: float = 5.0,
+        read_timeout: float = 60.0,
+        policy: Optional[RetryPolicy] = None,
+    ):
+        self._host = host
+        self._port = port
+        self._connect_timeout = connect_timeout
+        self._read_timeout = read_timeout
+        self._policy = policy or RetryPolicy(max_attempts=3)
+
+    # -- transport -----------------------------------------------------------
+    def _request_once(self, method: str, path: str, body: Optional[bytes]) -> Any:
+        sent = False
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self._connect_timeout
+        )
+        try:
+            conn.connect()
+            if conn.sock is not None:
+                conn.sock.settimeout(self._read_timeout)
+            sent = True
+            headers = {"Content-Length": str(len(body))} if body is not None else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, resp.getheader("Content-Type", ""), data
+        except Exception as ex:
+            ex._fugue_request_sent = sent  # type: ignore[attr-defined]
+            raise
+        finally:
+            conn.close()
+
+    def _request(
+        self, method: str, path: str, body: Optional[bytes] = None,
+        idempotent: bool = False,
+    ) -> Any:
+        attempts = 0
+        while True:
+            try:
+                return self._request_once(method, path, body)
+            except Exception as ex:
+                attempts += 1
+                sent = getattr(ex, "_fugue_request_sent", False)
+                retryable = (idempotent or not sent) and self._policy.should_retry(
+                    classify_failure(ex), attempts
+                )
+                if not retryable:
+                    raise
+                time.sleep(self._policy.delay(attempts, seed=path))
+
+    @staticmethod
+    def _json(status: int, ctype: str, data: bytes) -> Dict[str, Any]:
+        payload = json.loads(data.decode() or "{}")
+        payload["_http_status"] = status
+        return payload
+
+    # -- the session API -----------------------------------------------------
+    def submit(
+        self,
+        dag: Any,
+        tenant: str = "default",
+        priority: Optional[int] = None,
+        idempotency_key: Optional[str] = None,
+        reserve_bytes: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Submit a workflow (a built dag or a zero-arg factory — the
+        factory form is what actually crosses the wire cleanly, since a
+        built dag may close over local frames). Returns the submission
+        payload (``id``, ``status``, ``deduped``…); raises
+        :class:`ServeRejected` on a 429 shed."""
+        body = base64.b64encode(
+            cloudpickle.dumps(
+                {
+                    "dag": dag,
+                    "tenant": tenant,
+                    "priority": priority,
+                    "idempotency_key": idempotency_key,
+                    "reserve_bytes": reserve_bytes,
+                }
+            )
+        )
+        status, ctype, data = self._request(
+            "POST", "/serve/submit", body,
+            idempotent=idempotency_key is not None,
+        )
+        payload = self._json(status, ctype, data)
+        if status == 429:
+            raise ServeRejected(payload.get("rejected", "rejected"),
+                                payload.get("error", ""))
+        if status != 200:
+            raise ConnectionError(f"/serve/submit returned HTTP {status}: {payload}")
+        return payload
+
+    def poll(self, submission_id: str) -> Dict[str, Any]:
+        status, ctype, data = self._request(
+            "GET", f"/serve/poll?id={submission_id}", idempotent=True
+        )
+        return self._json(status, ctype, data)
+
+    def result(
+        self,
+        submission_id: str,
+        timeout: Optional[float] = None,
+        poll_interval: float = 0.05,
+    ) -> Dict[str, Any]:
+        """Poll until done, then fetch the yielded frames as pandas
+        (``{yield_name: pandas.DataFrame}``). Raises the execution's
+        error, re-hydrated."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            status, ctype, data = self._request(
+                "GET", f"/serve/result?id={submission_id}", idempotent=True
+            )
+            if status == 200 and ctype.startswith("application/octet-stream"):
+                ok, payload = cloudpickle.loads(base64.b64decode(data))
+                if not ok:
+                    raise payload
+                return payload
+            if status == 404:
+                raise KeyError(self._json(status, ctype, data).get("error"))
+            if status != 202:
+                raise ConnectionError(f"/serve/result returned HTTP {status}")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"submission {submission_id} not done after {timeout}s"
+                )
+            time.sleep(poll_interval)
+
+    def cancel(self, submission_id: str) -> Dict[str, Any]:
+        status, ctype, data = self._request(
+            "POST", "/serve/cancel", json.dumps({"id": submission_id}).encode(),
+            idempotent=True,  # cancel is naturally idempotent
+        )
+        return self._json(status, ctype, data)
+
+    def readyz(self) -> Dict[str, Any]:
+        status, ctype, data = self._request("GET", "/readyz", idempotent=True)
+        return self._json(status, ctype, data)

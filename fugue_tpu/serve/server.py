@@ -1,0 +1,615 @@
+"""The long-lived engine server: N concurrent sessions, one engine.
+
+Everything below PR 9 optimizes ONE workflow at a time; the north star —
+heavy traffic from many users — is an *execution environment serving
+many jobs* (arXiv:2301.07896), with per-job scheduling over a shared
+runtime (arXiv:2209.06146). :class:`EngineServer` is that environment,
+in-process: it owns one live :class:`~fugue_tpu.execution.ExecutionEngine`
+(its mesh, jit cache, result/delta cache, stats) and admits
+``workflow.run`` submissions from any number of concurrent sessions
+through an admission/scheduling queue.
+
+The moving parts (docs/serving.md):
+
+- **Admission**: a bounded queue (``fugue.tpu.serve.queue_depth``) —
+  past it submissions are REJECTED, and ``/readyz`` reports overloaded
+  *before* that so a load balancer can shed first. Tenant byte budgets
+  (``fugue.tpu.serve.tenant.<id>.budget_bytes``) gate admission against
+  the live charged-byte ledger (:class:`~fugue_tpu.serve.tenant.TenantAccounts`).
+- **Scheduling**: ``fugue.tpu.serve.max_concurrent`` worker threads;
+  lowest priority number first, FIFO within a priority, and a queued
+  execution's effective priority improves one level per
+  ``fugue.tpu.serve.aging_s`` waited — starvation-free by construction.
+- **Single-flight dedup**: submissions whose post-optimization plan
+  fingerprint (:mod:`fugue_tpu.serve.dedup`) matches an in-flight
+  execution JOIN it — one execution, every waiter gets the result.
+  A canceled waiter detaches without canceling the shared execution.
+- **Attribution**: every execution runs inside
+  ``run_labels(tenant=...)``, so the PR 6 span histograms
+  (``engine.stats()["latency"]``, ``/metrics``) carry a ``tenant``
+  label — bounded-cardinality via the same rotation as ``run``.
+"""
+
+import threading
+import time
+import uuid as _uuid
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional
+
+from ..constants import (
+    FUGUE_TPU_CONF_SERVE_AGING_S,
+    FUGUE_TPU_CONF_SERVE_DEFAULT_PRIORITY,
+    FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_TPU_CONF_SERVE_QUEUE_DEPTH,
+    FUGUE_TPU_CONF_SERVE_RESERVE_BYTES,
+    FUGUE_TPU_CONF_SERVE_RETAIN,
+)
+from .dedup import submission_key
+from .stats import ServeStats
+from .tenant import TenantAccounts, TenantPolicy, tenant_policy
+
+__all__ = [
+    "EngineServer",
+    "ServeRejected",
+    "Submission",
+    "SubmissionCanceled",
+]
+
+
+class ServeRejected(Exception):
+    """Admission refused (queue full / tenant budget / server stopped)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"submission rejected: {reason}" + (f" ({detail})" if detail else ""))
+        self.reason = reason
+
+
+class SubmissionCanceled(Exception):
+    """``result()`` called on a canceled submission."""
+
+
+class _Execution:
+    """One unit of engine work, shared by every deduped waiter."""
+
+    __slots__ = (
+        "key", "dag", "tenant", "priority", "seq", "submitted_at",
+        "started_at", "finished_at", "started", "state", "result",
+        "error", "waiters", "done",
+    )
+
+    def __init__(self, key: Optional[str], dag: Any, tenant: str,
+                 priority: int, seq: int):
+        self.key = key
+        self.dag = dag
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.seq = seq
+        self.submitted_at = time.monotonic()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.started = False
+        self.state = "queued"  # queued | running | done | failed | canceled
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.waiters: List["Submission"] = []
+        self.done = threading.Event()
+
+
+class Submission:
+    """One session's handle on a (possibly shared) execution."""
+
+    def __init__(self, server: "EngineServer", execution: _Execution,
+                 tenant: str, priority: int, deduped: bool):
+        self.id = _uuid.uuid4().hex[:16]
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.deduped = deduped
+        self._server = server
+        self._execution = execution
+        self._canceled = False
+        self._event = threading.Event()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def status(self) -> str:
+        if self._canceled:
+            return "canceled"
+        return self._execution.state
+
+    @property
+    def done(self) -> bool:
+        return self.status in ("done", "failed", "canceled")
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        ex = self._execution
+        if ex.started_at is None:
+            return None
+        return ex.started_at - ex.submitted_at
+
+    @property
+    def run_s(self) -> Optional[float]:
+        ex = self._execution
+        if ex.started_at is None or ex.finished_at is None:
+            return None
+        return ex.finished_at - ex.started_at
+
+    # -- blocking API --------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """True once the submission reached a terminal state."""
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block for the :class:`~fugue_tpu.workflow.FugueWorkflowResult`.
+
+        For a deduped submission this is the EXECUTED workflow's result —
+        the yielded frames are shared live objects, exactly like a
+        result-cache memory hit. Raises the execution's error, or
+        :class:`SubmissionCanceled`; ``TimeoutError`` past ``timeout``.
+        Claiming the result releases this submission's tenant byte
+        charge (the caller holds the frames now, not the server)."""
+        from ..obs import get_tracer
+
+        with get_tracer().span(
+            "serve.wait", cat="serve", tenant=self.tenant, id=self.id
+        ):
+            if not self._event.wait(timeout):
+                raise TimeoutError(
+                    f"submission {self.id} not done after {timeout}s "
+                    f"(status={self.status})"
+                )
+        if self._canceled:
+            raise SubmissionCanceled(f"submission {self.id} was canceled")
+        ex = self._execution
+        if ex.state == "failed":
+            assert ex.error is not None
+            raise ex.error
+        self._server._accounts.release(self.tenant, self.id)
+        return ex.result
+
+    def cancel(self) -> bool:
+        """Detach from the execution. Never cancels a SHARED execution:
+        other waiters keep theirs; only a queued execution whose last
+        waiter leaves is removed from the queue. True when this call
+        changed state (idempotent thereafter)."""
+        return self._server._cancel(self)
+
+
+class EngineServer:
+    """A long-lived serving front end over one shared engine."""
+
+    def __init__(self, engine: Any = None, conf: Any = None):
+        if engine is None:
+            from ..execution.factory import make_execution_engine
+
+            engine = make_execution_engine(None, conf)
+        self._engine = engine
+        c = engine.conf
+        self.max_concurrent = max(1, int(c.get(FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT, 2)))
+        self.queue_capacity = max(1, int(c.get(FUGUE_TPU_CONF_SERVE_QUEUE_DEPTH, 64)))
+        self.default_priority = int(c.get(FUGUE_TPU_CONF_SERVE_DEFAULT_PRIORITY, 5))
+        self.aging_s = float(c.get(FUGUE_TPU_CONF_SERVE_AGING_S, 30.0))
+        self.default_reserve = int(c.get(FUGUE_TPU_CONF_SERVE_RESERVE_BYTES, 0))
+        self.retain = max(1, int(c.get(FUGUE_TPU_CONF_SERVE_RETAIN, 256)))
+        self._stats = ServeStats()
+        self._accounts = TenantAccounts()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_Execution] = []
+        self._inflight: Dict[str, _Execution] = {}  # dedup key -> execution
+        self._subs: Dict[str, Submission] = {}
+        self._idem: Dict[str, str] = {}  # idempotency key -> submission id
+        self._done_order: List[str] = []  # retention ring of finished subs
+        self._policies: Dict[str, TenantPolicy] = {}
+        self._overlay_warned: set = set()
+        self._seq = 0
+        self._active = 0
+        self._peak_queue = 0
+        self._workers: List[threading.Thread] = []
+        self._running = False
+        # serving counters ride the engine's unified registry (ISSUE 3
+        # contract: engine.stats()["serve"], reset under keep-entries)
+        engine.metrics.register("serve", self._stats)
+        self._register_probes()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "EngineServer":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+            self._workers = [
+                threading.Thread(
+                    target=self._worker, name=f"fugue-serve-{i}", daemon=True
+                )
+                for i in range(self.max_concurrent)
+            ]
+        for t in self._workers:
+            t.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting and drain: in-flight executions finish, still-
+        queued ones fail their waiters with ``ServeRejected``."""
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            dropped, self._queue = self._queue, []
+            for ex in dropped:
+                ex.state = "failed"
+                ex.error = ServeRejected("server_stopped")
+                if ex.key is not None:
+                    self._inflight.pop(ex.key, None)
+            self._cv.notify_all()
+        for ex in dropped:
+            self._finish_waiters(ex)
+        for t in self._workers:
+            t.join(timeout=timeout)
+        self._workers = []
+
+    def __enter__(self) -> "EngineServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    @property
+    def engine(self) -> Any:
+        return self._engine
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_runs(self) -> int:
+        with self._lock:
+            return self._active
+
+    # -- admission -----------------------------------------------------------
+    def submit(
+        self,
+        dag: Any,
+        tenant: str = "default",
+        priority: Optional[int] = None,
+        idempotency_key: Optional[str] = None,
+        reserve_bytes: Optional[int] = None,
+    ) -> Submission:
+        """Admit one workflow. ``dag`` is a built ``FugueWorkflow`` or a
+        zero-arg factory returning one (factories keep one-pass stream
+        sources fresh per submission). Raises :class:`ServeRejected` on
+        queue-full / budget / stopped — rejection is an ERROR to the
+        session and a counter to the operator, never silent."""
+        from ..obs import get_tracer
+
+        tenant = str(tenant)
+        with get_tracer().span("serve.submit", cat="serve", tenant=tenant) as sp:
+            if not self._running:
+                raise ServeRejected("server_stopped")
+            if callable(dag) and not hasattr(dag, "_tasks"):
+                dag = dag()
+            self._stats.inc("submitted")
+            self._stats.inc_tenant(tenant, "submitted")
+            if idempotency_key is not None:
+                with self._lock:
+                    sid = self._idem.get(idempotency_key)
+                    prior = self._subs.get(sid) if sid is not None else None
+                if prior is not None:
+                    # the retry-safe replay: the client's resend (riding
+                    # the HTTP retry policy) maps onto the SAME submission
+                    self._stats.inc("idempotent_replays")
+                    sp.set(outcome="idempotent_replay", id=prior.id)
+                    return prior
+            pol = self._policy(tenant)
+            prio = (
+                int(priority)
+                if priority is not None
+                else (pol.priority if pol.priority is not None else self.default_priority)
+            )
+            if pol.conf_overlay:
+                dag._conf.update(pol.conf_overlay)
+            key = submission_key(dag, self._engine)
+            reserve = (
+                int(reserve_bytes) if reserve_bytes is not None else self.default_reserve
+            )
+            with self._cv:
+                if not self._running:
+                    raise ServeRejected("server_stopped")
+                # single-flight: an identical in-flight plan is joined,
+                # not re-run — no queue slot, no budget charge (the work
+                # and the live result already exist once)
+                if key is not None:
+                    ex = self._inflight.get(key)
+                    if ex is not None and ex.state in ("queued", "running"):
+                        sub = Submission(self, ex, tenant, prio, deduped=True)
+                        ex.waiters.append(sub)
+                        ex.priority = min(ex.priority, prio)
+                        self._subs[sub.id] = sub
+                        if idempotency_key is not None:
+                            self._idem[idempotency_key] = sub.id
+                        self._stats.inc("dedup_hits")
+                        self._stats.inc_tenant(tenant, "dedup_hits")
+                        sp.set(outcome="dedup", id=sub.id, key=key[:12])
+                        return sub
+                if len(self._queue) >= self.queue_capacity:
+                    self._stats.inc("rejected_queue_full")
+                    self._stats.inc_tenant(tenant, "rejected")
+                    sp.set(outcome="rejected_queue_full")
+                    raise ServeRejected(
+                        "queue_full",
+                        f"{len(self._queue)}/{self.queue_capacity} queued",
+                    )
+                sub = Submission(self, None, tenant, prio, deduped=False)  # type: ignore[arg-type]
+                if not self._accounts.try_charge(
+                    tenant, sub.id, reserve, pol.budget_bytes
+                ):
+                    self._stats.inc("rejected_budget")
+                    self._stats.inc_tenant(tenant, "rejected")
+                    sp.set(outcome="rejected_budget")
+                    raise ServeRejected(
+                        "tenant_budget",
+                        f"tenant {tenant} live {self._accounts.charged(tenant)}B"
+                        f" + reserve {reserve}B > budget {pol.budget_bytes}B",
+                    )
+                self._seq += 1
+                ex = _Execution(key, dag, tenant, prio, self._seq)
+                ex.waiters.append(sub)
+                sub._execution = ex
+                self._queue.append(ex)
+                self._peak_queue = max(self._peak_queue, len(self._queue))
+                if key is not None:
+                    self._inflight[key] = ex
+                self._subs[sub.id] = sub
+                if idempotency_key is not None:
+                    self._idem[idempotency_key] = sub.id
+                self._stats.inc("admitted")
+                self._cv.notify()
+            sp.set(
+                outcome="admitted",
+                id=sub.id,
+                priority=prio,
+                key=(key or "")[:12],
+                queue_depth=len(self._queue),
+            )
+            return sub
+
+    def get(self, submission_id: str) -> Optional[Submission]:
+        with self._lock:
+            return self._subs.get(submission_id)
+
+    # -- internals -----------------------------------------------------------
+    def _policy(self, tenant: str) -> TenantPolicy:
+        with self._lock:
+            pol = self._policies.get(tenant)
+        if pol is None:
+            pol = tenant_policy(self._engine.conf, tenant)
+            if pol.dropped_keys and tenant not in self._overlay_warned:
+                self._overlay_warned.add(tenant)
+                self._engine.log.warning(
+                    "tenant %s conf overlay keys %s dropped: only "
+                    "fugue.tpu.plan.* compile switches are per-run; other "
+                    "keys would leak into the shared engine conf",
+                    tenant,
+                    list(pol.dropped_keys),
+                )
+            with self._lock:
+                self._policies[tenant] = pol
+        return pol
+
+    def _pick_locked(self) -> Optional[_Execution]:
+        """Lowest effective (priority − levels aged), FIFO within — an
+        O(n) scan over a bounded queue; deterministic by seq."""
+        if not self._queue:
+            return None
+        now = time.monotonic()
+
+        def eff(ex: _Execution) -> Any:
+            aged = (
+                int((now - ex.submitted_at) / self.aging_s)
+                if self.aging_s > 0
+                else 0
+            )
+            return (ex.priority - aged, ex.seq)
+
+        best = min(self._queue, key=eff)
+        self._queue.remove(best)
+        return best
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait(timeout=0.5)
+                if not self._running:
+                    return
+                ex = self._pick_locked()
+                if ex is None:
+                    continue
+                ex.started = True
+                ex.started_at = time.monotonic()
+                ex.state = "running"
+                self._active += 1
+            try:
+                self._run_execution(ex)
+            finally:
+                with self._cv:
+                    self._active -= 1
+
+    def _run_execution(self, ex: _Execution) -> None:
+        from ..obs import get_tracer
+
+        tracer = get_tracer()
+        wait_s = (ex.started_at or ex.submitted_at) - ex.submitted_at
+        self._stats.inc("executions")
+        # tenant attribution: the run's span-histogram samples (and every
+        # thread the run forks — contexts propagate) carry tenant=<id>;
+        # workflow.run's own run_labels nests inside and overlays its
+        # workflow/run ids, keeping this tenant label
+        labels: Any = nullcontext()
+        if tracer.enabled:
+            from ..obs import run_labels
+
+            labels = run_labels(tenant=ex.tenant)
+        try:
+            with labels, tracer.span(
+                "serve.run",
+                cat="serve",
+                tenant=ex.tenant,
+                priority=ex.priority,
+                waiters=len(ex.waiters),
+                queue_wait_s=round(wait_s, 6),
+            ):
+                result = ex.dag.run(self._engine)
+            ex.result = result
+            ex.finished_at = time.monotonic()
+            ex.state = "done"
+        except BaseException as e:  # the waiter gets the error, not the worker
+            ex.error = e
+            ex.finished_at = time.monotonic()
+            ex.state = "failed"
+        if ex.state == "done":
+            self._stats.inc("completed")
+        else:
+            self._stats.inc("failed")
+        measured = _result_bytes(ex.result) if ex.state == "done" else 0
+        rows = _result_rows(ex.result) if ex.state == "done" else 0
+        run_s = (ex.finished_at or 0.0) - (ex.started_at or 0.0)
+        with self._lock:
+            if ex.key is not None and self._inflight.get(ex.key) is ex:
+                del self._inflight[ex.key]
+            waiters = list(ex.waiters)
+        for sub in waiters:
+            t = sub.tenant
+            self._stats.inc_tenant(t, "completed" if ex.state == "done" else "failed")
+            self._stats.inc_tenant(t, "queue_wait_s", wait_s)
+            self._stats.inc_tenant(t, "run_s", run_s)
+            if rows:
+                self._stats.inc_tenant(t, "rows_out", rows)
+            # live accounting: the reserve becomes the measured bytes the
+            # tenant now holds on the server (released when claimed)
+            self._accounts.restate(t, sub.id, measured)
+        self._finish_waiters(ex)
+        self._retire(waiters)
+
+    def _finish_waiters(self, ex: _Execution) -> None:
+        ex.done.set()
+        with self._lock:
+            waiters = list(ex.waiters)
+        for sub in waiters:
+            sub._event.set()
+
+    def _retire(self, finished: List[Submission]) -> None:
+        """Retention ring: keep the last ``serve.retain`` finished
+        submissions addressable (RPC result pickup); evicted ones release
+        their tenant charge."""
+        with self._lock:
+            self._done_order.extend(s.id for s in finished)
+            evicted: List[Submission] = []
+            while len(self._done_order) > self.retain:
+                sid = self._done_order.pop(0)
+                sub = self._subs.pop(sid, None)
+                if sub is not None:
+                    evicted.append(sub)
+            if evicted:
+                gone = {s.id for s in evicted}
+                self._idem = {
+                    k: v for k, v in self._idem.items() if v not in gone
+                }
+        for sub in evicted:
+            self._accounts.release(sub.tenant, sub.id)
+            self._stats.inc("retained_evictions")
+
+    def _cancel(self, sub: Submission) -> bool:
+        with self._cv:
+            if sub._canceled or sub._execution.state in ("done", "failed"):
+                return False
+            sub._canceled = True
+            ex = sub._execution
+            if sub in ex.waiters:
+                ex.waiters.remove(sub)
+            self._stats.inc("canceled")
+            if not ex.waiters and not ex.started and ex in self._queue:
+                # the last waiter left a not-yet-started execution: the
+                # work is no longer wanted by anyone — drop it
+                self._queue.remove(ex)
+                ex.state = "canceled"
+                if ex.key is not None and self._inflight.get(ex.key) is ex:
+                    del self._inflight[ex.key]
+                self._stats.inc("canceled_executions")
+        self._accounts.release(sub.tenant, sub.id)
+        sub._event.set()
+        return True
+
+    # -- observability -------------------------------------------------------
+    def _register_probes(self) -> None:
+        """Queue-depth / active-run gauges on the global resource sampler
+        (weakly bound — a collected server's probes remove themselves)."""
+        import weakref
+
+        from ..obs import get_sampler
+        from ..obs.sampler import ProbeGone
+
+        ref = weakref.ref(self)
+
+        def _probe(attr: str):
+            def fn() -> float:
+                s = ref()
+                if s is None:
+                    raise ProbeGone()
+                return float(getattr(s, attr))
+
+            return fn
+
+        sampler = get_sampler()
+        sampler.register_probe("serve_queue_depth", _probe("queue_depth"))
+        sampler.register_probe("serve_active_runs", _probe("active_runs"))
+
+    def stats(self) -> Dict[str, Any]:
+        """Counters plus live gauges — what ``/readyz`` and the bench
+        load driver read."""
+        out = self._stats.as_dict()
+        with self._lock:
+            out.update(
+                queue_depth=len(self._queue),
+                queue_capacity=self.queue_capacity,
+                peak_queue_depth=self._peak_queue,
+                active_runs=self._active,
+                max_concurrent=self.max_concurrent,
+                inflight_keys=len(self._inflight),
+                retained=len(self._done_order),
+            )
+        out["charged_bytes"] = self._accounts.as_dict()
+        return out
+
+
+def _result_bytes(result: Any) -> int:
+    """Measured live bytes of a run's yielded frames (best effort)."""
+    from ..cache.store import estimate_df_bytes
+
+    total = 0
+    try:
+        for y in (result.yields if result is not None else {}).values():
+            df = getattr(y, "result", None)
+            if df is not None:
+                total += estimate_df_bytes(df)
+    except Exception:
+        pass
+    return total
+
+
+def _result_rows(result: Any) -> int:
+    total = 0
+    try:
+        for y in (result.yields if result is not None else {}).values():
+            df = getattr(y, "result", None)
+            if df is not None and getattr(df, "is_bounded", False):
+                total += int(df.count())
+    except Exception:
+        pass
+    return total

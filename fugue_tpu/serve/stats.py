@@ -1,0 +1,77 @@
+"""Serving-layer counters: one thread-safe registry source with a global
+section and a per-tenant breakdown.
+
+Follows the repo-wide stats contracts: ``inc``/``as_dict`` under one
+narrow lock (``CacheStats`` style), and ``reset()`` zeroes counters
+without tearing down structure (the ``JitCache.reset`` keep-entries
+rule — gauges like queue depth are re-read live, never stored).
+"""
+
+import threading
+from typing import Dict
+
+__all__ = ["ServeStats"]
+
+_COUNTERS = (
+    "submitted",            # every submit() call that reached admission
+    "admitted",             # enqueued as a new execution
+    "dedup_hits",           # joined an identical in-flight execution
+    "idempotent_replays",   # same idempotency key re-submitted
+    "rejected_queue_full",
+    "rejected_budget",
+    "executions",           # executions actually started on a worker
+    "completed",
+    "failed",
+    "canceled",             # submissions canceled by their owner
+    "canceled_executions",  # queued executions whose last waiter canceled
+    "retained_evictions",   # completed submissions dropped past serve.retain
+)
+
+_TENANT_COUNTERS = (
+    "submitted",
+    "completed",
+    "failed",
+    "rejected",
+    "dedup_hits",
+    "rows_out",
+    "queue_wait_s",
+    "run_s",
+)
+
+
+class ServeStats:
+    """Thread-safe serving counters (a ``MetricsRegistry`` source)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+
+    def inc_tenant(self, tenant: str, name: str, n: float = 1) -> None:
+        with self._lock:
+            t = self._t.setdefault(str(tenant), {})
+            t[name] = t.get(name, 0) + n
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def as_dict(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {k: self._c.get(k, 0) for k in _COUNTERS}
+            out["tenants"] = {
+                tid: {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in sorted(t.items())
+                }
+                for tid, t in sorted(self._t.items())
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c: Dict[str, float] = {}
+            self._t: Dict[str, Dict[str, float]] = {}

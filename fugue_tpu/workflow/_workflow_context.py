@@ -124,11 +124,17 @@ class FugueWorkflowContext:
         # > 1 tasks run on pool threads whose span stacks are empty, so the
         # task spans parent onto it explicitly instead of detaching
         self._trace_root = get_tracer().current_span_id()
+        # the rpc server's start/stop is REF-COUNTED (RPCHandler._running),
+        # so N concurrent runs on one engine share one live server and the
+        # last finisher tears it down; the engine-level active-run counter
+        # is the occupancy gauge the serving layer's /readyz reports
         rpc_server = self._engine.rpc_server
         rpc_server.start()
+        self._engine._run_started()
         try:
             self._run_graph(tasks)
         finally:
+            self._engine._run_finished()
             rpc_server.stop()
             self._checkpoint_path.remove_temp_path()
 

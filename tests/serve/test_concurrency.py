@@ -1,0 +1,214 @@
+"""Shared-engine concurrency regression suite (ISSUE 10 satellite).
+
+The audit found three real races for simultaneous sessions on one
+engine: the ``JitCache`` hit/miss counters and the ``PlanStats``
+counters were bare read-modify-writes (lost updates), and the engine's
+lazily-created singletons (result cache, metrics registry, sub-engines)
+could be built twice on first concurrent touch, silently splitting
+state. These tests hammer two+ threads through ``workflow.run`` on ONE
+engine and assert bit-identical results plus COHERENT counters — the
+exact invariants those races broke.
+"""
+
+import threading
+from typing import Any, Dict, List
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import FUGUE_TPU_CONF_CACHE_ENABLED
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.serve import EngineServer
+
+THREADS = 2
+RUNS_PER_THREAD = 4
+
+
+def _frame(seed: int) -> pd.DataFrame:
+    n = 2048
+    return pd.DataFrame(
+        {
+            "k": [(i * 7 + seed) % 16 for i in range(n)],
+            # integer-valued floats: every fold order sums exactly, so
+            # bit-identity is meaningful rather than lucky
+            "v": [float((i * 13 + seed) % 1000) for i in range(n)],
+        }
+    )
+
+
+def _run_once(eng: Any, seed: int) -> pd.DataFrame:
+    dag = FugueWorkflow()
+    (
+        dag.df(_frame(seed))
+        .filter(col("v") > 50)
+        .partition_by("k")
+        .aggregate(
+            ff.sum(col("v")).alias("s"),
+            ff.count(col("v")).alias("n"),
+            ff.avg(col("v")).alias("m"),
+        )
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    return (
+        dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+    )
+
+
+@pytest.mark.parametrize("engine_cls", [NativeExecutionEngine, JaxExecutionEngine])
+def test_two_threads_through_workflow_run_bit_identical_and_coherent(engine_cls):
+    # cache OFF: every run must actually execute, so the expected counter
+    # totals are exact (and the engine paths are genuinely exercised)
+    eng = engine_cls({FUGUE_TPU_CONF_CACHE_ENABLED: False})
+    # serial oracle per seed, on a FRESH engine
+    oracle = {
+        t: _run_once(engine_cls({FUGUE_TPU_CONF_CACHE_ENABLED: False}), t)
+        for t in range(THREADS)
+    }
+    eng.reset_stats()
+    results: Dict[int, List[pd.DataFrame]] = {t: [] for t in range(THREADS)}
+    errors: List[BaseException] = []
+
+    def hammer(t: int) -> None:
+        try:
+            for _ in range(RUNS_PER_THREAD):
+                results[t].append(_run_once(eng, t))
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    # bit-identical: every concurrent run equals its serial oracle
+    for t in range(THREADS):
+        assert len(results[t]) == RUNS_PER_THREAD
+        for df in results[t]:
+            pd.testing.assert_frame_equal(df, oracle[t])
+    # coherent counters: PlanStats.absorb runs once per workflow.run —
+    # bare += lost updates here before the ISSUE 10 locks
+    stats = eng.stats()
+    assert stats["plan"]["runs"] == THREADS * RUNS_PER_THREAD
+    assert eng.active_runs == 0
+
+
+def test_jit_cache_counters_survive_a_counter_hammer():
+    """The raw counter race, isolated: N threads driving __contains__ on
+    one JitCache must account every probe (hits + misses == probes)."""
+    from fugue_tpu.jax.pipeline import JitCache
+
+    cache = JitCache()
+    cache["warm"] = object()
+    probes_per_thread = 20_000
+    n_threads = 4
+
+    def spin() -> None:
+        for i in range(probes_per_thread):
+            ("warm" if i % 2 else ("cold", i)) in cache
+
+    threads = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = cache.stats()
+    assert st["hits"] + st["misses"] == n_threads * probes_per_thread
+    assert st["hits"] == n_threads * probes_per_thread // 2
+
+
+def test_lazy_engine_singletons_are_created_once_under_concurrency():
+    """First concurrent touch of the engine's lazy singletons must yield
+    ONE object per engine, not one per thread."""
+    for _ in range(5):  # the race window is small — take a few shots
+        eng = NativeExecutionEngine()
+        seen: Dict[str, List[Any]] = {"cache": [], "metrics": [], "plan": []}
+        barrier = threading.Barrier(4)
+
+        def touch() -> None:
+            barrier.wait()
+            seen["cache"].append(eng.result_cache)
+            seen["metrics"].append(eng.metrics)
+            seen["plan"].append(eng.plan_stats)
+
+        threads = [threading.Thread(target=touch) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for name, objs in seen.items():
+            assert len({id(o) for o in objs}) == 1, f"{name} created twice"
+
+
+def test_hammer_through_engine_server_matches_serial(tmp_path):
+    """The end-to-end form: N sessions × M submissions through one
+    EngineServer on one jax engine WITH the result cache on — results
+    stay bit-identical to serial single-client runs and no submission
+    fails (the serve_load acceptance shape, sized for CI)."""
+    eng = JaxExecutionEngine(
+        {
+            "fugue.tpu.cache.enabled": True,
+            "fugue.tpu.cache.dir": str(tmp_path / "cache"),
+            "fugue.tpu.serve.max_concurrent": 3,
+        }
+    )
+    oracle = {
+        s: _run_once(JaxExecutionEngine({FUGUE_TPU_CONF_CACHE_ENABLED: False}), s)
+        for s in range(3)
+    }
+    failures: List[BaseException] = []
+    outs: List[Any] = []
+    with EngineServer(eng) as srv:
+
+        def session(i: int) -> None:
+            seed = i % 3
+            try:
+                sub = srv.submit(
+                    lambda: _mk_dag(seed), tenant=f"t{seed}"
+                )
+                res = sub.result(timeout=120)
+                df = (
+                    res.yields["r"].result.as_pandas()
+                    .sort_values("k")
+                    .reset_index(drop=True)
+                )
+                outs.append((seed, df))
+            except BaseException as e:
+                failures.append(e)
+
+        def _mk_dag(seed: int) -> FugueWorkflow:
+            dag = FugueWorkflow()
+            (
+                dag.df(_frame(seed))
+                .filter(col("v") > 50)
+                .partition_by("k")
+                .aggregate(
+                    ff.sum(col("v")).alias("s"),
+                    ff.count(col("v")).alias("n"),
+                    ff.avg(col("v")).alias("m"),
+                )
+                .yield_dataframe_as("r", as_local=True)
+            )
+            return dag
+
+        threads = [threading.Thread(target=session, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not failures, failures
+    assert len(outs) == 6
+    for seed, df in outs:
+        pd.testing.assert_frame_equal(df, oracle[seed])
+    st = srv.stats()
+    assert st["failed"] == 0 and st["submitted"] == 6
+    # completed counts EXECUTIONS; every session's submission finished
+    assert st["completed"] == st["executions"]
+    assert sum(t["completed"] for t in st["tenants"].values()) == 6
+    # 6 submissions over 3 distinct plans: sharing (in-flight dedup and/or
+    # result-cache hits) means the engine never ran all 6 from scratch
+    assert st["executions"] <= 6

@@ -1,0 +1,167 @@
+"""Plan-fingerprint single-flight (ISSUE 10 satellite): two sessions
+submitting the identical workflow concurrently share EXACTLY ONE
+execution — span/count proof — both receive identical results, and a
+canceled waiter never cancels the shared execution.
+"""
+
+import threading
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.obs import get_span_metrics, get_tracer
+from fugue_tpu.serve import EngineServer, SubmissionCanceled
+
+
+def _dag(rows: int = 256) -> FugueWorkflow:
+    dag = FugueWorkflow()
+    (
+        dag.df(
+            pd.DataFrame(
+                {"k": [i % 8 for i in range(rows)], "v": [float(i) for i in range(rows)]}
+            )
+        )
+        .filter(col("v") >= 16)
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    return dag
+
+
+class _Hold:
+    """Holds the single worker so identical submissions pile up queued."""
+
+    def __init__(self) -> None:
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def dag(self) -> FugueWorkflow:
+        hold = self
+
+        def make() -> pd.DataFrame:
+            hold.entered.set()
+            assert hold.release.wait(30)
+            return pd.DataFrame({"a": [1]})
+
+        dag = FugueWorkflow()
+        dag.create(make, schema="a:long").yield_dataframe_as("h", as_local=True)
+        return dag
+
+
+@pytest.fixture
+def tracing():
+    tr = get_tracer()
+    tr.clear()
+    get_span_metrics().clear()
+    tr.enable()
+    yield tr
+    tr.disable()
+    tr.clear()
+    get_span_metrics().clear()
+
+
+def test_identical_concurrent_submissions_share_one_execution(tracing):
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT: 1})
+    hold = _Hold()
+    with EngineServer(eng) as srv:
+        blocker = srv.submit(hold.dag())
+        assert hold.entered.wait(30)
+        # two "sessions" race identical submissions while the worker is held
+        subs = []
+        errs = []
+
+        def session(i: int) -> None:
+            try:
+                subs.append(srv.submit(_dag, tenant=f"tenant{i}"))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=session, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        hold.release.set()
+        blocker.result(timeout=60)
+        results = [s.result(timeout=60) for s in subs]
+    # count proof: one admitted execution served both sessions
+    st = srv.stats()
+    assert st["submitted"] == 3  # blocker + 2 sessions
+    assert st["executions"] == 2  # blocker + ONE shared run
+    assert st["dedup_hits"] == 1
+    assert {subs[0].deduped, subs[1].deduped} == {True, False}
+    # span proof: exactly two serve.run spans total (blocker + shared)
+    runs = [r for r in tracing.records() if r["name"] == "serve.run"]
+    assert len(runs) == 2, [r["args"] for r in runs]
+    shared = [r for r in runs if r["args"].get("waiters", 0) >= 2]
+    assert len(shared) == 1 and shared[0]["args"]["waiters"] == 2
+    # identical results: the very same live frames, like a cache mem hit
+    a, b = (res.yields["r"].result for res in results)
+    assert a is b
+    pdf = a.as_pandas()
+    assert len(pdf) == 8 and pdf["n"].sum() == 256 - 16
+
+
+def test_canceled_waiter_does_not_cancel_shared_execution(tracing):
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT: 1})
+    hold = _Hold()
+    with EngineServer(eng) as srv:
+        blocker = srv.submit(hold.dag())
+        assert hold.entered.wait(30)
+        keeper = srv.submit(_dag, tenant="keeper")
+        quitter = srv.submit(_dag, tenant="quitter")
+        assert quitter.deduped
+        assert quitter.cancel() is True
+        assert quitter.cancel() is False  # idempotent
+        hold.release.set()
+        blocker.result(timeout=60)
+        # the shared execution survived the waiter's cancellation
+        res = keeper.result(timeout=60)
+        assert len(res.yields["r"].result.as_pandas()) == 8
+        with pytest.raises(SubmissionCanceled):
+            quitter.result(timeout=5)
+    st = srv.stats()
+    assert st["canceled"] == 1
+    assert st["canceled_executions"] == 0  # the execution itself never died
+    assert st["executions"] == 2 and st["completed"] == 2
+
+
+def test_last_waiter_cancel_drops_queued_execution(tracing):
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT: 1})
+    hold = _Hold()
+    with EngineServer(eng) as srv:
+        blocker = srv.submit(hold.dag())
+        assert hold.entered.wait(30)
+        only = srv.submit(_dag, tenant="only")
+        assert only.cancel() is True
+        hold.release.set()
+        blocker.result(timeout=60)
+        # the canceled work never ran; a fresh identical submission gets
+        # a NEW execution (the in-flight key was cleaned up with it)
+        again = srv.submit(_dag, tenant="only")
+        assert not again.deduped
+        again.result(timeout=60)
+    st = srv.stats()
+    assert st["canceled_executions"] == 1
+    assert st["executions"] == 2  # blocker + the fresh resubmission
+
+
+def test_post_completion_submissions_do_not_share_in_flight(tracing):
+    """Single-flight is an IN-FLIGHT property: after the shared run
+    finishes, a new identical submission is a new execution (whether it
+    recomputes or is served by the result cache is the cache layer's
+    business, not the dedup map's)."""
+    eng = NativeExecutionEngine()
+    with EngineServer(eng) as srv:
+        first = srv.submit(_dag, tenant="a")
+        first.result(timeout=60)
+        second = srv.submit(_dag, tenant="b")
+        second.result(timeout=60)
+        assert not second.deduped
+    assert srv.stats()["executions"] == 2
