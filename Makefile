@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve cache-clean trace-smoke telemetry-smoke serve-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-analysis lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -7,6 +7,7 @@ install:
 
 test:
 	python -m pytest tests/ -q
+	-@$(MAKE) --no-print-directory lint-locks   # concurrency audit report; non-blocking
 	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
 	-@$(MAKE) --no-print-directory serve-smoke  # serving gate; non-blocking here
 
@@ -80,6 +81,23 @@ test-cache:
 # consistency, two-process append races, persist of delta-merged frames
 test-delta:
 	JAX_PLATFORMS=cpu python -m pytest tests/cache/test_delta_cache.py -q -m "not slow"
+
+# UDF static-analysis suite (docs/analysis.md): translated-vs-interpreted
+# parity across engines × optimizer on/off × bounded/streaming, the
+# refusal matrix (globals, mutable closures, .apply, loops, unknown
+# methods, non-determinism — each bit-identical with the reason rendered
+# in explain()), pruning-reaches-producer under analyzed UDFs, delta
+# serving of analyzed row-local chains, fingerprint invalidation on edit,
+# workflow.lint() diagnostics, analysis counters + /metrics exposition
+test-analysis:
+	JAX_PLATFORMS=cpu python -m pytest tests/analysis -q -m "not slow"
+
+# repo concurrency lint (ISSUE 10 audit as a repeatable AST check): flags
+# writes to shared-engine mutable attributes outside the audited lock
+# helpers. A report, not a gate — `make test` runs it non-blocking; use
+# `python tools/lint_locks.py --strict` to enforce locally
+lint-locks:
+	python tools/lint_locks.py
 
 # multi-tenant serving suite (docs/serving.md): admission queue + tenant
 # budgets + priority aging, plan-fingerprint single-flight (one shared

@@ -1346,6 +1346,153 @@ def _bench_segment_lowering(
     }
 
 
+def _bench_udf_trace(
+    rows: int = 400_000,
+    wide_cols: int = 56,
+    groups: int = 64,
+    chunk: int = 16_384,
+) -> dict:
+    """UDF auto-trace case (ISSUE 11): an UNTOUCHED plain-pandas UDF —
+    arithmetic + an ``np.where`` conditional + ``fillna`` + a projection —
+    over a wide streaming source, flowing into a grouped aggregate.
+
+    Translated (``fugue.tpu.plan.analyze_udfs`` ON, the default): the
+    static analyzer turns the UDF into assign/filter/select steps, column
+    pruning cuts every chunk to the 3 demanded columns inside the
+    producer, and fusion + segment lowering compile chain + aggregate
+    into ONE ``shard_map`` program — exactly one ``segment:<fp>`` jit
+    entry, zero per-verb launches, chunks never return to host between
+    verbs. Interpreted (analyze_udfs OFF — the pre-analysis engine): the
+    opaque callable demands every column and runs the host map path.
+
+    The gate (exit 13): >= 5x over the interpreted path, bit-identical
+    results, exactly one fused/lowered jit entry, zero segment
+    fallbacks, and the wide columns actually pruned."""
+    import numpy as _np
+    import pandas as _pd
+    import pyarrow as _pa
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS,
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    )
+    from fugue_tpu.dataframe import (
+        ArrowDataFrame,
+        LocalDataFrameIterableDataFrame,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+
+    rng = _np.random.default_rng(17)
+    pdf = _pd.DataFrame(
+        {
+            "k": rng.integers(0, groups, rows),
+            "v": rng.random(rows),
+            "w": rng.random(rows),
+            **{f"x{i}": rng.random(rows) for i in range(wide_cols)},
+        }
+    )
+    pdf.loc[pdf.index % 13 == 0, "v"] = _np.nan
+    tbl = _pa.Table.from_pandas(pdf, preserve_index=False)
+
+    def stream():
+        return LocalDataFrameIterableDataFrame(
+            (
+                ArrowDataFrame(tbl.slice(s, min(chunk, rows - s)))
+                for s in range(0, rows, chunk)
+            ),
+            schema=ArrowDataFrame(tbl).schema,
+        )
+
+    def featurize(df: _pd.DataFrame) -> _pd.DataFrame:
+        df["z"] = df["v"].fillna(0.0) * 2.0 + _np.where(
+            df["w"] > 0.5, df["w"], 0.25
+        )
+        df = df[df["z"] > 0.2]
+        return df
+
+    def run(analyze: bool):
+        eng = JaxExecutionEngine(
+            {
+                FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS: analyze,
+                FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: chunk,
+                FUGUE_TPU_CONF_CACHE_ENABLED: False,
+            }
+        )
+        best, res = None, None
+        for _ in range(3):  # first run pays jit compile; best-of-3
+            dag = FugueWorkflow()
+            (
+                dag.df(stream())
+                .transform(using=featurize, schema="*,z:double")
+                .partition_by("k")
+                .aggregate(
+                    ff.sum(col("z")).alias("s"),
+                    ff.count(col("z")).alias("n"),
+                    ff.avg(col("z")).alias("m"),
+                )
+                .yield_dataframe_as("r", as_local=True)
+            )
+            t0 = time.perf_counter()
+            dag.run(eng)
+            dt = time.perf_counter() - t0
+            res = (
+                dag.yields["r"]
+                .result.as_pandas()
+                .sort_values("k")
+                .reset_index(drop=True)
+            )
+            best = dt if best is None else min(best, dt)
+        return best, res, eng
+
+    translated_s, r_on, eng_on = run(True)
+    interpreted_s, r_off, _eng_off = run(False)
+    import pandas.testing as _pdt
+
+    identical = True
+    try:
+        _pdt.assert_frame_equal(r_on, r_off)
+    except AssertionError:
+        identical = False
+    st = eng_on.stats()
+    seg_entries = eng_on._jit_cache.segment_entries()
+    by_label = dict(st["jit_cache"].get("by_label", {}))
+    analysis = st["analysis"]
+    plan = st["plan"]
+    speedup = interpreted_s / max(translated_s, 1e-9)
+    one_entry = (
+        len(by_label) == 1
+        and all(k.startswith("segment:") for k in by_label)
+        and set(by_label.values()) == {1}
+    )
+    return {
+        "rows": rows,
+        "wide_cols": wide_cols,
+        "chunk_rows": chunk,
+        "translated_s": round(translated_s, 4),
+        "interpreted_s": round(interpreted_s, 4),
+        "speedup": round(speedup, 2),
+        "jit_by_label": by_label,
+        "segment_jit_entries": seg_entries,
+        "segments_fallback": plan["segments_fallback"],
+        "cols_pruned": plan["cols_pruned"],
+        "udfs_translated": analysis["udfs_translated"],
+        "udfs_refused": analysis["udfs_refused"],
+        "bit_identical": identical,
+        "correct": bool(
+            identical
+            and speedup >= 5.0
+            and one_entry
+            and len(seg_entries) == 1
+            and plan["segments_fallback"] == 0
+            and plan["cols_pruned"] >= wide_cols
+            and analysis["udfs_translated"] >= 1
+        ),
+    }
+
+
 def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> dict:
     """Out-of-core spill-shuffle join case (ISSUE 8): BOTH sides >=10x the
     device byte budget, joined bucket-at-a-time through the on-disk hash
@@ -1813,6 +1960,10 @@ def _smoke() -> None:
     # device budget; must finish under budget, bit-identical to the host
     # oracle, with zero broadcast-strategy joins
     shuffle_case = _bench_shuffle_join(budget_bytes=1 << 20, rows=700_000)
+    # UDF auto-trace (ISSUE 11): an untouched plain-pandas UDF must reach
+    # >=5x over the interpreted path via analyzer translation — one
+    # fused/lowered jit entry, zero per-verb launches, bit-identical
+    udf_case = _bench_udf_trace(rows=250_000, wide_cols=56)
     result = {
         "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
         "value": round(r["rps"], 1),
@@ -1830,6 +1981,7 @@ def _smoke() -> None:
         "delta_cache": delta_case,
         "segment_lowering": segment_case,
         "shuffle_join": shuffle_case,
+        "udf_trace": udf_case,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     try:  # drop the result where --compare picks it up (best effort)
@@ -1850,6 +2002,8 @@ def _smoke() -> None:
         raise SystemExit(10)
     if not delta_case["correct"]:
         raise SystemExit(11)
+    if not udf_case["correct"]:
+        raise SystemExit(13)  # 12 is the serve gate
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -2146,6 +2300,14 @@ def _telemetry_smoke(out_dir: str) -> None:
             "fugue_tpu_cache_bytes_skipped_delta",
         ):
             assert want in final, f"{want} missing from /metrics exposition"
+        # UDF static-analyzer counters (ISSUE 11) flatten through
+        # engine.stats()["analysis"]; exposition validity proven above
+        for want in (
+            "fugue_tpu_analysis_udfs_analyzed",
+            "fugue_tpu_analysis_udfs_translated",
+            "fugue_tpu_analysis_udfs_refused",
+        ):
+            assert want in final, f"{want} missing from /metrics exposition"
         with _ur.urlopen(
             f"http://{server.host}:{server.port}/healthz", timeout=5
         ) as resp:
@@ -2434,6 +2596,7 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # of rows as one new partition; the warm run serves
                     # the rest from the partition manifest
                     "delta_cache": _bench_delta_cache(),
+                    "udf_trace": _bench_udf_trace(),
                     # segment lowering (ISSUE 7): streaming fused chain →
                     # dense aggregate as ONE SPMD program per chunk,
                     # lowered vs fugue.tpu.plan.lower_segments=false

@@ -15,6 +15,7 @@ from ..exceptions import FugueInvalidOperation
 from .expressions import (
     ColumnExpr,
     _BinaryOpExpr,
+    _CaseWhenExpr,
     _FuncExpr,
     _LitColumnExpr,
     _NamedColumnExpr,
@@ -87,6 +88,15 @@ def _eval(cols: Dict[str, Any], expr: ColumnExpr) -> Any:
         if op == "|":
             return jnp.logical_or(l, r)
         raise NotImplementedError(op)
+    if isinstance(expr, _CaseWhenExpr):
+        # CASE WHEN as a reversed jnp.where chain: the FIRST matching case
+        # wins, NaN/false conditions fall through to the default — the
+        # same semantics the positional pandas evaluator implements
+        res = evaluate_jnp(cols, expr.default)
+        for c, v in reversed(expr.cases):
+            cond = evaluate_jnp(cols, c)
+            res = jnp.where(cond, evaluate_jnp(cols, v), res)
+        return res
     if isinstance(expr, _FuncExpr) and not expr.is_agg:
         if expr.func.upper() == "COALESCE":
             args = [evaluate_jnp(cols, a) for a in expr.args]
@@ -265,6 +275,17 @@ def evaluate_jnp_3v(
                     nul = pn & nul
                 return val, nul
             raise NotImplementedError(f"function {e.func} not supported on device")
+        if isinstance(e, _CaseWhenExpr):
+            # first matching case wins; a NULL condition falls through
+            # (SQL: NULL is not TRUE) — same outcome as the pandas path
+            val, nul = ev(e.default)
+            for c, v in reversed(e.cases):
+                cv, cn = ev(c)
+                vv, vn = ev(v)
+                take = jnp.asarray(cv, dtype=bool) & jnp.logical_not(cn)
+                val = jnp.where(take, vv, val)
+                nul = jnp.where(take, vn, nul)
+            return val, nul
         raise NotImplementedError(f"can't evaluate {type(e)} on device")
 
     return ev(expr)
@@ -431,6 +452,11 @@ def device_predicate_plan(
                 and e.func.upper() == "COALESCE"
                 and all(ok(a) for a in e.args)
             )
+        if isinstance(e, _CaseWhenExpr):
+            # lowered as a jnp.where chain in evaluate_jnp_3v; every
+            # condition/value/default must itself be device-evaluable
+            # (a None default fails the literal rule above)
+            return all(ok(c) for c in e.children)
         return False
 
     return (tables, expr) if ok(expr) else None
@@ -459,8 +485,12 @@ def can_evaluate_on_device(
     if isinstance(expr, _FuncExpr):
         if expr.is_agg or expr.func.upper() != "COALESCE":
             return False
+    elif isinstance(expr, _CaseWhenExpr):
+        # lowered as a jnp.where chain; a None default/value has no device
+        # representation (same rule as bare literals below)
+        pass
     elif not isinstance(expr, (_NamedColumnExpr, _LitColumnExpr, _BinaryOpExpr, _UnaryOpExpr)):
-        # unknown node types (CASE/IN/LIKE/...) have no jnp lowering yet
+        # unknown node types (IN/LIKE/...) have no jnp lowering yet
         return False
     return all(
         can_evaluate_on_device(c, device_cols, check_agg=False) for c in expr.children

@@ -146,6 +146,20 @@ FUGUE_TPU_CONF_PLAN_FUSE = "fugue.tpu.plan.fuse"
 # any lowering refusal keeps results bit-identical)
 FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS = "fugue.tpu.plan.lower_segments"
 
+# UDF static analysis (fugue_tpu/analysis, docs/analysis.md): AST-trace
+# plain-Python pandas UDFs into exact column read/write sets, purity/
+# row-locality verdicts, and (for the recognized shape subset) a
+# translation into the compiled step pipeline. analyze_udfs=false
+# restores the fully conservative pre-analysis treatment inside the
+# optimizer (UDFs demand ALL columns, never translate);
+# translate_udfs=false keeps the facts (pruning/pushdown still commute
+# through analyzed UDFs) but always runs UDFs on the interpreted path.
+# Both default ON; every refusal is bit-identical by construction. The
+# plan.* prefix keeps them per-run compile switches (never written into a
+# shared engine's conf).
+FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS = "fugue.tpu.plan.analyze_udfs"
+FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS = "fugue.tpu.plan.translate_udfs"
+
 # content-addressed result cache (fugue_tpu/cache, docs/cache.md): memoize
 # task outputs ACROSS runs, keyed on canonical post-optimization plan
 # fingerprints. Master switch (default ON — with no cache.dir the cache is

@@ -182,6 +182,7 @@ class ExecutionEngine(FugueEngineBase):
         self._rpc_server: Any = None
         self._resilience_stats: Any = None
         self._plan_stats: Any = None
+        self._analysis_stats: Any = None
         self._metrics: Any = None
         self._active_runs = 0
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
@@ -370,6 +371,7 @@ class ExecutionEngine(FugueEngineBase):
                     reg = MetricsRegistry()
                     reg.register("resilience", lambda: self.resilience_stats)
                     reg.register("plan", lambda: self.plan_stats)
+                    reg.register("analysis", lambda: self.analysis_stats)
                     reg.register("cache", lambda: self.result_cache.stats)
                     # distribution + resource sources are process-global (like
                     # the tracer feeding them) but mounted here so
@@ -482,6 +484,21 @@ class ExecutionEngine(FugueEngineBase):
 
                     self._plan_stats = PlanStats()
         return self._plan_stats
+
+    @property
+    def analysis_stats(self) -> Any:
+        """Cumulative UDF static-analyzer counters for workflows run on
+        this engine (``fugue_tpu/analysis``, docs/analysis.md):
+        udfs_analyzed / udfs_translated / udfs_refused by canonical
+        reason code. Alias of ``engine.metrics.get("analysis")`` — prefer
+        ``engine.stats()["analysis"]`` for reads."""
+        if getattr(self, "_analysis_stats", None) is None:
+            with self._rlock:
+                if getattr(self, "_analysis_stats", None) is None:
+                    from ..analysis import AnalysisStats
+
+                    self._analysis_stats = AnalysisStats()
+        return self._analysis_stats
 
     @property
     def result_cache(self) -> Any:

@@ -80,7 +80,18 @@ def node_delta_row_local(n: "LNode") -> bool:
     """Whether this node provably computes each output row from one input
     row (delta recompute may split its input at any partition boundary).
     Mirrors the fusion pass's K_SELECT guard: an aggregating / distinct /
-    HAVING select reads the whole frame."""
+    HAVING select reads the whole frame. A UDF transformer qualifies when
+    the static analyzer (``fugue_tpu/analysis``) proves it row-local,
+    pure and deterministic — every analysis failure is False."""
+    if n.kind == K_TRANSFORM:
+        if n.task is None:
+            return False
+        a = n.info.get("analysis")
+        if a is not None:
+            return bool(a.row_local and a.deterministic)
+        from ..analysis import transform_row_local
+
+        return transform_row_local(n.task)
     if n.kind not in DELTA_ROW_LOCAL_KINDS:
         return False
     if n.kind == K_SELECT:
@@ -480,7 +491,18 @@ def _node_schema(
         return first
     if n.kind in (K_FUSED, K_SEGMENT):
         return None  # no pass runs after fusion/lowering
-    return None  # transform / opaque / output
+    if n.kind == K_TRANSFORM:
+        # the analyzer (fugue_tpu/analysis) knows the declared output
+        # schema of analyzed plain-function UDFs
+        a = n.info.get("analysis")
+        if a is not None and a.schema_ok:
+            declared = [x for x, _ in a.declared]
+            if not a.star:
+                return declared
+            if first is not None:
+                return list(first) + [c for c in declared if c not in first]
+        return None
+    return None  # opaque / output
 
 
 def sniff_load_columns(path: Any, fmt: str) -> Optional[List[str]]:
@@ -628,7 +650,24 @@ def input_requirements(
         return [d for _ in n.inputs]
     if n.kind in (K_FUSED, K_SEGMENT):
         return [ALL for _ in n.inputs]
-    # transform (UDF column usage unknowable), output sinks, opaque
+    if n.kind == K_TRANSFORM and len(n.inputs) == 1:
+        # exact column facts from the static analyzer: the UDF reads R,
+        # writes W, and its declared schema decides what passes through —
+        # so pruning finally commutes through analyzed UDF transformers
+        a = n.info.get("analysis")
+        if a is not None and a.facts_ok and a.schema_ok and a.pure:
+            req = set(a.reads) | set(a.required_extra)
+            if a.star:
+                if d is ALL:
+                    return [ALL]
+                # demanded passthrough outputs must exist on the input
+                # (declared new names are produced by the UDF itself)
+                return [req | (set(d) - a.new_names)]
+            # explicit schema: enforcement selects every declared column
+            # from the returned frame; unwritten ones come from the input
+            return [req | ({x for x, _ in a.declared} - set(a.writes))]
+        return [ALL]
+    # transform (column usage unknowable), output sinks, opaque
     return [ALL for _ in n.inputs]
 
 

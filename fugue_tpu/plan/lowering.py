@@ -254,12 +254,15 @@ def lower_segments(nodes: List[LNode], report: Any) -> None:
         new_inputs = list(term.inputs)
         new_inputs[side] = chain[0].inputs[0]
         seg.inputs = new_inputs
-        seg.annotations.append(
+        desc = (
             f"lowered segment {fp}: "
             + " | ".join(describe_step(s) for s in steps)
             + " -> "
             + describe_terminal(terminal)
         )
+        seg.annotations.append(desc)
+        if hasattr(report, "segments"):
+            report.segments.append(desc)
         for c in cons[id(term)]:
             c.inputs = [seg if i is term else i for i in c.inputs]
         nodes[nodes.index(term)] = seg

@@ -11,11 +11,13 @@ import threading
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..constants import (
+    FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS,
     FUGUE_TPU_CONF_PLAN_FUSE,
     FUGUE_TPU_CONF_PLAN_LOWER_SEGMENTS,
     FUGUE_TPU_CONF_PLAN_OPTIMIZE,
     FUGUE_TPU_CONF_PLAN_PRUNE,
     FUGUE_TPU_CONF_PLAN_PUSHDOWN,
+    FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS,
 )
 from ..workflow._tasks import FugueTask
 from .ir import (
@@ -115,6 +117,15 @@ class PlanReport:
         self.bytes_skipped = 0
         self.segments_lowered = 0
         self.verbs_absorbed = 0
+        # UDF static analysis (fugue_tpu/analysis): per-run counters plus
+        # the structured per-UDF diagnostics workflow.lint() folds in
+        self.udfs_analyzed = 0
+        self.udfs_translated = 0
+        self.udfs_refused = 0
+        self.udf_diags: List[Dict[str, Any]] = []
+        # structured prediction facts for workflow.lint()
+        self.join_strategies: List[Dict[str, Any]] = []
+        self.segments: List[str] = []
         self.notes: List[str] = []
         self.before: List[str] = []
         self.after: List[str] = []
@@ -132,6 +143,7 @@ class PlanReport:
             "bytes_skipped": self.bytes_skipped,
             "segments_lowered": self.segments_lowered,
             "verbs_absorbed": self.verbs_absorbed,
+            "udfs_translated": self.udfs_translated,
         }
 
     @property
@@ -141,6 +153,7 @@ class PlanReport:
             + self.filters_pushed
             + self.verbs_fused
             + self.segments_lowered
+            + self.udfs_translated
         ) > 0
 
     def render(self) -> str:
@@ -152,13 +165,15 @@ class PlanReport:
         lines.append(
             "== optimized plan (cols_pruned=%d filters_pushed=%d "
             "verbs_fused=%d segments_lowered=%d verbs_absorbed=%d "
-            "bytes_skipped~%d) =="
+            "udfs_translated=%d/%d bytes_skipped~%d) =="
             % (
                 self.cols_pruned,
                 self.filters_pushed,
                 self.verbs_fused,
                 self.segments_lowered,
                 self.verbs_absorbed,
+                self.udfs_translated,
+                self.udfs_analyzed,
                 self.bytes_skipped,
             )
         )
@@ -303,6 +318,10 @@ def annotate_join_strategies(
             dec = choose_join_strategy(conf, lb, rb, rr)
             strategy, reason = dec.strategy, dec.reason
         n.annotations.append(f"strategy={strategy}")
+        report.join_strategies.append(
+            {"node": f"t{idx[id(n)]}", "how": how, "strategy": strategy,
+             "reason": reason}
+        )
         report.note(
             "join t%d (%s): strategy=%s -- %s"
             % (idx[id(n)], how, strategy, reason)
@@ -366,7 +385,10 @@ def annotate_delta_eligibility(nodes: List[LNode], report: "PlanReport") -> None
 
 
 def optimize_tasks(
-    tasks: List[FugueTask], conf: Any, stats: Optional[PlanStats] = None
+    tasks: List[FugueTask],
+    conf: Any,
+    stats: Optional[PlanStats] = None,
+    analysis_stats: Any = None,
 ) -> Tuple[List[FugueTask], Dict[int, FugueTask], Set[int], PlanReport]:
     """Rewrite the task DAG. Returns (tasks to execute, result-alias map
     {id(original task): executed task}, ids of original tasks whose
@@ -380,6 +402,19 @@ def optimize_tasks(
     nodes = build_graph(tasks)
     report.before = _render_nodes(nodes)
     annotate_join_strategies(nodes, conf, report)
+    if _flag(conf, FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS, True):
+        # UDF static analysis FIRST: translated UDFs become plain plan
+        # nodes every later pass (pushdown/prune/fuse/lower) composes
+        # with; analyzed-but-refused ones carry exact column facts
+        from ..analysis import expand_udf_transforms
+
+        diags = expand_udf_transforms(
+            nodes,
+            report,
+            translate=_flag(conf, FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS, True),
+        )
+        if analysis_stats is not None and diags:
+            analysis_stats.absorb(diags)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_PUSHDOWN, True):
         pushdown_filters(nodes, report)
     if _flag(conf, FUGUE_TPU_CONF_PLAN_PRUNE, True):

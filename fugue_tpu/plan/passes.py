@@ -32,6 +32,7 @@ from .ir import (
     K_SELECT,
     K_FUSED,
     K_SEGMENT,
+    K_TRANSFORM,
     LNode,
     compute_demand,
     consumers_map,
@@ -202,6 +203,29 @@ def _push_once(
         f.annotations.append(f"pushed below {how} join ({'left' if side == 0 else 'right'})")
         report.filters_pushed += 1
         return True
+    if p.kind == K_TRANSFORM:
+        # a filter commutes below an analyzed UDF transformer when the
+        # analyzer (fugue_tpu/analysis) proves the UDF row-local, pure and
+        # deterministic (dropping rows first changes nothing row-wise),
+        # under a '*' schema (names/dtypes of the filtered columns pass
+        # through unchanged), and the filter reads no written column
+        a = p.info.get("analysis")
+        if (
+            a is not None
+            and a.row_local
+            and a.deterministic
+            and a.star
+            and a.schema_ok
+            and a.writes is not None
+            and not (refs & (a.writes | a.new_names))
+        ):
+            swap()
+            return True
+        report.note(
+            "pushdown refused: UDF transformer not provably row-local/"
+            "pure or filter reads UDF-written columns"
+        )
+        return False
     if p.kind in (K_CREATE, K_LOAD):
         return False  # already at the producer
     report.note(f"pushdown stopped at {p.kind} (no commuting rule)")
@@ -378,7 +402,10 @@ def fuse_verbs(nodes: List[LNode], report: Any) -> None:
         if any(c.pinned for c in chain[:-1]):
             continue
         tail = chain[-1]
-        if tail.task is not None and not tail.task.checkpoint.is_null:
+        # a synthesized node (e.g. a translated UDF's tail) carries its
+        # origin task on tail_origin — same identity rules as a real task
+        tail_task = tail.tail_origin if tail.tail_origin is not None else tail.task
+        if tail_task is not None and not tail_task.checkpoint.is_null:
             continue
         stream_src = (
             len(head.inputs) == 1
@@ -392,7 +419,7 @@ def fuse_verbs(nodes: List[LNode], report: Any) -> None:
             steps.extend(_node_steps(c))
         fused = LNode(None, K_FUSED)
         fused.steps = steps
-        fused.tail_origin = tail.task
+        fused.tail_origin = tail_task
         # the fused task's output IS the chain tail's output; interior
         # results are fused away (their handles get a descriptive error)
         fused.result_of = list(tail.result_of)
@@ -517,6 +544,13 @@ def _emit_node(n: LNode, in_tasks: List[FugueTask]) -> FugueTask:
                 )
             t.defined_at = n.tail_origin.defined_at
         return t
+    if n.task is None:
+        # a synthesized plain verb (translated-UDF expansion,
+        # fugue_tpu/analysis/expand.py): emit a real builtin-processor
+        # task; the chain tail carries the origin transform's identity
+        t = _emit_synth_plain(n, in_tasks)
+        if t is not None:
+            return t
     assert n.task is not None
     unchanged = (
         n.param_override is None
@@ -531,3 +565,46 @@ def _emit_node(n: LNode, in_tasks: List[FugueTask]) -> FugueTask:
         params=n.param_override,
         input_tasks=in_tasks,
     )
+
+
+def _emit_synth_plain(n: LNode, in_tasks: List[FugueTask]) -> Optional[FugueTask]:
+    """Task for a synthesized plain-verb node (no originating task). The
+    same extension/params a workflow-built verb would carry, so the task
+    executes, fingerprints and classifies exactly like a hand-written one."""
+    from ..extensions._builtins import processors as bp
+
+    if n.kind == K_FILTER:
+        ext: Any = bp.Filter()
+        params: Dict[str, Any] = {"condition": n.info["condition"]}
+    elif n.kind == K_ASSIGN:
+        ext = bp.Assign()
+        params = {"columns": list(n.info["columns"])}
+    elif n.kind == K_SELECT:
+        ext = bp.Select()
+        params = {"columns": n.info["columns"]}
+        if n.info.get("where") is not None:
+            params["where"] = n.info["where"]
+        if n.info.get("having") is not None:
+            params["having"] = n.info["having"]
+    elif n.kind == K_PROJECT:
+        ext = bp.SelectColumns()
+        params = {"columns": list(n.info["columns"])}
+    elif n.kind == K_DROP:
+        ext = bp.DropColumns()
+        params = {
+            "columns": list(n.info["columns"]),
+            "if_exists": bool(n.info.get("if_exists", False)),
+        }
+    elif n.kind == K_RENAME:
+        ext = bp.Rename()
+        params = {"columns": dict(n.info["columns"])}
+    else:
+        return None
+    t = ProcessTask(ext, in_tasks, params=params, partition_spec=None)
+    if n.tail_origin is not None:
+        t.name = n.tail_origin.name
+        t.broadcast_flag = n.tail_origin.broadcast_flag
+        if n.tail_origin.yield_dataframe_handler is not None:
+            t.set_yield_dataframe_handler(n.tail_origin.yield_dataframe_handler)
+        t.defined_at = n.tail_origin.defined_at
+    return t

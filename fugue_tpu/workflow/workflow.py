@@ -912,7 +912,10 @@ class FugueWorkflow:
         tracer = get_tracer()
         with tracer.span("plan.optimize", cat="plan", tasks=len(self._tasks)) as psp:
             run_tasks, aliases, removed, report = optimize_tasks(
-                self._tasks, plan_conf, stats=e.plan_stats
+                self._tasks,
+                plan_conf,
+                stats=e.plan_stats,
+                analysis_stats=e.analysis_stats,
             )
             psp.set(**report.span_attrs())
         self._last_plan_report = report
@@ -979,23 +982,8 @@ class FugueWorkflow:
         except Exception as ex:  # export must never fail the run
             engine.log.warning("trace export failed: %s", ex)
 
-    def explain(self, conf: Any = None, engine: Any = None) -> str:
-        """Render what the plan optimizer (``fugue_tpu/plan``) would do to
-        this workflow's DAG — the logical plan, the optimized plan with
-        per-pass counters (cols_pruned / filters_pushed / verbs_fused /
-        bytes_skipped estimate), and any refusal notes — followed by the
-        result cache's would-be cut over the optimized plan: which tasks
-        hit, which are uncacheable (and why), and which upstream producers
-        a warm run would skip entirely. Dry-run only — nothing executes.
-        Pass ``engine`` to consult that engine's live cache tiers (memory
-        + disk); without it only a conf-derived disk store is probed.
-        After a ``run()``, the report of the plan that actually executed
-        is also available via ``last_plan_report``."""
-        from ..cache import describe_cache
+    def _merged_plan_conf(self, conf: Any = None, engine: Any = None) -> ParamDict:
         from ..constants import _FUGUE_GLOBAL_CONF
-        from ..plan import optimize_tasks
-        from ..plan.ir import build_graph
-        from ..plan.optimizer import _render_nodes
 
         merged = ParamDict(_FUGUE_GLOBAL_CONF)
         if engine is not None:
@@ -1003,6 +991,30 @@ class FugueWorkflow:
         merged.update(self._conf)
         if conf is not None:
             merged.update(ParamDict(conf))
+        return merged
+
+    def explain(
+        self, conf: Any = None, engine: Any = None, lint: bool = False
+    ) -> str:
+        """Render what the plan optimizer (``fugue_tpu/plan``) would do to
+        this workflow's DAG — the logical plan, the optimized plan with
+        per-pass counters (cols_pruned / filters_pushed / verbs_fused /
+        udfs_translated / bytes_skipped estimate), and any refusal notes
+        (including every UDF's analyzer verdict) — followed by the
+        result cache's would-be cut over the optimized plan: which tasks
+        hit, which are uncacheable (and why), and which upstream producers
+        a warm run would skip entirely. Dry-run only — nothing executes.
+        Pass ``engine`` to consult that engine's live cache tiers (memory
+        + disk); without it only a conf-derived disk store is probed.
+        ``lint=True`` appends the structured static-check section (see
+        :meth:`lint`). After a ``run()``, the report of the plan that
+        actually executed is also available via ``last_plan_report``."""
+        from ..cache import describe_cache
+        from ..plan import optimize_tasks
+        from ..plan.ir import build_graph
+        from ..plan.optimizer import _render_nodes
+
+        merged = self._merged_plan_conf(conf, engine)
         run_tasks, _, _, report = optimize_tasks(self._tasks, merged)
         if not report.before:
             report.before = _render_nodes(build_graph(self._tasks))
@@ -1015,7 +1027,20 @@ class FugueWorkflow:
                 engine_kind="any" if engine is None else type(engine).__name__,
             )
         )
+        if lint:
+            lines.append(self.lint(conf=conf, engine=engine).render())
         return "\n".join(lines)
+
+    def lint(self, conf: Any = None, engine: Any = None) -> Any:
+        """No-execution static check pass (docs/analysis.md): runs the
+        UDF analyzer plus the plan machinery over this workflow and
+        returns a :class:`~fugue_tpu.analysis.LintReport` of structured
+        diagnostics — per-UDF verdict and refusal reason, predicted join
+        strategies, predicted lowered segments, and every optimizer note.
+        Nothing executes and the compiled tasks are never mutated."""
+        from ..analysis import lint_tasks
+
+        return lint_tasks(self._tasks, self._merged_plan_conf(conf, engine))
 
     @property
     def last_plan_report(self) -> Any:

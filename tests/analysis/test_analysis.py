@@ -1,0 +1,817 @@
+"""UDF static analyzer (``fugue_tpu/analysis``, docs/analysis.md) — ISSUE 11.
+
+The checklist:
+
+- **parity matrix**: translated vs interpreted bit-identical across the
+  jax AND native engines × optimizer on/off × bounded AND streaming
+  inputs, over the recognized subset (arithmetic, comparisons, boolean
+  masks, fillna/clip/where/mask/isin/astype, np.where conditionals,
+  bound params + scalar closures, statically-decided ``if``);
+- **column-set correctness**: pruning reaches the producer under an
+  analyzed UDF (translated AND facts-only), spied on the producer;
+- **refusal matrix**: globals, closures over mutables, ``.apply``, loops
+  with break, unknown methods, non-determinism, data-dependent
+  conditionals, partitioned transforms, star-schema passthrough writes —
+  each refuses to the interpreted path bit-identically with its reason
+  rendered in ``workflow.explain()``;
+- **fingerprint**: an edited UDF translates to different steps (cache
+  miss), an identical one re-uses its cached trace;
+- **delta cache**: an analyzed row-local UDF chain over a grown source
+  delta-serves (only appended partitions recompute);
+- **surface**: ``workflow.lint()`` structured diagnostics,
+  ``explain(lint=True)``, ``engine.stats()["analysis"]`` counters
+  flattened onto a valid ``/metrics`` exposition, conf gates.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_CACHE_DIR,
+    FUGUE_TPU_CONF_CACHE_ENABLED,
+    FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS,
+    FUGUE_TPU_CONF_PLAN_OPTIMIZE,
+    FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS,
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.obs import get_tracer
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _frame(n=4000, cols=6, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 16, n),
+            "v": rng.random(n),
+            "w": rng.random(n),
+            **{f"x{i}": rng.random(n) for i in range(cols)},
+        }
+    )
+    pdf.loc[pdf.index % 9 == 0, "v"] = np.nan
+    return pdf
+
+
+def _stream(pdf: pd.DataFrame, step: int = 512):
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(step, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, step)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+def _run_once(build, conf, engine_cls=JaxExecutionEngine, sort=None):
+    conf = dict(conf)
+    conf.setdefault(FUGUE_TPU_CONF_CACHE_ENABLED, False)
+    eng = engine_cls(conf)
+    dag = FugueWorkflow()
+    build(dag)
+    dag.run(eng)
+    res = dag.yields["r"].result.as_pandas()
+    if sort:
+        res = res.sort_values(sort).reset_index(drop=True)
+    return res, eng, dag
+
+
+def _assert_translated_parity(build, sort=None, engine_conf=None):
+    """Translated (analysis ON) must be bit-identical to the pre-analysis
+    engine (analysis OFF) on BOTH engines × optimizer on/off; returns the
+    translated-path jax result and its engine/dag."""
+    base = dict(engine_conf or {})
+    ref = None
+    out = None
+    for engine_cls in (JaxExecutionEngine, NativeExecutionEngine):
+        for opt in (True, False):
+            for analyze in (True, False):
+                conf = dict(base)
+                conf[FUGUE_TPU_CONF_PLAN_OPTIMIZE] = opt
+                conf[FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS] = analyze
+                res, eng, dag = _run_once(build, conf, engine_cls, sort=sort)
+                if ref is None:
+                    ref = res
+                else:
+                    pd.testing.assert_frame_equal(res, ref)
+                if engine_cls is JaxExecutionEngine and opt and analyze:
+                    out = (res, eng, dag)
+    assert out is not None
+    return out
+
+
+# module-level UDFs (the analyzer reads their SOURCE; exec'd or REPL
+# functions refuse with reason "source")
+
+
+def udf_arith(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) * 2.0 + df["w"]
+    df = df[df["z"] > 0.3]
+    return df
+
+
+def udf_conditional(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = np.where(df["w"] > 0.5, df["w"] * 2.0, df["v"].fillna(0.25))
+    mask = df["z"] > 0.4
+    df = df[mask]
+    return df
+
+
+def udf_methods(df: pd.DataFrame) -> pd.DataFrame:
+    df["c"] = df["v"].clip(0.1, 0.9)
+    df["m"] = df["w"].where(df["w"] > 0.5, 0.5)
+    df["r"] = df["v"].fillna(0.0).round(2).abs()
+    df["kk"] = df["k"].isin([1, 2, 3])
+    df["f"] = df["k"].astype("float64")
+    return df
+
+
+def _make_scaled_udf(scale: float):
+    # a SCALAR closure cell — allowed (and part of the trace fingerprint)
+    def udf_params(df: pd.DataFrame, lo: float, hi: float = 0.8) -> pd.DataFrame:
+        df["z"] = (df["v"].fillna(lo) * scale).clip(lo, hi)
+        df = df[df["z"] >= lo]
+        return df
+
+    return udf_params
+
+
+def udf_overwrite(df: pd.DataFrame) -> pd.DataFrame:
+    df["v"] = df["v"].fillna(0.0) * 2.5
+    df["z"] = df["v"] + df["w"]
+    return df
+
+
+def udf_static_if(df: pd.DataFrame, mode: str = "double") -> pd.DataFrame:
+    if mode == "double":
+        df["z"] = df["v"].fillna(0.0) * 2.0
+    else:
+        df["z"] = df["v"].fillna(0.0) + 100.0
+    return df
+
+
+def udf_reduction(df: pd.DataFrame) -> pd.DataFrame:
+    total = df["v"].fillna(0.0).sum()
+    df["z"] = df["v"].fillna(0.0) / (total + 1.0)
+    return df
+
+
+# ---------------------------------------------------------------------------
+# parity matrix
+# ---------------------------------------------------------------------------
+
+
+def test_parity_arith_star_bounded():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_arith, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, dag = _assert_translated_parity(build)
+    assert (res["z"] > 0.3).all()
+    assert eng.stats()["analysis"]["udfs_translated"] >= 1
+    assert dag.last_plan_report.udfs_translated == 1
+
+
+def test_parity_conditional_and_series_mask():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_conditional, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, _, dag = _assert_translated_parity(build)
+    assert len(res) > 0
+    assert dag.last_plan_report.udfs_translated == 1
+
+
+def test_parity_method_subset():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(
+                pdf.copy(),
+                using=udf_methods,
+                schema="*,c:double,m:double,r:double,kk:bool,f:double",
+            ).yield_dataframe_as("r", as_local=True)
+        )
+
+    res, _, dag = _assert_translated_parity(build)
+    assert dag.last_plan_report.udfs_translated == 1
+    assert res["c"].dropna().between(0.1, 0.9).all()
+
+
+def test_parity_params_and_closure():
+    pdf = _frame()
+    udf = _make_scaled_udf(3.0)
+
+    def build(dag):
+        (
+            dag.transform(
+                pdf.copy(),
+                using=udf,
+                schema="*,z:double",
+                params=dict(lo=0.2),
+            ).yield_dataframe_as("r", as_local=True)
+        )
+
+    res, _, dag = _assert_translated_parity(build)
+    assert dag.last_plan_report.udfs_translated == 1
+    assert (res["z"] >= 0.2).all()
+
+
+def test_parity_explicit_schema_overwrite():
+    """An explicit full schema may overwrite existing columns (declared
+    dtypes are known) and narrows the output to the declared list."""
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(
+                pdf.copy(), using=udf_overwrite, schema="k:long,v:double,z:double"
+            ).yield_dataframe_as("r", as_local=True)
+        )
+
+    res, _, dag = _assert_translated_parity(build)
+    assert list(res.columns) == ["k", "v", "z"]
+    assert dag.last_plan_report.udfs_translated == 1
+
+
+def test_parity_static_if_takes_bound_branch():
+    pdf = _frame()
+    for mode in ("double", "add"):
+
+        def build(dag):
+            (
+                dag.transform(
+                    pdf.copy(),
+                    using=udf_static_if,
+                    schema="*,z:double",
+                    params=dict(mode=mode),
+                ).yield_dataframe_as("r", as_local=True)
+            )
+
+        res, _, dag = _assert_translated_parity(build)
+        assert dag.last_plan_report.udfs_translated == 1
+        if mode == "add":
+            assert (res["z"] >= 100.0).all()
+
+
+def test_parity_streaming_single_segment():
+    """Streaming source: the translated UDF chain + dense aggregate must
+    compile into ONE segment program — exactly one segment jit entry,
+    zero fallbacks, no engine.transform span — and stay bit-identical."""
+    pdf = _frame(6000)
+
+    def build(dag):
+        (
+            dag.df(_stream(pdf))
+            .transform(using=udf_arith, schema="*,z:double")
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"), ff.count(col("z")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    conf = {FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 512}
+    outs = []
+    for analyze in (True, False):
+        c = dict(conf)
+        c[FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS] = analyze
+        res, eng, dag = _run_once(build, c, JaxExecutionEngine, sort=["k"])
+        outs.append(res)
+        if analyze:
+            seg = eng._jit_cache.segment_entries()
+            assert len(seg) == 1 and set(seg.values()) == {1}, seg
+            st = eng.stats()["plan"]
+            assert st["segments_executed"] >= 1 and st["segments_fallback"] == 0
+    pd.testing.assert_frame_equal(outs[0], outs[1])
+
+
+def test_translated_fuses_with_surrounding_verbs():
+    """Workflow verbs around the UDF and the translated steps collapse
+    into one fused chain (no standalone engine.transform execution)."""
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf.copy())
+            .filter(col("w") < 0.95)
+            .transform(using=udf_arith, schema="*,z:double")
+            .select(col("k"), col("z"), (col("z") * 2).alias("z2"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.enable()
+    tracer.clear()
+    try:
+        res, eng, dag = _run_once(
+            build, {FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS: True}, JaxExecutionEngine
+        )
+        names = {r["name"] for r in tracer.records()}
+        assert "engine.fused" in names or any(
+            n == "plan.segment" for n in names
+        ), names
+        # the whole chain is ONE task: no separate filter/select verbs
+        assert "engine.filter" not in names and "engine.select" not in names
+        rep = dag.last_plan_report
+        assert rep.udfs_translated == 1 and rep.verbs_fused >= 4
+    finally:
+        if not was:
+            tracer.disable()
+        tracer.clear()
+    # and parity for the same workflow
+    _assert_translated_parity(build)
+
+
+# ---------------------------------------------------------------------------
+# column-set correctness (pruning reaches the producer)
+# ---------------------------------------------------------------------------
+
+
+def _pruned_columns_seen(build, conf):
+    import fugue_tpu.plan.passes as passes
+
+    seen = []
+    passes.PRUNE_OBSERVER = seen.append
+    try:
+        res, eng, dag = _run_once(build, conf, JaxExecutionEngine, sort=None)
+    finally:
+        passes.PRUNE_OBSERVER = None
+    return seen, res
+
+
+def test_pruning_reaches_producer_translated():
+    pdf = _frame(cols=8)
+
+    def build(dag):
+        (
+            dag.df(pdf.copy())
+            .transform(using=udf_arith, schema="*,z:double")
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    seen, _ = _pruned_columns_seen(build, {})
+    assert seen and all(set(s) == {"k", "v", "w"} for s in seen), seen[:3]
+
+
+def test_pruning_reaches_producer_facts_only():
+    """translate_udfs=false: the UDF stays interpreted but its EXACT
+    column reads still narrow demand — the producer only carries what
+    the UDF + downstream read."""
+    pdf = _frame(cols=8)
+
+    def build(dag):
+        (
+            dag.df(pdf.copy())
+            .transform(using=udf_arith, schema="*,z:double")
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    seen, _ = _pruned_columns_seen(
+        build, {FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS: False}
+    )
+    assert seen and all(set(s) == {"k", "v", "w"} for s in seen), seen[:3]
+    # parity for the facts-only path against fully-conservative
+    _assert_translated_parity(
+        build,
+        sort=["k"],
+        engine_conf={FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS: False},
+    )
+
+
+def test_pushdown_commutes_through_row_local_udf():
+    """translate_udfs=false: a filter over a column the (row-local, pure,
+    star-schema) UDF never writes commutes BELOW the interpreted UDF."""
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_writes_passthrough_free, schema="*,z:double")
+            .filter(col("x0") < 0.5)
+            .select(col("k"), col("z"), col("x0"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, dag = _run_once(
+        build, {FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS: False}, JaxExecutionEngine
+    )
+    assert dag.last_plan_report.filters_pushed >= 1
+    assert (res["x0"] < 0.5).all()
+    _assert_translated_parity(build)
+
+
+def udf_writes_passthrough_free(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) * 2.0 + df["w"]
+    return df
+
+
+def test_pruning_under_reduction_udf():
+    """A per-partition reduction is pure-but-not-row-local: interpreted
+    execution, exact reads — pruning still reaches the producer when the
+    downstream demand narrows (star passthrough demands what consumers
+    read plus what the UDF reads)."""
+    pdf = _frame(cols=8)
+
+    def build(dag):
+        (
+            dag.df(pdf.copy())
+            .transform(using=udf_reduction, schema="*,z:double")
+            .partition_by("k")
+            .aggregate(ff.sum(col("z")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    seen, _ = _pruned_columns_seen(build, {})
+    assert seen and all(set(s) == {"k", "v"} for s in seen), seen[:3]
+    res, eng, dag = _run_once(build, {}, JaxExecutionEngine)
+    assert eng.stats()["analysis"]["udfs_translated"] == 0
+    d = dag.last_plan_report.udf_diags[0]
+    assert d["code"] == "reduction" and not d["translated"]
+    _assert_translated_parity(build, sort=["k"])
+
+
+# ---------------------------------------------------------------------------
+# refusal matrix — every case bit-identical with the reason rendered
+# ---------------------------------------------------------------------------
+
+_GLOBAL_OFFSET = 1.5
+
+
+def udf_reads_global(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) + _GLOBAL_OFFSET
+    return df
+
+
+_MUTABLE = [2.0]
+
+
+def _make_closure_udf():
+    lut = _MUTABLE
+
+    def udf_mutable_closure(df: pd.DataFrame) -> pd.DataFrame:
+        df["z"] = df["v"].fillna(0.0) * lut[0]
+        return df
+
+    return udf_mutable_closure
+
+
+def udf_apply(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].apply(lambda x: x * 2)
+    return df
+
+
+def udf_loop(df: pd.DataFrame) -> pd.DataFrame:
+    for c in ["v", "w"]:
+        df[c] = df[c] * 2
+        if c == "v":
+            break
+    return df
+
+
+def udf_unknown_method(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].rolling(3).mean()
+    return df
+
+
+def udf_random(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) + np.random.random()
+    return df
+
+
+def udf_data_dependent_if(df: pd.DataFrame) -> pd.DataFrame:
+    if df["v"].mean() > 0.5:
+        df["z"] = df["v"].fillna(1.0)
+    else:
+        df["z"] = df["w"]
+    return df
+
+
+REFUSALS = [
+    (udf_reads_global, "globals"),
+    (_make_closure_udf(), "mutable-closure"),
+    (udf_apply, "apply"),
+    (udf_loop, "loop"),
+    (udf_unknown_method, "unknown-call"),
+    (udf_random, "non-deterministic"),
+    (udf_data_dependent_if, "conditional"),
+]
+
+
+@pytest.mark.parametrize(
+    "udf,code", REFUSALS, ids=[c for _, c in REFUSALS]
+)
+def test_refusal_matrix(udf, code):
+    pdf = _frame(1200)
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    if udf is udf_loop:
+
+        def build(dag):  # noqa: F811 - loop UDF mutates, declares no new col
+            (
+                dag.transform(pdf.copy(), using=udf, schema="*")
+                .yield_dataframe_as("r", as_local=True)
+            )
+
+    if udf is udf_random:
+        # non-deterministic: can't compare two runs — assert refusal only
+        res, eng, dag = _run_once(build, {}, JaxExecutionEngine)
+    else:
+        res, eng, dag = _assert_translated_parity(build)
+    stats = eng.stats()["analysis"]
+    assert stats["udfs_translated"] == 0
+    assert stats["udfs_refused"] >= 1
+    assert code in stats["refused"], stats["refused"]
+    dag2 = FugueWorkflow()
+    build(dag2)
+    text = dag2.explain()
+    assert "interpreted --" in text, text
+
+
+def test_refusal_partitioned_transform():
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.df(pdf.copy())
+            .partition_by("k")
+            .transform(using=udf_arith, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, dag = _assert_translated_parity(build, sort=["k", "v", "w"])
+    assert eng.stats()["analysis"]["refused"].get("partitioned", 0) >= 1
+
+
+def udf_writes_passthrough(df: pd.DataFrame) -> pd.DataFrame:
+    df["v"] = df["v"].fillna(0.0) * 2.0
+    return df
+
+
+def test_refusal_star_passthrough_write():
+    """Writing an existing column under a '*' schema: the enforced output
+    dtype is the ORIGINAL input dtype (unknown at plan time) — refuse."""
+    pdf = _frame()
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_writes_passthrough, schema="*")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, dag = _assert_translated_parity(build)
+    d = dag.last_plan_report.udf_diags[0]
+    assert not d["translated"] and "passthrough" in (d["reason"] or "")
+
+
+def udf_stale_series(df: pd.DataFrame) -> pd.DataFrame:
+    m = df["v"] > 0.5
+    df = df[df["w"] > 0.1]
+    df = df[m]
+    return df
+
+
+def test_refusal_stale_series_variable():
+    """A mask bound BEFORE a frame mutation is pandas-aligned by the
+    captured values — re-evaluating it later would see different rows, so
+    the analyzer refuses (aliasing)."""
+    pdf = _frame(800)
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_stale_series, schema="*")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, dag = _assert_translated_parity(build)
+    assert eng.stats()["analysis"]["refused"].get("aliasing", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# fingerprints, caching, delta
+# ---------------------------------------------------------------------------
+
+
+def udf_edit_v1(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) + 1.0
+    return df
+
+
+def udf_edit_v2(df: pd.DataFrame) -> pd.DataFrame:
+    df["z"] = df["v"].fillna(0.0) + 2.0
+    return df
+
+
+def test_fingerprint_invalidation_on_udf_edit(tmp_path):
+    """With the result cache ON, a translated plan's identity follows the
+    translated steps: the same UDF warm-hits, an edited one misses."""
+    d = str(tmp_path / "cache")
+    pdf = _frame(800)
+
+    def build_with(udf):
+        def build(dag):
+            (
+                dag.transform(pdf.copy(), using=udf, schema="*,z:double")
+                .yield_dataframe_as("r", as_local=True)
+            )
+
+        return build
+
+    conf = {FUGUE_TPU_CONF_CACHE_ENABLED: True, FUGUE_TPU_CONF_CACHE_DIR: d}
+    r1, _, _ = _run_once(build_with(udf_edit_v1), conf)
+    r1b, e1b, d1b = _run_once(build_with(udf_edit_v1), conf)
+    assert d1b.last_cache_plan.summary()["executes"] == 0  # warm hit
+    pd.testing.assert_frame_equal(r1, r1b)
+    r2, _, d2 = _run_once(build_with(udf_edit_v2), conf)
+    assert d2.last_cache_plan.summary()["executes"] >= 1  # edited: recompute
+    assert not r1.equals(r2)
+
+
+def test_delta_cache_serves_analyzed_udf_chain(tmp_path):
+    """A row-local analyzed UDF chain over a grown parquet directory
+    recomputes ONLY the appended partition on the warm run."""
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+
+    def write_part(i):
+        rng = np.random.default_rng(500 + i)
+        n = 700
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 8, n).astype("int64"),
+                    "v": rng.random(n),
+                    "w": rng.random(n),
+                }
+            ),
+            os.path.join(src, f"part_{i:03d}.parquet"),
+        )
+
+    for i in range(3):
+        write_part(i)
+
+    def build(dag):
+        (
+            dag.load(src, fmt="parquet")
+            .transform(using=udf_arith, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    conf = {
+        FUGUE_TPU_CONF_CACHE_ENABLED: True,
+        FUGUE_TPU_CONF_CACHE_DIR: str(tmp_path / "cache"),
+    }
+    r1, e1, _ = _run_once(build, conf)
+    write_part(3)  # grow the source
+    r2, e2, d2 = _run_once(build, conf)
+    cs = e2.stats()["cache"]
+    assert cs["partial_hits"] >= 1, cs
+    # 3 partitions served from cache, exactly the 1 appended one fresh
+    assert cs["delta_partitions_fresh"] == 1 and cs["delta_partitions"] == 3, cs
+    # bit-identical to a cache-off full recompute
+    ref, _, _ = _run_once(build, {FUGUE_TPU_CONF_CACHE_ENABLED: False})
+    pd.testing.assert_frame_equal(r2, ref)
+
+
+# ---------------------------------------------------------------------------
+# surface: lint, counters, metrics, conf gates
+# ---------------------------------------------------------------------------
+
+
+def test_lint_structured_diagnostics():
+    pdf = _frame()
+    dag = FugueWorkflow()
+    (
+        dag.transform(pdf, using=udf_arith, schema="*,z:double")
+        .partition_by("k")
+        .aggregate(ff.sum(col("z")).alias("s"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    rep = dag.lint()
+    udfs = rep.udfs
+    assert len(udfs) == 1 and udfs[0].status == "translated", rep.as_dict()
+    assert any(d.kind == "segment" for d in rep.diagnostics), rep.as_dict()
+    text = dag.explain(lint=True)
+    assert "== lint ==" in text and "[udf]" in text
+    # a refused UDF carries its reason code + message
+    dag2 = FugueWorkflow()
+    dag2.transform(pdf, using=udf_apply, schema="*,z:double").yield_dataframe_as(
+        "r2", as_local=True
+    )
+    rep2 = dag2.lint()
+    assert rep2.udfs[0].status == "apply", rep2.as_dict()
+    assert "apply" in rep2.udfs[0].message or ".apply" in rep2.udfs[0].message
+
+
+def test_counters_and_prometheus_exposition():
+    from fugue_tpu.obs import to_prometheus_text, validate_prometheus_text
+
+    pdf = _frame(800)
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_arith, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, _ = _run_once(build, {}, JaxExecutionEngine)
+    res2, eng2, _ = _run_once(
+        lambda dag: dag.transform(
+            pdf.copy(), using=udf_apply, schema="*,z:double"
+        ).yield_dataframe_as("r", as_local=True),
+        {},
+        JaxExecutionEngine,
+    )
+    st = eng.stats()["analysis"]
+    assert st == {
+        "udfs_analyzed": 1,
+        "udfs_translated": 1,
+        "udfs_refused": 0,
+        "refused": {},
+    }
+    text = to_prometheus_text(engine=eng2)
+    validate_prometheus_text(text)
+    for want in (
+        "fugue_tpu_analysis_udfs_analyzed 1",
+        "fugue_tpu_analysis_udfs_refused 1",
+        "fugue_tpu_analysis_refused_apply 1",
+    ):
+        assert want in text, want
+    # reset contract: counters zero, source object kept
+    eng2.reset_stats()
+    assert eng2.stats()["analysis"]["udfs_analyzed"] == 0
+
+
+def test_conf_gates():
+    pdf = _frame(800)
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=udf_arith, schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    # analyze_udfs=false: nothing analyzed, fully conservative
+    res_off, eng_off, dag_off = _run_once(
+        build, {FUGUE_TPU_CONF_PLAN_ANALYZE_UDFS: False}
+    )
+    assert eng_off.stats()["analysis"]["udfs_analyzed"] == 0
+    assert dag_off.last_plan_report.udfs_analyzed == 0
+    # translate_udfs=false: analyzed, refused with code "disabled"
+    res_nt, eng_nt, dag_nt = _run_once(
+        build, {FUGUE_TPU_CONF_PLAN_TRANSLATE_UDFS: False}
+    )
+    st = eng_nt.stats()["analysis"]
+    assert st["udfs_analyzed"] == 1 and st["udfs_translated"] == 0
+    assert st["refused"].get("disabled") == 1
+    pd.testing.assert_frame_equal(res_off, res_nt)
+
+
+def test_exec_udf_refuses_no_source():
+    """A UDF with no retrievable source (exec'd) refuses conservatively."""
+    ns = {"pd": pd}
+    exec(
+        "def bump(df: pd.DataFrame) -> pd.DataFrame:\n"
+        "    return df.assign(z=df['v'] + 1.0)\n",
+        ns,
+    )
+    pdf = _frame(600)
+
+    def build(dag):
+        (
+            dag.transform(pdf.copy(), using=ns["bump"], schema="*,z:double")
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    res, eng, _ = _run_once(build, {}, JaxExecutionEngine)
+    st = eng.stats()["analysis"]
+    assert st["udfs_translated"] == 0 and st["refused"].get("source") == 1

@@ -419,6 +419,7 @@ def test_engine_stats_surface_and_shims():
             "pipeline",
             "jit_cache",
             "plan",
+            "analysis",
             "cache",
             "shuffle",
             "latency",

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Repo concurrency lint (``make lint-locks``) — ISSUE 11 satellite.
+
+Extends the ISSUE 10 shared-engine concurrency audit into a repeatable
+AST check: inside classes that own a lock (``self._lock`` / ``self._rlock``
+/ ``self._cv`` assigned in ``__init__``), every write to a shared mutable
+attribute (``self.x = ...`` / ``self.x += 1``) must happen lexically
+under ``with self.<lock>:`` — the audited narrow-lock pattern
+(``PlanStats.inc``, ``CacheStats``, ``ShuffleStats``, ``AnalysisStats``,
+the engine's double-checked lazy singletons). A bare ``+=`` on one of
+these is exactly the lost-update class of bug the ISSUE 10 hammer caught.
+
+Heuristic, not a proof — so it is wired into ``make test`` as a
+NON-blocking report. Conventions it understands:
+
+- ``__init__`` writes are construction-time (single-threaded) — skipped;
+- methods named ``reset``/``clear`` that open with a lock are fine
+  (covered by the lexical check anyway);
+- methods whose name ends in ``_locked`` are called under the caller's
+  lock — skipped;
+- attributes in PER_CLASS_ALLOW are audited-safe (e.g. deliberate
+  lock-free idioms documented in the code, like JitCache's racing
+  compile-insert where both winners are identical).
+
+Run ``python tools/lint_locks.py --strict`` to exit non-zero on findings.
+"""
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_ROOT = os.path.join(REPO, "fugue_tpu")
+
+LOCK_ATTRS = {"_lock", "_rlock", "_cv"}
+
+# (class, attr) writes that are audited-safe by design. Keep this SHORT —
+# every entry should correspond to a comment in the source explaining why
+# the lock-free write is sound.
+PER_CLASS_ALLOW: Set[Tuple[str, str]] = {
+    # JitCache: the key-not-in-cache compile idiom deliberately stays
+    # lock-free — racing compiles are identical and the 2nd insert
+    # replaces the 1st (ISSUE 10 audit note)
+    ("JitCache", "_cache"),
+}
+
+# attribute-name prefixes that are configuration/identity set once at
+# construction or under external orchestration, not shared counters
+SKIP_PREFIXES = ("_lock", "_rlock", "_cv", "__")
+
+
+def _lock_names(cls: ast.ClassDef) -> Set[str]:
+    """Lock attributes assigned in __init__ (self._lock = Lock() style)."""
+    names: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr in LOCK_ATTRS
+                        ):
+                            names.add(t.attr)
+    return names
+
+
+def _with_holds_lock(w: ast.With, locks: Set[str]) -> bool:
+    for item in w.items:
+        for sub in ast.walk(item.context_expr):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in locks
+            ):
+                return True
+    return False
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one method body tracking whether the current node is inside a
+    ``with self.<lock>:`` block."""
+
+    def __init__(self, cls: str, method: str, locks: Set[str], findings: list):
+        self.cls = cls
+        self.method = method
+        self.locks = locks
+        self.findings = findings
+        self.depth = 0  # with-lock nesting
+
+    def visit_With(self, node: ast.With) -> None:
+        held = _with_holds_lock(node, self.locks)
+        if held:
+            self.depth += 1
+        self.generic_visit(node)
+        if held:
+            self.depth -= 1
+
+    def _check_target(self, t: ast.expr, lineno: int) -> None:
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            and not any(t.attr.startswith(p) for p in SKIP_PREFIXES)
+            and (self.cls, t.attr) not in PER_CLASS_ALLOW
+            and self.depth == 0
+        ):
+            self.findings.append((self.cls, self.method, t.attr, lineno))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    # nested defs get their own checker scope skipped (closures run later,
+    # possibly under different locking); keep the lint focused
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+
+def lint_file(path: str) -> List[Tuple[str, str, str, str, int]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return []
+    rel = os.path.relpath(path, REPO)
+    out: List[Tuple[str, str, str, str, int]] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        locks = _lock_names(cls)
+        if not locks:
+            continue
+        for m in cls.body:
+            if not isinstance(m, ast.FunctionDef):
+                continue
+            if m.name == "__init__" or m.name.endswith("_locked"):
+                continue
+            findings: list = []
+            checker = _MethodChecker(cls.name, m.name, locks, findings)
+            for stmt in m.body:
+                checker.visit(stmt)
+            out.extend((rel, c, meth, attr, ln) for c, meth, attr, ln in findings)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--strict", action="store_true", help="exit 1 when findings exist"
+    )
+    ap.add_argument("paths", nargs="*", help="files to lint (default: fugue_tpu/)")
+    args = ap.parse_args()
+    files: List[str] = []
+    if args.paths:
+        files = args.paths
+    else:
+        for root, _dirs, names in os.walk(SCAN_ROOT):
+            if "__pycache__" in root:
+                continue
+            files.extend(
+                os.path.join(root, n) for n in sorted(names) if n.endswith(".py")
+            )
+    findings = []
+    for p in files:
+        findings.extend(lint_file(p))
+    for rel, cls, meth, attr, ln in findings:
+        print(
+            f"{rel}:{ln}: {cls}.{meth} writes shared attribute "
+            f"'self.{attr}' outside 'with self.<lock>:'"
+        )
+    n = len(findings)
+    print(
+        f"lint-locks: {n} unguarded shared-attribute write(s) in "
+        f"{len(files)} file(s)"
+        + ("" if n == 0 else " -- audit each or add to PER_CLASS_ALLOW")
+    )
+    return 1 if (args.strict and n > 0) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
