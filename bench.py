@@ -843,6 +843,14 @@ def _north_star() -> None:
     compiled map (subtract) — so the 1B rows are generated on the fly,
     pass through the device in chunks, and never exist in full anywhere.
     Writes NORTH_STAR.json; bench runs embed it as extra.north_star_1b."""
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_TUNING_ENABLED,
+        register_global_conf,
+    )
+
+    # the north-star A/B (BENCH_NS_PREFETCH etc.) measures explicit static
+    # configurations; adaptive learning between stages would confound it
+    register_global_conf({FUGUE_TPU_CONF_TUNING_ENABLED: False})
     on_tpu = _tpu_reachable()
     if not on_tpu:
         _force_cpu_mesh()
@@ -1575,6 +1583,262 @@ def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> d
     }
 
 
+def _bench_adaptive_tuning(
+    rows: int = 400_000,
+    misconf_chunk: int = 2048,
+    groups: int = 64,
+    join_rows: int = 120_000,
+    join_budget: int = 2 << 20,
+    join_bucket_bytes: int = 16 << 10,
+) -> dict:
+    """Cost-based adaptive execution case (ISSUE 12, docs/tuning.md).
+
+    Deliberately mis-configures the engine — ``stream.chunk_rows`` 512x
+    too small for the workload, ``shuffle.bucket_bytes`` sizing ~10x too
+    many buckets — and lets the feedback layer fix it from its own
+    telemetry. The gate (exit 14): after convergence, a FRESH engine
+    (simulated restart — settings come back from ``ops/_tuned.json``)
+    runs the same plan >= 1.3x faster than the mis-conf'd cold run,
+    bit-identical; the tuned decisions render in ``workflow.explain()``;
+    ``fugue.tpu.tuning.enabled=false`` reproduces the static engine
+    exactly (same chunk count, same result); the spill join's calibrated
+    bucket count comes in under the mis-conf'd one; and a long-lived
+    ``EngineServer`` converges across >= 3 submissions of one plan. The
+    committed store file is snapshotted and restored, so bench runs
+    don't churn the repo."""
+    import numpy as _np
+    import pandas as _pd
+    import pyarrow as _pa
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col, functions as ff
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+        FUGUE_TPU_CONF_TUNING_ENABLED,
+    )
+    from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.jax import streaming as _streaming
+    from fugue_tpu.tuning import resolve_tuned_path
+
+    conf = {
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: misconf_chunk,
+        FUGUE_TPU_CONF_CACHE_ENABLED: False,
+        FUGUE_TPU_CONF_TUNING_ENABLED: True,  # bench-global conf turns it off
+    }
+    store_path = resolve_tuned_path(None)
+    snapshot = None
+    if os.path.exists(store_path):
+        with open(store_path) as f:
+            snapshot = f.read()
+
+    rng = _np.random.default_rng(19)
+    # integer values: int64 accumulation is associative, so the result is
+    # BIT-identical under any chunking — the honest way to assert the
+    # tuned chunk size changed nothing but the wall clock (float sums
+    # would drift in the last ulp when chunk boundaries move)
+    tbl = _pa.Table.from_pandas(
+        _pd.DataFrame(
+            {
+                "k": rng.integers(0, groups, rows),
+                "v": rng.integers(0, 1_000_000, rows),
+                "w": rng.integers(0, 1_000_000, rows),
+            }
+        ),
+        preserve_index=False,
+    )
+
+    def stream():
+        # the source is pre-chunked at the MIS-CONF'D size: tuned runs
+        # must coalesce, not just re-split
+        return LocalDataFrameIterableDataFrame(
+            (
+                ArrowDataFrame(tbl.slice(s, min(misconf_chunk, rows - s)))
+                for s in range(0, rows, misconf_chunk)
+            ),
+            schema=ArrowDataFrame(tbl).schema,
+        )
+
+    def dag():
+        d = FugueWorkflow()
+        (
+            d.df(stream())
+            .partition_by("k")
+            .aggregate(
+                ff.sum(col("v")).alias("s"),
+                ff.count(col("v")).alias("n"),
+                ff.avg(col("w")).alias("m"),
+            )
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return d
+
+    def run(eng):
+        d = dag()
+        t0 = time.perf_counter()
+        d.run(eng)
+        dt = time.perf_counter() - t0
+        res = (
+            d.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+        return dt, res, d
+
+    try:
+        # ---- stream phase: mis-conf'd cold run, converge, "restart" -------
+        eng = JaxExecutionEngine(conf)
+        cold_s, r_cold, d0 = run(eng)
+        fp = d0.last_plan_fingerprint
+        cold_chunks = int(_streaming.last_run_stats.get("chunks", 0))
+        generations = 1
+        for _ in range(5):  # bounded multiplicative => a few generations
+            generations += 1
+            run(eng)
+            entry = eng.tuner.store.plan_entry(fp) or {}
+            s = (entry.get("streams") or {}).get("aggregate") or {}
+            if s.get("converged"):
+                break
+        # restart: a FRESH engine reloads the converged settings from disk
+        eng_warm = JaxExecutionEngine(conf)
+        run(eng_warm)  # pays the one-time jit compile for the tuned capacity
+        warm_s, r_warm, d_warm = run(eng_warm)
+        warm_chunks = int(_streaming.last_run_stats.get("chunks", 0))
+        identical = bool(r_cold.equals(r_warm))
+        speedup = cold_s / max(warm_s, 1e-9)
+        t_warm = eng_warm.stats()["tuning"]
+        adaptive_used = int(t_warm["adaptive"]) >= 1
+        explain_txt = dag().explain(engine=eng_warm)
+        explained = (
+            "Adaptive tuning" in explain_txt and "chunk_rows=" in explain_txt
+        )
+        # ---- kill-switch: static behavior reproduced exactly --------------
+        eng_off = JaxExecutionEngine(dict(conf, **{FUGUE_TPU_CONF_TUNING_ENABLED: False}))
+        _, r_off, _ = run(eng_off)
+        off_chunks = int(_streaming.last_run_stats.get("chunks", 0))
+        killswitch_ok = bool(
+            r_off.equals(r_cold)
+            and off_chunks == cold_chunks
+            and eng_off.stats()["tuning"]["decisions"] == 0
+        )
+        # ---- shuffle phase: mis-conf'd bucket sizing gets calibrated ------
+        jconf = dict(
+            conf,
+            **{
+                FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: join_budget,
+                FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: join_bucket_bytes,
+                FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 1 << 20,
+            },
+        )
+        jleft = _pd.DataFrame(
+            {
+                "k": rng.integers(0, join_rows * 3, join_rows),
+                "a": rng.normal(size=join_rows),
+            }
+        )
+        jright = _pd.DataFrame(
+            {
+                "k": rng.integers(0, join_rows * 3, join_rows),
+                "b": rng.normal(size=join_rows),
+            }
+        )
+
+        def join_run(eng):
+            d = FugueWorkflow()
+            d.df(jleft).join(d.df(jright), how="inner", on=["k"]).yield_dataframe_as(
+                "j", as_local=True
+            )
+            t0 = time.perf_counter()
+            d.run(eng)
+            dt = time.perf_counter() - t0
+            res = d.yields["j"].result.as_pandas()
+            return dt, res.sort_values(list(res.columns)).reset_index(drop=True), d
+
+        eng_j = JaxExecutionEngine(jconf)
+        jcold_s, jr_cold, dj = join_run(eng_j)
+        jfp = dj.last_plan_fingerprint
+        jentry = eng_j.tuner.store.plan_entry(jfp) or {}
+        cold_buckets = int(eng_j.stats()["shuffle"]["buckets"])
+        jwarm_s, jr_warm, _ = join_run(eng_j)  # calibrated generation
+        cal_buckets = int(
+            ((jentry if jentry else {}).get("joins", {}) or {})
+            .get("join", {})
+            .get("buckets", 0)
+        ) or int(
+            (
+                (eng_j.tuner.store.plan_entry(jfp) or {}).get("joins", {}) or {}
+            )
+            .get("join", {})
+            .get("buckets", 0)
+        )
+        join_identical = bool(jr_cold.equals(jr_warm))
+        buckets_calibrated = bool(0 < cal_buckets < cold_buckets)
+        # ---- serve phase: a warm server converges across submissions ------
+        from fugue_tpu.serve import EngineServer
+
+        eng_srv = JaxExecutionEngine(conf)
+        submissions = 3
+        with EngineServer(eng_srv) as srv:
+            for _ in range(submissions):
+                sub = srv.submit(dag)
+                sub.result(timeout=120)
+        srv_t = srv.stats().get("tuning", {})
+        serve_converged = bool(
+            srv_t.get("adaptive", 0) >= 1 and srv_t.get("entries", 0) >= 1
+        )
+        return {
+            "rows": rows,
+            "misconf_chunk_rows": misconf_chunk,
+            "plan_fingerprint": fp,
+            "cold_s": round(cold_s, 4),
+            "warm_s": round(warm_s, 4),
+            "speedup": round(speedup, 2),
+            "generations": generations,
+            "cold_chunks": cold_chunks,
+            "warm_chunks": warm_chunks,
+            "tuned_chunk_rows": (
+                (eng_warm.tuner.store.plan_entry(fp) or {})
+                .get("streams", {})
+                .get("aggregate", {})
+                .get("chunk_rows")
+            ),
+            "identical": identical,
+            "explained": explained,
+            "killswitch_ok": killswitch_ok,
+            "join_cold_s": round(jcold_s, 3),
+            "join_warm_s": round(jwarm_s, 3),
+            "join_cold_buckets": cold_buckets,
+            "join_calibrated_buckets": cal_buckets,
+            "join_identical": join_identical,
+            "buckets_calibrated": buckets_calibrated,
+            "serve_submissions": submissions,
+            "serve_tuning": srv_t,
+            "store_path": store_path,
+            "correct": bool(
+                speedup >= 1.3
+                and identical
+                and adaptive_used
+                and explained
+                and killswitch_ok
+                and join_identical
+                and buckets_calibrated
+                and serve_converged
+            ),
+        }
+    finally:
+        # leave the committed store exactly as we found it
+        try:
+            if snapshot is None:
+                if os.path.exists(store_path):
+                    os.remove(store_path)
+            else:
+                with open(store_path, "w") as f:
+                    f.write(snapshot)
+        except OSError:
+            pass
+
+
 def _bench_serve_load(
     clients: int = 8, rounds: int = 2, rows: int = 48_000, parts: int = 12
 ) -> dict:
@@ -1863,6 +2127,15 @@ def _serve_smoke() -> None:
     executions, per-tenant p50/p99 + rows/s reported, results
     bit-identical to serial runs. Exit 12 on any violation (the next
     code after the 9/10/11 segment/shuffle/delta gates)."""
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_TUNING_ENABLED,
+        register_global_conf,
+    )
+
+    # the gate compares concurrent results bit-identically against serial
+    # cache-off oracles — adaptive chunk learning between rounds would
+    # move float accumulation boundaries; measure the static engine
+    register_global_conf({FUGUE_TPU_CONF_TUNING_ENABLED: False})
     case = _bench_serve_load()
     print(json.dumps({"metric": "serve_smoke", "serve_load": case}))
     if not case["correct"]:
@@ -1883,13 +2156,23 @@ def _smoke() -> None:
     t0 = time.perf_counter()
     # the result cache would serve repeated timed workflows from memory,
     # measuring memoization instead of the engine — OFF for the whole
-    # bench; the dedicated result-cache case re-enables it per-engine
+    # bench; the dedicated result-cache case re-enables it per-engine.
+    # adaptive tuning is OFF bench-wide for the same reason (repeated
+    # timed runs must measure the STATIC engine, and the other gates'
+    # chunk/bucket shapes must stay run-to-run deterministic); the
+    # dedicated adaptive_tuning case re-enables it per-engine
     from fugue_tpu.constants import (
         FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_TUNING_ENABLED,
         register_global_conf,
     )
 
-    register_global_conf({FUGUE_TPU_CONF_CACHE_ENABLED: False})
+    register_global_conf(
+        {
+            FUGUE_TPU_CONF_CACHE_ENABLED: False,
+            FUGUE_TPU_CONF_TUNING_ENABLED: False,
+        }
+    )
     recorded_rps: Optional[float] = None
     recorded_ratio: Optional[float] = None
     baseline_source = None
@@ -1964,6 +2247,11 @@ def _smoke() -> None:
     # >=5x over the interpreted path via analyzer translation — one
     # fused/lowered jit entry, zero per-verb launches, bit-identical
     udf_case = _bench_udf_trace(rows=250_000, wide_cols=56)
+    # cost-based adaptive execution (ISSUE 12): mis-conf'd chunk size +
+    # bucket sizing; the tuner must converge, persist to ops/_tuned.json,
+    # reload after "restart" at >=1.3x bit-identical, calibrate the spill
+    # join's bucket count, and converge on a live EngineServer
+    tuning_case = _bench_adaptive_tuning()
     result = {
         "metric": "bench_smoke_groupby_aggregate_rows_per_sec",
         "value": round(r["rps"], 1),
@@ -1982,6 +2270,7 @@ def _smoke() -> None:
         "segment_lowering": segment_case,
         "shuffle_join": shuffle_case,
         "udf_trace": udf_case,
+        "adaptive_tuning": tuning_case,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     try:  # drop the result where --compare picks it up (best effort)
@@ -2004,6 +2293,8 @@ def _smoke() -> None:
         raise SystemExit(11)
     if not udf_case["correct"]:
         raise SystemExit(13)  # 12 is the serve gate
+    if not tuning_case["correct"]:
+        raise SystemExit(14)
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -2362,14 +2653,21 @@ def main(strict_tpu: bool = False) -> None:
 
 
 def _main_impl(strict_tpu: bool = False) -> None:
-    # cache OFF bench-wide (see _smoke): timed repeats must hit the
-    # engine, not the memoization layer; extra.result_cache opts back in
+    # cache + adaptive tuning OFF bench-wide (see _smoke): timed repeats
+    # must hit the STATIC engine, not memoization or learned settings;
+    # extra.result_cache / extra.adaptive_tuning opt back in per-engine
     from fugue_tpu.constants import (
         FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_TUNING_ENABLED,
         register_global_conf,
     )
 
-    register_global_conf({FUGUE_TPU_CONF_CACHE_ENABLED: False})
+    register_global_conf(
+        {
+            FUGUE_TPU_CONF_CACHE_ENABLED: False,
+            FUGUE_TPU_CONF_TUNING_ENABLED: False,
+        }
+    )
     on_tpu = _tpu_reachable()
     if strict_tpu and not on_tpu:
         print("tunnel down: --capture requires a reachable TPU", file=sys.stderr)
@@ -2609,6 +2907,11 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # tenants × mixed workloads through one EngineServer
                     # with in-flight dedup, per-tenant p50/p99 + rows/s
                     "serve_load": _bench_serve_load(),
+                    # cost-based adaptive execution (ISSUE 12): the
+                    # feedback layer fixes deliberately mis-conf'd chunk
+                    # size + bucket sizing from its own telemetry,
+                    # persisted + reloaded across engine "restarts"
+                    "adaptive_tuning": _bench_adaptive_tuning(),
                     # most recent `bench.py --north-star` run (the literal
                     # 1B-row groupby-apply), if one has been captured
                     "north_star_1b": _load_north_star(),

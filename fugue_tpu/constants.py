@@ -247,6 +247,25 @@ FUGUE_TPU_CONF_SERVE_RETAIN = "fugue.tpu.serve.retain"
 # dropped with a warning)
 FUGUE_TPU_CONF_SERVE_TENANT_PREFIX = "fugue.tpu.serve.tenant."
 
+# --- cost-based adaptive execution (fugue_tpu/tuning, docs/tuning.md) ---
+# Feedback layer that re-derives stream chunk size / prefetch depth and
+# shuffle bucket sizing from the engine's OWN telemetry (pipeline stats,
+# spill-join observations), keyed by plan fingerprint. Master kill-switch:
+# =false restores the static-conf behavior bit-identically (no store
+# reads, no writes, every knob resolves exactly as before this layer).
+# Per-workflow/compile-conf scoped like fugue.tpu.plan.* — workflow.run
+# never writes fugue.tpu.tuning.* into a shared engine's conf.
+FUGUE_TPU_CONF_TUNING_ENABLED = "fugue.tpu.tuning.enabled"
+FUGUE_TPU_CONF_TUNING_PREFIX = "fugue.tpu.tuning."
+# where learned settings persist (atomic temp-write+rename; corrupt or
+# unwritable files degrade to defaults with ONE warning). Default: the
+# package's ops/_tuned.json, next to the dense-sum A/B winner; the
+# FUGUE_TPU_TUNING_PATH env var overrides (test isolation).
+FUGUE_TPU_CONF_TUNING_PATH = "fugue.tpu.tuning.path"
+# plan-fingerprint entries kept in the store; least-recently-used past it
+# are evicted at publish time (stale-plan hygiene for long-lived servers)
+FUGUE_TPU_CONF_TUNING_MAX_ENTRIES = "fugue.tpu.tuning.max_entries"
+
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE,

@@ -183,6 +183,7 @@ class ExecutionEngine(FugueEngineBase):
         self._resilience_stats: Any = None
         self._plan_stats: Any = None
         self._analysis_stats: Any = None
+        self._tuner: Any = None
         self._metrics: Any = None
         self._active_runs = 0
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
@@ -241,7 +242,8 @@ class ExecutionEngine(FugueEngineBase):
                 f"{type(engine)} requires {engine.execution_engine_constraint}"
             ),
         )
-        self._sql_engine = engine
+        with self._rlock:
+            self._sql_engine = engine
 
     # ---- context management (reference :50-89, 362-421, 1182-1212) -------
     @property
@@ -286,13 +288,17 @@ class ExecutionEngine(FugueEngineBase):
                 self._ctx_count -= 1
 
     def set_global(self) -> "ExecutionEngine":
+        # lock order matches stop(): the module-wide global-engine lock
+        # first, then the engine's own rlock for its shared flag
         with _GLOBAL_ENGINE_LOCK:
             old = _GLOBAL_ENGINE[0]
             if old is not None and old is not self:
-                old._is_global = False
+                with old._rlock:
+                    old._is_global = False
                 if not old.in_context:
                     old.stop()
-            self._is_global = True
+            with self._rlock:
+                self._is_global = True
             _GLOBAL_ENGINE[0] = self
         return self
 
@@ -347,7 +353,8 @@ class ExecutionEngine(FugueEngineBase):
         return self._rpc_server
 
     def set_rpc_server(self, server: Any) -> None:
-        self._rpc_server = server
+        with self._rlock:
+            self._rpc_server = server
         self._bind_rpc_metrics(server)
 
     def _bind_rpc_metrics(self, server: Any) -> None:
@@ -373,6 +380,7 @@ class ExecutionEngine(FugueEngineBase):
                     reg.register("plan", lambda: self.plan_stats)
                     reg.register("analysis", lambda: self.analysis_stats)
                     reg.register("cache", lambda: self.result_cache.stats)
+                    reg.register("tuning", lambda: self.tuner)
                     # distribution + resource sources are process-global (like
                     # the tracer feeding them) but mounted here so
                     # engine.stats() carries them and engine.reset_stats()
@@ -499,6 +507,23 @@ class ExecutionEngine(FugueEngineBase):
 
                     self._analysis_stats = AnalysisStats()
         return self._analysis_stats
+
+    @property
+    def tuner(self) -> Any:
+        """This engine's :class:`~fugue_tpu.tuning.Tuner` — cost-based
+        adaptive execution (``fugue_tpu/tuning``, docs/tuning.md): stream
+        chunk size / prefetch depth, shuffle bucket sizing and join-side
+        estimates learned from the engine's own telemetry, keyed by plan
+        fingerprint and persisted across restarts. Decisions and counters
+        live in ``engine.stats()["tuning"]``; ``engine.reset_stats()``
+        zeroes counters without forgetting learned settings."""
+        if getattr(self, "_tuner", None) is None:
+            with self._rlock:
+                if getattr(self, "_tuner", None) is None:
+                    from ..tuning import Tuner
+
+                    self._tuner = Tuner(self.conf)
+        return self._tuner
 
     @property
     def result_cache(self) -> Any:

@@ -1574,7 +1574,18 @@ class JaxExecutionEngine(ExecutionEngine):
         from .streaming import is_stream_frame, streaming_hash_join
 
         self._last_join_strategy = None
+        # adaptive execution (docs/tuning.md): inside an enabled run scope
+        # the tuner substitutes OBSERVED side cardinalities from previous
+        # runs of this plan where the static estimate is unknowable, and
+        # carries the calibrated bucket count into the spill shuffle; the
+        # runtime decision function below stays authoritative either way
+        tuner = getattr(self, "tuner", None)
         if is_stream_frame(df1) or is_stream_frame(df2):
+            tune = (
+                tuner.join_params(None, None, None)[3]
+                if tuner is not None
+                else None
+            )
             # one-pass input: bounded-memory broadcast-hash join first
             res = streaming_hash_join(self, df1, df2, how, on)
             if res is not None:
@@ -1586,7 +1597,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 # now the LAST resort, not the only remaining option
                 from ..shuffle.join import shuffle_spill_join
 
-                res = shuffle_spill_join(self, df1, df2, how, on)
+                res = shuffle_spill_join(self, df1, df2, how, on, tune=tune)
                 if res is not None:
                     sp.set(
                         strategy="shuffle_spill",
@@ -1598,16 +1609,19 @@ class JaxExecutionEngine(ExecutionEngine):
                 "the stream"
             )
         else:
-            dec = choose_join_strategy(
-                self.conf,
-                estimate_frame_bytes(df1),
-                estimate_frame_bytes(df2),
-                estimate_frame_rows(df2),
-            )
+            est_l = estimate_frame_bytes(df1)
+            est_r = estimate_frame_bytes(df2)
+            est_rr = estimate_frame_rows(df2)
+            tune = None
+            if tuner is not None:
+                est_l, est_r, est_rr, tune = tuner.join_params(
+                    est_l, est_r, est_rr
+                )
+            dec = choose_join_strategy(self.conf, est_l, est_r, est_rr)
             if dec.strategy == "shuffle_spill" and shuffle_enabled(self.conf):
                 from ..shuffle.join import shuffle_spill_join
 
-                res = shuffle_spill_join(self, df1, df2, how, on)
+                res = shuffle_spill_join(self, df1, df2, how, on, tune=tune)
                 if res is not None:
                     sp.set(strategy="shuffle_spill", reason=dec.reason)
                     return res

@@ -102,6 +102,11 @@ class PipelineStats:
     (producer-bound run) — whichever dominates names the bottleneck.
     """
 
+    # per-stream attribution (ISSUE 12 satellite): keep at most this many
+    # distinct stream keys; past it the oldest-inserted is dropped so a
+    # long-lived server's registry stays bounded
+    MAX_STREAMS = 64
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._totals: Dict[str, float] = {
@@ -114,6 +119,7 @@ class PipelineStats:
             "overlap_saved_s": 0.0,
         }
         self._last: Dict[str, Any] = {}
+        self._streams: Dict[str, Dict[str, Any]] = {}
 
     def record_run(
         self,
@@ -123,13 +129,19 @@ class PipelineStats:
         producer_wait_s: float,
         consumer_wait_s: float,
         wall_s: float,
+        rows: int = 0,
+        nbytes: int = 0,
+        stream: str = "",
     ) -> None:
         consumer_busy = max(wall_s - consumer_wait_s, 0.0)
         serial_estimate = producer_busy_s + consumer_busy
         saved = max(serial_estimate - wall_s, 0.0)
         run = {
             "verb": verb,
+            "stream": stream or verb,
             "chunks_prefetched": chunks,
+            "rows": int(rows),
+            "bytes": int(nbytes),
             "producer_busy_s": round(producer_busy_s, 6),
             "producer_wait_s": round(producer_wait_s, 6),
             "consumer_wait_s": round(consumer_wait_s, 6),
@@ -149,6 +161,40 @@ class PipelineStats:
             t["wall_s"] += wall_s
             t["overlap_saved_s"] += saved
             self._last = run
+            # per-stream accumulation keyed by the stream id (the tuning
+            # sid when a run scope is active, else the verb): overlap and
+            # producer/consumer waits attributable to ONE Load/segment
+            # instead of a whole-run blend
+            key = stream or verb
+            s = self._streams.get(key)
+            if s is None:
+                while len(self._streams) >= self.MAX_STREAMS:
+                    self._streams.pop(next(iter(self._streams)))
+                s = self._streams[key] = {
+                    "runs": 0,
+                    "chunks_prefetched": 0,
+                    "rows": 0,
+                    "producer_busy_s": 0.0,
+                    "producer_wait_s": 0.0,
+                    "consumer_wait_s": 0.0,
+                    "wall_s": 0.0,
+                    "overlap_saved_s": 0.0,
+                }
+            s["runs"] += 1
+            s["chunks_prefetched"] += chunks
+            s["rows"] += int(rows)
+            s["producer_busy_s"] = round(s["producer_busy_s"] + producer_busy_s, 6)
+            s["producer_wait_s"] = round(s["producer_wait_s"] + producer_wait_s, 6)
+            s["consumer_wait_s"] = round(s["consumer_wait_s"] + consumer_wait_s, 6)
+            s["wall_s"] = round(s["wall_s"] + wall_s, 6)
+            s["overlap_saved_s"] = round(s["overlap_saved_s"] + saved, 6)
+            serial = s["producer_busy_s"] + max(
+                s["wall_s"] - s["consumer_wait_s"], 0.0
+            )
+            s["overlap_fraction"] = (
+                round(s["overlap_saved_s"] / serial, 6) if serial > 0 else 0.0
+            )
+            s["last_overlap_fraction"] = run["overlap_fraction"]
         global last_run_stats
         last_run_stats = run
 
@@ -157,9 +203,14 @@ class PipelineStats:
         with self._lock:
             return dict(self._last)
 
+    def stream_stats(self, stream: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._streams.get(stream, {}))
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             t = dict(self._totals)
+            streams = {k: dict(v) for k, v in self._streams.items()}
         serial = t["producer_busy_s"] + max(t["wall_s"] - t["consumer_wait_s"], 0.0)
         t["overlap_fraction"] = (
             round(t["overlap_saved_s"] / serial, 6) if serial > 0 else 0.0
@@ -173,6 +224,7 @@ class PipelineStats:
         ):
             t[k] = round(t[k], 6)
         t["last_run"] = self.last_run
+        t["streams"] = streams
         return t
 
     def reset(self) -> None:
@@ -180,6 +232,7 @@ class PipelineStats:
             for k in self._totals:
                 self._totals[k] = 0 if k in ("runs", "chunks_prefetched") else 0.0
             self._last = {}
+            self._streams = {}
 
 
 class JitCache(dict):
@@ -273,21 +326,82 @@ class JitCache(dict):
 
 class _SerialChunks:
     """depth<=0 path: the same iterator/close() surface, no thread — the
-    bit-identical serial baseline the parity tests compare against."""
+    bit-identical serial baseline the parity tests compare against.
 
-    def __init__(self, source: Iterator[Any]):
+    With an ``observer`` attached (an adaptive-tuning handle inside an
+    enabled run scope — the single-core default where a producer thread
+    would only steal consumer time), the serial path still measures the
+    CHUNK-COUNT signal (chunks, rows, bytes, source-advance time, wall)
+    so chunk-size tuning works without a pipeline; producer/consumer
+    waits report 0 and depth tuning correctly stays put. Observer-less —
+    tuning disabled, direct engine calls — it measures nothing at all,
+    and it never touches ``PipelineStats`` either way (those counters
+    mean "prefetched" and stay bit-compatible with the pre-tuning
+    engine)."""
+
+    def __init__(
+        self,
+        source: Iterator[Any],
+        verb: str = "",
+        stream: str = "",
+        observer: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ):
         self._src = source
+        self._verb = verb
+        self._stream = stream
+        self._observer = observer
+        self._chunks = 0
+        self._rows = 0
+        self._bytes = 0
+        self._busy = 0.0
+        self._done = False
+        self._t0 = time.perf_counter() if observer is not None else 0.0
 
     def __iter__(self) -> "_SerialChunks":
         return self
 
     def __next__(self) -> Any:
-        return next(self._src)
+        if self._observer is None:
+            return next(self._src)
+        t0 = time.perf_counter()
+        try:
+            item = next(self._src)
+        except StopIteration:
+            self._finish()
+            raise
+        self._busy += time.perf_counter() - t0
+        self._chunks += 1
+        attrs = _chunk_attrs(item)
+        self._rows += int(attrs.get("rows", 0))
+        self._bytes += int(attrs.get("bytes", 0))
+        return item
+
+    def _finish(self) -> None:
+        if self._done or self._observer is None:
+            return
+        self._done = True
+        try:  # learning must never fail the stream
+            self._observer(
+                {
+                    "verb": self._verb,
+                    "stream": self._stream or self._verb,
+                    "chunks_prefetched": self._chunks,
+                    "rows": self._rows,
+                    "bytes": self._bytes,
+                    "producer_busy_s": self._busy,
+                    "producer_wait_s": 0.0,
+                    "consumer_wait_s": 0.0,
+                    "wall_s": time.perf_counter() - self._t0,
+                }
+            )
+        except Exception:
+            pass
 
     def close(self) -> None:
         close = getattr(self._src, "close", None)
         if close is not None:
             close()
+        self._finish()
 
 
 class _Failure:
@@ -316,6 +430,8 @@ class ChunkPrefetcher:
         stats: Optional[PipelineStats] = None,
         verb: str = "",
         injector: Any = None,
+        stream: str = "",
+        observer: Optional[Callable[[Dict[str, Any]], None]] = None,
     ):
         self._src = source
         self._depth = max(1, int(depth))
@@ -323,8 +439,12 @@ class ChunkPrefetcher:
         self._stop = threading.Event()
         self._stats = stats
         self._verb = verb
+        self._stream = stream
+        self._observer = observer
         self._injector = injector
         self._chunks = 0
+        self._rows = 0
+        self._bytes = 0
         self._producer_busy = 0.0
         self._producer_wait = 0.0
         self._consumer_wait = 0.0
@@ -399,6 +519,9 @@ class ChunkPrefetcher:
             # contract: the user sees where the decode actually failed
             raise obj.exc
         self._chunks += 1
+        attrs = _chunk_attrs(obj)
+        self._rows += int(attrs.get("rows", 0))
+        self._bytes += int(attrs.get("bytes", 0))
         return obj
 
     def _finish(self) -> None:
@@ -406,6 +529,17 @@ class ChunkPrefetcher:
         if self._recorded:
             return
         self._recorded = True
+        run = {
+            "verb": self._verb,
+            "stream": self._stream or self._verb,
+            "chunks_prefetched": self._chunks,
+            "rows": self._rows,
+            "bytes": self._bytes,
+            "producer_busy_s": self._producer_busy,
+            "producer_wait_s": self._producer_wait,
+            "consumer_wait_s": self._consumer_wait,
+            "wall_s": time.perf_counter() - self._t0,
+        }
         if self._stats is not None:
             self._stats.record_run(
                 self._verb,
@@ -413,8 +547,16 @@ class ChunkPrefetcher:
                 self._producer_busy,
                 self._producer_wait,
                 self._consumer_wait,
-                time.perf_counter() - self._t0,
+                run["wall_s"],
+                rows=self._rows,
+                nbytes=self._bytes,
+                stream=self._stream,
             )
+        if self._observer is not None:
+            try:  # learning must never fail the stream
+                self._observer(run)
+            except Exception:
+                pass
 
     def close(self) -> None:
         """Stop the producer and release everything it buffered. Safe to
@@ -435,12 +577,24 @@ def maybe_prefetch(
     stats: Optional[PipelineStats] = None,
     verb: str = "",
     injector: Any = None,
+    stream: str = "",
+    observer: Optional[Callable[[Dict[str, Any]], None]] = None,
 ) -> Any:
     """Wrap a chunk iterator in a :class:`ChunkPrefetcher` (depth > 0) or a
     same-interface serial shim (depth <= 0)."""
     if depth <= 0:
-        return _SerialChunks(iter(source))
-    return ChunkPrefetcher(iter(source), depth, stats=stats, verb=verb, injector=injector)
+        return _SerialChunks(
+            iter(source), verb=verb, stream=stream, observer=observer
+        )
+    return ChunkPrefetcher(
+        iter(source),
+        depth,
+        stats=stats,
+        verb=verb,
+        injector=injector,
+        stream=stream,
+        observer=observer,
+    )
 
 
 class _TracedChunks:
@@ -519,16 +673,40 @@ def engine_prefetcher(
     engine: Any, source: Iterator[Any], verb: str
 ) -> Any:
     """The streaming paths' one-liner: depth/stats/injector from ``engine``,
-    plus per-chunk trace spans when the global tracer is enabled."""
+    plus per-chunk trace spans when the global tracer is enabled.
+
+    When the chunk-size site left an adaptive-tuning handle for this verb
+    (``Tuner.stream_params`` inside an enabled run scope, docs/tuning.md),
+    the handle supplies the learned prefetch depth, keys the per-stream
+    pipeline stats by its stream id, and receives the finished run's
+    telemetry as the next generation's evidence. No handle — direct engine
+    calls, tuning disabled — resolves exactly as before."""
     from ..obs import get_tracer
     from ..resilience import FaultInjector
 
+    handle = None
+    tuner = getattr(engine, "tuner", None)
+    if tuner is not None:
+        handle = tuner.take_stream_handle(verb)
+    depth = (
+        handle.prefetch_depth
+        if handle is not None and handle.prefetch_depth is not None
+        else prefetch_depth(engine.conf)
+    )
+    observer = None
+    stream = ""
+    if handle is not None:
+        handle.used_depth = depth
+        observer = handle.observe
+        stream = handle.sid
     it = maybe_prefetch(
         source,
-        prefetch_depth(engine.conf),
+        depth,
         stats=getattr(engine, "pipeline_stats", None),
         verb=verb,
         injector=FaultInjector.from_conf(engine.conf),
+        stream=stream,
+        observer=observer,
     )
     tracer = get_tracer()
     if tracer.enabled:

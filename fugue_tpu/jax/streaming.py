@@ -200,7 +200,7 @@ def _closing(chunks_it: Any) -> Iterator[Any]:
 
 
 def _prefetched_pandas_chunks(
-    engine: Any, df: Any, chunk_rows: int, verb: str
+    engine: Any, df: Any, chunk_rows: int, verb: str, tune: Any = None
 ) -> Any:
     """The host-side chunk pipeline: decode chunks to pandas in the
     background thread while the caller consumes — used by the paths whose
@@ -208,10 +208,77 @@ def _prefetched_pandas_chunks(
     join probe)."""
     from .pipeline import engine_prefetcher
 
+    frames = _maybe_coalesce(_iter_local_frames(df, chunk_rows), chunk_rows, tune)
     return engine_prefetcher(
         engine,
-        (f.as_pandas() for f in _iter_local_frames(df, chunk_rows)),
+        (f.as_pandas() for f in frames),
         verb,
+    )
+
+
+def _tuned_chunk_rows(engine: Any, verb: str) -> Tuple[int, Any]:
+    """Resolve one stream's chunk size: the static
+    ``fugue.tpu.stream.chunk_rows`` conf, overridden by the adaptive
+    tuner (``fugue_tpu/tuning``, docs/tuning.md) when an enabled run
+    scope holds observations for this plan fingerprint. The returned
+    handle also reaches ``engine_prefetcher`` (same verb, same run) for
+    the learned prefetch depth and the telemetry feedback; outside a run
+    scope — direct engine calls, ``fugue.tpu.tuning.enabled=false`` —
+    this is exactly the old static resolution."""
+    static = int(
+        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
+    )
+    tuner = getattr(engine, "tuner", None)
+    if tuner is None:
+        return static, None
+    h = tuner.stream_params(verb, static)
+    if h is None:
+        return static, None
+    return int(h.chunk_rows), h
+
+
+def _maybe_coalesce(
+    frames: Iterator[LocalDataFrame], target_rows: int, tune: Any
+) -> Iterator[LocalDataFrame]:
+    """Merge undersized source chunks up to ``target_rows`` when an
+    ADAPTIVE chunk setting asks for it (``_rechunk`` only splits —
+    without this, a source pre-chunked smaller than the tuned size would
+    keep its per-chunk overhead no matter what the tuner learned). The
+    static path never coalesces: pre-tuning chunk shapes stay
+    bit-identical."""
+    if tune is None or not getattr(tune, "coalesce", False) or target_rows <= 0:
+        yield from frames
+        return
+    buf: List[LocalDataFrame] = []
+    have = 0
+    for f in frames:
+        n = f.count()
+        if n <= 0:
+            continue
+        if n >= target_rows and not buf:
+            yield f
+            continue
+        buf.append(f)
+        have += n
+        if have >= target_rows:
+            yield _concat_local(buf)
+            buf, have = [], 0
+    if buf:
+        yield buf[0] if len(buf) == 1 else _concat_local(buf)
+
+
+def _concat_local(frames: List[LocalDataFrame]) -> LocalDataFrame:
+    """One frame from many (same schema — one stream's chunks)."""
+    if all(isinstance(f, ArrowDataFrame) for f in frames):
+        try:
+            return ArrowDataFrame(pa.concat_tables([f.native for f in frames]))
+        except Exception:
+            pass
+    import pandas as _pd
+
+    return PandasDataFrame(
+        _pd.concat([f.as_pandas() for f in frames], ignore_index=True),
+        frames[0].schema,
     )
 
 
@@ -371,9 +438,7 @@ def streaming_dense_aggregate(
         return None
     mesh = engine._mesh
     shards = num_row_shards(mesh)
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "aggregate")
     capacity = pad_rows(max(chunk_rows, shards), shards)
 
     # eligibility is decided from the SCHEMA alone (via an empty probe
@@ -411,7 +476,10 @@ def streaming_dense_aggregate(
             return None  # declared range too wide for the dense plan
 
     # ---- the stream is consumed from here on; failures now RAISE ------
-    frames = _rechunk(_iter_local_frames(df, chunk_rows), capacity)
+    frames = _rechunk(
+        _maybe_coalesce(_iter_local_frames(df, chunk_rows), chunk_rows, tune),
+        capacity,
+    )
     try:
         first = next(frames)
     except StopIteration:
@@ -770,9 +838,8 @@ def plan_streaming_lowered_aggregate(
     needed: List[str] = chain["need"]
     in_np: Dict[str, np.dtype] = chain["in_np"]
     shards = num_row_shards(mesh)
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    label = f"segment:{fingerprint or 'anon'}"
+    chunk_rows, tune = _tuned_chunk_rows(engine, label)
     capacity = pad_rows(max(chunk_rows, shards), shards)
     vidx = {s: i for i, s in enumerate(srcs)}
     # value columns dedupe by source; floats are ALWAYS NaN-aware (a later
@@ -781,11 +848,13 @@ def plan_streaming_lowered_aggregate(
         (name, agg, vidx[src], src_np[src].kind == "f")
         for name, agg, src in plan["aggs"]
     )
-    label = f"segment:{fingerprint or 'anon'}"
 
     def run() -> DataFrame:
         # ---- the stream is consumed from here on; failures RAISE ------
-        frames = _rechunk(_iter_local_frames(df, chunk_rows), capacity)
+        frames = _rechunk(
+            _maybe_coalesce(_iter_local_frames(df, chunk_rows), chunk_rows, tune),
+            capacity,
+        )
         try:
             first = next(frames)
         except StopIteration:
@@ -1132,9 +1201,7 @@ def streaming_hash_join(
 
     mesh = engine._mesh
     shards = num_row_shards(mesh)
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "join")
     capacity = pad_rows(max(chunk_rows, shards), shards)
 
     if n_build == 0 and not outer:
@@ -1196,7 +1263,12 @@ def streaming_hash_join(
             engine,
             (
                 f.as_pandas().reset_index(drop=True)
-                for f in _rechunk(_iter_local_frames(stream_df, chunk_rows), capacity)
+                for f in _rechunk(
+                    _maybe_coalesce(
+                        _iter_local_frames(stream_df, chunk_rows), chunk_rows, tune
+                    ),
+                    capacity,
+                )
             ),
             "join",
         )
@@ -1306,9 +1378,7 @@ def streaming_compiled_map(
 
     mesh = engine._mesh
     shards = num_row_shards(mesh)
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "map")
     capacity = pad_rows(max(chunk_rows, shards), shards)
     in_schema = df.schema
     names = list(in_schema.names)
@@ -1345,7 +1415,12 @@ def streaming_compiled_map(
         full_valid_dev: List[Any] = []
 
         def produce() -> Iterator[Tuple[int, Any]]:
-            for f in _rechunk(_iter_local_frames(df, chunk_rows), capacity):
+            for f in _rechunk(
+                _maybe_coalesce(
+                    _iter_local_frames(df, chunk_rows), chunk_rows, tune
+                ),
+                capacity,
+            ):
                 n, cols, nulls = _chunk_columns(f, names)
                 full = n == capacity
                 buf: Dict[str, Any] = {}
@@ -1455,9 +1530,7 @@ def streaming_take(
     O(n·keys), far below device-offload profitability."""
     from ..collections.partition import parse_presort_exp
 
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "take")
     sorts = (
         parse_presort_exp(presort)
         if presort
@@ -1471,7 +1544,7 @@ def streaming_take(
     schema = Schema(df.schema)
     buf: Optional[pd.DataFrame] = None
     stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "take")
+    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "take", tune)
     try:
         for pf in chunks_it:
             stats["chunks"] += 1
@@ -1530,15 +1603,13 @@ def streaming_distinct(engine: Any, df: Any) -> DataFrame:
     """DISTINCT over a one-pass stream: chunk-wise dedupe against the
     running distinct set — memory is O(distinct rows + chunk), independent
     of stream length (SQL NaN==NaN semantics, matching the engines)."""
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "distinct")
     from ..execution.native_execution_engine import _drop_duplicates
 
     schema = Schema(df.schema)
     buf: Optional[pd.DataFrame] = None
     stats = {"chunks": 0, "rows": 0, "peak_device_bytes": 0}
-    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "distinct")
+    chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "distinct", tune)
     try:
         for pf in chunks_it:
             stats["chunks"] += 1
@@ -1610,9 +1681,7 @@ def streaming_keyed_compiled_map(
         np_dtypes[f.name] = np.dtype(f.type.to_pandas_dtype())
     mesh = engine._mesh
     shards = num_row_shards(mesh)
-    chunk_rows = int(
-        engine.conf.get(FUGUE_TPU_CONF_STREAM_CHUNK_ROWS, DEFAULT_CHUNK_ROWS)
-    )
+    chunk_rows, tune = _tuned_chunk_rows(engine, "keyed_map")
     capacity = pad_rows(max(chunk_rows, shards), shards)
     sharding = NamedSharding(mesh, P(ROW_AXIS))
     out_schema = Schema(output_schema)
@@ -1684,7 +1753,9 @@ def streaming_keyed_compiled_map(
         first = [True]
         # prefetch the host decode of the NEXT chunk while run_batch runs
         # the compiled keyed map on the current batch
-        chunks_it = _prefetched_pandas_chunks(engine, df, chunk_rows, "keyed_map")
+        chunks_it = _prefetched_pandas_chunks(
+            engine, df, chunk_rows, "keyed_map", tune
+        )
         for pf in _closing(chunks_it):
             stats["chunks"] += 1
             stats["rows"] += len(pf)

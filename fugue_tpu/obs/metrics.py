@@ -103,9 +103,10 @@ class Histogram:
     def __init__(self, bounds: Tuple[float, ...] = DEFAULT_LATENCY_BOUNDS):
         self.bounds = tuple(bounds)
         self._lock = threading.Lock()
-        self._zero()
+        self._zero_locked()
 
-    def _zero(self) -> None:
+    def _zero_locked(self) -> None:
+        # caller holds self._lock (construction is single-threaded)
         self.counts: List[int] = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
@@ -211,7 +212,7 @@ class Histogram:
 
     def reset(self) -> None:
         with self._lock:
-            self._zero()
+            self._zero_locked()
 
 
 def _labels_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:

@@ -167,7 +167,8 @@ class ResourceSampler:
             try:
                 self.sample_once()
             except Exception:
-                self.sample_errors += 1
+                with self._lock:
+                    self.sample_errors += 1
 
     # -- sampling ------------------------------------------------------------
     def sample_once(self) -> Dict[str, float]:
@@ -183,7 +184,8 @@ class ResourceSampler:
             except ProbeGone:
                 gone.append(name)
             except Exception:
-                self.sample_errors += 1
+                with self._lock:
+                    self.sample_errors += 1
         ts = time.perf_counter_ns()
         with self._lock:
             for name in gone:

@@ -213,9 +213,13 @@ class Tracer:
             try:
                 import jax
 
-                self._ann_cls = jax.profiler.TraceAnnotation
+                cls: Any = jax.profiler.TraceAnnotation
             except Exception:
-                self._ann_cls = None
+                cls = None
+            # racing first-touchers resolve the IDENTICAL class; the lock
+            # just makes the publish a clean single write
+            with self._lock:
+                self._ann_cls = cls
         return self._ann_cls
 
     def current_span_id(self) -> Optional[str]:
@@ -286,10 +290,12 @@ class Tracer:
 
     # -- switches -----------------------------------------------------------
     def enable(self) -> None:
-        self.enabled = True
+        with self._lock:
+            self.enabled = True
 
     def disable(self) -> None:
-        self.enabled = False
+        with self._lock:
+            self.enabled = False
 
 
 _TRACER = Tracer()

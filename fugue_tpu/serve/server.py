@@ -244,9 +244,10 @@ class EngineServer:
             self._cv.notify_all()
         for ex in dropped:
             self._finish_waiters(ex)
-        for t in self._workers:
+        with self._lock:
+            workers, self._workers = self._workers, []
+        for t in workers:
             t.join(timeout=timeout)
-        self._workers = []
 
     def __enter__(self) -> "EngineServer":
         return self.start()
@@ -393,8 +394,9 @@ class EngineServer:
                 self._overlay_warned.add(tenant)
                 self._engine.log.warning(
                     "tenant %s conf overlay keys %s dropped: only "
-                    "fugue.tpu.plan.* compile switches are per-run; other "
-                    "keys would leak into the shared engine conf",
+                    "fugue.tpu.plan.* / fugue.tpu.tuning.* compile switches "
+                    "are per-run; other keys would leak into the shared "
+                    "engine conf",
                     tenant,
                     list(pol.dropped_keys),
                 )
@@ -585,6 +587,18 @@ class EngineServer:
                 retained=len(self._done_order),
             )
         out["charged_bytes"] = self._accounts.as_dict()
+        # adaptive-execution convergence at a glance (docs/tuning.md): the
+        # long-lived server is exactly where cross-submission learning
+        # pays off, so surface the tuner's counters next to the serving
+        # gauges (full decisions stay in engine.stats()["tuning"])
+        try:
+            t = self._engine.tuner.as_dict()
+            out["tuning"] = {
+                k: t.get(k, 0)
+                for k in ("decisions", "adaptive", "static", "converged", "entries")
+            }
+        except Exception:
+            pass
         return out
 
 

@@ -31,6 +31,7 @@ abandoned (GeneratorExit) — and on any failure during partitioning.
 """
 
 import os
+import time
 from typing import Any, Callable, Iterator, List, Optional
 
 import numpy as np
@@ -226,12 +227,24 @@ def _device_bucket_join(
 
 
 def shuffle_spill_join(
-    engine: Any, df1: DataFrame, df2: DataFrame, how: str, on: Any = None
+    engine: Any,
+    df1: DataFrame,
+    df2: DataFrame,
+    how: str,
+    on: Any = None,
+    tune: Any = None,
 ) -> Optional[DataFrame]:
     """Spill-partition both sides and join bucket-at-a-time. Returns a
     one-pass stream of result chunks, or None when the join can't
     hash-partition (cross join, unhashable key types, keyless) — the
-    caller falls back to the legacy ladder."""
+    caller falls back to the legacy ladder.
+
+    ``tune`` is the adaptive-execution handle (docs/tuning.md): it
+    supplies the CALIBRATED bucket count for this plan's join when prior
+    runs observed it (replacing the static ``budget/32`` sizing guess)
+    and receives this run's measured side bytes/rows and bucket-pair
+    device peak as the next generation's evidence. None (tuning disabled,
+    direct engine calls) resolves exactly as before."""
     from ..dataframe.utils import get_join_schemas, parse_join_type
     from ..jax.streaming import _device_peak_bytes
     from ..obs import get_tracer
@@ -250,9 +263,12 @@ def shuffle_spill_join(
     if kinds is None:
         return None
     conf = engine.conf
+    t_start = time.perf_counter()
     est1, est2 = estimate_frame_bytes(df1), estimate_frame_bytes(df2)
     est = max(est1 or 0, est2 or 0) or None
-    n_buckets = bucket_count(conf, est)
+    n_buckets = (
+        tune.bucket_count(conf, est) if tune is not None else bucket_count(conf, est)
+    )
     root = spill_dir_root(conf)
     os.makedirs(root, exist_ok=True)
     spill_dir = new_spill_dir(root)
@@ -276,6 +292,12 @@ def shuffle_spill_join(
         raise
     if stats is not None:
         stats.inc("joins_spill")
+    if tune is not None:
+        # the ACTUAL side sizes (the partitioner measured every row) — the
+        # observed cardinalities the next run's strategy decision consumes
+        tune.observe_sides(
+            left.bytes_spilled, right.bytes_spilled, left.rows, right.rows
+        )
     l_schema = Schema(df1.schema).pa_schema
     r_schema = Schema(df2.schema).pa_schema
     cap_l = max(left.max_bucket_rows, 1)
@@ -333,6 +355,10 @@ def shuffle_spill_join(
             remove_spill_dir(spill_dir)
             if stats is not None:
                 stats.inc("spill_dirs_cleaned")
+            if tune is not None:
+                tune.observe_run(
+                    run["peak_device_bytes"], time.perf_counter() - t_start
+                )
             from ..jax import streaming as _streaming
 
             _streaming.last_run_stats = dict(run, verb="shuffle_join")

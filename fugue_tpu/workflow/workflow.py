@@ -890,17 +890,23 @@ class FugueWorkflow:
     def run(self, engine: Any = None, conf: Any = None, **kwargs: Any) -> FugueWorkflowResult:
         infer_by = kwargs.pop("infer_by", None) or self._collect_raw_inputs()
         e = make_execution_engine(engine, conf, infer_by=infer_by, **kwargs)
-        from ..constants import FUGUE_TPU_CONF_PLAN_PREFIX
+        from ..constants import (
+            FUGUE_TPU_CONF_PLAN_PREFIX,
+            FUGUE_TPU_CONF_TUNING_PREFIX,
+        )
 
         # the optimizer gate sees engine conf overlaid with this
         # workflow's compile conf (same precedence explain() uses); plan.*
-        # compile switches stay per-workflow instead of being written into
-        # a possibly shared engine's conf, where they would leak into
-        # later runs of OTHER workflows on the same engine
+        # and tuning.* compile switches stay per-workflow instead of being
+        # written into a possibly shared engine's conf, where they would
+        # leak into later runs of OTHER workflows on the same engine (the
+        # per-tenant tuning kill-switch depends on this)
         plan_conf = ParamDict(e.conf)
         for k, v in self._conf.items():
             plan_conf[k] = v
-            if not str(k).startswith(FUGUE_TPU_CONF_PLAN_PREFIX):
+            if not str(k).startswith(
+                (FUGUE_TPU_CONF_PLAN_PREFIX, FUGUE_TPU_CONF_TUNING_PREFIX)
+            ):
                 e.conf[k] = v
         self._last_engine = e
         ctx = FugueWorkflowContext(e)
@@ -940,11 +946,19 @@ class FugueWorkflow:
             ).hexdigest()[:8]
             run_attrs = {"workflow": wf_label, "run": _uuid.uuid4().hex[:8]}
             run_ctx = _run_labels(**run_attrs)
+        # adaptive execution (docs/tuning.md): key this run's telemetry by
+        # the POST-optimization plan fingerprint so the tuner's learned
+        # settings apply to — and learn from — exactly this plan; the
+        # scope respects a per-workflow/per-tenant tuning kill-switch via
+        # plan_conf without touching the shared engine
+        from ..tuning import plan_fingerprint as _plan_fp, run_scope as _tuning_scope
+
+        self._last_plan_fingerprint = _plan_fp(run_tasks)
         try:
             with e._as_borrowed_context():
                 with run_ctx, tracer.span(
                     "workflow.run", cat="workflow", tasks=len(run_tasks), **run_attrs
-                ):
+                ), _tuning_scope(e, self._last_plan_fingerprint, plan_conf):
                     ctx.run(
                         run_tasks,
                         result_aliases=aliases,
@@ -1027,6 +1041,14 @@ class FugueWorkflow:
                 engine_kind="any" if engine is None else type(engine).__name__,
             )
         )
+        # adaptive tuning (docs/tuning.md): what the tuner would use for
+        # this plan right now — every learned knob with its evidence and
+        # confidence, or why each stays static
+        from ..tuning import describe_tuning, plan_fingerprint
+
+        lines.extend(
+            describe_tuning(merged, plan_fingerprint(run_tasks), engine=engine)
+        )
         if lint:
             lines.append(self.lint(conf=conf, engine=engine).render())
         return "\n".join(lines)
@@ -1047,6 +1069,13 @@ class FugueWorkflow:
         """The :class:`~fugue_tpu.plan.PlanReport` of the last ``run()``
         (None before the first run)."""
         return getattr(self, "_last_plan_report", None)
+
+    @property
+    def last_plan_fingerprint(self) -> Optional[str]:
+        """The plan fingerprint of the last ``run()`` — the key the
+        adaptive tuner stores learned settings under (None before the
+        first run or for unfingerprintable plans)."""
+        return getattr(self, "_last_plan_fingerprint", None)
 
     @property
     def last_cache_plan(self) -> Any:

@@ -421,6 +421,7 @@ def test_engine_stats_surface_and_shims():
             "plan",
             "analysis",
             "cache",
+            "tuning",
             "shuffle",
             "latency",
             "telemetry",

@@ -1,0 +1,460 @@
+"""Cost-based adaptive execution (ISSUE 12, docs/tuning.md).
+
+Covers the _tuned.json lifecycle (atomic publish under a two-process
+race, corrupt/truncated file -> defaults with ONE warning, stale
+fingerprint eviction), the conf kill-switch restoring static behavior
+bit-identically, the bounded-multiplicative adjustment policy, warm-run
+convergence + restart reload, per-stream pipeline stats, and the
+decision surfaces (explain(), engine.stats()["tuning"], /metrics).
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_CACHE_ENABLED,
+    FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
+    FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH,
+    FUGUE_TPU_CONF_TUNING_ENABLED,
+    FUGUE_TPU_CONF_TUNING_MAX_ENTRIES,
+    FUGUE_TPU_CONF_TUNING_PATH,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.tuning import (
+    TunedStore,
+    adjust_buckets,
+    adjust_stream,
+    describe_tuning,
+)
+
+ROWS = 300_000
+CHUNK = 2048
+GROUPS = 32
+
+
+def _table(rows=ROWS, seed=5):
+    rng = np.random.default_rng(seed)
+    return pa.Table.from_pandas(
+        pd.DataFrame(
+            {"k": rng.integers(0, GROUPS, rows), "v": rng.random(rows)}
+        ),
+        preserve_index=False,
+    )
+
+
+_TBL = _table()
+
+
+def _stream(tbl=_TBL, chunk=CHUNK):
+    return LocalDataFrameIterableDataFrame(
+        (
+            ArrowDataFrame(tbl.slice(s, min(chunk, tbl.num_rows - s)))
+            for s in range(0, tbl.num_rows, chunk)
+        ),
+        schema=ArrowDataFrame(tbl).schema,
+    )
+
+
+def _engine(path, **extra):
+    conf = {
+        FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: CHUNK,
+        FUGUE_TPU_CONF_CACHE_ENABLED: False,
+        FUGUE_TPU_CONF_TUNING_PATH: str(path),
+    }
+    conf.update(extra)
+    return JaxExecutionEngine(conf)
+
+
+def _run_agg(eng, wf_conf=None):
+    dag = FugueWorkflow(wf_conf)
+    (
+        dag.df(_stream())
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    dag.run(eng)
+    res = (
+        dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+    )
+    return res, dag
+
+
+# ---------------------------------------------------------------------------
+# adjustment policy (pure functions)
+# ---------------------------------------------------------------------------
+
+
+def test_adjust_stream_grows_chunk_when_over_band():
+    adj = adjust_stream(
+        2048,
+        0,
+        {"chunks_prefetched": 128, "wall_s": 1.0, "rows": 262144, "bytes": 0},
+        1 << 30,
+    )
+    assert adj is not None and not adj["converged"]
+    # bounded multiplicative: at most 4x per generation
+    assert 2048 < adj["chunk_rows"] <= 2048 * 4
+    assert "chunk_rows 2048 ->" in adj["evidence"]
+
+
+def test_adjust_stream_no_signal_on_tiny_runs():
+    # fast runs and single chunks carry no signal -- tiny test workloads
+    # must never perturb the store
+    assert adjust_stream(2048, 0, {"chunks_prefetched": 128, "wall_s": 0.01}, 0) is None
+    assert adjust_stream(2048, 0, {"chunks_prefetched": 0, "wall_s": 9.9}, 0) is None
+
+
+def test_adjust_stream_in_band_converges():
+    adj = adjust_stream(65536, 0, {"chunks_prefetched": 8, "wall_s": 1.0}, 0)
+    assert adj is not None and adj["converged"]
+    assert adj["chunk_rows"] == 65536
+
+
+def test_adjust_stream_depth_responds_to_waits():
+    # consumer starved -> deepen
+    adj = adjust_stream(
+        65536,
+        2,
+        {
+            "chunks_prefetched": 12,
+            "wall_s": 2.0,
+            "producer_wait_s": 0.0,
+            "consumer_wait_s": 1.0,
+        },
+        0,
+    )
+    assert adj["prefetch_depth"] == 4
+    # producer starved -> shallower (floor 2)
+    adj = adjust_stream(
+        65536,
+        8,
+        {
+            "chunks_prefetched": 12,
+            "wall_s": 2.0,
+            "producer_wait_s": 1.0,
+            "consumer_wait_s": 0.0,
+        },
+        0,
+    )
+    assert adj["prefetch_depth"] == 4
+    # serial path (depth 0): no wait data, depth stays put
+    adj = adjust_stream(
+        65536,
+        0,
+        {"chunks_prefetched": 12, "wall_s": 2.0, "consumer_wait_s": 1.0},
+        0,
+    )
+    assert adj["prefetch_depth"] == 0
+
+
+def test_adjust_stream_byte_cap_bounds_chunk():
+    # 1 KiB/row, budget 8 MiB -> chunk capped at budget/8/bpr = 1024 rows
+    # floor CHUNK_MIN_ROWS applies
+    adj = adjust_stream(
+        4096,
+        0,
+        {
+            "chunks_prefetched": 256,
+            "wall_s": 3.0,
+            "rows": 1 << 20,
+            "bytes": 1 << 30,
+        },
+        8 << 20,
+    )
+    assert adj["chunk_rows"] == 4096  # capped back to the floor == current
+
+
+def test_adjust_buckets_shrinks_when_peak_far_under_budget():
+    adj = adjust_buckets(
+        256, {"peak_device_bytes": 1 << 20, "wall_s": 2.0}, 256 << 20
+    )
+    assert adj is not None and not adj["converged"]
+    assert adj["buckets"] == 32  # bounded by MAX_BUCKET_FACTOR=8
+    # over budget -> more buckets, regardless of wall
+    adj = adjust_buckets(
+        8, {"peak_device_bytes": 64 << 20, "wall_s": 0.05}, 16 << 20
+    )
+    assert adj["buckets"] > 8
+    # near target -> converged
+    adj = adjust_buckets(
+        64, {"peak_device_bytes": 100 << 20, "wall_s": 2.0}, 256 << 20
+    )
+    assert adj["converged"] and adj["buckets"] == 64
+    # small bucket counts are noise -- never adjusted
+    assert (
+        adjust_buckets(8, {"peak_device_bytes": 1 << 20, "wall_s": 2.0}, 256 << 20)
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# store lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_store_publish_atomic_and_preserves_foreign_keys(tmp_path):
+    path = str(tmp_path / "_tuned.json")
+    with open(path, "w") as f:
+        json.dump({"dense_sum": {"cpu": "onehot"}}, f)
+    store = TunedStore(path)
+    assert store.publish("fp1", lambda e: dict(e, streams={"s": {"chunk_rows": 1}}))
+    doc = json.load(open(path))
+    assert doc["dense_sum"] == {"cpu": "onehot"}  # the A/B winner survives
+    assert doc["tuning"]["plans"]["fp1"]["streams"]["s"]["chunk_rows"] == 1
+    assert doc["tuning"]["plans"]["fp1"]["gen"] == 1
+    # no temp litter
+    assert [f for f in os.listdir(tmp_path) if f != "_tuned.json"] == []
+
+
+def test_store_corrupt_file_defaults_with_one_warning(tmp_path, caplog):
+    path = str(tmp_path / "_tuned.json")
+    with open(path, "w") as f:
+        f.write('{"tuning": {"plans": {"fp1"')  # truncated mid-write
+    with caplog.at_level(logging.WARNING, logger="fugue_tpu.tuning"):
+        s1 = TunedStore(path)
+        assert s1.plan_entry("fp1") is None  # defaults, not a crash
+        assert s1.plans() == {}
+        s2 = TunedStore(path)  # a second store over the same path
+        assert s2.plan_entry("fp1") is None
+    warns = [r for r in caplog.records if "corrupt" in r.getMessage()]
+    assert len(warns) == 1  # ONE warning per path per process
+    # learning still works memory-side and repairs the file on publish
+    assert s1.publish("fp2", lambda e: dict(e, streams={"s": {"chunk_rows": 2}}))
+    assert json.load(open(path))["tuning"]["plans"]["fp2"]
+
+
+def test_store_stale_fingerprint_eviction(tmp_path):
+    path = str(tmp_path / "_tuned.json")
+    store = TunedStore(path, max_entries=3)
+    import time as _t
+
+    for i in range(5):
+        assert store.publish(
+            f"fp{i}", lambda e: dict(e, streams={"s": {"chunk_rows": 1}})
+        )
+        _t.sleep(0.01)  # distinct last-used timestamps
+    plans = json.load(open(path))["tuning"]["plans"]
+    assert sorted(plans) == ["fp2", "fp3", "fp4"]  # LRU evicted fp0, fp1
+    assert store.count() == 3
+
+
+def _race_worker(args):
+    path, wid = args
+    from fugue_tpu.tuning import TunedStore
+
+    store = TunedStore(path)
+    for i in range(25):
+        store.publish(
+            f"fp_{wid}",
+            lambda e: dict(e, streams={"s": {"chunk_rows": i + 1}}),
+        )
+        # concurrent reads must always see a complete document
+        store.plans()
+    return store.plan_entry(f"fp_{wid}")["streams"]["s"]["chunk_rows"]
+
+
+def test_store_two_process_publish_race(tmp_path):
+    """Two processes hammering publishes on one path: every intermediate
+    read parses (temp-write+rename means no torn file is ever visible),
+    and the final document is valid with well-formed entries."""
+    import multiprocessing as mp
+
+    path = str(tmp_path / "_tuned.json")
+    ctx = mp.get_context("fork")
+    with ctx.Pool(2) as pool:
+        outs = pool.map(_race_worker, [(path, 0), (path, 1)])
+    assert outs == [25, 25]
+    doc = json.load(open(path))  # parses -- never torn
+    plans = doc["tuning"]["plans"]
+    assert set(plans) <= {"fp_0", "fp_1"} and len(plans) >= 1
+    for e in plans.values():
+        assert e["streams"]["s"]["chunk_rows"] == 25
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: learning, convergence, restart, kill-switch
+# ---------------------------------------------------------------------------
+
+
+def test_warm_runs_converge_and_persist(tmp_path):
+    path = tmp_path / "_tuned.json"
+    eng = _engine(path)
+    res0, dag0 = _run_agg(eng)
+    fp = dag0.last_plan_fingerprint
+    assert fp is not None
+    t = eng.stats()["tuning"]
+    assert t["decisions"] >= 1 and t["static"] >= 1 and t["observations"] >= 1
+    # generation 2: same plan shape (fresh stream object) -> same
+    # fingerprint -> adaptive chunk size, bit-identical result
+    res1, dag1 = _run_agg(eng)
+    assert dag1.last_plan_fingerprint == fp
+    pd.testing.assert_frame_equal(res0, res1)
+    t = eng.stats()["tuning"]
+    assert t["adaptive"] >= 1
+    last = [d for d in t["last_decisions"] if d["target"] == "stream"][-1]
+    assert last["source"] == "adaptive"
+    assert last["value"]["chunk_rows"] > CHUNK  # grew off the mis-conf
+    # persisted: the store file holds the plan entry
+    doc = json.load(open(path))
+    entry = doc["tuning"]["plans"][fp]
+    assert entry["streams"]["aggregate"]["chunk_rows"] > CHUNK
+    # "restart": a FRESH engine (new tuner) over the same path reloads
+    eng2 = _engine(path)
+    res2, dag2 = _run_agg(eng2)
+    pd.testing.assert_frame_equal(res0, res2)
+    t2 = eng2.stats()["tuning"]
+    assert t2["adaptive"] >= 1 and t2["loads"] >= 1
+
+
+def test_kill_switch_restores_static_behavior(tmp_path):
+    path = tmp_path / "_tuned.json"
+    # learn an adaptive entry first
+    eng = _engine(path)
+    res_ref, _ = _run_agg(eng)
+    _run_agg(eng)
+    assert eng.stats()["tuning"]["adaptive"] >= 1
+    # engine-level kill-switch: fresh engine, tuning off -- no decisions,
+    # no store reads, static chunking, bit-identical result
+    eng_off = _engine(path, **{FUGUE_TPU_CONF_TUNING_ENABLED: False})
+    res_off, dag_off = _run_agg(eng_off)
+    pd.testing.assert_frame_equal(res_ref, res_off)
+    t = eng_off.stats()["tuning"]
+    assert t["decisions"] == 0 and t["observations"] == 0 and t["loads"] == 0
+    # per-workflow kill-switch on a TUNED engine: the workflow compile
+    # conf disables tuning for this run only, without touching the
+    # shared engine conf (the serve tenant-overlay contract)
+    res_wf, _ = _run_agg(eng, wf_conf={FUGUE_TPU_CONF_TUNING_ENABLED: False})
+    pd.testing.assert_frame_equal(res_ref, res_wf)
+    assert FUGUE_TPU_CONF_TUNING_ENABLED not in eng.conf
+
+
+def test_disabled_matches_never_enabled_chunking(tmp_path):
+    """enabled=false reproduces the pre-tuning engine exactly: same chunk
+    count through the stream as an engine that never had a store."""
+    from fugue_tpu.jax import streaming as st
+
+    path = tmp_path / "_tuned.json"
+    eng = _engine(path)
+    _run_agg(eng)
+    _run_agg(eng)  # adaptive entry exists now
+    st.last_run_stats = {}
+    eng_off = _engine(path, **{FUGUE_TPU_CONF_TUNING_ENABLED: False})
+    _run_agg(eng_off)
+    off_chunks = st.last_run_stats.get("chunks")
+    st.last_run_stats = {}
+    eng_fresh = _engine(tmp_path / "other.json")
+    _run_agg(eng_fresh)
+    fresh_chunks = st.last_run_stats.get("chunks")
+    assert off_chunks == fresh_chunks  # static chunking, bit-identical
+
+
+def test_max_entries_conf(tmp_path):
+    path = tmp_path / "_tuned.json"
+    eng = _engine(path, **{FUGUE_TPU_CONF_TUNING_MAX_ENTRIES: 7})
+    assert eng.tuner.store.max_entries == 7
+
+
+# ---------------------------------------------------------------------------
+# surfaces: per-stream stats, explain, engine.stats, /metrics, serve overlay
+# ---------------------------------------------------------------------------
+
+
+def test_per_stream_pipeline_stats(tmp_path):
+    eng = _engine(tmp_path / "_tuned.json", **{FUGUE_TPU_CONF_STREAM_PREFETCH_DEPTH: 2})
+    _run_agg(eng)
+    ps = eng.stats()["pipeline"]
+    assert "streams" in ps and len(ps["streams"]) >= 1
+    sid, s = next(iter(ps["streams"].items()))
+    assert "aggregate" in sid
+    for k in (
+        "runs",
+        "chunks_prefetched",
+        "producer_wait_s",
+        "consumer_wait_s",
+        "overlap_fraction",
+    ):
+        assert k in s
+    assert s["runs"] >= 1 and s["chunks_prefetched"] >= 1
+
+
+def test_explain_renders_decisions(tmp_path):
+    path = tmp_path / "_tuned.json"
+    eng = _engine(path)
+
+    def dag():
+        d = FugueWorkflow()
+        (
+            d.df(_stream())
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return d
+
+    cold = dag().explain(engine=eng)
+    assert "Adaptive tuning" in cold
+    assert "static: no observations" in cold
+    res, d1 = _run_agg(eng)
+    warm = dag().explain(engine=eng)
+    assert d1.last_plan_fingerprint in warm
+    assert "chunk_rows=" in warm and "obs=" in warm
+    # disabled renders the refusal reason
+    off = dag().explain(conf={FUGUE_TPU_CONF_TUNING_ENABLED: False}, engine=eng)
+    assert "DISABLED (fugue.tpu.tuning.enabled=false)" in off
+
+
+def test_stats_group_and_reset_contract(tmp_path):
+    eng = _engine(tmp_path / "_tuned.json")
+    _run_agg(eng)
+    _run_agg(eng)
+    t = eng.stats()["tuning"]
+    assert t["decisions"] >= 2 and t["entries"] >= 1
+    eng.reset_stats()
+    t = eng.stats()["tuning"]
+    assert t["decisions"] == 0 and t["observations"] == 0
+    # learned entries are KEPT (the JitCache keep-entries contract)
+    assert t["entries"] >= 1
+
+
+def test_tuning_flattens_onto_metrics(tmp_path):
+    from fugue_tpu.obs import validate_prometheus_text
+    from fugue_tpu.obs.prom import to_prometheus_text
+
+    eng = _engine(tmp_path / "_tuned.json")
+    _run_agg(eng)
+    text = to_prometheus_text(engine=eng)
+    assert "fugue_tpu_tuning_decisions" in text
+    assert "fugue_tpu_tuning_entries" in text
+    validate_prometheus_text(text)
+
+
+def test_tenant_overlay_allows_tuning_keys():
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve.tenant import tenant_policy
+
+    eng = NativeExecutionEngine(
+        {
+            "fugue.tpu.serve.tenant.acme.conf.fugue.tpu.tuning.enabled": False,
+            "fugue.tpu.serve.tenant.acme.conf.fugue.tpu.cache.enabled": False,
+        }
+    )
+    pol = tenant_policy(eng.conf, "acme")
+    assert pol.conf_overlay == {"fugue.tpu.tuning.enabled": False}
+    assert pol.dropped_keys == ("fugue.tpu.cache.enabled",)
+
+
+def test_describe_tuning_without_engine(tmp_path):
+    lines = describe_tuning(
+        {FUGUE_TPU_CONF_TUNING_PATH: str(tmp_path / "x.json")}, "deadbeef"
+    )
+    assert any("static: no observations" in ln for ln in lines)
