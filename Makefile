@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -10,6 +10,7 @@ test:
 	python tools/lint_locks.py --strict         # concurrency audit; BLOCKING (ISSUE 12)
 	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
 	-@$(MAKE) --no-print-directory serve-smoke  # serving gate; non-blocking here
+	-@$(MAKE) --no-print-directory fleet-smoke  # fleet chaos gate; non-blocking here
 
 # downsized perf gate (≤~30s): device-aggregate worker only, fails when the
 # oracle-normalized groupby_aggregate vs_baseline drops >20% below the
@@ -122,6 +123,15 @@ test-serve:
 # p50/p99 + rows/s, results bit-identical to serial cache-off runs
 serve-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
+
+# fleet chaos gate (ISSUE 13 acceptance, exit 15): 3 EngineServer
+# processes sharing a store + journal dir behind a FleetClient; one
+# replica SIGKILLed mid-execution — every submission completes (failover
+# under the same idempotency key), the journal audit shows ZERO duplicate
+# completed executions, >= 1 cross-replica dedup hit and >= 1 claim-lease
+# steal observed, results bit-identical to a serial cache-off oracle
+fleet-smoke:
+	JAX_PLATFORMS=cpu python bench.py --fleet-smoke
 
 # wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
 # (defaults to $FUGUE_TPU_CACHE_DIR)

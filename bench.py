@@ -49,7 +49,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", "2000000"))
 N_GROUPS = int(os.environ.get("BENCH_GROUPS", "1000"))
@@ -2142,6 +2142,279 @@ def _serve_smoke() -> None:
         raise SystemExit(12)
 
 
+# ---------------------------------------------------------------------------
+# extra.serve_fleet — the ISSUE 13 chaos gate (make fleet-smoke, exit 15)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_slow_factory(marker: str, sleep_s: float):
+    """A fingerprintable plan that signals run-start (marker file) and
+    holds the execution open long enough to SIGKILL its replica."""
+
+    def build():
+        import pandas as _pd
+
+        from fugue_tpu import FugueWorkflow
+        from fugue_tpu.column import col, functions as ff
+
+        def crawl(df: _pd.DataFrame) -> _pd.DataFrame:
+            with open(marker, "w") as f:
+                f.write("running")
+            time.sleep(sleep_s)
+            return df.assign(v=df["v"] * 2.0)
+
+        dag = FugueWorkflow()
+        (
+            dag.df(
+                _pd.DataFrame(
+                    {
+                        "k": [i % 4 for i in range(64)],
+                        "v": [float(i) for i in range(64)],
+                    }
+                )
+            )
+            .transform(crawl, schema="*")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def _fleet_agg_factory(seed: int):
+    def build():
+        import pandas as _pd
+
+        from fugue_tpu import FugueWorkflow
+        from fugue_tpu.column import col, functions as ff
+
+        dag = FugueWorkflow()
+        (
+            dag.df(
+                _pd.DataFrame(
+                    {
+                        "k": [i % 8 for i in range(4096)],
+                        "v": [float((i * 7 + seed) % 1000) for i in range(4096)],
+                    }
+                )
+            )
+            .partition_by("k")
+            .aggregate(
+                ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n")
+            )
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def _fleet_replica_main(store: str, jdir: str, idx: int, port_file: str) -> None:
+    """One fleet replica: engine + EngineServer + HTTP surface over the
+    shared store; parks until the parent terminates (or SIGKILLs) it."""
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve import EngineServer
+
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            "fugue.tpu.cache.dir": store,
+            "fugue.tpu.serve.journal.dir": jdir,
+            "fugue.tpu.serve.replica_id": f"r{idx}",
+            "fugue.tpu.serve.max_concurrent": 2,
+            "fugue.tpu.serve.queue_depth": 64,
+            "fugue.tpu.serve.fleet.lease_s": 10.0,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    srv = EngineServer(eng).start()
+    rpc.bind_serve(srv)
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{rpc.host} {rpc.port}")
+    os.replace(tmp, port_file)
+    while True:  # the parent owns this process's lifetime
+        time.sleep(0.5)
+
+
+def _bench_serve_fleet(replicas: int = 3) -> Dict[str, Any]:
+    """Chaos proof for the replicated serving tier (docs/serving.md
+    "Fleet"): N server processes share one store + journal dir; a
+    FleetClient balances a round of submissions (identical plans fanned
+    across replicas); one replica is SIGKILLed mid-execution. Gates:
+
+    - zero lost submissions (failover via idempotency key);
+    - zero duplicate COMPLETED executions per plan key (journal audit:
+      the killed owner's unfinished run is the only allowed re-run);
+    - >= 1 cross-replica dedup hit and >= 1 claim steal observed;
+    - every result bit-identical to a serial cache-off oracle.
+    """
+    import multiprocessing as _mp
+    import shutil as _shutil
+    import signal as _signal
+    import tempfile as _tempfile
+    import urllib.request as _urlreq
+
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve import FleetClient
+    from fugue_tpu.serve.journal import SubmissionJournal
+
+    root = _tempfile.mkdtemp(prefix="fugue_bench_fleet_")
+    store = os.path.join(root, "store")
+    jdir = os.path.join(root, "journal")
+    marker = os.path.join(root, "marker")
+    ctx = _mp.get_context("fork")
+    procs = []
+    t0 = time.perf_counter()
+    try:
+        port_files = [os.path.join(root, f"port_{i}") for i in range(replicas)]
+        for i in range(replicas):
+            p = ctx.Process(
+                target=_fleet_replica_main, args=(store, jdir, i, port_files[i])
+            )
+            p.start()
+            procs.append(p)
+        addrs = []
+        for pf in port_files:
+            deadline = time.monotonic() + 60
+            while not os.path.exists(pf):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet replica never came up")
+                time.sleep(0.05)
+            host, port = open(pf).read().split()
+            addrs.append((host, int(port)))
+        fc = FleetClient(addrs)
+
+        # --- the round: a slow victim plan + identical fast plans fanned
+        # across replicas. The slow one goes first (empty fleet -> lands
+        # on replica 0 deterministically).
+        slow_factory = _fleet_slow_factory(marker, 6.0)
+        slow_sub = fc.submit(slow_factory, tenant="chaos")
+        victim = slow_sub.replica
+        seeds = [0, 1, 2, 3]
+        subs = []
+        for rep in range(3):  # 3 waves of the same 4 plans = dedup fodder
+            for s in seeds:
+                subs.append(
+                    (s, fc.submit(_fleet_agg_factory(s), tenant=f"t{s % 2}"))
+                )
+        # --- SIGKILL the victim once its slow run is provably in flight
+        deadline = time.monotonic() + 60
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline:
+                raise RuntimeError("victim never started the slow plan")
+            time.sleep(0.02)
+        os.kill(procs[victim].pid, _signal.SIGKILL)
+        procs[victim].join(10)
+
+        # --- collect everything; the slow submission fails over
+        results = {}
+        slow_frames = fc.result(slow_sub, timeout=120)["r"]
+        for s, sub in subs:
+            results.setdefault(s, []).append(fc.result(sub, timeout=120)["r"])
+        completed = 1 + sum(len(v) for v in results.values())
+
+        # --- survivor stats: cross-replica dedup + steals observed
+        hits = steals = 0
+        for i, (host, port) in enumerate(addrs):
+            if i == victim:
+                continue
+            with _urlreq.urlopen(f"http://{host}:{port}/stats") as r:
+                serve = json.loads(r.read().decode())["serve"]
+            hits += serve["fleet_result_hits"]
+            steals += serve["fleet_claim_steals"]
+
+        # --- journal audit: per plan key, COMPLETED executions == 1
+        execs: Dict[str, List[Tuple[str, str]]] = {}
+        dones: Dict[str, set] = {}
+        for name in os.listdir(jdir):
+            path = os.path.join(jdir, name)
+            done_sids = set()
+            recs = SubmissionJournal.read_records(path)
+            for rec in recs:
+                if rec.get("op") == "done" and rec.get("state") == "done":
+                    done_sids.add(rec.get("sid"))
+            for rec in recs:
+                if rec.get("op") == "exec" and rec.get("key"):
+                    execs.setdefault(rec["key"], []).append((name, rec.get("sid")))
+            dones[name] = done_sids
+        duplicate_execs = 0
+        for key, entries in execs.items():
+            completed_execs = sum(
+                1 for name, sid in entries if sid in dones.get(name, ())
+            )
+            duplicate_execs += max(0, completed_execs - 1)
+
+        # --- serial oracle, cache + fleet fully off
+        identical = True
+        for s, frames in results.items():
+            dag = _fleet_agg_factory(s)()
+            dag.run(NativeExecutionEngine({"fugue.tpu.cache.enabled": False}))
+            want = (
+                dag.yields["r"]
+                .result.as_pandas()
+                .sort_values("k")
+                .reset_index(drop=True)
+            )
+            for got in frames:
+                got = got.sort_values("k").reset_index(drop=True)
+                identical = identical and got.equals(want)
+        odag = slow_factory()
+        odag.run(NativeExecutionEngine({"fugue.tpu.cache.enabled": False}))
+        owant = (
+            odag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+        sgot = slow_frames.sort_values("k").reset_index(drop=True)
+        identical = identical and sgot.equals(owant)
+
+        submissions = 1 + len(subs)
+        correct = (
+            completed == submissions
+            and duplicate_execs == 0
+            and hits >= 1
+            and steals >= 1
+            and identical
+        )
+        return {
+            "replicas": replicas,
+            "victim": victim,
+            "submissions": submissions,
+            "completed": completed,
+            "client": fc.stats(),
+            "fleet_result_hits": hits,
+            "claim_steals": steals,
+            "exec_keys": len(execs),
+            "duplicate_completed_execs": duplicate_execs,
+            "bit_identical": identical,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "correct": correct,
+        }
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+        _shutil.rmtree(root, ignore_errors=True)
+
+
+def _fleet_smoke() -> None:
+    """``make fleet-smoke``: the ISSUE 13 chaos gate — >= 2 replicas over
+    a shared store, one SIGKILLed mid-round; every submission completes
+    via idempotent failover, the journal audit shows zero duplicate
+    completed executions, >= 1 cross-replica dedup hit and >= 1 claim
+    steal, results bit-identical to a serial cache-off oracle. Exit 15
+    on any violation (the next code after the 12/13/14 serve/udf/tuning
+    gates)."""
+    case = _bench_serve_fleet()
+    print(json.dumps({"metric": "serve_fleet", "chaos": case}))
+    if not case["correct"]:
+        raise SystemExit(15)
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -3006,6 +3279,9 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-smoke":
         with _bench_lock():
             _serve_smoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-smoke":
+        with _bench_lock():
+            _fleet_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "--telemetry-smoke":
         out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
         with _bench_lock():

@@ -27,7 +27,9 @@ contract: counters zero, live entries stay.
 import json
 import os
 import shutil
+import socket
 import threading
+import time
 import uuid as _uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
@@ -165,11 +167,13 @@ class ArtifactStore:
         self.root = path
         self.objs = os.path.join(path, "objs")
         self.manifests = os.path.join(path, "manifests")
+        self.claims = os.path.join(path, "claims")
         self.cap = int(cap_bytes)
         self.cap_entries = int(cap_entries)
         self._log = log
         os.makedirs(self.objs, exist_ok=True)
         os.makedirs(self.manifests, exist_ok=True)
+        os.makedirs(self.claims, exist_ok=True)
 
     # -- paths ---------------------------------------------------------------
     def _obj(self, fp: str) -> str:
@@ -183,6 +187,103 @@ class ArtifactStore:
 
     def _manifest(self, key: str) -> str:
         return os.path.join(self.manifests, key + ".manifest.json")
+
+    def _claim(self, key: str) -> str:
+        return os.path.join(self.claims, key + ".claim.json")
+
+    # -- fingerprint-ownership claims (docs/serving.md "Fleet") --------------
+    # Replicas sharing this store collapse identical work ACROSS processes
+    # by claiming a key before executing it: the winner executes and
+    # publishes, everyone else waits on the published artifact. The claim
+    # is a small json file created with O_CREAT|O_EXCL (the same
+    # kernel-atomic primitive the temp-write+rename publishes lean on), so
+    # exactly one creator wins a cold race. A claim is STEALABLE when its
+    # owner is provably dead (same-host pid gone) or its lease expired —
+    # steal races settle by re-reading the file after the atomic rewrite:
+    # whichever payload survived the rename owns it.
+    def try_claim(
+        self, key: str, owner: str, lease_s: float
+    ) -> Tuple[bool, Optional[Dict[str, Any]]]:
+        """(owned, holder_payload). ``owned`` means THIS ``owner`` holds
+        the claim now (fresh, re-entered after a restart, or stolen);
+        otherwise ``holder_payload`` is the live holder to wait on."""
+        path = self._claim(key)
+        payload = {
+            "owner": owner,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "ts": time.time(),
+            "lease_s": float(lease_s),
+        }
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            try:
+                data = json.dumps(payload).encode()
+                os.write(fd, data)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            return True, payload
+        except FileExistsError:
+            pass
+        except OSError:
+            return False, None  # store trouble: behave as not-owned
+        holder = self.read_claim(key)
+        if holder is not None:
+            if holder.get("owner") == owner:
+                # re-entrant: this replica restarting and replaying its
+                # journal meets its own pre-crash claim
+                return True, holder
+            if not self._claim_stealable(holder):
+                return False, holder
+        # expired/dead/torn: steal via atomic rewrite; the last rename
+        # wins, so re-read to learn who actually owns it now
+        try:
+            self._write_json(path, payload)
+        except OSError:
+            return False, holder
+        cur = self.read_claim(key)
+        return (cur is not None and cur.get("owner") == owner), cur
+
+    @staticmethod
+    def _claim_stealable(holder: Dict[str, Any]) -> bool:
+        ts = float(holder.get("ts", 0.0))
+        lease = float(holder.get("lease_s", 0.0))
+        if ts + lease <= time.time():
+            return True
+        # a SIGKILLed same-host owner shouldn't pin its claim for the
+        # whole lease: a dead pid is stealable immediately
+        pid = holder.get("pid")
+        if pid and holder.get("host") == socket.gethostname():
+            try:
+                os.kill(int(pid), 0)
+            except ProcessLookupError:
+                return True
+            except OSError:
+                pass
+        return False
+
+    def read_claim(self, key: str) -> Optional[Dict[str, Any]]:
+        """The current claim payload, or None. A torn/corrupt claim file
+        is deleted and reads as absent (stealable, never a wedge)."""
+        path = self._claim(key)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:
+            _best_effort_remove(path)
+            return None
+
+    def release_claim(self, key: str, owner: str) -> bool:
+        """Remove the claim if ``owner`` still holds it (a steal victim's
+        late release must not drop the thief's claim)."""
+        cur = self.read_claim(key)
+        if cur is not None and cur.get("owner") != owner:
+            return False
+        _best_effort_remove(self._claim(key))
+        return True
 
     # -- delta manifests -----------------------------------------------------
     def load_manifest(self, key: str) -> Optional[Dict[str, Any]]:

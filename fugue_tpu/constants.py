@@ -241,11 +241,49 @@ FUGUE_TPU_CONF_SERVE_RETAIN = "fugue.tpu.serve.retain"
 # per-tenant overlays: fugue.tpu.serve.tenant.<id>.priority (scheduling
 # default), fugue.tpu.serve.tenant.<id>.budget_bytes (admission gate:
 # live charged bytes + the new reserve must stay under it; 0 = unlimited),
-# and fugue.tpu.serve.tenant.<id>.conf.<key> (per-run conf overlay —
-# restricted to fugue.tpu.plan.* compile switches, which are per-workflow
-# by design; other keys would leak into the shared engine conf and are
-# dropped with a warning)
+# and fugue.tpu.serve.tenant.<id>.conf.<key> (per-run conf overlay — any
+# fugue.tpu.* key: workflow.run scopes conf per run, so an overlay can
+# never leak into another tenant's run; non-fugue.tpu keys are dropped
+# with a warning)
 FUGUE_TPU_CONF_SERVE_TENANT_PREFIX = "fugue.tpu.serve.tenant."
+# keys every tenant conf overlay must start with (run-scoped by the
+# workflow.run conf overlay; see docs/serving.md)
+FUGUE_TPU_CONF_SERVE_TENANT_OVERLAY_PREFIX = "fugue.tpu."
+# distinct tenant ids the serving layer keeps state for (per-tenant stats
+# breakdown, parsed tenant policies, the one-warning-per-tenant set) —
+# least-recently-seen tenants past it are evicted, the same LRU
+# discipline as the serve.retain retention ring: a hostile client minting
+# tenant ids must not leak memory in a long-lived server
+FUGUE_TPU_CONF_SERVE_MAX_TENANTS = "fugue.tpu.serve.max_tenants"
+
+# --- serving fleet (fugue_tpu/serve/fleet.py, docs/serving.md "Fleet") ---
+# master switch for cross-replica coordination. ON by default but only
+# ACTIVE when the engine mounts a shared disk store (fugue.tpu.cache.dir)
+# — replicas sharing that directory collapse identical submissions across
+# processes via claim files and serve each other's published results.
+# =false (or a single replica with no shared store) preserves the
+# single-server behavior bit-identically, including the /serve/* wire
+# contract.
+FUGUE_TPU_CONF_SERVE_FLEET_ENABLED = "fugue.tpu.serve.fleet.enabled"
+# claim lease in seconds: a claim older than this whose owner can't be
+# proven alive is STEALABLE — a dead replica's in-flight plan is taken
+# over by whichever waiter gets the atomic claim rewrite in first. A
+# same-host owner with a dead pid is stealable immediately.
+FUGUE_TPU_CONF_SERVE_FLEET_LEASE_S = "fugue.tpu.serve.fleet.lease_s"
+# how often a cross-replica waiter re-checks the shared store for the
+# owner's published result (and the owner's claim for expiry)
+FUGUE_TPU_CONF_SERVE_FLEET_POLL_S = "fugue.tpu.serve.fleet.poll_s"
+# published serve-result payloads kept in the shared store (mtime-LRU
+# eviction past it, the ArtifactStore discipline)
+FUGUE_TPU_CONF_SERVE_FLEET_MAX_RESULTS = "fugue.tpu.serve.fleet.max_results"
+# this replica's stable identity in claim files / journal names /
+# /readyz; default "<hostname>-<pid>" (unique per process)
+FUGUE_TPU_CONF_SERVE_REPLICA_ID = "fugue.tpu.serve.replica_id"
+# crash-safe submission journal: the directory holding each replica's
+# append-only fsync'd WAL (<replica_id>.jsonl). Unset (default) disables
+# journaling; on restart a replica REPLAYS its own unfinished entries
+# under their original idempotency keys (docs/serving.md "Fleet").
+FUGUE_TPU_CONF_SERVE_JOURNAL_DIR = "fugue.tpu.serve.journal.dir"
 
 # --- cost-based adaptive execution (fugue_tpu/tuning, docs/tuning.md) ---
 # Feedback layer that re-derives stream chunk size / prefetch depth and

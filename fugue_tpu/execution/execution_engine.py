@@ -60,6 +60,18 @@ _CONTEXT_ENGINE: ContextVar[Optional["ExecutionEngine"]] = ContextVar(
 _GLOBAL_ENGINE_LOCK = RLock()
 _GLOBAL_ENGINE: List[Optional["ExecutionEngine"]] = [None]
 
+# run-scoped conf overlays (docs/serving.md "Per-run conf scoping"):
+# ``workflow.run`` used to write workflow conf into the shared engine's
+# conf dict, where it leaked into every later run on the same engine.
+# Instead each run enters ``engine.run_conf_scope(overlay)``, which binds
+# a merged base+overlay view to THIS context only; ``engine.conf`` reads
+# resolve through it. Context-local, so concurrent runs on one engine
+# each see their own conf; task threads (copy_context in
+# _workflow_context) and fork workers inherit the scope, exactly like
+# run_labels. The list holds (engine id, merged view) pairs so nested
+# runs on DIFFERENT engines don't shadow each other's overlays.
+_RUN_CONF: ContextVar[tuple] = ContextVar("fugue_tpu_run_conf", default=())
+
 
 class FugueEngineBase(ABC):
     @property
@@ -201,7 +213,40 @@ class ExecutionEngine(FugueEngineBase):
 
     @property
     def conf(self) -> ParamDict:
+        scopes = _RUN_CONF.get()
+        if scopes:
+            me = id(self)
+            for eng_id, view in reversed(scopes):
+                if eng_id == me:
+                    return view
         return self._conf
+
+    @property
+    def base_conf(self) -> ParamDict:
+        """The engine-level conf dict itself, ignoring any active
+        run-scope overlay — what a deliberate engine-global write should
+        target, and what run-scope leak tests assert against."""
+        return self._conf
+
+    @contextmanager
+    def run_conf_scope(self, overlay: Any = None) -> Iterator[ParamDict]:
+        """Bind ``overlay`` over this engine's conf for the current
+        context only (and everything it forks via ``copy_context`` /
+        ``fork``). Reads through ``engine.conf`` resolve overlay-first;
+        writes land in the scoped view and vanish at exit — a run can no
+        longer mutate a shared engine's conf. Nestable; inner scopes see
+        outer overlays (merged at entry)."""
+        if not overlay:
+            yield self.conf
+            return
+        merged = ParamDict(self.conf)  # current view: nested scopes stack
+        merged.update(overlay)
+        scopes = _RUN_CONF.get()
+        token = _RUN_CONF.set(scopes + ((id(self), merged),))
+        try:
+            yield merged
+        finally:
+            _RUN_CONF.reset(token)
 
     @property
     def log(self) -> logging.Logger:

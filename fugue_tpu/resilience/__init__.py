@@ -12,6 +12,8 @@ from .fault import (
     SITE_MAP_CHUNK,
     SITE_MAP_DISPATCH,
     SITE_RPC_REQUEST,
+    SITE_SERVE_CLAIM,
+    SITE_SERVE_JOURNAL,
     SITE_SHUFFLE_SPILL,
     SITE_STREAM_CHUNK,
     SITE_TASK_EXECUTE,
@@ -37,6 +39,8 @@ __all__ = [
     "SITE_TASK_EXECUTE",
     "SITE_RPC_REQUEST",
     "SITE_CHECKPOINT_SAVE",
+    "SITE_SERVE_JOURNAL",
+    "SITE_SERVE_CLAIM",
     "SITE_SHUFFLE_SPILL",
     "SITE_STREAM_CHUNK",
     "RetryPolicy",

@@ -56,6 +56,8 @@ __all__ = [
     "SITE_CHECKPOINT_SAVE",
     "SITE_STREAM_CHUNK",
     "SITE_SHUFFLE_SPILL",
+    "SITE_SERVE_JOURNAL",
+    "SITE_SERVE_CLAIM",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -73,6 +75,17 @@ SITE_STREAM_CHUNK = "stream.chunk"
 # here leaves that one bucket unpublished; the reader recovers it by
 # repartitioning ONLY that bucket from a replayable source
 SITE_SHUFFLE_SPILL = "shuffle.spill"
+# inside EngineServer.submit, between the WAL append and the submission
+# entering the queue (fugue_tpu/serve/server.py) — `kill` here leaves a
+# journaled-but-never-queued admission: the crash window a restart's
+# journal replay (and a FleetClient failover) must cover exactly once
+SITE_SERVE_JOURNAL = "serve.journal"
+# inside FleetCoordinator.acquire, between the cross-replica claim write
+# and execution start (fugue_tpu/serve/fleet.py) — `kill` here leaves a
+# dead owner's claim for waiters to steal (lease expiry / dead-pid
+# detection); `delay` widens the window so a chaos test can SIGKILL the
+# owner deterministically mid-claim
+SITE_SERVE_CLAIM = "serve.claim"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

@@ -275,8 +275,19 @@ class HttpRPCServer(RPCServer):
             return 200, "application/json", json.dumps(payload).encode()
         st = srv.stats()
         full = st["queue_depth"] >= st["queue_capacity"] or not srv.running
+        # shared-store health (ISSUE 13 satellite): a replica whose cache
+        # or journal disk died must be DRAINED by the balancer — it can
+        # neither journal admissions nor publish fleet results — so it
+        # answers 503 with its own status, distinct from "overloaded"
+        health = srv.store_health()
+        unwritable = not health.get("writable", True)
+        status = (
+            "store_unwritable"
+            if unwritable
+            else ("overloaded" if full else "ready")
+        )
         payload = {
-            "status": "overloaded" if full else "ready",
+            "status": status,
             "serve_bound": True,
             "accepting": bool(srv.running),
             "queue_depth": st["queue_depth"],
@@ -284,10 +295,13 @@ class HttpRPCServer(RPCServer):
             "queue_free": max(0, st["queue_capacity"] - st["queue_depth"]),
             "active_runs": st["active_runs"],
             "max_concurrent": st["max_concurrent"],
+            "replica_id": st.get("replica_id"),
+            "store": health,
         }
-        # 503 on full: the shape a load balancer sheds on — BEFORE the
-        # admission queue starts rejecting sessions outright
-        return (503 if full else 200), "application/json", json.dumps(payload).encode()
+        # 503 on full/unwritable: the shape a load balancer sheds on —
+        # BEFORE the admission queue starts rejecting sessions outright
+        code = 503 if (full or unwritable) else 200
+        return code, "application/json", json.dumps(payload).encode()
 
     @staticmethod
     def _query_id(query: str) -> Optional[str]:
@@ -341,7 +355,10 @@ class HttpRPCServer(RPCServer):
         if sub.status in ("queued", "running"):
             return 202, "application/json", json.dumps(self._sub_payload(sub)).encode()
         try:
-            res = sub.result(timeout=0)
+            # status is terminal but the waiter event is set a beat later
+            # (the execution's finish path runs stats/publish first) —
+            # a short bounded wait instead of timeout=0 absorbs the race
+            res = sub.result(timeout=5)
             frames = {}
             for name, y in res.yields.items():
                 df = getattr(y, "result", None)

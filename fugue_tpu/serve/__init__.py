@@ -21,17 +21,24 @@ Over HTTP (the ``rpc/http.py`` surface)::
 
 from .client import ServeHttpClient
 from .dedup import submission_key
+from .fleet import FleetClient, FleetCoordinator, FleetResult, FleetSubmission
+from .journal import SubmissionJournal
 from .server import EngineServer, ServeRejected, Submission, SubmissionCanceled
 from .stats import ServeStats
 from .tenant import TenantAccounts, TenantPolicy, tenant_policy
 
 __all__ = [
     "EngineServer",
+    "FleetClient",
+    "FleetCoordinator",
+    "FleetResult",
+    "FleetSubmission",
     "ServeHttpClient",
     "ServeRejected",
     "ServeStats",
     "Submission",
     "SubmissionCanceled",
+    "SubmissionJournal",
     "TenantAccounts",
     "TenantPolicy",
     "submission_key",

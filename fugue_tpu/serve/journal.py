@@ -1,0 +1,164 @@
+"""Crash-safe submission journal: a per-replica write-ahead log.
+
+A replica that dies mid-run must not LOSE admitted submissions — the
+fleet contract (docs/serving.md "Fleet") is exactly-once *observable*
+effect over at-least-once execution. The journal is the at-least-once
+half: every admission appends one fsync'd jsonl record (idempotency key,
+tenant, priority, cloudpickled dag payload) BEFORE the submission enters
+the queue, and completion appends a ``done`` record. On restart the
+replica replays its own unfinished entries under their original
+idempotency keys; a balancer (:class:`~fugue_tpu.serve.fleet.FleetClient`)
+fails a dead replica's submissions over to a survivor the same way. The
+cross-replica claim protocol (``cache/store.py``) turns either replay
+into a dedup hit instead of a duplicate execution whenever the original
+run got far enough to publish.
+
+File format — append-only jsonl, one file per replica
+(``<dir>/<replica_id>.jsonl``), records:
+
+- ``{"op": "admit", "sid", "idem", "tenant", "priority", "reserve",
+  "dag" (base64 cloudpickle | null), "ts"}``
+- ``{"op": "exec", "sid", "key"}`` — this replica became the claim owner
+  and is about to execute (the no-double-execution audit reads these)
+- ``{"op": "done", "sid", "state"}`` — terminal; replay skips the sid
+
+Appends are atomic at the record level (single ``write`` of one line,
+fsync'd); a torn final line — the crash window — is skipped by the
+reader, which costs at most the one record whose admission never
+completed anyway (the ``serve.journal`` fault site sits exactly there).
+"""
+
+import base64
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SubmissionJournal"]
+
+
+class SubmissionJournal:
+    """Append-only fsync'd WAL of one replica's admitted submissions."""
+
+    def __init__(self, path: str, replica_id: str, log: Any = None):
+        self.path = path
+        self.replica_id = replica_id
+        self._log = log
+        self._lock = threading.Lock()
+        self._fd: Optional[int] = None
+        self._appends = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # -- write side ----------------------------------------------------------
+    def _append(self, rec: Dict[str, Any]) -> None:
+        line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            if self._fd is None:
+                self._fd = os.open(
+                    self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644
+                )
+            os.write(self._fd, line)
+            os.fsync(self._fd)
+            self._appends += 1
+
+    def admit(
+        self,
+        sid: str,
+        idem: Optional[str],
+        tenant: str,
+        priority: int,
+        reserve: int,
+        dag: Any,
+    ) -> None:
+        """Journal an admission. The dag (or factory) is cloudpickled
+        best-effort: an unpicklable in-process dag (closing over live
+        frames) journals with ``dag=null`` — the admission is still
+        audited, it just can't be replayed from this file."""
+        payload: Optional[str] = None
+        try:
+            import cloudpickle
+
+            payload = base64.b64encode(cloudpickle.dumps(dag)).decode()
+        except Exception:
+            if self._log is not None:
+                self._log.warning(
+                    "journal: submission %s dag not picklable; journaled "
+                    "without a replayable payload",
+                    sid,
+                )
+        self._append(
+            {
+                "op": "admit",
+                "sid": sid,
+                "idem": idem,
+                "tenant": tenant,
+                "priority": int(priority),
+                "reserve": int(reserve),
+                "dag": payload,
+                "ts": time.time(),
+            }
+        )
+
+    def exec_start(self, sid: str, key: Optional[str]) -> None:
+        self._append({"op": "exec", "sid": sid, "key": key})
+
+    def done(self, sid: str, state: str) -> None:
+        self._append({"op": "done", "sid": sid, "state": state})
+
+    @property
+    def appends(self) -> int:
+        with self._lock:
+            return self._appends
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    # -- read side -----------------------------------------------------------
+    @staticmethod
+    def read_records(path: str) -> List[Dict[str, Any]]:
+        """Every parseable record in ``path`` (a torn trailing line —
+        the crash window — is skipped)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(path, "rb") as f:
+                for raw in f:
+                    try:
+                        out.append(json.loads(raw.decode()))
+                    except Exception:
+                        continue
+        except FileNotFoundError:
+            pass
+        return out
+
+    def unfinished(self) -> List[Dict[str, Any]]:
+        """This replica's admitted-but-not-done records, in admission
+        order — what a restart replays."""
+        done = set()
+        admits: List[Dict[str, Any]] = []
+        for rec in self.read_records(self.path):
+            op = rec.get("op")
+            if op == "done":
+                done.add(rec.get("sid"))
+            elif op == "admit":
+                admits.append(rec)
+        return [r for r in admits if r.get("sid") not in done]
+
+    def decode_dag(self, rec: Dict[str, Any]) -> Optional[Any]:
+        payload = rec.get("dag")
+        if not payload:
+            return None
+        try:
+            import cloudpickle
+
+            return cloudpickle.loads(base64.b64decode(payload))
+        except Exception:
+            if self._log is not None:
+                self._log.warning(
+                    "journal: replay of %s skipped (payload undecodable)",
+                    rec.get("sid"),
+                )
+            return None

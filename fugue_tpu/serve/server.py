@@ -30,21 +30,34 @@ The moving parts (docs/serving.md):
   label — bounded-cardinality via the same rotation as ``run``.
 """
 
+import os
+import socket
 import threading
 import time
 import uuid as _uuid
+from collections import OrderedDict
 from contextlib import nullcontext
 from typing import Any, Dict, List, Optional
 
 from ..constants import (
     FUGUE_TPU_CONF_SERVE_AGING_S,
     FUGUE_TPU_CONF_SERVE_DEFAULT_PRIORITY,
+    FUGUE_TPU_CONF_SERVE_FLEET_ENABLED,
+    FUGUE_TPU_CONF_SERVE_FLEET_LEASE_S,
+    FUGUE_TPU_CONF_SERVE_FLEET_MAX_RESULTS,
+    FUGUE_TPU_CONF_SERVE_FLEET_POLL_S,
+    FUGUE_TPU_CONF_SERVE_JOURNAL_DIR,
     FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_TPU_CONF_SERVE_MAX_TENANTS,
     FUGUE_TPU_CONF_SERVE_QUEUE_DEPTH,
+    FUGUE_TPU_CONF_SERVE_REPLICA_ID,
     FUGUE_TPU_CONF_SERVE_RESERVE_BYTES,
     FUGUE_TPU_CONF_SERVE_RETAIN,
 )
+from ..resilience import SITE_SERVE_CLAIM, SITE_SERVE_JOURNAL, FaultInjector
 from .dedup import submission_key
+from .fleet import FleetCoordinator, FleetResult
+from .journal import SubmissionJournal
 from .stats import ServeStats
 from .tenant import TenantAccounts, TenantPolicy, tenant_policy
 
@@ -191,7 +204,11 @@ class EngineServer:
         self.aging_s = float(c.get(FUGUE_TPU_CONF_SERVE_AGING_S, 30.0))
         self.default_reserve = int(c.get(FUGUE_TPU_CONF_SERVE_RESERVE_BYTES, 0))
         self.retain = max(1, int(c.get(FUGUE_TPU_CONF_SERVE_RETAIN, 256)))
-        self._stats = ServeStats()
+        self.max_tenants = max(1, int(c.get(FUGUE_TPU_CONF_SERVE_MAX_TENANTS, 256)))
+        self.replica_id = str(
+            c.get(FUGUE_TPU_CONF_SERVE_REPLICA_ID, "")
+        ) or f"{socket.gethostname()}-{os.getpid()}"
+        self._stats = ServeStats(max_tenants=self.max_tenants)
         self._accounts = TenantAccounts()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -200,13 +217,49 @@ class EngineServer:
         self._subs: Dict[str, Submission] = {}
         self._idem: Dict[str, str] = {}  # idempotency key -> submission id
         self._done_order: List[str] = []  # retention ring of finished subs
-        self._policies: Dict[str, TenantPolicy] = {}
-        self._overlay_warned: set = set()
+        # per-tenant state is LRU-bounded like the retention ring: tenant
+        # ids are client-supplied, and a hostile client minting ids must
+        # rotate state, never grow it (ISSUE 13 satellite)
+        self._policies: "OrderedDict[str, TenantPolicy]" = OrderedDict()
+        self._overlay_warned: "OrderedDict[str, bool]" = OrderedDict()
+        self._store_health: Dict[str, Any] = {}
+        self._store_health_ts = 0.0
         self._seq = 0
         self._active = 0
         self._peak_queue = 0
         self._workers: List[threading.Thread] = []
         self._running = False
+        self._injector = FaultInjector.from_conf(c)
+        # fleet coordination (docs/serving.md "Fleet"): active only when
+        # the engine mounts a shared disk store — replicas sharing that
+        # directory collapse identical submissions across processes.
+        # fleet.enabled=false restores single-server behavior exactly.
+        self._fleet: Optional[FleetCoordinator] = None
+        if bool(c.get(FUGUE_TPU_CONF_SERVE_FLEET_ENABLED, True)):
+            disk = getattr(engine.result_cache, "disk", None)
+            if disk is not None:
+                self._fleet = FleetCoordinator(
+                    disk,
+                    self.replica_id,
+                    lease_s=float(c.get(FUGUE_TPU_CONF_SERVE_FLEET_LEASE_S, 30.0)),
+                    poll_s=float(c.get(FUGUE_TPU_CONF_SERVE_FLEET_POLL_S, 0.05)),
+                    max_results=int(
+                        c.get(FUGUE_TPU_CONF_SERVE_FLEET_MAX_RESULTS, 256)
+                    ),
+                    stats=self._stats,
+                    injector=self._injector,
+                    log=engine.log,
+                )
+        # crash-safe submission journal (serve/journal.py): per-replica
+        # fsync'd WAL; admissions append BEFORE queueing, restarts replay
+        self._journal: Optional[SubmissionJournal] = None
+        jdir = str(c.get(FUGUE_TPU_CONF_SERVE_JOURNAL_DIR, ""))
+        if jdir:
+            self._journal = SubmissionJournal(
+                os.path.join(jdir, f"{self.replica_id}.jsonl"),
+                self.replica_id,
+                log=engine.log,
+            )
         # serving counters ride the engine's unified registry (ISSUE 3
         # contract: engine.stats()["serve"], reset under keep-entries)
         engine.metrics.register("serve", self._stats)
@@ -226,7 +279,37 @@ class EngineServer:
             ]
         for t in self._workers:
             t.start()
+        self._replay_journal()
         return self
+
+    def _replay_journal(self) -> None:
+        """Resubmit this replica's admitted-but-unfinished journal
+        entries under their original idempotency keys (crash recovery).
+        The claim protocol turns a replay whose original execution
+        published into a fleet result hit, not a re-run."""
+        if self._journal is None:
+            return
+        for rec in self._journal.unfinished():
+            dag = self._journal.decode_dag(rec)
+            if dag is None:
+                # audited but not replayable (unpicklable in-process dag)
+                self._journal.done(rec.get("sid", ""), "unreplayable")
+                continue
+            try:
+                self.submit(
+                    dag,
+                    tenant=rec.get("tenant", "default"),
+                    priority=rec.get("priority"),
+                    idempotency_key=rec.get("idem"),
+                    reserve_bytes=rec.get("reserve"),
+                )
+                self._stats.inc("journal_replays")
+            except ServeRejected:
+                pass  # shed on replay too: rejection is never silent
+            finally:
+                # the replayed submission journals its own fresh admit
+                # record; retire the pre-crash one either way
+                self._journal.done(rec.get("sid", ""), "replayed")
 
     def stop(self, timeout: Optional[float] = 30.0) -> None:
         """Stop admitting and drain: in-flight executions finish, still-
@@ -244,10 +327,18 @@ class EngineServer:
             self._cv.notify_all()
         for ex in dropped:
             self._finish_waiters(ex)
+            if self._journal is not None:
+                for sub in ex.waiters:
+                    # an ORDERLY stop retires its drained admissions so a
+                    # restart doesn't replay work the client saw rejected
+                    # (a crash, by definition, writes nothing here)
+                    self._journal.done(sub.id, "dropped")
         with self._lock:
             workers, self._workers = self._workers, []
         for t in workers:
             t.join(timeout=timeout)
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "EngineServer":
         return self.start()
@@ -273,6 +364,43 @@ class EngineServer:
         with self._lock:
             return self._active
 
+    def store_health(self) -> Dict[str, Any]:
+        """Writability of the shared dirs this replica depends on (the
+        fleet result store and the journal dir) — what ``/readyz`` turns
+        into a 503 ``store_unwritable`` so the balancer DRAINS a replica
+        whose disk died instead of queueing onto it. Probed by actually
+        creating+removing a file, cached for 5s (readyz is polled)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._store_health and now - self._store_health_ts < 5.0:
+                return dict(self._store_health)
+        probes: List[str] = []
+        if self._fleet is not None:
+            probes.append(self._fleet.results_dir)
+        if self._journal is not None:
+            d = os.path.dirname(self._journal.path)
+            if d:
+                probes.append(d)
+        health: Dict[str, Any] = {"writable": True, "probed": bool(probes)}
+        for d in probes:
+            probe = os.path.join(d, f".probe_{_uuid.uuid4().hex}")
+            try:
+                with open(probe, "w") as f:
+                    f.write("ok")
+                os.remove(probe)
+            except OSError as ex:
+                health = {
+                    "writable": False,
+                    "probed": True,
+                    "path": d,
+                    "error": f"{type(ex).__name__}: {ex}",
+                }
+                break
+        with self._lock:
+            self._store_health = dict(health)
+            self._store_health_ts = now
+        return health
+
     # -- admission -----------------------------------------------------------
     def submit(
         self,
@@ -293,6 +421,9 @@ class EngineServer:
         with get_tracer().span("serve.submit", cat="serve", tenant=tenant) as sp:
             if not self._running:
                 raise ServeRejected("server_stopped")
+            # the journal records what was SUBMITTED: a factory pickles
+            # (and replays fresh); a built dag is journaled best-effort
+            raw_dag = dag
             if callable(dag) and not hasattr(dag, "_tasks"):
                 dag = dag()
             self._stats.inc("submitted")
@@ -319,6 +450,16 @@ class EngineServer:
             reserve = (
                 int(reserve_bytes) if reserve_bytes is not None else self.default_reserve
             )
+            # cluster-wide result cache (docs/serving.md "Fleet"): a plan
+            # some replica already executed and published answers here
+            # without queueing — the cross-replica analogue of a result-
+            # cache memory hit. Probed OUTSIDE the admission lock (disk).
+            if key is not None and self._fleet is not None:
+                sub = self._admit_fleet_hit(
+                    key, tenant, prio, reserve, idempotency_key, pol, sp
+                )
+                if sub is not None:
+                    return sub
             with self._cv:
                 if not self._running:
                     raise ServeRejected("server_stopped")
@@ -336,6 +477,9 @@ class EngineServer:
                             self._idem[idempotency_key] = sub.id
                         self._stats.inc("dedup_hits")
                         self._stats.inc_tenant(tenant, "dedup_hits")
+                        self._journal_admit(
+                            sub, idempotency_key, tenant, prio, reserve, raw_dag
+                        )
                         sp.set(outcome="dedup", id=sub.id, key=key[:12])
                         return sub
                 if len(self._queue) >= self.queue_capacity:
@@ -362,6 +506,12 @@ class EngineServer:
                 ex = _Execution(key, dag, tenant, prio, self._seq)
                 ex.waiters.append(sub)
                 sub._execution = ex
+                # WAL before the queue: an admission the client can see
+                # must survive this process dying (the serve.journal
+                # fault site sits exactly in that window)
+                self._journal_admit(
+                    sub, idempotency_key, tenant, prio, reserve, raw_dag
+                )
                 self._queue.append(ex)
                 self._peak_queue = max(self._peak_queue, len(self._queue))
                 if key is not None:
@@ -385,23 +535,135 @@ class EngineServer:
             return self._subs.get(submission_id)
 
     # -- internals -----------------------------------------------------------
+    def _journal_admit(
+        self,
+        sub: Submission,
+        idem: Optional[str],
+        tenant: str,
+        prio: int,
+        reserve: int,
+        dag: Any,
+    ) -> None:
+        """WAL append + the ``serve.journal`` fault site (between the
+        fsync'd append and the submission becoming admitted)."""
+        if self._journal is not None:
+            self._journal.admit(sub.id, idem, tenant, prio, reserve, dag)
+            self._stats.inc("journal_appends")
+        self._injector.fire(SITE_SERVE_JOURNAL)
+
+    def _admit_fleet_hit(
+        self,
+        key: str,
+        tenant: str,
+        prio: int,
+        reserve: int,
+        idem: Optional[str],
+        pol: TenantPolicy,
+        sp: Any,
+    ) -> Optional[Submission]:
+        """Serve a submission from another replica's published result
+        (or this one's, from a previous life). None = no artifact, take
+        the normal admission path."""
+        payload = self._fleet.lookup(key)
+        if payload is None:
+            return None
+        try:
+            result = self._rehydrate(payload)
+        except Exception:
+            # an unloadable payload is a miss, never a wedge
+            return None
+        sub = Submission(self, None, tenant, prio, deduped=True)  # type: ignore[arg-type]
+        with self._cv:
+            if not self._running:
+                raise ServeRejected("server_stopped")
+            if not self._accounts.try_charge(tenant, sub.id, reserve, pol.budget_bytes):
+                self._stats.inc("rejected_budget")
+                self._stats.inc_tenant(tenant, "rejected")
+                sp.set(outcome="rejected_budget")
+                raise ServeRejected(
+                    "tenant_budget",
+                    f"tenant {tenant} live {self._accounts.charged(tenant)}B"
+                    f" + reserve {reserve}B > budget {pol.budget_bytes}B",
+                )
+            self._seq += 1
+            ex = _Execution(key, None, tenant, prio, self._seq)
+            now = time.monotonic()
+            ex.started_at = now
+            ex.finished_at = now
+            ex.state = "done"
+            ex.result = result
+            ex.waiters.append(sub)
+            sub._execution = ex
+            self._subs[sub.id] = sub
+            if idem is not None:
+                self._idem[idem] = sub.id
+        measured = _result_bytes(result)
+        self._accounts.restate(tenant, sub.id, measured)
+        self._stats.inc_tenant(tenant, "completed")
+        self._stats.inc_tenant(tenant, "dedup_hits")
+        ex.done.set()
+        sub._event.set()
+        self._retire([sub])
+        sp.set(outcome="fleet_hit", id=sub.id, key=key[:12])
+        return sub
+
+    def _rehydrate(self, payload: Dict[str, Any]) -> FleetResult:
+        """``{name: (pandas, schema_str)}`` → engine frames wrapped in a
+        result the waiters (and /serve/result) can read like any other."""
+        yields: Dict[str, Any] = {}
+        for name, item in payload.items():
+            pdf, schema = item
+            df = self._engine.to_df(pdf, schema=schema) if schema else (
+                self._engine.to_df(pdf)
+            )
+            yields[name] = df
+        return FleetResult(yields)
+
+    @staticmethod
+    def _extract_frames(result: Any) -> Optional[Dict[str, Any]]:
+        """A publishable ``{name: (pandas, schema_str)}`` of the run's
+        yields, or None when any frame can't cross a process boundary
+        (unbounded/stream/device-laid-out) — then nothing publishes."""
+        frames: Dict[str, Any] = {}
+        try:
+            for name, y in (result.yields if result is not None else {}).items():
+                df = getattr(y, "result", None)
+                if df is None or not getattr(df, "is_bounded", False):
+                    return None
+                frames[name] = (df.as_pandas(), str(df.schema))
+        except Exception:
+            return None
+        return frames
     def _policy(self, tenant: str) -> TenantPolicy:
         with self._lock:
             pol = self._policies.get(tenant)
+            if pol is not None:
+                self._policies.move_to_end(tenant)
         if pol is None:
             pol = tenant_policy(self._engine.conf, tenant)
-            if pol.dropped_keys and tenant not in self._overlay_warned:
-                self._overlay_warned.add(tenant)
+            warn = False
+            with self._lock:
+                if pol.dropped_keys and tenant not in self._overlay_warned:
+                    warn = True
+                    self._overlay_warned[tenant] = True
+                    self._overlay_warned.move_to_end(tenant)
+                    while len(self._overlay_warned) > self.max_tenants:
+                        self._overlay_warned.popitem(last=False)
+                self._policies[tenant] = pol
+                self._policies.move_to_end(tenant)
+                # LRU-bounded like the retention ring: client-supplied
+                # tenant ids must rotate state, never grow it
+                while len(self._policies) > self.max_tenants:
+                    self._policies.popitem(last=False)
+            if warn:
                 self._engine.log.warning(
-                    "tenant %s conf overlay keys %s dropped: only "
-                    "fugue.tpu.plan.* / fugue.tpu.tuning.* compile switches "
-                    "are per-run; other keys would leak into the shared "
-                    "engine conf",
+                    "tenant %s conf overlay keys %s dropped: overlays are "
+                    "run-scoped fugue.tpu.* keys only; keys outside "
+                    "fugue.tpu.* change workflow/compile semantics and "
+                    "are refused",
                     tenant,
                     list(pol.dropped_keys),
                 )
-            with self._lock:
-                self._policies[tenant] = pol
         return pol
 
     def _pick_locked(self) -> Optional[_Execution]:
@@ -458,23 +720,62 @@ class EngineServer:
             from ..obs import run_labels
 
             labels = run_labels(tenant=ex.tenant)
+        fleet_owner = False
         try:
-            with labels, tracer.span(
-                "serve.run",
-                cat="serve",
-                tenant=ex.tenant,
-                priority=ex.priority,
-                waiters=len(ex.waiters),
-                queue_wait_s=round(wait_s, 6),
-            ):
-                result = ex.dag.run(self._engine)
-            ex.result = result
-            ex.finished_at = time.monotonic()
-            ex.state = "done"
+            # cross-replica single-flight (docs/serving.md "Fleet"): claim
+            # the key in the shared store, or serve the owner's published
+            # result instead of re-executing. acquire() is bounded by the
+            # holder's lease — a dead owner's claim is stolen, never waited
+            # on forever.
+            if self._fleet is not None and ex.key is not None:
+                role, payload = self._fleet.acquire(ex.key)
+                if role == "result":
+                    ex.result = self._rehydrate(payload)
+                    ex.finished_at = time.monotonic()
+                    ex.state = "done"
+                else:
+                    fleet_owner = True
+                    # between claim write and execution start — the chaos
+                    # tests' deterministic crash point; an injected error
+                    # here unwinds through the release below
+                    self._injector.fire(SITE_SERVE_CLAIM)
+            if ex.state != "done":
+                if self._journal is not None:
+                    # the no-double-execution audit reads these: one exec
+                    # record per dag actually run on this replica
+                    self._journal.exec_start(
+                        ex.waiters[0].id if ex.waiters else "", ex.key
+                    )
+                    self._stats.inc("journal_appends")
+                with labels, tracer.span(
+                    "serve.run",
+                    cat="serve",
+                    tenant=ex.tenant,
+                    priority=ex.priority,
+                    waiters=len(ex.waiters),
+                    queue_wait_s=round(wait_s, 6),
+                ):
+                    result = ex.dag.run(self._engine)
+                ex.result = result
+                ex.finished_at = time.monotonic()
+                ex.state = "done"
+                if fleet_owner:
+                    frames = self._extract_frames(result)
+                    if frames is not None:
+                        # publish releases the claim; waiters fleet-wide
+                        # load this artifact instead of executing
+                        self._fleet.publish_result(ex.key, frames)
+                    else:
+                        self._fleet.release(ex.key)
         except BaseException as e:  # the waiter gets the error, not the worker
             ex.error = e
             ex.finished_at = time.monotonic()
             ex.state = "failed"
+            if fleet_owner:
+                # no error tombstones: a failed owner releases the claim
+                # so a cross-replica waiter re-decides (executes) rather
+                # than caching a failure fleet-wide
+                self._fleet.release(ex.key)
         if ex.state == "done":
             self._stats.inc("completed")
         else:
@@ -496,6 +797,9 @@ class EngineServer:
             # live accounting: the reserve becomes the measured bytes the
             # tenant now holds on the server (released when claimed)
             self._accounts.restate(t, sub.id, measured)
+            if self._journal is not None:
+                self._journal.done(sub.id, ex.state)
+                self._stats.inc("journal_appends")
         self._finish_waiters(ex)
         self._retire(waiters)
 
@@ -545,6 +849,8 @@ class EngineServer:
                     del self._inflight[ex.key]
                 self._stats.inc("canceled_executions")
         self._accounts.release(sub.tenant, sub.id)
+        if self._journal is not None:
+            self._journal.done(sub.id, "canceled")
         sub._event.set()
         return True
 
@@ -585,6 +891,9 @@ class EngineServer:
                 max_concurrent=self.max_concurrent,
                 inflight_keys=len(self._inflight),
                 retained=len(self._done_order),
+                replica_id=self.replica_id,
+                fleet_enabled=self._fleet is not None,
+                journal_enabled=self._journal is not None,
             )
         out["charged_bytes"] = self._accounts.as_dict()
         # adaptive-execution convergence at a glance (docs/tuning.md): the

@@ -9,13 +9,13 @@ Policy comes from the engine conf under ``fugue.tpu.serve.tenant.<id>.*``
   completed-but-unclaimed ones) plus the new submission's reserve must
   stay under it. 0 = unlimited.
 - ``conf.<key>`` — a per-run conf overlay merged into every submitted
-  workflow's compile conf. Restricted to ``fugue.tpu.plan.*`` and
-  ``fugue.tpu.tuning.*`` compile switches: those are scoped per-workflow
-  by the run path (a tenant can e.g. opt out of adaptive tuning with
-  ``conf.fugue.tpu.tuning.enabled=false``), while any other key would be
-  written into the SHARED engine conf by ``workflow.run`` and leak into
-  other tenants' runs — such keys are dropped with one warning per
-  tenant.
+  workflow's compile conf. Any ``fugue.tpu.*`` key is accepted:
+  ``workflow.run`` scopes workflow conf per run (the engine's
+  ``run_conf_scope`` context overlay), so an overlay can never be
+  written into the SHARED engine conf or leak into another tenant's run.
+  Keys outside ``fugue.tpu.*`` (workflow/compile semantics like
+  ``fugue.workflow.*``) are still dropped with one warning per tenant —
+  they change what a dag MEANS, not how this engine runs it.
 
 Accounting is *live*, not declarative: a submission is admitted against
 its declared ``reserve_bytes`` (or the ``fugue.tpu.serve.reserve_bytes``
@@ -29,9 +29,8 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 from ..constants import (
-    FUGUE_TPU_CONF_PLAN_PREFIX,
+    FUGUE_TPU_CONF_SERVE_TENANT_OVERLAY_PREFIX,
     FUGUE_TPU_CONF_SERVE_TENANT_PREFIX,
-    FUGUE_TPU_CONF_TUNING_PREFIX,
 )
 
 __all__ = ["TenantPolicy", "TenantAccounts", "tenant_policy"]
@@ -77,12 +76,11 @@ def tenant_policy(conf: Any, tenant: str) -> TenantPolicy:
             budget = int(v)
         elif sub.startswith("conf."):
             key = sub[len("conf."):]
-            # only plan.*/tuning.* compile switches stay scoped to one
-            # workflow; anything else would be written into the shared
-            # engine conf by the run path and leak across tenants
-            if key.startswith(
-                (FUGUE_TPU_CONF_PLAN_PREFIX, FUGUE_TPU_CONF_TUNING_PREFIX)
-            ):
+            # any fugue.tpu.* key is safely per-run now that workflow.run
+            # scopes workflow conf (engine.run_conf_scope) instead of
+            # writing it into the shared engine conf; keys outside it are
+            # compile-semantics knobs a serving operator shouldn't set
+            if key.startswith(FUGUE_TPU_CONF_SERVE_TENANT_OVERLAY_PREFIX):
                 overlay[key] = v
             else:
                 dropped.append(key)

@@ -204,9 +204,14 @@ class StrongCheckpoint(Checkpoint):
 class CheckpointPath:
     """Temp/permanent checkpoint directory lifecycle (reference ``:131``)."""
 
-    def __init__(self, engine: ExecutionEngine):
+    def __init__(self, engine: ExecutionEngine, conf: Any = None):
+        # conf: the run-scoped merge when built by a workflow run — a
+        # workflow-level checkpoint path must keep working now that
+        # workflow conf no longer writes through to the engine
         self._engine = engine
-        self._conf_path = engine.conf.get(FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, "")
+        self._conf_path = (conf if conf is not None else engine.conf).get(
+            FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, ""
+        )
         self._temp_path = ""
         self._execution_id = ""
 

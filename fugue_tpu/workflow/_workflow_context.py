@@ -28,21 +28,26 @@ from ._tasks import FugueTask
 
 
 class FugueWorkflowContext:
-    def __init__(self, execution_engine: ExecutionEngine):
+    def __init__(self, execution_engine: ExecutionEngine, conf: Any = None):
+        # conf is the RUN-scoped merge (engine conf + workflow conf) when
+        # workflow.run builds the context; workflow conf no longer writes
+        # through to the engine, so reading engine.conf alone would miss
+        # workflow-level fault plans / retry policies
+        conf = conf if conf is not None else execution_engine.conf
         self._engine = execution_engine
-        self._checkpoint_path = CheckpointPath(execution_engine)
+        self._checkpoint_path = CheckpointPath(execution_engine, conf=conf)
         self._results: Dict[str, DataFrame] = {}
         self._aliases: Dict[int, FugueTask] = {}
         self._removed: Set[int] = set()
         self._cache_plan: Any = None
         # fault budgets span the whole run (an injected `error@1` fails one
         # task once, not once per retry attempt)
-        self._injector = FaultInjector.from_conf(execution_engine.conf)
+        self._injector = FaultInjector.from_conf(conf)
         # default 1 attempt = fail fast, the reference behavior; retried
         # attempts re-consult StrongCheckpoint.exists so work that already
         # reached storage replays from disk instead of recomputing
         self._task_policy = RetryPolicy.from_conf(
-            execution_engine.conf,
+            conf,
             prefix="fugue.tpu.retry.task",
             default_attempts=1,
         )

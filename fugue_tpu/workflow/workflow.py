@@ -890,28 +890,22 @@ class FugueWorkflow:
     def run(self, engine: Any = None, conf: Any = None, **kwargs: Any) -> FugueWorkflowResult:
         infer_by = kwargs.pop("infer_by", None) or self._collect_raw_inputs()
         e = make_execution_engine(engine, conf, infer_by=infer_by, **kwargs)
-        from ..constants import (
-            FUGUE_TPU_CONF_PLAN_PREFIX,
-            FUGUE_TPU_CONF_TUNING_PREFIX,
-        )
-
         # the optimizer gate sees engine conf overlaid with this
-        # workflow's compile conf (same precedence explain() uses); plan.*
-        # and tuning.* compile switches stay per-workflow instead of being
-        # written into a possibly shared engine's conf, where they would
-        # leak into later runs of OTHER workflows on the same engine (the
-        # per-tenant tuning kill-switch depends on this)
+        # workflow's compile conf (same precedence explain() uses). The
+        # workflow conf is RUN-SCOPED: instead of being written into a
+        # possibly shared engine's conf — where it leaked into later runs
+        # of OTHER workflows on the same engine — the execution below
+        # enters e.run_conf_scope(self._conf), a context-local overlay
+        # every engine.conf read inside this run (and the threads/workers
+        # it forks) resolves through. Per-tenant serve overlays depend on
+        # this: any fugue.tpu.* key is now safely per-run.
         plan_conf = ParamDict(e.conf)
         for k, v in self._conf.items():
             plan_conf[k] = v
-            if not str(k).startswith(
-                (FUGUE_TPU_CONF_PLAN_PREFIX, FUGUE_TPU_CONF_TUNING_PREFIX)
-            ):
-                e.conf[k] = v
         self._last_engine = e
-        ctx = FugueWorkflowContext(e)
+        ctx = FugueWorkflowContext(e, conf=plan_conf)
         self._last_context = ctx
-        self._apply_auto_persist(e)
+        self._apply_auto_persist(e, plan_conf)
         from ..obs import get_tracer
         from ..plan import optimize_tasks
 
@@ -955,7 +949,7 @@ class FugueWorkflow:
 
         self._last_plan_fingerprint = _plan_fp(run_tasks)
         try:
-            with e._as_borrowed_context():
+            with e.run_conf_scope(self._conf), e._as_borrowed_context():
                 with run_ctx, tracer.span(
                     "workflow.run", cat="workflow", tasks=len(run_tasks), **run_attrs
                 ), _tuning_scope(e, self._last_plan_fingerprint, plan_conf):
@@ -967,19 +961,25 @@ class FugueWorkflow:
         except Exception as ex:
             from .._utils.exception import modify_traceback
 
-            raise modify_traceback(ex, e.conf)
+            # plan_conf, not e.conf: the run scope has already exited
+            # here, and the exception conf keys may be workflow-scoped
+            raise modify_traceback(ex, plan_conf)
         finally:
-            self._maybe_export_trace(e, tracer)
+            self._maybe_export_trace(e, tracer, plan_conf)
         return FugueWorkflowResult(self._yields)
 
-    def _maybe_export_trace(self, engine: Any, tracer: Any) -> None:
-        """Auto-export a Chrome trace after the run when the engine conf
-        sets ``fugue.tpu.trace.dir`` (one file per run, load in Perfetto)."""
+    def _maybe_export_trace(
+        self, engine: Any, tracer: Any, conf: Any = None
+    ) -> None:
+        """Auto-export a Chrome trace after the run when the (run-scoped)
+        conf sets ``fugue.tpu.trace.dir`` (one file per run, Perfetto)."""
         from ..constants import FUGUE_TPU_CONF_TRACE_DIR
 
         if not tracer.enabled:
             return
-        trace_dir = engine.conf.get(FUGUE_TPU_CONF_TRACE_DIR, "")
+        trace_dir = (conf if conf is not None else engine.conf).get(
+            FUGUE_TPU_CONF_TRACE_DIR, ""
+        )
         if trace_dir == "":
             return
         import os
@@ -1145,14 +1145,18 @@ class FugueWorkflow:
                     res.append(p["data"])
         return res
 
-    def _apply_auto_persist(self, engine: Any) -> None:
-        if not engine.conf.get(FUGUE_CONF_WORKFLOW_AUTO_PERSIST, False):
+    def _apply_auto_persist(self, engine: Any, conf: Any = None) -> None:
+        # conf is the run-scoped merge (engine conf + workflow conf) —
+        # workflow conf is no longer written into the engine, so reading
+        # engine.conf here would miss a workflow-level auto_persist
+        conf = conf if conf is not None else engine.conf
+        if not conf.get(FUGUE_CONF_WORKFLOW_AUTO_PERSIST, False):
             return
         consumers: Dict[int, int] = {}
         for t in self._tasks:
             for d in t.inputs:
                 consumers[id(d)] = consumers.get(id(d), 0) + 1
-        value = engine.conf.get(FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE, "")
+        value = conf.get(FUGUE_CONF_WORKFLOW_AUTO_PERSIST_VALUE, "")
         for t in self._tasks:
             if consumers.get(id(t), 0) > 1 and t.checkpoint.is_null and t.has_output:
                 t.set_checkpoint(

@@ -1,0 +1,486 @@
+"""Fleet-grade serving resilience (ISSUE 13, docs/serving.md "Fleet").
+
+Covers the cross-replica claim/lease protocol (atomic acquire, lease
+expiry, dead-pid steal), the shared-store single-flight where server B
+serves a plan server A executed — including across real processes and
+after A is SIGKILLed mid-execution — the crash-safe submission journal's
+replay, the run-scoped tenant conf overlay (the lifted ROADMAP 3a
+restriction, with the no-leak regression), the /readyz store-health
+drain, and the LRU bounds on per-tenant server state.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import shutil
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.cache.store import ArtifactStore
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_CACHE_DIR,
+    FUGUE_TPU_CONF_SERVE_FLEET_ENABLED,
+    FUGUE_TPU_CONF_SERVE_JOURNAL_DIR,
+    FUGUE_TPU_CONF_SERVE_MAX_TENANTS,
+    FUGUE_TPU_CONF_SERVE_REPLICA_ID,
+)
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.serve import (
+    EngineServer,
+    FleetClient,
+    ServeRejected,
+    ServeStats,
+    SubmissionJournal,
+)
+
+
+def _agg_factory(seed: int = 0, rows: int = 64):
+    def build() -> FugueWorkflow:
+        dag = FugueWorkflow()
+        (
+            dag.df(
+                pd.DataFrame(
+                    {
+                        "k": [i % 4 for i in range(rows)],
+                        "v": [float(i + seed) for i in range(rows)],
+                    }
+                )
+            )
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def _frames(result) -> pd.DataFrame:
+    return (
+        result.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+    )
+
+
+def _conf(store, jdir=None, rid=None, **extra):
+    conf = {FUGUE_TPU_CONF_CACHE_DIR: str(store)}
+    if jdir is not None:
+        conf[FUGUE_TPU_CONF_SERVE_JOURNAL_DIR] = str(jdir)
+    if rid is not None:
+        conf[FUGUE_TPU_CONF_SERVE_REPLICA_ID] = rid
+    conf.update(extra)
+    return conf
+
+
+# ---------------------------------------------------------------------------
+# the claim/lease protocol (cache/store.py)
+# ---------------------------------------------------------------------------
+
+
+def test_claim_acquire_hold_release(tmp_path):
+    st = ArtifactStore(str(tmp_path), 0)
+    owned, holder = st.try_claim("k1", "A", 30.0)
+    assert owned and holder["owner"] == "A"
+    # a second owner is held off and told who holds it
+    owned, holder = st.try_claim("k1", "B", 30.0)
+    assert not owned and holder["owner"] == "A"
+    # re-entrant: the same owner (a restarted replica replaying its
+    # journal) re-enters its own claim
+    owned, _ = st.try_claim("k1", "A", 30.0)
+    assert owned
+    # release is owner-checked: a steal victim's late release must not
+    # drop the current holder's claim
+    assert not st.release_claim("k1", "B")
+    assert st.release_claim("k1", "A")
+    assert st.read_claim("k1") is None
+
+
+def test_claim_lease_expiry_steal(tmp_path):
+    st = ArtifactStore(str(tmp_path), 0)
+    assert st.try_claim("k", "A", 0.05)[0]
+    time.sleep(0.12)
+    owned, holder = st.try_claim("k", "B", 30.0)
+    assert owned and holder["owner"] == "B"
+
+
+def test_claim_dead_pid_steal_and_torn_claim(tmp_path):
+    import socket
+
+    st = ArtifactStore(str(tmp_path), 0)
+    # same-host holder with a dead pid: stealable immediately, no lease wait
+    with open(st._claim("k"), "w") as f:
+        json.dump(
+            {
+                "owner": "ghost",
+                "pid": 2 ** 22 + 12345,  # beyond pid_max on this box
+                "host": socket.gethostname(),
+                "ts": time.time(),
+                "lease_s": 9999.0,
+            },
+            f,
+        )
+    owned, holder = st.try_claim("k", "B", 30.0)
+    assert owned and holder["owner"] == "B"
+    # a torn claim file reads as absent (stealable), never a wedge
+    with open(st._claim("torn"), "w") as f:
+        f.write('{"owner": "gho')
+    assert st.read_claim("torn") is None
+    assert st.try_claim("torn", "B", 30.0)[0]
+
+
+# ---------------------------------------------------------------------------
+# cross-replica single-flight (same process: two servers, one store)
+# ---------------------------------------------------------------------------
+
+
+def test_second_server_serves_first_servers_result(tmp_path):
+    store = tmp_path / "store"
+    a = NativeExecutionEngine(_conf(store, rid="A"))
+    with EngineServer(a) as sa:
+        ra = _frames(sa.submit(_agg_factory(3)).result(timeout=60))
+        assert sa.stats()["fleet_publishes"] == 1
+    b = NativeExecutionEngine(_conf(store, rid="B"))
+    with EngineServer(b) as sb:
+        rb = _frames(sb.submit(_agg_factory(3)).result(timeout=60))
+        st = sb.stats()
+    # B answered from A's published artifact: a fleet hit, zero dag runs
+    assert st["fleet_result_hits"] >= 1 and st["executions"] == 0
+    assert ra.equals(rb)  # bit-identical across the store round trip
+    assert ra["s"].tolist() == rb["s"].tolist()
+
+
+def test_fleet_kill_switch_restores_single_server_behavior(tmp_path):
+    store = tmp_path / "store"
+    a = NativeExecutionEngine(
+        _conf(store, rid="A", **{FUGUE_TPU_CONF_SERVE_FLEET_ENABLED: False})
+    )
+    with EngineServer(a) as sa:
+        _frames(sa.submit(_agg_factory(3)).result(timeout=60))
+        st = sa.stats()
+    assert st["fleet_enabled"] is False
+    assert st["fleet_publishes"] == 0 and st["fleet_claims"] == 0
+    # nothing was written to the fleet surfaces of the shared store
+    assert not os.path.exists(str(store / "serve")) or not os.listdir(
+        str(store / "serve")
+    )
+    assert os.listdir(str(store / "claims")) == []
+    # a second, fleet-enabled server misses (nothing published) and runs
+    b = NativeExecutionEngine(_conf(store, rid="B"))
+    with EngineServer(b) as sb:
+        _frames(sb.submit(_agg_factory(3)).result(timeout=60))
+        assert sb.stats()["executions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# two real processes
+# ---------------------------------------------------------------------------
+
+
+def _exec_worker(args):
+    """Fork worker: run one EngineServer over the shared store, execute
+    one submission, return its frames + counters."""
+    store, jdir, rid, seed = args
+    eng = NativeExecutionEngine(_conf(store, jdir=jdir, rid=rid))
+    with EngineServer(eng) as srv:
+        res = srv.submit(_agg_factory(seed), tenant="t").result(timeout=60)
+        out = _frames(res)
+        st = srv.stats()
+    return out.values.tolist(), st["executions"], st["fleet_publishes"]
+
+
+def test_two_process_cross_server_dedup(tmp_path):
+    """Server B (fresh process) serves a plan server A (another process)
+    executed — the ISSUE 13 cross-process dedup satellite."""
+    store, jdir = str(tmp_path / "store"), str(tmp_path / "journal")
+    ctx = mp.get_context("fork")
+    with ctx.Pool(1) as pool:
+        (rows_a, exec_a, pub_a) = pool.map(
+            _exec_worker, [(store, jdir, "A", 11)]
+        )[0]
+    assert exec_a == 1 and pub_a == 1
+    eng = NativeExecutionEngine(_conf(store, jdir=jdir, rid="B"))
+    with EngineServer(eng) as srv:
+        res = srv.submit(_agg_factory(11), tenant="t2").result(timeout=60)
+        rows_b = _frames(res).values.tolist()
+        st = srv.stats()
+    assert st["fleet_result_hits"] >= 1 and st["executions"] == 0
+    assert rows_a == rows_b
+
+
+def _slow_factory(marker: str, sleep_s: float):
+    def build() -> FugueWorkflow:
+        def crawl(df: pd.DataFrame) -> pd.DataFrame:
+            with open(marker, "w") as f:
+                f.write("running")
+            time.sleep(sleep_s)
+            return df.assign(v=df["v"] * 2.0)
+
+        dag = FugueWorkflow()
+        (
+            dag.df(
+                pd.DataFrame(
+                    {"k": [i % 4 for i in range(32)], "v": [float(i) for i in range(32)]}
+                )
+            )
+            .transform(crawl, schema="*")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def test_claim_steal_completes_bit_identical(tmp_path):
+    """End to end with a short runtime: A dies holding the claim, B
+    steals, executes, and B's result matches a serial no-fleet oracle."""
+    store, jdir = str(tmp_path / "store"), str(tmp_path / "journal")
+    marker = str(tmp_path / "marker")
+    factory = _slow_factory(marker, 0.8)
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=_victim_main_short, args=(store, jdir, marker))
+    p.start()
+    deadline = time.monotonic() + 30
+    while not os.path.exists(marker) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert os.path.exists(marker)
+    os.kill(p.pid, signal.SIGKILL)
+    p.join(10)
+    eng = NativeExecutionEngine(_conf(store, jdir=jdir, rid="B"))
+    with EngineServer(eng) as srv:
+        res = srv.submit(factory).result(timeout=60)
+        got = _frames(res)
+        st = srv.stats()
+    assert st["fleet_claim_steals"] >= 1 and st["executions"] == 1
+    # serial oracle: same dag, fleet and cache off entirely
+    oracle_eng = NativeExecutionEngine()
+    dag = factory()
+    dag.run(oracle_eng)
+    want = (
+        dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+    )
+    assert got.equals(want)
+
+
+def _victim_main_short(store, jdir, marker):
+    eng = NativeExecutionEngine(_conf(store, jdir=jdir, rid="victim"))
+    srv = EngineServer(eng).start()
+    sub = srv.submit(_slow_factory(marker, 0.8))
+    sub.wait(60)
+
+
+# ---------------------------------------------------------------------------
+# the serve fault sites (docs/resilience.md)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_journal_fault_site_fails_admission_once(tmp_path):
+    from fugue_tpu.resilience.policy import InjectedFaultError
+
+    eng = NativeExecutionEngine({"fugue.tpu.fault.plan": "serve.journal=error"})
+    with EngineServer(eng) as srv:
+        with pytest.raises(InjectedFaultError):
+            srv.submit(_agg_factory(1))
+        # budget spent: the retry (a client resend) admits cleanly
+        assert len(_frames(srv.submit(_agg_factory(1)).result(timeout=60))) == 4
+
+
+def test_serve_claim_fault_site_releases_claim(tmp_path):
+    """An injected failure between claim write and execution start must
+    release the claim — a wedged claim would stall every identical
+    submission fleet-wide until the lease expires."""
+    from fugue_tpu.resilience.policy import InjectedFaultError
+
+    store = tmp_path / "store"
+    eng = NativeExecutionEngine(
+        _conf(store, rid="A", **{"fugue.tpu.fault.plan": "serve.claim=error"})
+    )
+    with EngineServer(eng) as srv:
+        with pytest.raises(InjectedFaultError):
+            srv.submit(_agg_factory(2)).result(timeout=60)
+        assert os.listdir(str(store / "claims")) == []
+        # the failure was NOT cached fleet-wide: the retry executes
+        assert len(_frames(srv.submit(_agg_factory(2)).result(timeout=60))) == 4
+
+
+# ---------------------------------------------------------------------------
+# the crash-safe journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_records_and_unfinished(tmp_path):
+    j = SubmissionJournal(str(tmp_path / "r1.jsonl"), "r1")
+    j.admit("s1", "idem-1", "t", 5, 0, _agg_factory(1))
+    j.admit("s2", None, "t", 5, 0, _agg_factory(2))
+    j.exec_start("s1", "key1")
+    j.done("s1", "done")
+    j.close()
+    un = j.unfinished()
+    assert [r["sid"] for r in un] == ["s2"]
+    dag = j.decode_dag(un[0])
+    assert dag is not None and callable(dag)
+    # a torn trailing line (the crash window) is skipped, not fatal
+    with open(j.path, "ab") as f:
+        f.write(b'{"op": "admit", "sid": "s3"')
+    assert [r["sid"] for r in j.unfinished()] == ["s2"]
+
+
+def test_journal_replay_on_restart(tmp_path):
+    """A journaled-but-unfinished admission (the replica died before the
+    run completed) replays on restart under its idempotency key."""
+    store, jdir = str(tmp_path / "store"), str(tmp_path / "journal")
+    # simulate the dead replica's WAL: admit fsync'd, no done record
+    j = SubmissionJournal(os.path.join(jdir, "R1.jsonl"), "R1")
+    j.admit("dead-sid", "idem-9", "acme", 5, 0, _agg_factory(7))
+    j.close()
+    eng = NativeExecutionEngine(_conf(store, jdir=jdir, rid="R1"))
+    with EngineServer(eng) as srv:  # start() replays
+        st = srv.stats()
+        assert st["journal_replays"] == 1
+        # the replayed submission is live under the original key: a
+        # client retry maps onto it instead of double-submitting
+        sub = srv.submit(_agg_factory(7), tenant="acme", idempotency_key="idem-9")
+        assert srv.stats()["idempotent_replays"] == 1
+        res = sub.result(timeout=60)
+        assert len(_frames(res)) == 4
+    # the pre-crash record is retired: a second restart replays nothing
+    eng2 = NativeExecutionEngine(_conf(store, jdir=jdir, rid="R1"))
+    with EngineServer(eng2) as srv2:
+        assert srv2.stats()["journal_replays"] == 0
+
+
+# ---------------------------------------------------------------------------
+# run-scoped tenant conf (the lifted ROADMAP 3a restriction)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_overlay_arbitrary_tpu_keys_no_cross_tenant_leak():
+    """Tenant overlays accept ANY fugue.tpu.* key, the key is visible to
+    that tenant's run (through the engine's run-scoped conf), and it
+    NEVER leaks into the shared engine conf or another tenant's run."""
+    eng = NativeExecutionEngine(
+        {
+            # an arbitrary non-plan, non-tuning key: previously dropped
+            "fugue.tpu.serve.tenant.acme.conf.fugue.tpu.stream.chunk_rows": 777,
+        }
+    )
+    seen = {}
+
+    def probe_factory(tag):
+        def build() -> FugueWorkflow:
+            def probe() -> pd.DataFrame:
+                from fugue_tpu.execution.factory import (
+                    try_get_context_execution_engine,
+                )
+
+                e = try_get_context_execution_engine()
+                seen[tag] = e.conf.get("fugue.tpu.stream.chunk_rows", -1)
+                return pd.DataFrame({"a": [1]})
+
+            dag = FugueWorkflow()
+            dag.create(probe, schema="a:long").yield_dataframe_as(
+                "r", as_local=True
+            )
+            return dag
+
+        return build
+
+    with EngineServer(eng) as srv:
+        srv.submit(probe_factory("acme"), tenant="acme").result(timeout=60)
+        srv.submit(probe_factory("other"), tenant="other").result(timeout=60)
+    assert seen["acme"] == 777  # the overlay reached acme's run
+    assert seen["other"] == -1  # ...and nobody else's
+    # and the shared engine conf never saw it
+    assert "fugue.tpu.stream.chunk_rows" not in eng.conf
+    assert "fugue.tpu.stream.chunk_rows" not in eng.base_conf
+
+
+def test_run_conf_scope_restores_after_run():
+    eng = NativeExecutionEngine()
+    dag = FugueWorkflow({"fugue.tpu.cache.enabled": False})
+    dag.df(pd.DataFrame({"a": [1, 2]})).yield_dataframe_as("r", as_local=True)
+    dag.run(eng)
+    assert "fugue.tpu.cache.enabled" not in eng.conf
+
+
+# ---------------------------------------------------------------------------
+# bounded per-tenant state (hostile tenant-id minting)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_stats_tenant_breakdown_is_lru_bounded():
+    st = ServeStats(max_tenants=4)
+    for i in range(10):
+        st.inc_tenant(f"t{i}", "submitted")
+    d = st.as_dict()
+    assert len(d["tenants"]) == 4
+    assert set(d["tenants"]) == {"t6", "t7", "t8", "t9"}  # oldest rotated
+    assert d["tenant_evictions"] == 6
+
+
+def test_server_policy_and_warn_maps_bounded():
+    eng = NativeExecutionEngine({FUGUE_TPU_CONF_SERVE_MAX_TENANTS: 3})
+    with EngineServer(eng) as srv:
+        for i in range(8):
+            srv.submit(_agg_factory(i), tenant=f"mint{i}").result(timeout=60)
+        assert len(srv._policies) <= 3
+        assert len(srv._overlay_warned) <= 3
+        assert len(srv.stats()["tenants"]) <= 3
+
+
+# ---------------------------------------------------------------------------
+# /readyz store health (the drain signal)
+# ---------------------------------------------------------------------------
+
+
+def _get(rpc, path):
+    url = f"http://{rpc.host}:{rpc.port}{path}"
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def test_readyz_store_unwritable_503_and_balancer_drain(tmp_path):
+    store = tmp_path / "store"
+    eng = NativeExecutionEngine(
+        _conf(
+            store,
+            rid="sick",
+            **{"fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer"},
+        )
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    srv = EngineServer(eng).start()
+    rpc.bind_serve(srv)
+    try:
+        code, ready = _get(rpc, "/readyz")
+        assert code == 200 and ready["status"] == "ready"
+        assert ready["store"]["writable"] is True and ready["replica_id"] == "sick"
+        # the disk dies under the replica: the fleet results dir vanishes
+        shutil.rmtree(str(store / "serve"))
+        with srv._lock:
+            srv._store_health_ts = 0.0  # expire the 5s probe cache
+        code, ready = _get(rpc, "/readyz")
+        assert code == 503 and ready["status"] == "store_unwritable"
+        assert ready["store"]["writable"] is False
+        # the balancer drains it: no candidates, fleet-wide shed
+        fc = FleetClient([(rpc.host, rpc.port)])
+        with pytest.raises(ServeRejected) as ei:
+            fc.submit(_agg_factory(1))
+        assert ei.value.reason == "fleet_unavailable"
+        # liveness is untouched: a sick-disk server is not restarted
+        code, live = _get(rpc, "/healthz")
+        assert code == 200 and live["status"] == "ok"
+    finally:
+        srv.stop()
+        rpc.stop()

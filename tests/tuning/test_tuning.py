@@ -449,8 +449,13 @@ def test_tenant_overlay_allows_tuning_keys():
         }
     )
     pol = tenant_policy(eng.conf, "acme")
-    assert pol.conf_overlay == {"fugue.tpu.tuning.enabled": False}
-    assert pol.dropped_keys == ("fugue.tpu.cache.enabled",)
+    # ISSUE 13 lifted the plan.*/tuning.*-only restriction: workflow.run
+    # scopes conf per run, so ANY fugue.tpu.* key is a safe overlay now
+    assert pol.conf_overlay == {
+        "fugue.tpu.tuning.enabled": False,
+        "fugue.tpu.cache.enabled": False,
+    }
+    assert pol.dropped_keys == ()
 
 
 def test_describe_tuning_without_engine(tmp_path):
