@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -11,6 +11,7 @@ test:
 	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
 	-@$(MAKE) --no-print-directory serve-smoke  # serving gate; non-blocking here
 	-@$(MAKE) --no-print-directory fleet-smoke  # fleet chaos gate; non-blocking here
+	-@$(MAKE) --no-print-directory dist-smoke   # worker-tier chaos gate; non-blocking here
 
 # downsized perf gate (≤~30s): device-aggregate worker only, fails when the
 # oracle-normalized groupby_aggregate vs_baseline drops >20% below the
@@ -132,6 +133,25 @@ serve-smoke:
 # steal observed, results bit-identical to a serial cache-off oracle
 fleet-smoke:
 	JAX_PLATFORMS=cpu python bench.py --fleet-smoke
+
+# distributed worker-tier suite (docs/distributed.md): heartbeat
+# freshness/staleness, lease acquire/renew/steal (expiry + heartbeat +
+# pid-fallback matrix), end-to-end dist-vs-serial bit-identity, lease
+# expiry mid-task re-dispatch, speculative duplicate publish (one done
+# record, one artifact), supervisor restart over in-flight leases,
+# remote fragment fetch + orphaned-output recovery, fault sites
+test-dist:
+	JAX_PLATFORMS=cpu python -m pytest tests/distributed -q -m "not slow"
+
+# worker-tier chaos gate (ISSUE 14 acceptance, exit 16): 3 DistWorker
+# processes + supervisor run a distributed load→shuffle-join→aggregate;
+# the worker holding the straggler map lease is SIGKILLed mid-shuffle —
+# all partitions complete via heartbeat-proven lease re-dispatch, the
+# bucket audit shows ZERO lost/double-counted rows, and the result is
+# bit-identical to the single-process cache-off oracle (the
+# fugue.tpu.dist.enabled=false kill-switch path)
+dist-smoke:
+	JAX_PLATFORMS=cpu python bench.py --dist-smoke
 
 # wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
 # (defaults to $FUGUE_TPU_CACHE_DIR)

@@ -2415,6 +2415,242 @@ def _fleet_smoke() -> None:
         raise SystemExit(15)
 
 
+# ---------------------------------------------------------------------------
+# extra.dist_chaos — the ISSUE 14 chaos gate (make dist-smoke, exit 16)
+# ---------------------------------------------------------------------------
+
+
+_DIST_CONF = {
+    "fugue.tpu.dist.heartbeat.interval_s": 0.2,
+    "fugue.tpu.dist.heartbeat.stale_after_s": 1.2,
+    "fugue.tpu.dist.lease_s": 2.5,
+    "fugue.tpu.dist.fetch": "remote",  # the true multi-host shape
+    "fugue.tpu.cache.enabled": False,
+    "fugue.tpu.tuning.enabled": False,
+}
+
+
+def _dist_worker_main(board: str, wid: str, stop_file: str) -> None:
+    """One worker process of the tier: engine + heartbeat + HTTP fragment
+    server, pulling leased tasks off the shared board until stopped."""
+    from fugue_tpu.dist import DistWorker
+
+    w = DistWorker(board, wid, conf=dict(_DIST_CONF))
+    w.start()
+    try:
+        w.serve_forever(stop_file=stop_file)
+    finally:
+        w.stop()
+
+
+def _dist_job_fns(marker: str):
+    """The smoke job: map doubles v (and, on source part 0, signals
+    run-start and straggles long enough to SIGKILL its worker mid-map —
+    mid-shuffle, since map tasks ARE the shuffle's partition stage);
+    reduce joins the bucket and partially aggregates; combine merges the
+    partials. All row/partition-local, so serial == distributed."""
+    import pandas as _pd
+
+    def map_left(pdf: "_pd.DataFrame") -> "_pd.DataFrame":
+        if len(pdf) and int(pdf["part"].iloc[0]) == 0:
+            with open(marker, "w") as f:
+                f.write("shuffling")
+            time.sleep(4.0)
+        return pdf.drop(columns=["part"]).assign(v2=pdf["v"] * 2.0)
+
+    def reduce_fn(l: "_pd.DataFrame", r: "_pd.DataFrame") -> "_pd.DataFrame":
+        m = l.merge(r, on="k", how="inner")
+        m = m.assign(x=m["v2"] * m["w"])
+        return m.groupby("k", as_index=False).agg(s=("x", "sum"), n=("x", "count"))
+
+    def combine(parts):
+        pdf = _pd.concat(parts, ignore_index=True) if parts else _pd.DataFrame()
+        return (
+            pdf.groupby("k", as_index=False)
+            .agg(s=("s", "sum"), n=("n", "sum"))
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+
+    return map_left, reduce_fn, combine
+
+
+def _bench_dist_chaos(workers: int = 3) -> Dict[str, Any]:
+    """Chaos proof for the worker tier (docs/distributed.md): 3 worker
+    processes + a supervisor run a distributed load → shuffle-join →
+    aggregate; the worker holding the straggler map lease is SIGKILLed
+    mid-shuffle. Gates:
+
+    - every partition completes (lease expiry → heartbeat-proven death →
+      re-dispatch to a live worker; >= 1 WORKER_LOST re-dispatch seen);
+    - the artifact/bucket audit shows ZERO lost and ZERO double-counted
+      rows across the exchange;
+    - the result is bit-identical to the single-process cache-off oracle
+      (`fugue.tpu.dist.enabled=false` — the kill-switch path itself).
+    """
+    import multiprocessing as _mp
+    import pandas as _pd
+    import shutil as _shutil
+    import signal as _signal
+    import tempfile as _tempfile
+
+    from fugue_tpu.dist import DistSupervisor, read_heartbeat
+
+    root = _tempfile.mkdtemp(prefix="fugue_bench_dist_")
+    board = os.path.join(root, "board")
+    data = os.path.join(root, "data")
+    marker = os.path.join(root, "marker")
+    stop_file = os.path.join(root, "stop")
+    os.makedirs(data)
+    # the inputs: 6 left parts x 3000 rows (k ~ 97 groups), 3 right parts
+    left, right = [], []
+    for i in range(6):
+        p = os.path.join(data, f"left_{i}.parquet")
+        _pd.DataFrame(
+            {
+                "part": i,
+                "k": [(j * 13 + i) % 97 for j in range(3000)],
+                "v": [float((j * 7 + i) % 1000) for j in range(3000)],
+            }
+        ).to_parquet(p)
+        left.append(p)
+    for i in range(3):
+        p = os.path.join(data, f"right_{i}.parquet")
+        _pd.DataFrame(
+            {
+                "k": [(j + i * 33) % 97 for j in range(400)],
+                "w": [float((j * 3 + i) % 50) for j in range(400)],
+            }
+        ).to_parquet(p)
+        right.append(p)
+    map_left, reduce_fn, combine = _dist_job_fns(marker)
+    ctx = _mp.get_context("fork")
+    procs = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(workers):
+            p = ctx.Process(
+                target=_dist_worker_main, args=(board, f"w{i}", stop_file)
+            )
+            p.start()
+            procs.append(p)
+        sup = DistSupervisor(board, conf=dict(_DIST_CONF))
+        jid = sup.plan_join_job(
+            left,
+            right,
+            ["k"],
+            reduce_fn,
+            combine_fn=combine,
+            map_left=map_left,
+            buckets=8,
+        )
+        # --- SIGKILL the straggler's worker once it is provably mid-map
+        deadline = time.monotonic() + 60
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline:
+                raise RuntimeError("no worker ever started the straggler map")
+            time.sleep(0.02)
+        lease = sup.leases.read(f"{jid}-m-left-0000")
+        victim_wid = lease["owner"] if lease else None
+        hb = read_heartbeat(sup.board.hb_dir, victim_wid) if victim_wid else None
+        if hb is None:
+            raise RuntimeError(f"no heartbeat for lease owner {victim_wid!r}")
+        victim_pid = int(hb["pid"])
+        os.kill(victim_pid, _signal.SIGKILL)
+        for p in procs:
+            if p.pid == victim_pid:
+                p.join(10)
+
+        result = sup.wait_job(jid, timeout=180)
+        audit = sup.audit_job(jid)
+        dist_stats = sup.engine.stats()["dist"]
+
+        # --- the single-process cache-off oracle: the kill-switch path
+        os.remove(marker)
+        oracle_sup = DistSupervisor(
+            os.path.join(root, "oracle_board"),
+            conf=dict(_DIST_CONF, **{"fugue.tpu.dist.enabled": False}),
+        )
+        oracle = oracle_sup.run_join_job(
+            left,
+            right,
+            ["k"],
+            reduce_fn,
+            combine_fn=combine,
+            map_left=map_left,
+            buckets=8,
+        )
+        identical = result.equals(oracle)
+
+        n_map, n_reduce = len(left) + len(right), 8
+        completed = audit["map_done"] + audit["reduce_done"]
+        redispatches = int(dist_stats.get("redispatch_worker_lost", 0)) + int(
+            dist_stats.get("redispatch_transient", 0)
+        )
+        correct = (
+            completed == n_map + n_reduce
+            and audit["rows_lost"] == 0
+            and audit["rows_double_counted"] == 0
+            and dist_stats.get("redispatch_worker_lost", 0) >= 1
+            and identical
+        )
+        worker_counters = {
+            w: {
+                k: s.get(k, 0)
+                for k in (
+                    "tasks_completed",
+                    "fragments_remote",
+                    "fragments_local",
+                    "orphaned_outputs_recovered",
+                    "leases_stolen",
+                )
+            }
+            for w, s in (dist_stats.get("workers") or {}).items()
+        }
+        return {
+            "workers": workers,
+            "victim": victim_wid,
+            "map_tasks": n_map,
+            "reduce_tasks": n_reduce,
+            "completed": completed,
+            "result_rows": int(len(result)),
+            "redispatch_worker_lost": dist_stats.get("redispatch_worker_lost", 0),
+            "redispatch_transient": dist_stats.get("redispatch_transient", 0),
+            "redispatches": redispatches,
+            "audit": audit,
+            "worker_counters": worker_counters,
+            "bit_identical": identical,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "correct": correct,
+        }
+    finally:
+        try:
+            with open(stop_file, "w") as f:
+                f.write("stop")
+        except OSError:
+            pass
+        for p in procs:
+            p.join(5)
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+        _shutil.rmtree(root, ignore_errors=True)
+
+
+def _dist_smoke() -> None:
+    """``make dist-smoke``: the ISSUE 14 chaos gate — 3 workers +
+    supervisor run a distributed load→shuffle-join→aggregate, one worker
+    SIGKILLed mid-shuffle; all partitions complete via lease re-dispatch,
+    the artifact audit shows zero lost/double-counted bucket rows, and
+    the result is bit-identical to the single-process cache-off oracle
+    (the `fugue.tpu.dist.enabled=false` kill-switch path). Exit 16 on
+    any violation (the next code after the fleet gate's 15)."""
+    case = _bench_dist_chaos()
+    print(json.dumps({"metric": "dist_chaos", "chaos": case}))
+    if not case["correct"]:
+        raise SystemExit(16)
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -3282,6 +3518,9 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-smoke":
         with _bench_lock():
             _fleet_smoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--dist-smoke":
+        with _bench_lock():
+            _dist_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "--telemetry-smoke":
         out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
         with _bench_lock():

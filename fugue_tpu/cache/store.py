@@ -43,7 +43,96 @@ __all__ = [
     "ResultCache",
     "estimate_df_bytes",
     "clean_cache_dir",
+    "try_claim_file",
+    "read_claim_file",
+    "release_claim_file",
 ]
+
+
+# ---------------------------------------------------------------------------
+# the shared file-claim primitive (docs/serving.md "Fleet",
+# docs/distributed.md "Leases")
+# ---------------------------------------------------------------------------
+# One small json file created with O_CREAT|O_EXCL — the same kernel-atomic
+# primitive the temp-write+rename publishes lean on — so exactly one
+# creator wins a cold race. A held claim is STEALABLE when the caller's
+# ``stealable(holder)`` predicate says so (lease expiry, dead pid, stale
+# heartbeat); steal races settle by re-reading the file after the atomic
+# rewrite: whichever payload survived the rename owns it. The fleet's
+# fingerprint-ownership claims and the dist tier's task leases are both
+# THIS protocol with different payloads and different stealable rules.
+
+
+def _claim_write_json(final: str, payload: Dict[str, Any]) -> None:
+    tmp = f"{final}.__tmp_{_uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, final)
+
+
+def read_claim_file(path: str) -> Optional[Dict[str, Any]]:
+    """The current claim payload, or None. A torn/corrupt claim file is
+    deleted and reads as absent (stealable, never a wedge)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception:
+        _best_effort_remove(path)
+        return None
+
+
+def try_claim_file(
+    path: str,
+    payload: Dict[str, Any],
+    stealable: Any,
+) -> Tuple[bool, Optional[Dict[str, Any]]]:
+    """Atomically claim ``path`` with ``payload`` (must carry ``owner``).
+
+    Returns ``(owned, holder)``: ``owned`` means the payload's owner
+    holds the claim now (fresh, re-entered, or stolen); otherwise
+    ``holder`` is the live holder to wait on. ``stealable(holder)``
+    decides whether a foreign holder may be overwritten."""
+    owner = payload.get("owner")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        try:
+            data = json.dumps(payload).encode()
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        return True, payload
+    except FileExistsError:
+        pass
+    except OSError:
+        return False, None  # store trouble: behave as not-owned
+    holder = read_claim_file(path)
+    if holder is not None:
+        if holder.get("owner") == owner:
+            # re-entrant: the owner restarting meets its own prior claim
+            return True, holder
+        if not stealable(holder):
+            return False, holder
+    # expired/dead/torn: steal via atomic rewrite; the last rename wins,
+    # so re-read to learn who actually owns it now
+    try:
+        _claim_write_json(path, payload)
+    except OSError:
+        return False, holder
+    cur = read_claim_file(path)
+    return (cur is not None and cur.get("owner") == owner), cur
+
+
+def release_claim_file(path: str, owner: str) -> bool:
+    """Remove the claim if ``owner`` still holds it (a steal victim's
+    late release must not drop the thief's claim)."""
+    cur = read_claim_file(path)
+    if cur is not None and cur.get("owner") != owner:
+        return False
+    _best_effort_remove(path)
+    return True
 
 _COUNTERS = (
     "lookups",
@@ -162,7 +251,13 @@ class ArtifactStore:
     """Content-addressed parquet artifacts under ``<dir>/objs``."""
 
     def __init__(
-        self, path: str, cap_bytes: int, log: Any = None, cap_entries: int = 0
+        self,
+        path: str,
+        cap_bytes: int,
+        log: Any = None,
+        cap_entries: int = 0,
+        hb_dir: Optional[str] = None,
+        hb_stale_s: float = 3.0,
     ):
         self.root = path
         self.objs = os.path.join(path, "objs")
@@ -170,6 +265,12 @@ class ArtifactStore:
         self.claims = os.path.join(path, "claims")
         self.cap = int(cap_bytes)
         self.cap_entries = int(cap_entries)
+        # cross-host claim-steal liveness (docs/distributed.md): when a
+        # heartbeat dir is configured, a claim owner's staleness there is
+        # the death proof; the same-host pid probe stays as the fallback
+        # for owners that never wrote a beat
+        self.hb_dir = hb_dir or None
+        self.hb_stale_s = float(hb_stale_s)
         self._log = log
         os.makedirs(self.objs, exist_ok=True)
         os.makedirs(self.manifests, exist_ok=True)
@@ -207,7 +308,6 @@ class ArtifactStore:
         """(owned, holder_payload). ``owned`` means THIS ``owner`` holds
         the claim now (fresh, re-entered after a restart, or stolen);
         otherwise ``holder_payload`` is the live holder to wait on."""
-        path = self._claim(key)
         payload = {
             "owner": owner,
             "pid": os.getpid(),
@@ -215,44 +315,27 @@ class ArtifactStore:
             "ts": time.time(),
             "lease_s": float(lease_s),
         }
-        try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            try:
-                data = json.dumps(payload).encode()
-                os.write(fd, data)
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-            return True, payload
-        except FileExistsError:
-            pass
-        except OSError:
-            return False, None  # store trouble: behave as not-owned
-        holder = self.read_claim(key)
-        if holder is not None:
-            if holder.get("owner") == owner:
-                # re-entrant: this replica restarting and replaying its
-                # journal meets its own pre-crash claim
-                return True, holder
-            if not self._claim_stealable(holder):
-                return False, holder
-        # expired/dead/torn: steal via atomic rewrite; the last rename
-        # wins, so re-read to learn who actually owns it now
-        try:
-            self._write_json(path, payload)
-        except OSError:
-            return False, holder
-        cur = self.read_claim(key)
-        return (cur is not None and cur.get("owner") == owner), cur
+        return try_claim_file(self._claim(key), payload, self._claim_stealable)
 
-    @staticmethod
-    def _claim_stealable(holder: Dict[str, Any]) -> bool:
+    def _claim_stealable(self, holder: Dict[str, Any]) -> bool:
         ts = float(holder.get("ts", 0.0))
         lease = float(holder.get("lease_s", 0.0))
         if ts + lease <= time.time():
             return True
-        # a SIGKILLed same-host owner shouldn't pin its claim for the
-        # whole lease: a dead pid is stealable immediately
+        # cross-host liveness first (ISSUE 14): a stale heartbeat is proof
+        # of death regardless of host; a FRESH one pins the claim for the
+        # rest of its lease even when the pid probe can't see the owner
+        if self.hb_dir:
+            from ..dist.heartbeat import holder_alive
+
+            alive = holder_alive(
+                str(holder.get("owner") or ""), self.hb_dir, self.hb_stale_s
+            )
+            if alive is not None:
+                return not alive
+        # fallback (no heartbeat dir configured, or an owner that never
+        # beat): a SIGKILLed same-host owner shouldn't pin its claim for
+        # the whole lease — a dead pid is stealable immediately
         pid = holder.get("pid")
         if pid and holder.get("host") == socket.gethostname():
             try:
@@ -266,24 +349,12 @@ class ArtifactStore:
     def read_claim(self, key: str) -> Optional[Dict[str, Any]]:
         """The current claim payload, or None. A torn/corrupt claim file
         is deleted and reads as absent (stealable, never a wedge)."""
-        path = self._claim(key)
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except FileNotFoundError:
-            return None
-        except Exception:
-            _best_effort_remove(path)
-            return None
+        return read_claim_file(self._claim(key))
 
     def release_claim(self, key: str, owner: str) -> bool:
         """Remove the claim if ``owner`` still holds it (a steal victim's
         late release must not drop the thief's claim)."""
-        cur = self.read_claim(key)
-        if cur is not None and cur.get("owner") != owner:
-            return False
-        _best_effort_remove(self._claim(key))
-        return True
+        return release_claim_file(self._claim(key), owner)
 
     # -- delta manifests -----------------------------------------------------
     def load_manifest(self, key: str) -> Optional[Dict[str, Any]]:
@@ -482,10 +553,22 @@ class ResultCache:
             _get(FUGUE_TPU_CONF_CACHE_DIR, "") or os.environ.get("FUGUE_TPU_CACHE_DIR", "")
         )
         if self.enabled and cache_dir:
+            from ..constants import (
+                FUGUE_TPU_CONF_DIST_HB_DIR,
+                FUGUE_TPU_CONF_DIST_HB_STALE_S,
+            )
+
             cap = int(_get(FUGUE_TPU_CONF_CACHE_DISK_BYTES, 4 * 1024 * 1024 * 1024))
             cap_entries = int(_get(FUGUE_TPU_CONF_CACHE_DISK_MAX_ENTRIES, 65536))
             try:
-                store = ArtifactStore(cache_dir, cap, log=log, cap_entries=cap_entries)
+                store = ArtifactStore(
+                    cache_dir,
+                    cap,
+                    log=log,
+                    cap_entries=cap_entries,
+                    hb_dir=str(_get(FUGUE_TPU_CONF_DIST_HB_DIR, "")) or None,
+                    hb_stale_s=float(_get(FUGUE_TPU_CONF_DIST_HB_STALE_S, 3.0)),
+                )
                 probe = os.path.join(store.objs, f".probe_{_uuid.uuid4().hex}")
                 with open(probe, "w") as f:
                     f.write("ok")

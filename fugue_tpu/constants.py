@@ -284,6 +284,45 @@ FUGUE_TPU_CONF_SERVE_REPLICA_ID = "fugue.tpu.serve.replica_id"
 # journaling; on restart a replica REPLAYS its own unfinished entries
 # under their original idempotency keys (docs/serving.md "Fleet").
 FUGUE_TPU_CONF_SERVE_JOURNAL_DIR = "fugue.tpu.serve.journal.dir"
+# journal compaction threshold (bytes): past it the WAL is rewritten
+# atomically with every terminal submission's records dropped — replay
+# semantics are provably unchanged (unfinished() parity). 0 disables.
+FUGUE_TPU_CONF_SERVE_JOURNAL_MAX_BYTES = "fugue.tpu.serve.journal.max_bytes"
+
+# --- multi-host worker tier (fugue_tpu/dist, docs/distributed.md) ---
+# master kill-switch: =false makes DistSupervisor.run_* execute the whole
+# job serially in THIS process (same functions, same bucket order) —
+# bit-identical to the distributed result by construction.
+FUGUE_TPU_CONF_DIST_ENABLED = "fugue.tpu.dist.enabled"
+# task lease duration: a lease this old whose owner cannot be proven
+# alive (heartbeat) is stealable by any live worker; owners renew at
+# lease_s/3 while executing, so only a dead or wedged owner expires.
+FUGUE_TPU_CONF_DIST_LEASE_S = "fugue.tpu.dist.lease_s"
+# heartbeat protocol: every worker/replica writes
+# <heartbeat.dir>/<id>.hb.json every interval_s (atomic rename); a
+# heartbeat older than stale_after_s is PROOF of death for lease/claim
+# stealing — the cross-host replacement for same-host pid probes. The
+# dir is shared by the dist worker tier AND the serve fleet (an
+# EngineServer with this key set writes heartbeats under its replica_id,
+# and claim stealing in cache/store.py consults them).
+FUGUE_TPU_CONF_DIST_HB_DIR = "fugue.tpu.dist.heartbeat.dir"
+FUGUE_TPU_CONF_DIST_HB_INTERVAL_S = "fugue.tpu.dist.heartbeat.interval_s"
+FUGUE_TPU_CONF_DIST_HB_STALE_S = "fugue.tpu.dist.heartbeat.stale_after_s"
+# shuffle-fragment fetch mode: "auto" reads the producer's file directly
+# when its path is visible on this host's filesystem and falls back to
+# the producer's HTTP /dist/fetch route; "remote" always fetches over
+# HTTP except from this worker's own dir (the true multi-host shape —
+# what the chaos gate runs); "local" never fetches (single-host tier).
+FUGUE_TPU_CONF_DIST_FETCH = "fugue.tpu.dist.fetch"
+# reduce-side bucket count for the network-partitioned exchange
+FUGUE_TPU_CONF_DIST_BUCKETS = "fugue.tpu.dist.buckets"
+# straggler mitigation: a task leased (and renewed) by a LIVE owner for
+# longer than this is marked speculative — a second worker re-executes
+# it and the first published done-record wins (artifacts are content-
+# addressed, so the loser's publish dedups). 0 (default) disables.
+FUGUE_TPU_CONF_DIST_SPECULATIVE_AFTER_S = "fugue.tpu.dist.speculative_after_s"
+# supervisor/worker poll cadence over the shared board
+FUGUE_TPU_CONF_DIST_POLL_S = "fugue.tpu.dist.poll_s"
 
 # --- cost-based adaptive execution (fugue_tpu/tuning, docs/tuning.md) ---
 # Feedback layer that re-derives stream chunk size / prefetch depth and

@@ -9,6 +9,8 @@ from .counters import ResilienceStats
 from .fault import (
     NULL_INJECTOR,
     SITE_CHECKPOINT_SAVE,
+    SITE_DIST_HEARTBEAT,
+    SITE_DIST_LEASE,
     SITE_MAP_CHUNK,
     SITE_MAP_DISPATCH,
     SITE_RPC_REQUEST,
@@ -43,6 +45,8 @@ __all__ = [
     "SITE_SERVE_CLAIM",
     "SITE_SHUFFLE_SPILL",
     "SITE_STREAM_CHUNK",
+    "SITE_DIST_LEASE",
+    "SITE_DIST_HEARTBEAT",
     "RetryPolicy",
     "Deadline",
     "FailureCategory",

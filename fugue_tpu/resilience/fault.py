@@ -58,6 +58,8 @@ __all__ = [
     "SITE_SHUFFLE_SPILL",
     "SITE_SERVE_JOURNAL",
     "SITE_SERVE_CLAIM",
+    "SITE_DIST_LEASE",
+    "SITE_DIST_HEARTBEAT",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -86,6 +88,17 @@ SITE_SERVE_JOURNAL = "serve.journal"
 # detection); `delay` widens the window so a chaos test can SIGKILL the
 # owner deterministically mid-claim
 SITE_SERVE_CLAIM = "serve.claim"
+# inside DistWorker.run_task, between the task-lease acquisition and the
+# task body (fugue_tpu/dist/worker.py) — `error` here leaves an acquired
+# lease to unwind-release (the fail record is TRANSIENT, the task is
+# re-dispatched); `kill` leaves an orphaned lease for a live worker to
+# steal once the dead owner's heartbeat goes stale
+SITE_DIST_LEASE = "dist.lease"
+# inside HeartbeatWriter's beat loop, before each atomic heartbeat write
+# (fugue_tpu/dist/heartbeat.py) — `error` SKIPS that beat (a simulated
+# network partition: enough skipped beats and the worker reads as dead
+# to lease/claim stealers); `delay` widens the gap the same way
+SITE_DIST_HEARTBEAT = "dist.heartbeat"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

@@ -42,7 +42,7 @@ from ..resilience import (
     ResilienceStats,
     RetryPolicy,
     classify_failure,
-)
+)  # classify_failure also stamps /serve/poll's error_code (ISSUE 14)
 from .base import RPCClient, RPCServer
 
 
@@ -171,12 +171,18 @@ class HttpRPCServer(RPCServer):
     - ``POST /serve/submit``, ``GET /serve/poll``, ``GET /serve/result``,
       ``POST /serve/cancel`` — the remote session surface over a bound
       EngineServer (see docs/serving.md; idempotency keys make submit
-      safe under the retry policy).
+      safe under the retry policy);
+    - ``GET /dist/fetch?path=<rel>`` — the worker tier's shuffle-fragment
+      channel (ISSUE 14, docs/distributed.md): a bound
+      :class:`~fugue_tpu.dist.DistWorker` serves files from its OWN data
+      dir (path-jailed) so another host's reduce task can pull this
+      worker's bucket fragments without a shared filesystem.
 
     Bind an engine with :meth:`bind_engine` (the engine does this itself
-    when it creates or is handed the server) and a serving front end with
-    :meth:`bind_serve`; unbound, the global span metrics and sampler
-    still serve and the serve routes answer 404."""
+    when it creates or is handed the server), a serving front end with
+    :meth:`bind_serve`, and a dist worker with :meth:`bind_dist`;
+    unbound, the global span metrics and sampler still serve and the
+    serve/dist routes answer 404."""
 
     def __init__(self, conf: Any = None):
         super().__init__(conf)
@@ -203,6 +209,7 @@ class HttpRPCServer(RPCServer):
         self._thread: Any = None
         self._engine_ref: Any = None
         self._serve_ref: Any = None
+        self._dist_ref: Any = None
         self._started_at = time.time()
 
     # -- telemetry binding ---------------------------------------------------
@@ -215,6 +222,11 @@ class HttpRPCServer(RPCServer):
         """Point the /serve/* routes and /readyz at an
         :class:`~fugue_tpu.serve.EngineServer` (held weakly)."""
         self._serve_ref = weakref.ref(server)
+
+    def bind_dist(self, worker: Any) -> None:
+        """Point /dist/fetch at a :class:`~fugue_tpu.dist.DistWorker`
+        (held weakly) — anything with ``read_blob(rel) -> bytes|None``."""
+        self._dist_ref = weakref.ref(worker)
 
     def _metrics_engine(self) -> Any:
         return self._engine_ref() if self._engine_ref is not None else None
@@ -264,7 +276,31 @@ class HttpRPCServer(RPCServer):
             return self._serve_poll(query)
         if path == "/serve/result":
             return self._serve_result(query)
+        if path == "/dist/fetch":
+            return self._dist_fetch(query)
         return None
+
+    # -- dist worker routes (ISSUE 14; see docs/distributed.md) --------------
+    def _dist_fetch(self, query: str) -> Any:
+        """Serve one shuffle fragment from the bound worker's data dir.
+        404 covers everything the caller treats as "unavailable": no
+        worker bound, missing file, or a path outside the jail — the
+        consumer's orphan-recovery ladder takes it from there."""
+        from urllib.parse import parse_qs
+
+        worker = self._dist_ref() if self._dist_ref is not None else None
+        if worker is None:
+            return 404, "application/json", b'{"error": "no dist worker bound"}'
+        vals = parse_qs(query).get("path")
+        rel = vals[0] if vals else ""
+        blob = worker.read_blob(rel) if rel else None
+        if blob is None:
+            return (
+                404,
+                "application/json",
+                json.dumps({"error": f"no fragment at {rel!r}"}).encode(),
+            )
+        return 200, "application/octet-stream", blob
 
     # -- serving routes (ISSUE 10; see docs/serving.md) ----------------------
     def _readyz(self) -> Any:
@@ -337,6 +373,10 @@ class HttpRPCServer(RPCServer):
         err = sub._execution.error if sub._execution is not None else None
         if sub.status == "failed" and err is not None:
             out["error"] = f"{type(err).__name__}: {err}"
+            # the PR 1 taxonomy travels with the error so a remote caller
+            # can distinguish retryable (worker_lost/transient/timeout)
+            # from fatal (poison) without parsing message strings
+            out["error_code"] = classify_failure(err).value
         return out
 
     def _serve_poll(self, query: str) -> Any:

@@ -19,7 +19,7 @@ Over HTTP (the ``rpc/http.py`` surface)::
     frames = client.result(sid, timeout=60)
 """
 
-from .client import ServeHttpClient
+from .client import ServeHttpClient, ServeWorkerLost
 from .dedup import submission_key
 from .fleet import FleetClient, FleetCoordinator, FleetResult, FleetSubmission
 from .journal import SubmissionJournal
@@ -36,6 +36,7 @@ __all__ = [
     "ServeHttpClient",
     "ServeRejected",
     "ServeStats",
+    "ServeWorkerLost",
     "Submission",
     "SubmissionCanceled",
     "SubmissionJournal",

@@ -6,6 +6,15 @@ Retry semantics follow the rpc/http.py idempotency rule — a submit is
 only blindly re-sent when it carries an ``idempotency_key`` (the server
 then maps the resend onto the SAME submission), otherwise only
 failures-before-send retry.
+
+Error taxonomy (ISSUE 14 satellite): a replica that dies POST-ADMIT —
+unreachable when the result is fetched, or restarted without this
+submission's state — surfaces as :class:`ServeWorkerLost` (``code ==
+"worker_lost"``, classified ``WORKER_LOST`` = retryable by the PR 1
+taxonomy) instead of a generic transport error, so callers (and
+:class:`~fugue_tpu.serve.FleetClient`) can mechanically distinguish
+"replay me elsewhere" from a workflow's own deterministic failure,
+which re-raises as itself and is NEVER retried.
 """
 
 import base64
@@ -16,10 +25,27 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from ..resilience import RetryPolicy, classify_failure
+from ..resilience import RetryPolicy, WorkerLostError, classify_failure
 from .server import ServeRejected
 
-__all__ = ["ServeHttpClient"]
+__all__ = ["ServeHttpClient", "ServeWorkerLost"]
+
+
+class ServeWorkerLost(WorkerLostError, KeyError):
+    """A serve replica died (or lost its state) after admitting a
+    submission. ``code`` is the stable taxonomy string callers switch
+    on; the original transport failure is chained as ``__cause__``.
+    Also a ``KeyError`` (the unknown-id contract predates the taxonomy),
+    but ``classify_failure`` sees ``WorkerLostError`` first: retryable."""
+
+    code = "worker_lost"
+
+    def __init__(self, message: str, submission_id: Optional[str] = None):
+        super().__init__(message)
+        self.submission_id = submission_id
+
+    def __str__(self) -> str:  # KeyError would repr() the message
+        return self.args[0] if self.args else ""
 
 
 class ServeHttpClient:
@@ -120,10 +146,23 @@ class ServeHttpClient:
             raise ConnectionError(f"/serve/submit returned HTTP {status}: {payload}")
         return payload
 
+    def _lost(self, sid: str, what: str, cause: Optional[BaseException]) -> Any:
+        raise ServeWorkerLost(
+            f"serve replica {self._host}:{self._port} lost submission "
+            f"{sid} during {what}"
+            + (f" ({type(cause).__name__}: {cause})" if cause is not None else ""),
+            submission_id=sid,
+        ) from cause
+
     def poll(self, submission_id: str) -> Dict[str, Any]:
-        status, ctype, data = self._request(
-            "GET", f"/serve/poll?id={submission_id}", idempotent=True
-        )
+        try:
+            status, ctype, data = self._request(
+                "GET", f"/serve/poll?id={submission_id}", idempotent=True
+            )
+        except (ConnectionError, OSError) as ex:
+            # the replica is gone with our submission: structured
+            # worker_lost, not a generic transport error
+            return self._lost(submission_id, "poll", ex)
         return self._json(status, ctype, data)
 
     def result(
@@ -134,19 +173,26 @@ class ServeHttpClient:
     ) -> Dict[str, Any]:
         """Poll until done, then fetch the yielded frames as pandas
         (``{yield_name: pandas.DataFrame}``). Raises the execution's
-        error, re-hydrated."""
+        error, re-hydrated — or :class:`ServeWorkerLost` when the
+        REPLICA (not the workflow) died post-admit: unreachable, or
+        restarted without this submission (404 on a known-admitted id)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            status, ctype, data = self._request(
-                "GET", f"/serve/result?id={submission_id}", idempotent=True
-            )
+            try:
+                status, ctype, data = self._request(
+                    "GET", f"/serve/result?id={submission_id}", idempotent=True
+                )
+            except (ConnectionError, OSError) as ex:
+                return self._lost(submission_id, "result", ex)
             if status == 200 and ctype.startswith("application/octet-stream"):
                 ok, payload = cloudpickle.loads(base64.b64decode(data))
                 if not ok:
                     raise payload
                 return payload
             if status == 404:
-                raise KeyError(self._json(status, ctype, data).get("error"))
+                # admitted here, unknown now: the replica restarted and
+                # lost (or retention-evicted) this submission's state
+                return self._lost(submission_id, "result (unknown id)", None)
             if status != 202:
                 raise ConnectionError(f"/serve/result returned HTTP {status}")
             if deadline is not None and time.monotonic() > deadline:

@@ -243,11 +243,16 @@ class FleetClient:
     retry — maps onto one observable submission.
     """
 
-    # transport-shaped failures trigger failover; a workflow's own error
+    # the worker_lost taxonomy (ISSUE 14): ServeWorkerLost (a replica
+    # dead or stateless post-admit — WorkerLostError, retryable) and raw
+    # transport failures trigger failover; a workflow's own error
     # (rehydrated from the result payload) never does — re-running a
     # deterministically failing plan elsewhere just fails again, and
-    # would re-run its side effects
-    _FAILOVER_ERRORS = (ConnectionError, OSError, KeyError)
+    # would re-run its side effects. KeyError stays for pre-taxonomy
+    # callers' unknown-id shape.
+    from ..resilience import WorkerLostError as _WL
+
+    _FAILOVER_ERRORS = (ConnectionError, OSError, KeyError, _WL)
 
     def __init__(
         self,

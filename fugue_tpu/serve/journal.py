@@ -26,6 +26,18 @@ Appends are atomic at the record level (single ``write`` of one line,
 fsync'd); a torn final line — the crash window — is skipped by the
 reader, which costs at most the one record whose admission never
 completed anyway (the ``serve.journal`` fault site sits exactly there).
+
+Compaction (ISSUE 14 satellite, the PR 13 follow-up): a WAL only ever
+grows, and a long-lived replica's is dominated by records of submissions
+that already reached a terminal ``done`` — dead weight for the only
+thing the file is FOR (replay). When the file passes
+``fugue.tpu.serve.journal.max_bytes`` (checked every few appends, or on
+an explicit :meth:`compact`), it is rewritten keeping exactly the
+records of sids with NO ``done`` record, fsync'd to a temp file and
+atomically published over the original — a crash mid-compaction leaves
+the complete old file. ``unfinished()`` is provably identical before and
+after (the replay-parity test), and the no-double-exec audit only ever
+loses exec/done PAIRS of completed work, which it counts as zero anyway.
 """
 
 import base64
@@ -41,13 +53,20 @@ __all__ = ["SubmissionJournal"]
 class SubmissionJournal:
     """Append-only fsync'd WAL of one replica's admitted submissions."""
 
-    def __init__(self, path: str, replica_id: str, log: Any = None):
+    # how often the size check runs; a stat per append would be waste
+    _COMPACT_CHECK_EVERY = 32
+
+    def __init__(
+        self, path: str, replica_id: str, log: Any = None, max_bytes: int = 0
+    ):
         self.path = path
         self.replica_id = replica_id
+        self.max_bytes = int(max_bytes)
         self._log = log
         self._lock = threading.Lock()
         self._fd: Optional[int] = None
         self._appends = 0
+        self._compactions = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     # -- write side ----------------------------------------------------------
@@ -61,6 +80,16 @@ class SubmissionJournal:
             os.write(self._fd, line)
             os.fsync(self._fd)
             self._appends += 1
+            if (
+                self.max_bytes > 0
+                and self._appends % self._COMPACT_CHECK_EVERY == 0
+            ):
+                try:
+                    over = os.fstat(self._fd).st_size > self.max_bytes
+                except OSError:
+                    over = False
+                if over:
+                    self._compact_locked()
 
     def admit(
         self,
@@ -111,11 +140,71 @@ class SubmissionJournal:
         with self._lock:
             return self._appends
 
+    @property
+    def compactions(self) -> int:
+        with self._lock:
+            return self._compactions
+
     def close(self) -> None:
         with self._lock:
             if self._fd is not None:
                 os.close(self._fd)
                 self._fd = None
+
+    # -- compaction ----------------------------------------------------------
+    def compact(self) -> int:
+        """Rewrite the WAL keeping only records of sids with no terminal
+        ``done`` record. Returns how many records were dropped. Replay
+        parity: ``unfinished()`` before == after, by construction."""
+        with self._lock:
+            return self._compact_locked()
+
+    def _compact_locked(self) -> int:
+        recs = self.read_records(self.path)
+        done = {r.get("sid") for r in recs if r.get("op") == "done"}
+        keep = [r for r in recs if r.get("sid") not in done]
+        dropped = len(recs) - len(keep)
+        if dropped <= 0:
+            return 0
+        tmp = f"{self.path}.__compact_{os.getpid()}"
+        try:
+            fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+            try:
+                for r in keep:
+                    os.write(
+                        fd, (json.dumps(r, separators=(",", ":")) + "\n").encode()
+                    )
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, self.path)
+        except OSError as ex:
+            # a failed compaction must never lose the WAL: the original
+            # file is untouched until the atomic rename
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            if self._log is not None:
+                self._log.warning("journal compaction of %s failed: %s", self.path, ex)
+            return 0
+        # the old fd points at the unlinked pre-compaction inode: reopen
+        # so later appends land in the compacted file
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = os.open(
+                self.path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644
+            )
+        self._compactions += 1
+        if self._log is not None:
+            self._log.info(
+                "journal %s compacted: %d record(s) of finished submissions "
+                "dropped, %d kept",
+                os.path.basename(self.path),
+                dropped,
+                len(keep),
+            )
+        return dropped
 
     # -- read side -----------------------------------------------------------
     @staticmethod

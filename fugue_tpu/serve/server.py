@@ -40,6 +40,8 @@ from contextlib import nullcontext
 from typing import Any, Dict, List, Optional
 
 from ..constants import (
+    FUGUE_TPU_CONF_DIST_HB_DIR,
+    FUGUE_TPU_CONF_DIST_HB_INTERVAL_S,
     FUGUE_TPU_CONF_SERVE_AGING_S,
     FUGUE_TPU_CONF_SERVE_DEFAULT_PRIORITY,
     FUGUE_TPU_CONF_SERVE_FLEET_ENABLED,
@@ -47,6 +49,7 @@ from ..constants import (
     FUGUE_TPU_CONF_SERVE_FLEET_MAX_RESULTS,
     FUGUE_TPU_CONF_SERVE_FLEET_POLL_S,
     FUGUE_TPU_CONF_SERVE_JOURNAL_DIR,
+    FUGUE_TPU_CONF_SERVE_JOURNAL_MAX_BYTES,
     FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT,
     FUGUE_TPU_CONF_SERVE_MAX_TENANTS,
     FUGUE_TPU_CONF_SERVE_QUEUE_DEPTH,
@@ -259,6 +262,27 @@ class EngineServer:
                 os.path.join(jdir, f"{self.replica_id}.jsonl"),
                 self.replica_id,
                 log=engine.log,
+                max_bytes=int(
+                    c.get(FUGUE_TPU_CONF_SERVE_JOURNAL_MAX_BYTES, 64 * 1024 * 1024)
+                ),
+            )
+        # cross-host liveness (ISSUE 14): with a heartbeat dir configured
+        # this replica beats under its replica_id, and the shared store's
+        # claim stealing (cache/store.py) judges it by that beat instead
+        # of a same-host pid probe — fleet claim steal works across hosts
+        self._heartbeat: Optional[Any] = None
+        hb_dir = str(c.get(FUGUE_TPU_CONF_DIST_HB_DIR, ""))
+        if hb_dir:
+            from ..dist.heartbeat import DEFAULT_INTERVAL_S, HeartbeatWriter
+
+            self._heartbeat = HeartbeatWriter(
+                hb_dir,
+                self.replica_id,
+                interval_s=float(
+                    c.get(FUGUE_TPU_CONF_DIST_HB_INTERVAL_S, DEFAULT_INTERVAL_S)
+                ),
+                injector=self._injector,
+                log=engine.log,
             )
         # serving counters ride the engine's unified registry (ISSUE 3
         # contract: engine.stats()["serve"], reset under keep-entries)
@@ -279,6 +303,8 @@ class EngineServer:
             ]
         for t in self._workers:
             t.start()
+        if self._heartbeat is not None:
+            self._heartbeat.start()
         self._replay_journal()
         return self
 
@@ -337,6 +363,10 @@ class EngineServer:
             workers, self._workers = self._workers, []
         for t in workers:
             t.join(timeout=timeout)
+        if self._heartbeat is not None:
+            # an orderly stop removes the beat file — departure reads as
+            # UNKNOWN (pid fallback), not as a death to steal from
+            self._heartbeat.stop(remove=True)
         if self._journal is not None:
             self._journal.close()
 
@@ -894,6 +924,10 @@ class EngineServer:
                 replica_id=self.replica_id,
                 fleet_enabled=self._fleet is not None,
                 journal_enabled=self._journal is not None,
+                journal_compactions=(
+                    self._journal.compactions if self._journal is not None else 0
+                ),
+                heartbeat_enabled=self._heartbeat is not None,
             )
         out["charged_bytes"] = self._accounts.as_dict()
         # adaptive-execution convergence at a glance (docs/tuning.md): the

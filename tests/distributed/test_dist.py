@@ -1,0 +1,654 @@
+"""Fault-tolerant multi-host worker tier (ISSUE 14, docs/distributed.md).
+
+The partial-failure matrix: heartbeat fresh vs stale, lease expiry
+mid-task, speculative duplicate publish (both publish, one done record,
+one artifact), supervisor restart over in-flight leases, remote fragment
+fetch + orphaned-output recovery — each proven bit-identical to the
+serial (kill-switch) oracle where a job result exists. Plus the
+heartbeat adoption in the shared store's claim stealing and the new
+``dist.lease`` / ``dist.heartbeat`` fault sites.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.cache.store import ArtifactStore
+from fugue_tpu.dist import (
+    DistJobError,
+    DistSupervisor,
+    DistWorker,
+    HeartbeatWriter,
+    LeaseBoard,
+    holder_alive,
+    read_heartbeat,
+    spec_fingerprint,
+)
+from fugue_tpu.resilience import FailureCategory, classify_failure
+
+CONF = {
+    "fugue.tpu.dist.heartbeat.interval_s": 0.1,
+    "fugue.tpu.dist.heartbeat.stale_after_s": 0.6,
+    "fugue.tpu.dist.lease_s": 2.0,
+    "fugue.tpu.dist.poll_s": 0.01,
+    "fugue.tpu.cache.enabled": False,
+    "fugue.tpu.tuning.enabled": False,
+}
+
+
+def _write_inputs(tmp_path, n_left=3, n_right=2):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    left, right = [], []
+    for i in range(n_left):
+        p = str(data / f"l{i}.parquet")
+        pd.DataFrame(
+            {
+                "k": [(j * 3 + i) % 7 for j in range(40)],
+                "v": [float(j + i * 40) for j in range(40)],
+            }
+        ).to_parquet(p)
+        left.append(p)
+    for i in range(n_right):
+        p = str(data / f"r{i}.parquet")
+        pd.DataFrame(
+            {"k": list(range(7)), "w": [float(i * 10 + j) for j in range(7)]}
+        ).to_parquet(p)
+        right.append(p)
+    return left, right
+
+
+def _map_left(pdf):
+    return pdf.assign(v2=pdf["v"] * 2.0)
+
+
+def _reduce(l, r):
+    m = l.merge(r, on="k", how="inner")
+    m = m.assign(x=m["v2"] * m["w"])
+    return m.groupby("k", as_index=False).agg(s=("x", "sum"), n=("x", "count"))
+
+
+def _combine(parts):
+    pdf = pd.concat(parts, ignore_index=True) if parts else pd.DataFrame()
+    return (
+        pdf.groupby("k", as_index=False)
+        .agg(s=("s", "sum"), n=("n", "sum"))
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+
+
+def _serial(board, left, right, **kw):
+    sup = DistSupervisor(
+        str(board), conf=dict(CONF, **{"fugue.tpu.dist.enabled": False})
+    )
+    return sup.run_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine, map_left=_map_left, **kw
+    )
+
+
+class _WorkerPool:
+    """N in-process workers draining the board on daemon threads."""
+
+    def __init__(self, board, n, conf=None, start_http=False):
+        self.stop_file = os.path.join(str(board), "_stop")
+        self.workers = [
+            DistWorker(
+                str(board), f"w{i}", conf=dict(conf or CONF), start_http=start_http
+            ).start()
+            for i in range(n)
+        ]
+        self.threads = [
+            threading.Thread(
+                target=w.serve_forever,
+                kwargs={"stop_file": self.stop_file},
+                daemon=True,
+            )
+            for w in self.workers
+        ]
+        for t in self.threads:
+            t.start()
+
+    def close(self):
+        with open(self.stop_file, "w") as f:
+            f.write("stop")
+        for t in self.threads:
+            t.join(timeout=10)
+        for w in self.workers:
+            w.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat liveness
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_write_read_fresh_stale(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), "w0", interval_s=0.1)
+    assert hb.beat()
+    payload = read_heartbeat(str(tmp_path), "w0")
+    assert payload["name"] == "w0" and payload["pid"] == os.getpid()
+    assert holder_alive("w0", str(tmp_path), stale_after_s=5.0) is True
+    time.sleep(0.25)
+    assert holder_alive("w0", str(tmp_path), stale_after_s=0.2) is False
+    # no beat file / no dir configured = UNKNOWN, the pid-probe fallback
+    assert holder_alive("nobody", str(tmp_path)) is None
+    assert holder_alive("w0", None) is None
+    # torn file reads as absent, never a crash
+    with open(os.path.join(str(tmp_path), "torn.hb.json"), "w") as f:
+        f.write('{"name": "torn"')
+    assert holder_alive("torn", str(tmp_path)) is None
+
+
+def test_heartbeat_writer_loop_and_orderly_stop(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), "w1", interval_s=0.05).start()
+    try:
+        first = read_heartbeat(str(tmp_path), "w1")["seq"]
+        time.sleep(0.3)
+        assert read_heartbeat(str(tmp_path), "w1")["seq"] > first
+    finally:
+        hb.stop(remove=True)
+    # an orderly departure removes the beat: UNKNOWN, not "dead"
+    assert read_heartbeat(str(tmp_path), "w1") is None
+
+
+def test_heartbeat_fault_site_skips_beats(tmp_path, monkeypatch):
+    from fugue_tpu.resilience import FaultInjector
+
+    hb = HeartbeatWriter(
+        str(tmp_path),
+        "w2",
+        interval_s=0.05,
+        injector=FaultInjector("dist.heartbeat=error@2"),
+    )
+    assert not hb.beat()  # injected partition: beat skipped
+    assert not hb.beat()
+    assert hb.beat()  # budget spent: beats resume
+    assert hb.skipped == 2
+
+
+# ---------------------------------------------------------------------------
+# leases: expiry / heartbeat / pid-probe stealing matrix
+# ---------------------------------------------------------------------------
+
+
+def test_lease_acquire_renew_release(tmp_path):
+    lb = LeaseBoard(str(tmp_path))
+    owned, _ = lb.try_acquire("t1", "w0", lease_s=30.0)
+    assert owned
+    # held fresh by a live same-host pid: not stealable by another owner
+    owned2, holder = lb.try_acquire("t1", "w1", lease_s=30.0)
+    assert not owned2 and holder["owner"] == "w0"
+    assert lb.renew("t1", "w0", 30.0)
+    assert not lb.renew("t1", "w1", 30.0)  # non-owner renew is a no-op
+    assert lb.release("t1", "w0")
+    owned3, _ = lb.try_acquire("t1", "w1", lease_s=30.0)
+    assert owned3
+
+
+def test_lease_expiry_steal(tmp_path):
+    lb = LeaseBoard(str(tmp_path))
+    assert lb.try_acquire("t1", "w0", lease_s=0.1)[0]
+    time.sleep(0.15)
+    owned, cur = lb.try_acquire("t1", "w1", lease_s=5.0)
+    assert owned and cur["owner"] == "w1"
+    # the victim's late release must not drop the thief's lease
+    assert not lb.release("t1", "w0")
+    assert lb.read("t1")["owner"] == "w1"
+
+
+def test_lease_heartbeat_liveness_matrix(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    lb = LeaseBoard(str(tmp_path / "leases"), hb_dir=hb_dir, hb_stale_s=0.3)
+    writer = HeartbeatWriter(hb_dir, "w0", interval_s=0.05)
+    # fresh heartbeat + unexpired lease: NOT stealable
+    writer.beat()
+    assert lb.try_acquire("t1", "w0", lease_s=30.0)[0]
+    assert not lb.stealable(lb.read("t1"))
+    assert not lb.try_acquire("t1", "w1", lease_s=30.0)[0]
+    # stale heartbeat: provably dead — stealable IMMEDIATELY, mid-lease
+    time.sleep(0.4)
+    assert lb.stealable(lb.read("t1"))
+    owned, cur = lb.try_acquire("t1", "w1", lease_s=30.0)
+    assert owned and cur["owner"] == "w1"
+    # fresh heartbeat never pins an EXPIRED lease (live-but-wedged owner)
+    writer2 = HeartbeatWriter(hb_dir, "w1", interval_s=0.05)
+    writer2.beat()
+    lease = lb.read("t1")
+    lease["ts"] = time.time() - 100.0
+    with open(lb._lease("t1"), "w") as f:
+        json.dump(lease, f)
+    assert lb.stealable(lb.read("t1"))
+
+
+def test_store_claim_steal_uses_heartbeat_liveness(tmp_path):
+    """Satellite: fleet claim stealing (cache/store.py) judges a claim
+    owner by its heartbeat when a heartbeat dir is configured, so the
+    steal works cross-host; the pid probe stays as the fallback."""
+    hb_dir = str(tmp_path / "hb")
+    os.makedirs(hb_dir)
+    store = ArtifactStore(
+        str(tmp_path / "store"), cap_bytes=0, hb_dir=hb_dir, hb_stale_s=0.3
+    )
+    assert store.try_claim("key1", "r0", lease_s=30.0)[0]
+    # no heartbeat for r0: UNKNOWN -> pid fallback; our own live pid
+    # holds, so another replica cannot steal
+    assert not store.try_claim("key1", "r1", lease_s=30.0)[0]
+    # a STALE heartbeat is proof of death: stealable mid-lease, even
+    # though the recorded pid (ours) is alive — the cross-host semantics
+    HeartbeatWriter(hb_dir, "r0", interval_s=0.05).beat()
+    time.sleep(0.4)
+    owned, cur = store.try_claim("key1", "r1", lease_s=30.0)
+    assert owned and cur["owner"] == "r1"
+    # a FRESH heartbeat pins the claim for its lease
+    HeartbeatWriter(hb_dir, "r1", interval_s=0.05).beat()
+    assert not store.try_claim("key1", "r2", lease_s=30.0)[0]
+
+
+# ---------------------------------------------------------------------------
+# jobs: serial oracle, kill-switch, end-to-end bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_serial_path_matches_direct_pandas(tmp_path):
+    left, right = _write_inputs(tmp_path)
+    serial = _serial(tmp_path / "board", left, right, buckets=4)
+    l = pd.concat([pd.read_parquet(p) for p in left], ignore_index=True)
+    l = _map_left(l)
+    r = pd.concat([pd.read_parquet(p) for p in right], ignore_index=True)
+    m = l.merge(r, on="k", how="inner")
+    m = m.assign(x=m["v2"] * m["w"])
+    want = (
+        m.groupby("k", as_index=False)
+        .agg(s=("x", "sum"), n=("x", "count"))
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(serial, want)
+
+
+def test_dist_end_to_end_bit_identical_and_audit_zero(tmp_path):
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial(tmp_path / "oracle", left, right, buckets=4)
+    pool = _WorkerPool(board, 2)
+    try:
+        sup = DistSupervisor(str(board), conf=dict(CONF))
+        jid = sup.plan_join_job(
+            left, right, ["k"], _reduce, combine_fn=_combine,
+            map_left=_map_left, buckets=4,
+        )
+        got = sup.wait_job(jid, timeout=60)
+        assert got.equals(serial)
+        audit = sup.audit_job(jid)
+        assert audit["rows_lost"] == 0 and audit["rows_double_counted"] == 0
+        assert audit["map_done"] == 5 and audit["reduce_done"] == 4
+        d = sup.engine.stats()["dist"]
+        assert d["jobs"] == 1 and d["map_tasks"] == 5 and d["reduce_tasks"] == 4
+        # worker counters shipped home via heartbeats/done records (the
+        # exact totals lag by up to one beat — presence is the contract)
+        assert d["workers"]
+        assert sum(
+            s.get("tasks_completed", 0) for s in d["workers"].values()
+        ) >= 1
+    finally:
+        pool.close()
+
+
+def test_lease_expiry_mid_task_redispatched_worker_lost(tmp_path):
+    """A 'worker' grabs a map lease, beats once, and dies (its heartbeat
+    goes stale, its lease never renews): a live worker steals the lease,
+    the supervisor classifies the owner change WORKER_LOST, and the job
+    completes bit-identically."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial(tmp_path / "oracle", left, right, buckets=4)
+    sup = DistSupervisor(str(board), conf=dict(CONF))
+    jid = sup.plan_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=4,
+    )
+    ghost_lease = sup.leases
+    tid = f"{jid}-m-left-0000"
+    HeartbeatWriter(sup.board.hb_dir, "ghost", interval_s=0.05).beat()
+    assert ghost_lease.try_acquire(tid, "ghost", lease_s=30.0)[0]
+    time.sleep(0.7)  # the ghost's only beat goes stale
+    pool = _WorkerPool(board, 2)
+    try:
+        got = sup.wait_job(jid, timeout=60)
+        assert got.equals(serial)
+        # the steal was classified WORKER_LOST at the steal site (stale
+        # ghost heartbeat) and shipped home in the thief's counters
+        assert sup.engine.stats()["dist"]["redispatch_worker_lost"] >= 1
+    finally:
+        pool.close()
+
+
+def test_speculative_duplicate_publish_one_record_one_artifact(tmp_path):
+    """Both the owner and the speculative twin execute the same reduce:
+    both publish, the artifact dedups by content address, exactly one
+    done record survives, the loser counts a speculative loss."""
+    left, right = _write_inputs(tmp_path, n_left=1, n_right=1)
+    board = tmp_path / "board"
+    w0 = DistWorker(str(board), "w0", conf=dict(CONF), start_http=False)
+    w1 = DistWorker(str(board), "w1", conf=dict(CONF), start_http=False)
+    sup = DistSupervisor(str(board), conf=dict(CONF))
+    jid = sup.plan_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=1,
+    )
+    # complete the maps so the reduce is runnable
+    for tid in sup.board.list_tasks():
+        if "-m-" in tid:
+            assert w0.run_task(tid)
+    rtid = f"{jid}-r-0000"
+    sup.board.mark_speculative(rtid)
+    # the "slow owner": acquires the primary lease but hasn't finished
+    assert w0.leases.try_acquire(rtid, "w0", lease_s=30.0)[0]
+    w0.heartbeat.beat()
+    # the volunteer twin runs under the speculative lease and WINS
+    assert w1.run_task(rtid, speculative=True)
+    assert w1.stats.get("speculative_wins") == 1
+    # the owner finishes late: publishes the identical artifact, loses
+    # the done record, and that's a counted non-event
+    w0.leases.release(rtid, "w0")
+    assert w0.run_task(rtid)
+    assert w0.stats.get("duplicate_publishes") == 1
+    done = [
+        n for n in os.listdir(sup.board.done_dir) if n.startswith(rtid)
+    ]
+    assert len(done) == 1
+    rec = sup.board.read_done(rtid)
+    assert rec["worker"] == "w1" and rec["speculative"] is True
+    store = ArtifactStore(sup.board.store_dir, cap_bytes=0)
+    objs = [n for n in os.listdir(store.objs) if n == rec["fp"] + ".parquet"]
+    assert len(objs) == 1
+    got = sup.wait_job(jid, timeout=30)
+    serial = _serial(
+        tmp_path / "oracle", left, right, buckets=1
+    )
+    assert got.equals(serial)
+
+
+def test_supervisor_restart_resumes_inflight_job(tmp_path):
+    """All job state lives on the board: a NEW supervisor (the restart)
+    picks up an in-flight job by id and completes it — in-flight leases
+    keep running under the new watcher."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial(tmp_path / "oracle", left, right, buckets=4)
+    sup1 = DistSupervisor(str(board), conf=dict(CONF))
+    jid = sup1.plan_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=4,
+    )
+    pool = _WorkerPool(board, 2)
+    try:
+        # wait until SOME work is in flight/done, then "crash" sup1
+        deadline = time.monotonic() + 30
+        while sup1.board.done_count(sup1.board.list_tasks()) == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        del sup1
+        sup2 = DistSupervisor(str(board), conf=dict(CONF))
+        got = sup2.wait_job(jid, timeout=60)
+        assert got.equals(serial)
+        audit = sup2.audit_job(jid)
+        assert audit["rows_lost"] == 0 and audit["rows_double_counted"] == 0
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# the network-partitioned exchange: remote fetch + orphan recovery
+# ---------------------------------------------------------------------------
+
+
+def test_remote_fragment_fetch_over_http(tmp_path):
+    """fetch=remote forces every foreign fragment over the producer's
+    /dist/fetch route — the true multi-host shape — and the result stays
+    bit-identical."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial(tmp_path / "oracle", left, right, buckets=4)
+    conf = dict(CONF, **{"fugue.tpu.dist.fetch": "remote"})
+    producer = DistWorker(str(board), "wp", conf=conf, start_http=True).start()
+    consumer = DistWorker(str(board), "wc", conf=conf, start_http=True).start()
+    try:
+        sup = DistSupervisor(str(board), conf=conf)
+        jid = sup.plan_join_job(
+            left, right, ["k"], _reduce, combine_fn=_combine,
+            map_left=_map_left, buckets=4,
+        )
+        for tid in sup.board.list_tasks():
+            if "-m-" in tid:
+                assert producer.run_task(tid)
+        for tid in sup.board.list_tasks():
+            if "-r-" in tid:
+                assert consumer.run_task(tid)
+        got = sup.wait_job(jid, timeout=30)
+        assert got.equals(serial)
+        assert consumer.stats.get("fragments_remote") > 0
+        assert consumer.stats.get("fragments_local") == 0
+        audit = sup.audit_job(jid)
+        assert audit["rows_lost"] == 0 and audit["rows_double_counted"] == 0
+    finally:
+        producer.stop()
+        consumer.stop()
+
+
+def test_orphaned_fragment_recovery_dead_producer(tmp_path):
+    """The producer dies AFTER completing its maps but before consumers
+    fetched: the consumer proves the fragments unreachable, invalidates
+    the producer's done records (orphan recovery — the remote-fetch
+    extension of PR 8 torn-bucket recovery), re-runs the maps itself and
+    the job still completes bit-identically."""
+    from fugue_tpu.dist.worker import BucketUnavailableError
+
+    left, right = _write_inputs(tmp_path, n_left=2, n_right=1)
+    board = tmp_path / "board"
+    serial = _serial(tmp_path / "oracle", left, right, buckets=2)
+    conf = dict(CONF, **{"fugue.tpu.dist.fetch": "remote"})
+    producer = DistWorker(str(board), "wp", conf=conf, start_http=True).start()
+    consumer = DistWorker(str(board), "wc", conf=conf, start_http=True)
+    consumer.start()
+    sup = DistSupervisor(str(board), conf=conf)
+    jid = sup.plan_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=2,
+    )
+    map_tids = [t for t in sup.board.list_tasks() if "-m-" in t]
+    for tid in map_tids:
+        assert producer.run_task(tid)
+    # kill the producer the hard way: HTTP gone, heartbeat goes stale
+    producer._rpc.stop_server()
+    producer.heartbeat.stop(remove=False)
+    time.sleep(0.7)
+    rtid = f"{jid}-r-0000"
+    with pytest.raises(BucketUnavailableError) as ei:
+        consumer._execute_reduce(consumer.board.read_task(rtid))
+    assert classify_failure(ei.value) is FailureCategory.TRANSIENT
+    assert consumer.stats.get("orphaned_outputs_recovered") >= 1
+    # at least one producer done record was invalidated for re-dispatch
+    assert any(sup.board.read_done(t) is None for t in map_tids)
+    # the consumer (a live worker) re-runs the orphaned maps + reduces
+    pool_stop = os.path.join(str(board), "_stop")
+    t = threading.Thread(
+        target=consumer.serve_forever, kwargs={"stop_file": pool_stop}, daemon=True
+    )
+    t.start()
+    try:
+        got = sup.wait_job(jid, timeout=60)
+        assert got.equals(serial)
+        audit = sup.audit_job(jid)
+        assert audit["rows_lost"] == 0 and audit["rows_double_counted"] == 0
+    finally:
+        with open(pool_stop, "w") as f:
+            f.write("stop")
+        t.join(timeout=10)
+        consumer.stop()
+        producer.stop()
+
+
+# ---------------------------------------------------------------------------
+# failure taxonomy + fault sites
+# ---------------------------------------------------------------------------
+
+
+def test_dist_lease_fault_site_transient_retry(tmp_path):
+    left, right = _write_inputs(tmp_path, n_left=1, n_right=1)
+    board = tmp_path / "board"
+    conf = dict(CONF, **{"fugue.tpu.fault.plan": "dist.lease=error@1"})
+    w = DistWorker(str(board), "w0", conf=conf, start_http=False)
+    sup = DistSupervisor(str(board), conf=dict(CONF))
+    jid = sup.plan_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=1,
+    )
+    tid = f"{jid}-m-left-0000"
+    # first attempt eats the injected fault: failure recorded TRANSIENT,
+    # lease released on unwind
+    assert not w.run_task(tid)
+    fails = sup.board.failures(tid)
+    assert len(fails) == 1 and fails[0]["category"] == "transient"
+    assert sup.leases.read(tid) is None
+    # the budget is spent: the next scan retries and succeeds
+    assert w.poll_once()
+    assert sup.board.read_done(tid) is not None
+
+
+def test_poison_task_aborts_job_with_report(tmp_path):
+    left, right = _write_inputs(tmp_path, n_left=1, n_right=1)
+    board = tmp_path / "board"
+
+    def bad_map(pdf):
+        raise ValueError("deterministically broken")
+
+    pool = _WorkerPool(board, 1)
+    try:
+        sup = DistSupervisor(str(board), conf=dict(CONF))
+        with pytest.raises(DistJobError) as ei:
+            sup.run_join_job(
+                left, right, ["k"], _reduce, combine_fn=_combine,
+                map_left=bad_map, buckets=1, timeout=30,
+            )
+        assert "poison" in str(ei.value)
+        assert any("ValueError" in "".join(v) for v in ei.value.report.values())
+        # workers stop touching a poisoned task (no retry storm)
+        time.sleep(0.2)
+        n = len(
+            [
+                f
+                for f in os.listdir(sup.board.fail_dir)
+                if f.endswith(".json")
+            ]
+        )
+        time.sleep(0.3)
+        n2 = len(
+            [
+                f
+                for f in os.listdir(sup.board.fail_dir)
+                if f.endswith(".json")
+            ]
+        )
+        assert n2 == n
+    finally:
+        pool.close()
+
+
+def test_worker_spans_ship_home_with_worker_label(tmp_path):
+    """With tracing on, each task's dist.task span (worker attr) rides
+    its done record and the supervisor ingests it under dist.job — the
+    fork-worker ship-home protocol, across real process boundaries."""
+    from fugue_tpu.obs import get_tracer
+
+    left, right = _write_inputs(tmp_path, n_left=1, n_right=1)
+    board = tmp_path / "board"
+    tracer = get_tracer()
+    tracer.enable()
+    try:
+        tracer.clear()
+        pool = _WorkerPool(board, 1)
+        try:
+            sup = DistSupervisor(str(board), conf=dict(CONF))
+            sup.run_join_job(
+                left, right, ["k"], _reduce, combine_fn=_combine,
+                map_left=_map_left, buckets=2, timeout=60,
+            )
+        finally:
+            pool.close()
+        recs = tracer.records()
+        jobs = [r for r in recs if r["name"] == "dist.job"]
+        tasks = [r for r in recs if r["name"] == "dist.task"]
+        assert len(jobs) == 1
+        # 2 maps + 2 reduces, each labeled with the executing worker
+        assert len(tasks) == 4
+        assert all(t["args"]["worker"] == "w0" for t in tasks)
+        assert {t["args"]["kind"] for t in tasks} == {"map", "reduce"}
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+def test_engine_server_adopts_heartbeat_liveness(tmp_path):
+    """Satellite: an EngineServer with fugue.tpu.dist.heartbeat.dir set
+    beats under its replica_id (what fleet claim stealing reads), and an
+    orderly stop removes the beat."""
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve import EngineServer
+
+    hb_dir = str(tmp_path / "hb")
+    eng = NativeExecutionEngine(
+        {
+            "fugue.tpu.dist.heartbeat.dir": hb_dir,
+            "fugue.tpu.dist.heartbeat.interval_s": 0.05,
+            "fugue.tpu.serve.replica_id": "rX",
+            "fugue.tpu.cache.enabled": False,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    srv = EngineServer(eng).start()
+    try:
+        assert holder_alive("rX", hb_dir, stale_after_s=5.0) is True
+        assert srv.stats()["heartbeat_enabled"] is True
+    finally:
+        srv.stop()
+    assert read_heartbeat(hb_dir, "rX") is None
+
+
+def test_spec_fingerprint_deterministic():
+    a = spec_fingerprint("j", "reduce", 3, ["m1", "m2"])
+    b = spec_fingerprint("j", "reduce", 3, ["m1", "m2"])
+    c = spec_fingerprint("j", "reduce", 4, ["m1", "m2"])
+    assert a == b and a != c
+
+
+def test_kill_switch_restores_single_process_bit_identically(tmp_path):
+    """fugue.tpu.dist.enabled=false routes run_join_job through the
+    serial path: no tasks on the board, no workers needed, result
+    identical to the distributed one."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial_board = tmp_path / "serial_board"
+    pool = _WorkerPool(board, 2)
+    try:
+        sup = DistSupervisor(str(board), conf=dict(CONF))
+        dist = sup.run_join_job(
+            left, right, ["k"], _reduce, combine_fn=_combine,
+            map_left=_map_left, buckets=4, timeout=60,
+        )
+    finally:
+        pool.close()
+    off = DistSupervisor(
+        str(serial_board), conf=dict(CONF, **{"fugue.tpu.dist.enabled": False})
+    )
+    serial = off.run_join_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=4,
+    )
+    assert dist.equals(serial)
+    assert off.board.list_tasks() == []  # nothing ever hit the board

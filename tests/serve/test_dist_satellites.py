@@ -1,0 +1,190 @@
+"""ISSUE 14 serve-side satellites: journal compaction (replay parity,
+auto-threshold) and the structured ``worker_lost`` error taxonomy on the
+/serve result channel and FleetClient failover set."""
+
+import os
+import socket
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.resilience import FailureCategory, WorkerLostError, classify_failure
+from fugue_tpu.serve import (
+    EngineServer,
+    FleetClient,
+    ServeHttpClient,
+    ServeWorkerLost,
+    SubmissionJournal,
+)
+
+
+def _fill(j: SubmissionJournal, n_done: int, n_open: int) -> None:
+    for i in range(n_done):
+        j.admit(f"d{i}", f"idem-d{i}", "t", 5, 0, None)
+        j.exec_start(f"d{i}", f"key-{i}")
+        j.done(f"d{i}", "done")
+    for i in range(n_open):
+        j.admit(f"o{i}", f"idem-o{i}", "t", 5, 0, None)
+
+
+# ---------------------------------------------------------------------------
+# journal compaction
+# ---------------------------------------------------------------------------
+
+
+def test_journal_compaction_replay_parity(tmp_path):
+    path = str(tmp_path / "r0.jsonl")
+    j = SubmissionJournal(path, "r0")
+    _fill(j, n_done=20, n_open=3)
+    before = j.unfinished()
+    size_before = os.path.getsize(path)
+    dropped = j.compact()
+    assert dropped == 20 * 3  # admit+exec+done per finished sid
+    assert os.path.getsize(path) < size_before
+    # the ONLY contract: replay semantics are unchanged
+    assert j.unfinished() == before
+    assert [r["sid"] for r in before] == ["o0", "o1", "o2"]
+    # appends keep working after the fd swap, into the compacted file
+    j.done("o0", "done")
+    assert [r["sid"] for r in j.unfinished()] == ["o1", "o2"]
+    assert j.compactions == 1
+    j.close()
+
+
+def test_journal_compaction_noop_when_nothing_finished(tmp_path):
+    j = SubmissionJournal(str(tmp_path / "r0.jsonl"), "r0")
+    _fill(j, n_done=0, n_open=4)
+    assert j.compact() == 0
+    assert len(j.unfinished()) == 4
+    j.close()
+
+
+def test_journal_auto_compaction_past_threshold(tmp_path):
+    path = str(tmp_path / "r0.jsonl")
+    j = SubmissionJournal(path, "r0", max_bytes=2048)
+    # lots of finished records blow past the threshold; the size check
+    # runs every _COMPACT_CHECK_EVERY appends
+    _fill(j, n_done=80, n_open=2)
+    assert j.compactions >= 1
+    assert os.path.getsize(path) <= 2048 + 1024  # shrunk back to ~open set
+    assert [r["sid"] for r in j.unfinished()] == ["o0", "o1"]
+    j.close()
+
+
+def test_journal_crash_mid_compaction_keeps_old_file(tmp_path):
+    """The compaction publish is atomic: a temp file dying before the
+    rename leaves the complete original WAL."""
+    path = str(tmp_path / "r0.jsonl")
+    j = SubmissionJournal(path, "r0")
+    _fill(j, n_done=5, n_open=2)
+    before = j.unfinished()
+    # simulate the crash window: a leftover temp file is just litter
+    with open(path + ".__compact_999999", "w") as f:
+        f.write('{"op": "admit"')
+    assert j.unfinished() == before
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# worker_lost taxonomy
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_result_on_dead_replica_raises_structured_worker_lost():
+    cl = ServeHttpClient("127.0.0.1", _free_port(), connect_timeout=0.2)
+    with pytest.raises(ServeWorkerLost) as ei:
+        cl.result("sub-123", timeout=5)
+    err = ei.value
+    assert err.code == "worker_lost"
+    assert err.submission_id == "sub-123"
+    # the PR 1 taxonomy sees a retryable WORKER_LOST, never POISON
+    assert classify_failure(err) is FailureCategory.WORKER_LOST
+    # FleetClient fails these over (same idempotency key, new replica)
+    assert isinstance(err, FleetClient._FAILOVER_ERRORS)
+    # the pre-taxonomy unknown-id contract still holds
+    assert isinstance(err, KeyError)
+    with pytest.raises(ServeWorkerLost):
+        cl.poll("sub-123")
+
+
+def test_result_unknown_id_on_live_replica_is_worker_lost(tmp_path):
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            "fugue.tpu.cache.enabled": False,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    try:
+        srv = EngineServer(eng).start()
+        rpc.bind_serve(srv)
+        cl = ServeHttpClient(rpc.host, rpc.port)
+        with pytest.raises(ServeWorkerLost) as ei:
+            cl.result("never-admitted")
+        assert ei.value.code == "worker_lost"
+        srv.stop()
+    finally:
+        rpc.stop()
+
+
+def test_poll_payload_carries_error_code_taxonomy():
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            "fugue.tpu.cache.enabled": False,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    try:
+        srv = EngineServer(eng).start()
+        rpc.bind_serve(srv)
+        cl = ServeHttpClient(rpc.host, rpc.port)
+
+        def bad_dag():
+            def boom(pdf: pd.DataFrame) -> pd.DataFrame:
+                raise ValueError("deterministic")
+
+            dag = FugueWorkflow()
+            (
+                dag.df(pd.DataFrame({"k": [1], "v": [1.0]}))
+                .transform(boom, schema="*")
+                .yield_dataframe_as("r", as_local=True)
+            )
+            return dag
+
+        sub = cl.submit(bad_dag, tenant="t")
+        with pytest.raises(ValueError):
+            cl.result(sub["id"], timeout=60)
+        poll = cl.poll(sub["id"])
+        assert poll["status"] == "failed"
+        # a deterministic user-code failure is POISON: a caller must NOT
+        # retry it elsewhere (vs worker_lost, which it should)
+        assert poll["error_code"] == "poison"
+        srv.stop()
+    finally:
+        rpc.stop()
+
+
+def test_worker_lost_is_retryable_poison_is_not():
+    lost = ServeWorkerLost("replica died", submission_id="s")
+    assert isinstance(lost, WorkerLostError)
+    from fugue_tpu.resilience import RetryPolicy
+
+    pol = RetryPolicy(max_attempts=3)
+    assert pol.should_retry(classify_failure(lost), 1)
+    assert not pol.should_retry(classify_failure(ValueError("poison")), 1)

@@ -277,8 +277,12 @@ def test_store_two_process_publish_race(tmp_path):
     doc = json.load(open(path))  # parses -- never torn
     plans = doc["tuning"]["plans"]
     assert set(plans) <= {"fp_0", "fp_1"} and len(plans) >= 1
+    # the documented race contract (store.py): the LAST writer's own
+    # entry is its final value; the other entry may lose at most its
+    # newest few publishes to last-writer-wins — never its integrity
+    assert any(e["streams"]["s"]["chunk_rows"] == 25 for e in plans.values())
     for e in plans.values():
-        assert e["streams"]["s"]["chunk_rows"] == 25
+        assert 1 <= e["streams"]["s"]["chunk_rows"] <= 25
 
 
 # ---------------------------------------------------------------------------
