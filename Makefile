@@ -5,13 +5,17 @@
 install:
 	pip install -e . --no-deps --no-build-isolation
 
+# the four smoke gates below are non-blocking in `make test` (their
+# dedicated targets stay blocking) — but a failure must never be SILENT:
+# each emits a one-line WARNING so a regressed chaos/perf gate is visible
+# in CI logs instead of scrolling past as an ignored make error
 test:
 	python -m pytest tests/ -q
 	python tools/lint_locks.py --strict         # concurrency audit; BLOCKING (ISSUE 12)
-	-@$(MAKE) --no-print-directory bench-smoke  # perf report; non-blocking here
-	-@$(MAKE) --no-print-directory serve-smoke  # serving gate; non-blocking here
-	-@$(MAKE) --no-print-directory fleet-smoke  # fleet chaos gate; non-blocking here
-	-@$(MAKE) --no-print-directory dist-smoke   # worker-tier chaos gate; non-blocking here
+	-@$(MAKE) --no-print-directory bench-smoke  || echo "WARNING: bench-smoke FAILED (non-blocking in 'make test'); run 'make bench-smoke' to reproduce"
+	-@$(MAKE) --no-print-directory serve-smoke  || echo "WARNING: serve-smoke FAILED (non-blocking in 'make test'); run 'make serve-smoke' to reproduce"
+	-@$(MAKE) --no-print-directory fleet-smoke  || echo "WARNING: fleet-smoke FAILED (non-blocking in 'make test'); run 'make fleet-smoke' to reproduce"
+	-@$(MAKE) --no-print-directory dist-smoke   || echo "WARNING: dist-smoke FAILED (non-blocking in 'make test'); run 'make dist-smoke' to reproduce"
 
 # downsized perf gate (≤~30s): device-aggregate worker only, fails when the
 # oracle-normalized groupby_aggregate vs_baseline drops >20% below the

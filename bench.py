@@ -1583,6 +1583,172 @@ def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> d
     }
 
 
+def _bench_shuffle_pipeline(
+    budget_bytes: int = 1 << 20, rows: int = 700_000, runs: int = 2
+) -> dict:
+    """Pipelined out-of-core exchange case (ISSUE 15, docs/shuffle.md
+    "Pipelined exchange"): the SAME over-budget join as
+    ``extra.shuffle_join`` (both sides ~10x a 1MiB device budget), run
+    A/B — the overlapped pipeline (write-behind spill + mem-resident
+    bucket tier + bucket-pair prefetch/grouping) against the
+    ``fugue.tpu.shuffle.pipeline.enabled=false`` phase-barrier
+    kill-switch. Gates (exit 17):
+
+    - pipelined >= 1.3x the phase-barrier wall (best of ``runs`` each,
+      so one-off compiles don't decide the ratio);
+    - results bit-identical across the switch AND to the pandas oracle;
+    - the pipelined ``peak_device_bytes`` — with in-flight prefetched
+      pairs counted via ``jax.live_arrays`` on BOTH pipeline threads —
+      stays UNDER the budget, and within 1.1x of the committed smoke
+      baseline's recording when one exists (regression fence);
+    - the kill-switch run's span multiset is exactly the serial shape
+      (one engine.join, one shuffle.partition per side, one
+      shuffle.bucket per bucket) — the "restores PR 8" proof.
+    """
+    import numpy as _np
+    import pandas as _pd
+
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.obs import get_tracer
+
+    rng = _np.random.default_rng(8)
+    kmax = rows * 3
+    left = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "a": rng.normal(size=rows)}
+    )
+    right = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "b": rng.normal(size=rows)}
+    )
+    side_bytes = int(left.memory_usage(index=False).sum())
+
+    def _run(pipe: bool, trace: bool) -> dict:
+        eng = JaxExecutionEngine(
+            {
+                FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget_bytes,
+                FUGUE_TPU_CONF_CACHE_ENABLED: False,
+                FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: pipe,
+            }
+        )
+        l, r = eng.to_df(left), eng.to_df(right)
+        tracer = get_tracer()
+        was_enabled = tracer.enabled
+        mark = tracer.mark()
+        if trace:
+            tracer.enable()
+        spans: dict = {}
+        bucket_span_ids = []
+        walls = []
+        got = None
+        try:
+            for n in range(runs):
+                t0 = time.perf_counter()
+                res = eng.join(l, r, how="inner", on=["k"])
+                tbl = res.as_arrow()
+                walls.append(time.perf_counter() - t0)
+                if got is None:
+                    got = (
+                        tbl.replace_schema_metadata(None)
+                        .to_pandas()
+                        .sort_values(["k", "a", "b"])
+                        .reset_index(drop=True)
+                    )
+                if trace and n == 0:
+                    for rec in tracer.take_since(mark):
+                        spans[rec["name"]] = spans.get(rec["name"], 0) + 1
+                        if rec["name"] == "shuffle.bucket":
+                            bucket_span_ids.append(rec["args"].get("bucket"))
+                    if not was_enabled:
+                        tracer.disable()  # only the first run is traced
+        finally:
+            if not was_enabled:
+                tracer.disable()
+        st = eng.stats()["shuffle"]
+        return {
+            "wall_s": round(min(walls), 3),
+            "walls": [round(w, 3) for w in walls],
+            "frame": got,
+            "spans": spans,
+            "bucket_span_ids": bucket_span_ids,
+            "stats": {k: int(v) for k, v in st.items()},
+        }
+
+    pipe = _run(True, trace=False)
+    barrier = _run(False, trace=True)
+    oracle = (
+        left.merge(right, on="k")[list(pipe["frame"].columns)]
+        .sort_values(["k", "a", "b"])
+        .reset_index(drop=True)
+    )
+    parity_switch = bool(pipe["frame"].equals(barrier["frame"]))
+    parity_oracle = bool(
+        pipe["frame"].equals(oracle.astype(pipe["frame"].dtypes.to_dict()))
+    )
+    speedup = round(barrier["wall_s"] / max(pipe["wall_s"], 1e-9), 2)
+    peak = pipe["stats"]["peak_device_bytes"]
+    peak_over_budget = round(peak / budget_bytes, 3)
+    # the serial shape: one join, one partition per side, one bucket span
+    # per bucket id 0..P-1 in order — PR 8's exact span multiset
+    ids = barrier["bucket_span_ids"]
+    serial_spans_ok = bool(
+        barrier["spans"].get("engine.join") == 1
+        and barrier["spans"].get("shuffle.partition") == 2
+        and ids == list(range(len(ids)))
+        and len(ids) > 0
+        and barrier["stats"]["mem_buckets"] == 0
+        and barrier["stats"]["group_joins"] == 0
+    )
+    # regression fence: the committed smoke baseline records the honest
+    # pipelined peak (prefetched pairs counted); future changes must not
+    # creep past 1.1x of it
+    peak_fence = 1.0
+    try:
+        with open(os.path.join(REPO_ROOT, "BENCH_SMOKE_BASELINE.json")) as f:
+            recorded = json.load(f).get("shuffle_pipeline", {}).get(
+                "peak_over_budget"
+            )
+        if recorded:
+            peak_fence = min(1.0, 1.1 * float(recorded))
+    except Exception:
+        pass
+    return {
+        "rows_per_side": rows,
+        "side_over_budget": round(side_bytes / budget_bytes, 2),
+        "device_budget_bytes": budget_bytes,
+        "pipelined_wall_s": pipe["wall_s"],
+        "barrier_wall_s": barrier["wall_s"],
+        "speedup": speedup,
+        "peak_device_bytes": peak,
+        "peak_over_budget": peak_over_budget,
+        "peak_fence": peak_fence,
+        "barrier_peak_over_budget": round(
+            barrier["stats"]["peak_device_bytes"] / budget_bytes, 3
+        ),
+        "mem_buckets": pipe["stats"]["mem_buckets"],
+        "mem_bucket_bytes": pipe["stats"]["mem_bucket_bytes"],
+        "mem_demotions": pipe["stats"]["mem_demotions"],
+        "group_joins": pipe["stats"]["group_joins"],
+        "bucket_joins": pipe["stats"]["bucket_joins"],
+        "barrier_spans": barrier["spans"],
+        "parity_switch": parity_switch,
+        "parity_oracle": parity_oracle,
+        "serial_spans_ok": serial_spans_ok,
+        "correct": bool(
+            speedup >= 1.3
+            and parity_switch
+            and parity_oracle
+            and 0 < peak_over_budget <= peak_fence
+            and serial_spans_ok
+            and pipe["stats"]["pipelined_joins"] >= 1
+            and pipe["stats"]["mem_buckets"] > 0
+        ),
+    }
+
+
 def _bench_adaptive_tuning(
     rows: int = 400_000,
     misconf_chunk: int = 2048,
@@ -2752,6 +2918,14 @@ def _smoke() -> None:
     # device budget; must finish under budget, bit-identical to the host
     # oracle, with zero broadcast-strategy joins
     shuffle_case = _bench_shuffle_join(budget_bytes=1 << 20, rows=700_000)
+    # pipelined exchange (ISSUE 15): the same over-budget join A/B'd
+    # against the fugue.tpu.shuffle.pipeline.enabled=false kill-switch;
+    # must be >=1.3x, bit-identical both across the switch and to the
+    # oracle, peak (with prefetched pairs counted) under the budget and
+    # the kill-switch span multiset exactly the PR 8 serial shape
+    shuffle_pipeline_case = _bench_shuffle_pipeline(
+        budget_bytes=1 << 20, rows=700_000
+    )
     # UDF auto-trace (ISSUE 11): an untouched plain-pandas UDF must reach
     # >=5x over the interpreted path via analyzer translation — one
     # fused/lowered jit entry, zero per-verb launches, bit-identical
@@ -2778,6 +2952,7 @@ def _smoke() -> None:
         "delta_cache": delta_case,
         "segment_lowering": segment_case,
         "shuffle_join": shuffle_case,
+        "shuffle_pipeline": shuffle_pipeline_case,
         "udf_trace": udf_case,
         "adaptive_tuning": tuning_case,
         "wall_s": round(time.perf_counter() - t0, 1),
@@ -2804,6 +2979,8 @@ def _smoke() -> None:
         raise SystemExit(13)  # 12 is the serve gate
     if not tuning_case["correct"]:
         raise SystemExit(14)
+    if not shuffle_pipeline_case["correct"]:
+        raise SystemExit(17)  # 15/16 are the fleet/dist chaos gates
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -3412,6 +3589,11 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # >=10x an 8MiB device budget, joined bucket-at-a-time
                     # from on-disk hash buckets under the budget
                     "shuffle_join": _bench_shuffle_join(),
+                    # pipelined exchange (ISSUE 15): the over-budget
+                    # spill join A/B'd against the phase-barrier
+                    # kill-switch — write-behind spill + mem-resident
+                    # bucket tier + bucket-pair prefetch/grouping
+                    "shuffle_pipeline": _bench_shuffle_pipeline(),
                     # multi-tenant serving (ISSUE 10): 8 clients × 4
                     # tenants × mixed workloads through one EngineServer
                     # with in-flight dedup, per-tenant p50/p99 + rows/s

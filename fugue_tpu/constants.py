@@ -216,6 +216,29 @@ FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET = "fugue.tpu.shuffle.device_budget_bytes"
 # (default: ops/join.py MAX_BROADCAST_ROWS). Conf-driven so deployments
 # can trade replication memory against exchange latency per mesh.
 FUGUE_TPU_CONF_JOIN_BROADCAST_MAX_ROWS = "fugue.tpu.join.broadcast_max_rows"
+# --- pipelined out-of-core exchange (docs/shuffle.md "Pipelined
+# exchange") --- kill-switch for the overlapped spill pipeline:
+# write-behind bucket writes, the memory-resident bucket tier, and
+# bucket-pair prefetch + budget-bounded pair grouping in the spill join.
+# =false restores the strict phase-barrier PR 8 path bit-identically
+# (identical span multisets, identical per-bucket chunking).
+FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED = "fugue.tpu.shuffle.pipeline.enabled"
+# host-byte ledger for the memory-resident bucket tier: buckets whose
+# accumulated arrow bytes fit this budget never touch disk (demoted
+# largest-first under pressure; demoted buckets keep the full
+# write+publish+recovery discipline). 0/unset = auto (1/16 of host
+# MemTotal, capped at 256MiB); negative disables the tier.
+FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES = "fugue.tpu.shuffle.mem_bucket_bytes"
+# bucket-pair prefetch depth for the spill join's consumer: the producer
+# reads+decodes+pads+device-ingests pair group i+1 while the kernel runs
+# group i. unset = the stream prefetcher's auto default (0 on single-core
+# cpu-mesh hosts, where a producer thread only steals consumer time);
+# <=0 = consume serially (still grouped + mem-tiered when the pipeline
+# is enabled).
+FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH = "fugue.tpu.shuffle.prefetch_depth"
+# bounded write-behind queue depth (bucket batches in flight to the
+# background spill writer thread before the partitioner blocks)
+FUGUE_TPU_CONF_SHUFFLE_WRITEBEHIND_DEPTH = "fugue.tpu.shuffle.writebehind_depth"
 
 # --- multi-tenant serving layer (fugue_tpu/serve, docs/serving.md) ---
 # concurrent workflow executions one EngineServer runs at a time (its
@@ -323,6 +346,13 @@ FUGUE_TPU_CONF_DIST_BUCKETS = "fugue.tpu.dist.buckets"
 FUGUE_TPU_CONF_DIST_SPECULATIVE_AFTER_S = "fugue.tpu.dist.speculative_after_s"
 # supervisor/worker poll cadence over the shared board
 FUGUE_TPU_CONF_DIST_POLL_S = "fugue.tpu.dist.poll_s"
+# reduce-side fragment prefetch depth: fragments for a bucket are fetched
+# (local read or remote /dist/fetch) through a depth-bounded background
+# producer so network/disk fetch of fragment i+1 overlaps the decode and
+# reduce compute of fragment i. <=0 = fetch serially (the pre-pipeline
+# shape); default 2 (network fetch releases the GIL, so the overlap is
+# real even on single-core hosts).
+FUGUE_TPU_CONF_DIST_FETCH_PREFETCH_DEPTH = "fugue.tpu.dist.fetch_prefetch_depth"
 
 # --- cost-based adaptive execution (fugue_tpu/tuning, docs/tuning.md) ---
 # Feedback layer that re-derives stream chunk size / prefetch depth and

@@ -163,6 +163,7 @@ class DistWorker:
     ):
         from ..constants import (
             FUGUE_TPU_CONF_DIST_FETCH,
+            FUGUE_TPU_CONF_DIST_FETCH_PREFETCH_DEPTH,
             FUGUE_TPU_CONF_DIST_HB_INTERVAL_S,
             FUGUE_TPU_CONF_DIST_HB_STALE_S,
             FUGUE_TPU_CONF_DIST_LEASE_S,
@@ -177,6 +178,12 @@ class DistWorker:
         self.lease_s = float(c.get(FUGUE_TPU_CONF_DIST_LEASE_S, 15.0))
         self.poll_s = max(0.005, float(c.get(FUGUE_TPU_CONF_DIST_POLL_S, 0.05)))
         self.fetch_mode = str(c.get(FUGUE_TPU_CONF_DIST_FETCH, "auto"))
+        # reduce-side fragment prefetch (docs/distributed.md): fetch of
+        # fragment i+1 (HTTP /dist/fetch or local read) overlaps the
+        # decode+reduce of fragment i; <=0 restores serial fetches
+        self.fetch_prefetch_depth = int(
+            c.get(FUGUE_TPU_CONF_DIST_FETCH_PREFETCH_DEPTH, 2)
+        )
         hb_interval = float(
             c.get(FUGUE_TPU_CONF_DIST_HB_INTERVAL_S, DEFAULT_INTERVAL_S)
         )
@@ -431,24 +438,49 @@ class DistWorker:
         for side, ex in spec["exchanges"].items():
             frames: List[pd.DataFrame] = []
             consumed[side] = {}
-            for ptid in ex["producers"]:
-                rec = self.board.read_done(ptid)
-                if rec is None:
-                    # the producer was invalidated after our dep check —
-                    # transient by definition, re-scan will wait on it
-                    raise BucketUnavailableError(
-                        f"producer {ptid} has no done record (invalidated "
-                        "mid-read); re-dispatching"
-                    )
-                frag = (rec.get("fragments") or {}).get(str(bucket))
-                if frag is None:
-                    consumed[side][ptid] = 0
-                    continue
-                tbl, was_remote = self._fetch_fragment(rec, frag, ptid)
-                frames.append(tbl.to_pandas())
-                consumed[side][ptid] = int(tbl.num_rows)
-                remote += int(was_remote)
-                local += int(not was_remote)
+            # fragment fetches flow through the PR 2 prefetcher: the
+            # producer thread pulls fragment i+1 over /dist/fetch (or
+            # reads it locally) while this thread decodes and reduces
+            # fragment i — network wait overlaps reduce compute. Fetch
+            # failures (BucketUnavailableError and friends) re-raise
+            # here with their original traceback; depth<=0 is the serial
+            # pre-pipeline shape.
+            from ..jax.pipeline import maybe_prefetch
+
+            def fetch(producers: List[str]) -> Any:
+                for ptid in producers:
+                    rec = self.board.read_done(ptid)
+                    if rec is None:
+                        # the producer was invalidated after our dep
+                        # check — transient by definition, re-scan will
+                        # wait on it
+                        raise BucketUnavailableError(
+                            f"producer {ptid} has no done record "
+                            "(invalidated mid-read); re-dispatching"
+                        )
+                    frag = (rec.get("fragments") or {}).get(str(bucket))
+                    if frag is None:
+                        yield ptid, None, False
+                        continue
+                    tbl, was_remote = self._fetch_fragment(rec, frag, ptid)
+                    yield ptid, tbl, was_remote
+
+            it = maybe_prefetch(
+                fetch(list(ex["producers"])),
+                self.fetch_prefetch_depth,
+                verb="dist.fetch",
+            )
+            try:
+                for ptid, tbl, was_remote in it:
+                    if tbl is None:
+                        consumed[side][ptid] = 0
+                        continue
+                    frames.append(tbl.to_pandas())
+                    consumed[side][ptid] = int(tbl.num_rows)
+                    remote += int(was_remote)
+                    local += int(not was_remote)
+            finally:
+                it.close()
             if frames:
                 pdf = (
                     frames[0].reset_index(drop=True)

@@ -650,7 +650,17 @@ class _TracedChunks:
 
 def _chunk_attrs(item: Any) -> Dict[str, Any]:
     """Cheap rows/bytes attributes for a chunk of any streaming shape
-    (pandas frame, arrow table, (n, device) tuple, LocalDataFrame)."""
+    (pandas frame, arrow table, (n, device) tuple, LocalDataFrame).
+
+    Producers streaming OPAQUE items (the shuffle's ingested bucket-pair
+    groups, the dist worker's fetched fragments) can label them by
+    attaching a ``prefetch_attrs`` dict attribute — it wins over every
+    heuristic below, so their rows/bytes land in ``PipelineStats`` and
+    the per-chunk trace spans without this function learning their
+    shape."""
+    attrs = getattr(item, "prefetch_attrs", None)
+    if isinstance(attrs, dict):
+        return attrs
     try:
         if isinstance(item, tuple) and len(item) > 0 and isinstance(item[0], int):
             return {"rows": item[0]}

@@ -6,6 +6,11 @@ repartition and joins past device memory.
   detection + single-bucket recovery.
 - :mod:`.join` — bucket-at-a-time spill joins over the existing device
   kernels, and spill-based hash repartition.
+- :mod:`.pipeline` — the pipelined-exchange primitives (ISSUE 15):
+  write-behind spill writer, the memory-resident bucket tier's byte
+  ledger, and the per-exchange pipeline context; kill-switch
+  ``fugue.tpu.shuffle.pipeline.enabled=false`` restores the strict
+  phase-barrier path bit-identically.
 - :mod:`.strategy` — the ONE broadcast/copartition/shuffle_spill decision
   rule, shared by plan time (``workflow.explain()``) and run time
   (``engine.join``).
@@ -22,6 +27,7 @@ from .partitioner import (
     spill_partition,
 )
 from .join import shuffle_spill_join, spill_repartition
+from .pipeline import MemBucketLedger, SpillPipeline, SpillWriter
 from .stats import ShuffleStats
 from .strategy import (
     JoinDecision,
@@ -31,9 +37,13 @@ from .strategy import (
     device_budget_bytes,
     estimate_frame_bytes,
     estimate_frame_rows,
+    mem_bucket_cap_bytes,
+    pair_prefetch_depth,
+    pipeline_enabled,
     shuffle_enabled,
     spill_dir_root,
     target_bucket_bytes,
+    writebehind_depth,
 )
 
 __all__ = [
@@ -57,4 +67,11 @@ __all__ = [
     "shuffle_enabled",
     "spill_dir_root",
     "target_bucket_bytes",
+    "MemBucketLedger",
+    "SpillPipeline",
+    "SpillWriter",
+    "mem_bucket_cap_bytes",
+    "pair_prefetch_depth",
+    "pipeline_enabled",
+    "writebehind_depth",
 ]

@@ -52,10 +52,16 @@ from .partitioner import (
     remove_spill_dir,
     spill_partition,
 )
+from .pipeline import MemBucketLedger, SpillPipeline
 from .strategy import (
     bucket_count,
+    device_budget_bytes,
     estimate_frame_bytes,
+    mem_bucket_cap_bytes,
+    pair_prefetch_depth,
+    pipeline_enabled,
     spill_dir_root,
+    writebehind_depth,
 )
 
 __all__ = ["shuffle_spill_join", "spill_repartition"]
@@ -106,6 +112,7 @@ def _spill_side(
     spill_dir: str,
     injector: FaultInjector,
     parent_span: Optional[str],
+    pipeline: Optional[SpillPipeline] = None,
 ) -> SpilledSide:
     from ..jax.streaming import is_stream_frame
     from ..obs import get_tracer
@@ -128,12 +135,15 @@ def _spill_side(
             injector=injector,
             stats=stats,
             replay=replay,
+            pipeline=pipeline,
         )
         sp.set(
             rows=spilled.rows,
             buckets=sum(1 for r in spilled.bucket_rows if r > 0),
             bytes=spilled.bytes_spilled,
         )
+        if pipeline is not None:
+            sp.set(mem_buckets=len(spilled.mem_tables))
     return spilled
 
 
@@ -269,20 +279,37 @@ def shuffle_spill_join(
     n_buckets = (
         tune.bucket_count(conf, est) if tune is not None else bucket_count(conf, est)
     )
+    # pipelined exchange (docs/shuffle.md "Pipelined exchange"): one mem
+    # ledger + write-behind context shared by both sides; the tuner may
+    # substitute a learned pair-prefetch depth / mem-tier budget for this
+    # plan. The kill-switch leaves pipeline=None — the PR 8 phase-barrier
+    # path, byte-identical.
+    pipe_on = pipeline_enabled(conf)
+    pair_depth = pair_prefetch_depth(conf)
+    mem_cap = mem_bucket_cap_bytes(conf)
+    if tune is not None and pipe_on:
+        pair_depth, mem_cap = tune.pipeline_params(conf, pair_depth, mem_cap)
+    stats = getattr(engine, "_shuffle_stats", None)
+    pipeline = (
+        SpillPipeline(MemBucketLedger(mem_cap), writebehind_depth(conf), stats)
+        if pipe_on
+        else None
+    )
     root = spill_dir_root(conf)
     os.makedirs(root, exist_ok=True)
     spill_dir = new_spill_dir(root)
     _track_spill_dir(engine, spill_dir, True)
-    stats = getattr(engine, "_shuffle_stats", None)
     injector = FaultInjector.from_conf(conf)
     tracer = get_tracer()
     parent = tracer.current_span_id()
     try:
         left = _spill_side(
-            engine, df1, "left", keys, kinds, n_buckets, spill_dir, injector, parent
+            engine, df1, "left", keys, kinds, n_buckets, spill_dir, injector,
+            parent, pipeline,
         )
         right = _spill_side(
-            engine, df2, "right", keys, kinds, n_buckets, spill_dir, injector, parent
+            engine, df2, "right", keys, kinds, n_buckets, spill_dir, injector,
+            parent, pipeline,
         )
     except BaseException:
         _track_spill_dir(engine, spill_dir, False)
@@ -363,7 +390,206 @@ def shuffle_spill_join(
 
             _streaming.last_run_stats = dict(run, verb="shuffle_join")
 
-    return LocalDataFrameIterableDataFrame(gen(), schema=out_schema)
+    def gen_pipelined() -> Iterator[Any]:
+        """The overlapped consumer: bucket pairs flow through a
+        depth-bounded producer (the PR 2 prefetcher machinery) that
+        reads+decodes+pads+device-ingests pair group i+1 while the join
+        kernel runs group i. Adjacent device-eligible pairs coalesce
+        into budget-bounded GROUPS — hash partitioning guarantees keys
+        never cross buckets, so ``join(concat Lᵢ, concat Rᵢ) =
+        ⋃ᵢ join(Lᵢ, Rᵢ)`` and one kernel launch covers many tiny
+        buckets. Group size is capped so ``(depth+1)`` in-flight groups
+        stay under half the device budget; the measured peak (sampled on
+        BOTH threads, so in-flight prefetched pairs count) proves it."""
+        from ..jax.pipeline import maybe_prefetch
+        from ..jax.streaming import _device_peak_bytes
+
+        budget = device_budget_bytes(conf)
+        inflight = max(1, pair_depth + 1)
+        bpr_l = left.bytes_spilled / max(left.rows, 1)
+        bpr_r = right.bytes_spilled / max(right.rows, 1)
+        pair_bytes = cap_l * bpr_l + cap_r * bpr_r
+        # group sizing is MEASURED, not guessed: the first group is one
+        # pair (the serial working set, known to fit), and every group's
+        # sampled live-array peak re-derives the target — budget over
+        # 2.5x the RUNNING-MAX per-pair peak per in-flight group, growth
+        # bounded to 2x per step so a skewed bucket can't overshoot.
+        # ``g_max`` is a static guard from the raw ingest estimate:
+        # dup-heavy joins whose expansion output dwarfs their ingest
+        # stay near 1 pair per launch (exactly the serial shape),
+        # because their measured pair peak says so.
+        g_max = max(1, min(64, int(budget / max(1.0, 2.0 * inflight * pair_bytes))))
+        run = {
+            "chunks": 0,
+            "rows": 0,
+            "peak_device_bytes": 0,
+            "buckets": n_buckets,
+            "pairs_per_group": 1,
+        }
+        state = {"pair_peak": 0, "g": 1}
+
+        def _retarget() -> None:
+            pp = state["pair_peak"]
+            if pp <= 0:
+                return
+            g = int(budget / max(1.0, 2.5 * inflight * pp))
+            state["g"] = max(1, min(g_max, g, state["g"] * 2))
+            run["pairs_per_group"] = max(run["pairs_per_group"], state["g"])
+
+        def build(batch: List[Any]) -> Any:
+            lcat = (
+                batch[0][1]
+                if len(batch) == 1
+                else pa.concat_tables([b[1] for b in batch])
+            )
+            rcat = (
+                batch[0][2]
+                if len(batch) == 1
+                else pa.concat_tables([b[2] for b in batch])
+            )
+            jl = _ingest_padded(engine, lcat, cap_l * len(batch))
+            jr = _ingest_padded(engine, rcat, cap_r * len(batch))
+            # sampled on the PRODUCER thread, right after ingest: an
+            # in-flight prefetched group is device-resident from this
+            # moment and must count toward the budget proof
+            peak = _device_peak_bytes()
+            run["peak_device_bytes"] = max(run["peak_device_bytes"], peak)
+            if stats is not None:
+                stats.peak(peak)
+            return ("dev", [b[0] for b in batch], jl, jr, lcat, rcat)
+
+        def produce() -> Iterator[Any]:
+            batch: List[Any] = []
+            for i in range(n_buckets):
+                lt = left.read_bucket(i, stats)
+                rt = right.read_bucket(i, stats)
+                if lt is None and rt is None:
+                    continue
+                if lt is not None and rt is not None:
+                    batch.append((i, lt, rt))
+                    if len(batch) >= state["g"]:
+                        yield build(batch)
+                        batch = []
+                elif jt in ("inner", "left_semi"):
+                    continue  # one side empty ⇒ no matches, no output
+                else:
+                    if batch:  # flush first: outputs stay in bucket order
+                        yield build(batch)
+                        batch = []
+                    yield ("host", i, lt, rt)
+            if batch:
+                yield build(batch)
+
+        it = maybe_prefetch(
+            produce(),
+            pair_depth,
+            stats=getattr(engine, "pipeline_stats", None),
+            verb="shuffle.pairs",
+            stream=tune.sid if tune is not None else "",
+            observer=tune.observe_pair_stream if tune is not None else None,
+        )
+        if stats is not None:
+            stats.inc("pipelined_joins")
+        try:
+            for item in it:
+                if item[0] == "host":
+                    _, i, lt, rt = item
+                    with tracer.span(
+                        "shuffle.bucket", cat="shuffle", parent=parent, bucket=i
+                    ) as sp:
+                        res = _host_bucket_join(
+                            engine, lt, rt, l_schema, r_schema, jt, on
+                        )
+                        out = _to_out_table(res, out_schema)
+                        if stats is not None:
+                            stats.inc("bucket_joins")
+                            stats.inc("bucket_rows_out", out.num_rows)
+                        sp.set(
+                            rows_left=0 if lt is None else lt.num_rows,
+                            rows_right=0 if rt is None else rt.num_rows,
+                            rows_out=out.num_rows,
+                        )
+                else:
+                    _, bids, jl, jr, lcat, rcat = item
+                    item = None  # drop the tuple's device refs: only the
+                    # locals below keep the group alive, and they are
+                    # cleared before the next dequeue
+                    with tracer.span(
+                        "shuffle.bucket",
+                        cat="shuffle",
+                        parent=parent,
+                        bucket=bids[0],
+                        pairs=len(bids),
+                    ) as sp:
+                        res = _device_bucket_join(
+                            engine, jl, jr, jt, on, out_schema
+                        )
+                        if res is None:
+                            # the kernels refuse the whole group (exotic
+                            # dtypes, slot overflow): the host engine is
+                            # the per-bucket oracle and a group is a
+                            # union of disjoint-key buckets, so one host
+                            # join of the concatenations is exact
+                            jl = jr = None
+                            res = _host_bucket_join(
+                                engine, lcat, rcat, l_schema, r_schema, jt, on
+                            )
+                        out = _to_out_table(res, out_schema)
+                        peak = _device_peak_bytes()
+                        run["peak_device_bytes"] = max(
+                            run["peak_device_bytes"], peak
+                        )
+                        state["pair_peak"] = max(
+                            state["pair_peak"], -(-peak // len(bids))
+                        )
+                        _retarget()
+                        rows_l, rows_r = lcat.num_rows, rcat.num_rows
+                        res = jl = jr = lcat = rcat = None  # free eagerly
+                        if stats is not None:
+                            stats.inc("bucket_joins", len(bids))
+                            stats.inc("group_joins")
+                            stats.inc("bucket_rows_out", out.num_rows)
+                            stats.peak(peak)
+                        sp.set(
+                            rows_left=rows_l,
+                            rows_right=rows_r,
+                            rows_out=out.num_rows,
+                        )
+                run["chunks"] += 1
+                run["rows"] += out.num_rows
+                if out.num_rows > 0:
+                    yield ArrowDataFrame(out)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            left.release_mem()
+            right.release_mem()
+            _track_spill_dir(engine, spill_dir, False)
+            remove_spill_dir(spill_dir)
+            if stats is not None:
+                stats.inc("spill_dirs_cleaned")
+            if tune is not None:
+                # the tuner calibrates BUCKET COUNT from a per-pair peak;
+                # normalize the grouped measurement so its target holds
+                tune.observe_run(
+                    state["pair_peak"] or run["peak_device_bytes"],
+                    time.perf_counter() - t_start,
+                )
+                tune.observe_pipeline(
+                    {
+                        "pairs_per_group": run["pairs_per_group"],
+                        "mem_bytes_used": pipeline.ledger.peak_bytes,
+                        "mem_cap_bytes": pipeline.ledger.cap_bytes,
+                        "mem_demotions": pipeline.ledger.demotions,
+                    }
+                )
+            from ..jax import streaming as _streaming
+
+            _streaming.last_run_stats = dict(run, verb="shuffle_join")
+
+    chosen = gen_pipelined() if pipeline is not None else gen()
+    return LocalDataFrameIterableDataFrame(chosen, schema=out_schema)
 
 
 def spill_repartition(
@@ -382,16 +608,26 @@ def spill_repartition(
     n_buckets = int(num) if num and num > 0 else bucket_count(
         conf, estimate_frame_bytes(df)
     )
+    stats = getattr(engine, "_shuffle_stats", None)
+    pipeline = (
+        SpillPipeline(
+            MemBucketLedger(mem_bucket_cap_bytes(conf)),
+            writebehind_depth(conf),
+            stats,
+        )
+        if pipeline_enabled(conf)
+        else None
+    )
     root = spill_dir_root(conf)
     os.makedirs(root, exist_ok=True)
     spill_dir = new_spill_dir(root)
     _track_spill_dir(engine, spill_dir, True)
-    stats = getattr(engine, "_shuffle_stats", None)
     injector = FaultInjector.from_conf(conf)
     parent = get_tracer().current_span_id()
     try:
         side = _spill_side(
-            engine, df, "part", by, kinds, n_buckets, spill_dir, injector, parent
+            engine, df, "part", by, kinds, n_buckets, spill_dir, injector,
+            parent, pipeline,
         )
     except BaseException:
         _track_spill_dir(engine, spill_dir, False)
@@ -415,4 +651,36 @@ def spill_repartition(
             if stats is not None:
                 stats.inc("spill_dirs_cleaned")
 
-    return LocalDataFrameIterableDataFrame(gen(), schema=schema)
+    def gen_pipelined() -> Iterator[Any]:
+        # the pipelined form keeps ONE chunk per bucket (every key lives
+        # in exactly one chunk — the spill-repartition contract) but
+        # reads+decodes bucket i+1 in the background while the consumer
+        # maps bucket i; mem-resident buckets skip disk entirely
+        from ..jax.pipeline import maybe_prefetch
+
+        def produce() -> Iterator[Any]:
+            for i in range(n_buckets):
+                tbl = side.read_bucket(i, stats)
+                if tbl is not None and tbl.num_rows > 0:
+                    yield ArrowDataFrame(tbl)
+
+        it = maybe_prefetch(
+            produce(),
+            pair_prefetch_depth(conf),
+            stats=getattr(engine, "pipeline_stats", None),
+            verb="shuffle.read",
+        )
+        try:
+            yield from it
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            side.release_mem()
+            _track_spill_dir(engine, spill_dir, False)
+            remove_spill_dir(spill_dir)
+            if stats is not None:
+                stats.inc("spill_dirs_cleaned")
+
+    chosen = gen_pipelined() if pipeline is not None else gen()
+    return LocalDataFrameIterableDataFrame(chosen, schema=schema)

@@ -153,7 +153,14 @@ def spill_dir_bytes(paths: Any) -> int:
 
     ``paths`` may be the engine's live spill-dir set, mutated by
     join/repartition threads while the sampler iterates — snapshot it,
-    retrying once if a concurrent add/discard races the copy."""
+    retrying once if a concurrent add/discard races the copy.
+
+    ``*.tmp`` files are EXCLUDED from the walk: a bucket mid-publish
+    briefly has both its tmp and (on republish after recovery) its
+    published file visible, and with write-behind spill the tmp files
+    stay open for the whole partition pass — counting them double-counts
+    the bucket and made the probe report phantom bytes during the
+    temp-write+rename window."""
     dirs: Tuple[str, ...] = ()
     for _ in range(2):
         try:
@@ -165,6 +172,8 @@ def spill_dir_bytes(paths: Any) -> int:
     for d in dirs:
         try:
             for name in os.listdir(d):
+                if name.endswith(".tmp"):
+                    continue
                 try:
                     total += os.path.getsize(os.path.join(d, name))
                 except OSError:
@@ -181,7 +190,15 @@ def spill_dir_bytes(paths: Any) -> int:
 class SpilledSide:
     """P published bucket files plus the ledger needed to read them back
     safely (expected per-bucket row counts) and to recover a damaged one
-    (the replay factory, when the source can be re-iterated)."""
+    (the replay factory, when the source can be re-iterated).
+
+    With the pipelined exchange, some buckets live in the
+    **memory-resident tier** instead of on disk: ``mem_tables`` maps
+    bucket id → accumulated arrow slices whose bytes fit the exchange's
+    ``MemBucketLedger``. ``read_bucket`` serves them without any disk or
+    IPC round-trip; torn/absent-file detection and recovery are
+    unchanged for everything else (a demoted bucket is
+    indistinguishable from a serial one)."""
 
     def __init__(
         self,
@@ -194,6 +211,9 @@ class SpilledSide:
         bucket_rows: List[int],
         bytes_spilled: int,
         replay: Optional[Callable[[], Iterator[pa.Table]]],
+        mem_tables: Optional[Dict[int, List[pa.Table]]] = None,
+        ledger: Any = None,
+        mem_bytes: int = 0,
     ):
         self.spill_dir = spill_dir
         self.side = side
@@ -204,9 +224,20 @@ class SpilledSide:
         self.bucket_rows = bucket_rows
         self.bytes_spilled = bytes_spilled
         self.replay = replay
+        self.mem_tables = mem_tables or {}
+        self.mem_bytes = mem_bytes
+        self._ledger = ledger
 
     def path(self, i: int) -> str:
         return os.path.join(self.spill_dir, f"{self.side}_{i:05d}.arrow")
+
+    def release_mem(self) -> None:
+        """Return this side's memory-resident bytes to the exchange
+        ledger (the consuming stream's ``finally``). Idempotent."""
+        if self._ledger is not None and self.mem_bytes > 0:
+            self._ledger.release(self.mem_bytes)
+            self.mem_bytes = 0
+        self.mem_tables = {}
 
     @property
     def rows(self) -> int:
@@ -219,10 +250,23 @@ class SpilledSide:
     def read_bucket(self, i: int, stats: Any = None) -> Optional[pa.Table]:
         """Bucket ``i`` fully decoded (torn files can't parse), validated
         against the ledger row count; a missing/corrupt bucket is deleted
-        and repartitioned from the source — only that bucket."""
+        and repartitioned from the source — only that bucket. A
+        memory-resident bucket is served straight from its accumulated
+        arrow slices, no disk and no IPC decode."""
         expected = self.bucket_rows[i]
         if expected == 0:
             return None
+        parts = self.mem_tables.get(i)
+        if parts is not None:
+            tbl = parts[0] if len(parts) == 1 else pa.concat_tables(parts)
+            if tbl.num_rows == expected:
+                if stats is not None:
+                    stats.inc("mem_bucket_hits")
+                return tbl
+            # a mem bucket that disagrees with its own ledger can only be
+            # a bug — but recovery is cheap and already exists: fall
+            # through to the disk/replay path below
+            self.mem_tables.pop(i, None)
         path = self.path(i)
         tbl: Optional[pa.Table] = None
         if os.path.exists(path):
@@ -279,6 +323,23 @@ class SpilledSide:
 # the one-pass spill
 # ---------------------------------------------------------------------------
 
+def _chunk_bucket_parts(
+    tbl: pa.Table, keys: List[str], kinds: List[str], n_buckets: int
+) -> Iterator[Tuple[int, pa.Table]]:
+    """One chunk split into its non-empty (bucket_id, slice) parts —
+    the ONE split implementation shared by the serial and pipelined
+    spill paths (stable argsort, schema preserved bit-for-bit)."""
+    ids = bucket_ids(tbl, keys, kinds, n_buckets)
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    bounds = np.searchsorted(sorted_ids, np.arange(n_buckets + 1), side="left")
+    for i in range(n_buckets):
+        lo, hi = bounds[i], bounds[i + 1]
+        if lo == hi:
+            continue
+        yield i, tbl.take(pa.array(order[lo:hi], type=pa.int64()))
+
+
 def spill_partition(
     chunks: Iterator[pa.Table],
     pa_schema: pa.Schema,
@@ -290,10 +351,30 @@ def spill_partition(
     injector: Optional[FaultInjector] = None,
     stats: Any = None,
     replay: Optional[Callable[[], Iterator[pa.Table]]] = None,
+    pipeline: Any = None,
 ) -> SpilledSide:
     """Consume ``chunks`` once, routing rows into ``n_buckets`` spill
     files under ``spill_dir``. Buckets a fault rule tears stay
-    unpublished — the reader repairs them lazily via ``read_bucket``."""
+    unpublished — the reader repairs them lazily via ``read_bucket``.
+
+    ``pipeline`` (a :class:`~fugue_tpu.shuffle.pipeline.SpillPipeline`)
+    switches to the overlapped form: batches go to a write-behind
+    background writer and small buckets stay in the memory-resident
+    tier. ``None`` is the strict PR 8 serial path, byte-identical."""
+    if pipeline is not None:
+        return _spill_partition_pipelined(
+            chunks,
+            pa_schema,
+            keys,
+            kinds,
+            n_buckets,
+            spill_dir,
+            side,
+            injector,
+            stats,
+            replay,
+            pipeline,
+        )
     writers: Dict[int, Any] = {}
     sinks: Dict[int, Any] = {}
     bucket_rows = [0] * n_buckets
@@ -317,19 +398,9 @@ def spill_partition(
             n_chunks += 1
             if tbl.schema != pa_schema:
                 tbl = tbl.cast(pa_schema)
-            ids = bucket_ids(tbl, keys, kinds, n_buckets)
-            order = np.argsort(ids, kind="stable")
-            sorted_ids = ids[order]
-            bounds = np.searchsorted(
-                sorted_ids, np.arange(n_buckets + 1), side="left"
-            )
-            for i in range(n_buckets):
-                lo, hi = bounds[i], bounds[i + 1]
-                if lo == hi:
-                    continue
-                part = tbl.take(pa.array(order[lo:hi], type=pa.int64()))
+            for i, part in _chunk_bucket_parts(tbl, keys, kinds, n_buckets):
                 _writer(i).write_table(part)
-                bucket_rows[i] += int(hi - lo)
+                bucket_rows[i] += part.num_rows
     finally:
         for w in writers.values():
             try:
@@ -373,4 +444,143 @@ def spill_partition(
         bucket_rows,
         bytes_spilled,
         replay,
+    )
+
+
+def _spill_partition_pipelined(
+    chunks: Iterator[pa.Table],
+    pa_schema: pa.Schema,
+    keys: List[str],
+    kinds: List[str],
+    n_buckets: int,
+    spill_dir: str,
+    side: str,
+    injector: Optional[FaultInjector],
+    stats: Any,
+    replay: Optional[Callable[[], Iterator[pa.Table]]],
+    pipeline: Any,
+) -> SpilledSide:
+    """The overlapped spill (docs/shuffle.md "Pipelined exchange"):
+    decode/hash of chunk n+1 overlaps the disk write of chunk n through
+    the bounded write-behind writer, and buckets whose accumulated arrow
+    bytes fit the exchange's mem ledger never touch disk at all.
+
+    Demotion is largest-first: when a batch can't be admitted, the
+    biggest memory-resident bucket moves (in accumulation order, so the
+    on-disk row order matches a serial spill of the same bucket) to the
+    write-behind writer until the batch fits or the tier is empty. The
+    ``shuffle.spill`` fault site fires per bucket either on the writer
+    thread (disk buckets, between write-close and publish) or at mem
+    retention — an injected fault DROPS the mem bucket, the tier's form
+    of a torn publish, and ``read_bucket`` recovers it from the source.
+    """
+    ledger = pipeline.ledger
+    writer: Any = None
+    mem: Dict[int, List[pa.Table]] = {}
+    mem_bytes: Dict[int, int] = {}
+    disk_bound: set = set()
+    bucket_rows = [0] * n_buckets
+    n_chunks = 0
+
+    def _writer() -> Any:
+        nonlocal writer
+        if writer is None:
+            writer = pipeline.writer(spill_dir, side, pa_schema, injector)
+        return writer
+
+    def _demote_one() -> bool:
+        if not mem_bytes:
+            return False
+        j = max(mem_bytes, key=lambda k: mem_bytes[k])
+        for p in mem.pop(j):
+            _writer().submit(j, p)
+        ledger.release(mem_bytes.pop(j))
+        disk_bound.add(j)
+        ledger.note_demotion()
+        if stats is not None:
+            stats.inc("mem_demotions")
+        return True
+
+    try:
+        for tbl in chunks:
+            if tbl.num_rows == 0:
+                continue
+            n_chunks += 1
+            if tbl.schema != pa_schema:
+                tbl = tbl.cast(pa_schema)
+            for i, part in _chunk_bucket_parts(tbl, keys, kinds, n_buckets):
+                bucket_rows[i] += part.num_rows
+                admitted = False
+                nb = int(part.nbytes)
+                if i not in disk_bound:
+                    while True:
+                        if ledger.admit(nb):
+                            admitted = True
+                            break
+                        if not _demote_one():
+                            break
+                if admitted and i in disk_bound:
+                    # the demotion loop evicted THIS bucket while making
+                    # room — a bucket is mem- or disk-resident, never both
+                    ledger.release(nb)
+                    admitted = False
+                if admitted:
+                    mem.setdefault(i, []).append(part)
+                    mem_bytes[i] = mem_bytes.get(i, 0) + nb
+                else:
+                    _writer().submit(i, part)
+                    disk_bound.add(i)
+    except BaseException:
+        if writer is not None:
+            writer.abort()
+        ledger.release(sum(mem_bytes.values()))
+        raise
+
+    published: Dict[int, int] = {}
+    batches = 0
+    try:
+        if writer is not None:
+            published, wfaults, batches = writer.finalize()
+            if stats is not None and wfaults:
+                stats.inc("spill_faults", wfaults)
+    except BaseException:
+        ledger.release(sum(mem_bytes.values()))
+        raise
+    # mem-tier retention: the fault site fires per resident bucket, in
+    # bucket order; a fault drops the bucket (release + lazy recovery)
+    mem_total = 0
+    for i in sorted(mem):
+        try:
+            if injector is not None:
+                injector.fire(SITE_SHUFFLE_SPILL)
+            mem_total += mem_bytes[i]
+        except Exception:
+            ledger.release(mem_bytes[i])
+            del mem[i]
+            del mem_bytes[i]
+            if stats is not None:
+                stats.inc("spill_faults")
+    bytes_spilled = sum(published.values()) + mem_total
+    if stats is not None:
+        stats.inc("partitions")
+        stats.inc("chunks", n_chunks)
+        stats.inc("rows_spilled", sum(bucket_rows))
+        stats.inc("bytes_spilled", bytes_spilled)
+        stats.inc("buckets", sum(1 for r in bucket_rows if r > 0))
+        stats.inc("mem_buckets", len(mem))
+        stats.inc("mem_bucket_bytes", mem_total)
+        stats.inc("writebehind_batches", batches)
+    return SpilledSide(
+        spill_dir,
+        side,
+        pa_schema,
+        keys,
+        kinds,
+        n_buckets,
+        bucket_rows,
+        bytes_spilled,
+        replay,
+        mem_tables=mem,
+        ledger=ledger,
+        mem_bytes=mem_total,
     )

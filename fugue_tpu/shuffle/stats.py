@@ -16,8 +16,8 @@ _COUNTERS = (
     "partitions",  # sides spilled to buckets
     "chunks",  # input chunks consumed by the partitioner
     "rows_spilled",
-    "bytes_spilled",  # on-disk bucket bytes written
-    "buckets",  # bucket files published
+    "bytes_spilled",  # bucket payload bytes routed (disk-encoded + mem-resident)
+    "buckets",  # buckets materialized (disk files + mem-resident)
     "bucket_joins",  # bucket pairs joined
     "bucket_rows_out",
     "bucket_recoveries",  # torn/corrupt/missing buckets repartitioned
@@ -25,6 +25,14 @@ _COUNTERS = (
     "spill_dirs_cleaned",
     "joins_spill",  # joins executed with the spill-shuffle strategy
     "repartitions_spill",
+    # --- pipelined exchange (docs/shuffle.md "Pipelined exchange") ---
+    "pipelined_joins",  # spill joins that ran the overlapped pipeline
+    "group_joins",  # coalesced pair-group kernel launches
+    "mem_buckets",  # buckets retained in the memory-resident tier
+    "mem_bucket_bytes",  # arrow bytes those buckets held (never hit disk)
+    "mem_bucket_hits",  # bucket reads served from the mem tier
+    "mem_demotions",  # mem buckets demoted to disk under ledger pressure
+    "writebehind_batches",  # batches routed through the background writer
 )
 
 

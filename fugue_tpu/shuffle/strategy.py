@@ -29,6 +29,10 @@ from ..constants import (
     FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
     FUGUE_TPU_CONF_SHUFFLE_DIR,
     FUGUE_TPU_CONF_SHUFFLE_ENABLED,
+    FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES,
+    FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED,
+    FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH,
+    FUGUE_TPU_CONF_SHUFFLE_WRITEBEHIND_DEPTH,
 )
 
 __all__ = [
@@ -42,10 +46,16 @@ __all__ = [
     "estimate_frame_bytes",
     "estimate_frame_rows",
     "choose_join_strategy",
+    "pipeline_enabled",
+    "mem_bucket_cap_bytes",
+    "pair_prefetch_depth",
+    "writebehind_depth",
 ]
 
 DEFAULT_BUCKET_BYTES = 1 << 26  # 64 MiB on disk per bucket
 MAX_BUCKETS = 4096
+DEFAULT_MEM_BUCKET_CAP = 1 << 28  # mem-tier auto ledger ceiling: 256 MiB
+DEFAULT_WRITEBEHIND_DEPTH = 8
 
 
 class JoinDecision(NamedTuple):
@@ -121,6 +131,54 @@ def target_bucket_bytes(conf: Any) -> int:
     # measured ~8-14x one bucket's bytes for dup-heavy joins, so default
     # to 1/32 of the budget, floored so tiny budgets stay practical
     return max(1 << 16, min(DEFAULT_BUCKET_BYTES, device_budget_bytes(conf) // 32))
+
+
+def pipeline_enabled(conf: Any) -> bool:
+    """``fugue.tpu.shuffle.pipeline.enabled`` — the pipelined-exchange
+    kill-switch (docs/shuffle.md "Pipelined exchange"). False restores
+    the strict phase-barrier spill path bit-identically."""
+    return bool(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED, True))
+
+
+def mem_bucket_cap_bytes(conf: Any) -> int:
+    """Host-byte ledger cap for the memory-resident bucket tier. 0/unset
+    = auto (1/16 of host MemTotal, at most 256MiB — the tier is a cache,
+    not a license to buffer a whole exchange); negative disables."""
+    raw = int(_conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES, 0) or 0)
+    if raw < 0:
+        return 0
+    if raw > 0:
+        return raw
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return min(DEFAULT_MEM_BUCKET_CAP, int(line.split()[1]) * 1024 // 16)
+    except Exception:
+        pass
+    return DEFAULT_MEM_BUCKET_CAP
+
+
+def pair_prefetch_depth(conf: Any) -> int:
+    """Bucket-pair prefetch depth for the pipelined spill join. Unset →
+    the stream prefetcher's auto default (0 on single-core cpu-mesh
+    hosts, where a producer thread only steals consumer time)."""
+    raw = _conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH, None)
+    if raw is None:
+        from ..jax.pipeline import default_prefetch_depth
+
+        return default_prefetch_depth()
+    return int(raw)
+
+
+def writebehind_depth(conf: Any) -> int:
+    """Bounded write-behind queue depth (bucket batches in flight to the
+    background spill writer before the partitioner blocks)."""
+    d = int(
+        _conf_get(conf, FUGUE_TPU_CONF_SHUFFLE_WRITEBEHIND_DEPTH, 0)
+        or DEFAULT_WRITEBEHIND_DEPTH
+    )
+    return max(1, d)
 
 
 def bucket_count(conf: Any, est_bytes: Optional[int]) -> int:

@@ -57,6 +57,7 @@ __all__ = [
     "describe_tuning",
     "adjust_stream",
     "adjust_buckets",
+    "adjust_pipeline",
 ]
 
 # -- adjustment policy constants (docs/tuning.md "Adjustment policy") -------
@@ -73,6 +74,9 @@ MAX_BUCKET_FACTOR = 8.0
 MIN_BUCKETS_TO_SHRINK = 16  # below this, per-bucket overhead is noise
 PEAK_TARGET_FRACTION = 2  # aim bucket-pair peak at budget/2
 CARDINALITY_MARGIN = 0.2  # republish observed sizes on >20% drift
+PAIR_DEPTH_MAX = 4  # bucket-pair prefetch never queues deeper than this
+MEM_BYTES_MIN = 1 << 26  # learned mem-tier budget floor (64 MiB)
+MEM_BYTES_MAX = 1 << 30  # ... and ceiling (1 GiB)
 
 
 _ADDR_RE = None
@@ -259,6 +263,70 @@ def adjust_buckets(
     }
 
 
+def adjust_pipeline(
+    depth: int, mem_bytes: int, obs: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """Next-generation (pair_depth, mem_bytes) for one pipelined
+    exchange from its observed producer/consumer waits and mem-tier
+    pressure, or None when the run carries no usable signal.
+
+    - consumer starved (the kernel waited on the pair producer far
+      longer than the producer waited on a full queue) → deepen the
+      pair prefetch, up to ``PAIR_DEPTH_MAX``;
+    - producer starved → shallower (floor 0: serial consumption — on a
+      single-core mesh a producer thread only steals consumer time);
+    - demotions under ledger pressure → grow the mem-tier budget
+      (bounded 2x per generation, capped at ``MEM_BYTES_MAX``);
+    - a tier running far under its cap shrinks toward what the exchange
+      actually used, releasing host memory the pipeline can't use.
+    """
+    groups = int(obs.get("pipe_chunks", 0) or 0)
+    wall = float(obs.get("wall_s", 0.0) or 0.0)
+    if groups <= 0 or wall < MIN_SHUFFLE_WALL_S:
+        return None
+    notes: List[str] = []
+    new_depth, new_mem = int(depth), int(mem_bytes)
+    pw = float(obs.get("pipe_producer_wait_s", 0.0) or 0.0)
+    cw = float(obs.get("pipe_consumer_wait_s", 0.0) or 0.0)
+    if cw > max(2.0 * pw, 0.05) and depth < PAIR_DEPTH_MAX and groups > 2 * max(depth, 1):
+        new_depth = min(PAIR_DEPTH_MAX, max(1, depth * 2))
+        notes.append(
+            f"producer-bound (consumer waited {cw:.2f}s vs {pw:.2f}s): "
+            f"pair_depth {depth} -> {new_depth}"
+        )
+    elif pw > max(2.0 * cw, 0.05) and depth > 0:
+        new_depth = depth // 2
+        notes.append(
+            f"consumer-bound (producer waited {pw:.2f}s vs {cw:.2f}s): "
+            f"pair_depth {depth} -> {new_depth}"
+        )
+    demotions = int(obs.get("mem_demotions", 0) or 0)
+    used = int(obs.get("mem_bytes_used", 0) or 0)
+    if demotions > 0 and mem_bytes < MEM_BYTES_MAX:
+        new_mem = min(MEM_BYTES_MAX, max(MEM_BYTES_MIN, mem_bytes * 2))
+        notes.append(
+            f"{demotions} demotions under a {mem_bytes}B ledger: "
+            f"mem_bytes -> {new_mem}"
+        )
+    elif demotions == 0 and 0 < used < mem_bytes // 4 and mem_bytes > MEM_BYTES_MIN:
+        new_mem = max(MEM_BYTES_MIN, used * 2)
+        notes.append(
+            f"tier used {used}B of {mem_bytes}B with no pressure: "
+            f"mem_bytes -> {new_mem}"
+        )
+    converged = new_depth == depth and new_mem == mem_bytes
+    return {
+        "pair_depth": new_depth,
+        "mem_bytes": new_mem,
+        "converged": converged,
+        "evidence": "; ".join(notes)
+        or (
+            f"pipeline balanced: {groups} groups, waits {pw:.2f}s/{cw:.2f}s, "
+            f"tier {used}B/{mem_bytes}B"
+        ),
+    }
+
+
 # -- run scope ---------------------------------------------------------------
 class _Scope:
     """One workflow.run's tuning context: the plan fingerprint, per-kind
@@ -372,13 +440,23 @@ class StreamHandle:
 class ExchangeHandle:
     """One spill join/repartition's calibration + observation funnel."""
 
-    __slots__ = ("scope", "sid", "entry", "used_buckets", "obs")
+    __slots__ = (
+        "scope",
+        "sid",
+        "entry",
+        "used_buckets",
+        "used_pair_depth",
+        "used_mem_bytes",
+        "obs",
+    )
 
     def __init__(self, scope: _Scope, sid: str, entry: Optional[Dict[str, Any]]):
         self.scope = scope
         self.sid = sid
         self.entry = dict(entry or {})
         self.used_buckets = 0
+        self.used_pair_depth = 0
+        self.used_mem_bytes = 0
         self.obs: Dict[str, Any] = {}
         scope.add_exchange(self)
 
@@ -407,6 +485,56 @@ class ExchangeHandle:
             }
         )
         return n
+
+    def pipeline_params(
+        self, conf: Any, static_depth: int, static_mem_bytes: int
+    ) -> Tuple[int, int]:
+        """Resolve the pipelined exchange's pair-prefetch depth and
+        mem-tier budget: the learned values when prior runs of this plan
+        observed the pipeline, the static conf resolution otherwise.
+        Every resolution is recorded as a decision with its evidence."""
+        depth, mem = self.entry.get("pair_depth"), self.entry.get("mem_bytes")
+        if depth is not None or mem is not None:
+            d = int(depth) if depth is not None else int(static_depth)
+            m = int(mem) if mem is not None else int(static_mem_bytes)
+            source = "adaptive"
+            evidence = str(self.entry.get("pipe_evidence", ""))
+        else:
+            d, m = int(static_depth), int(static_mem_bytes)
+            source, evidence = "static", "no observations"
+        self.used_pair_depth, self.used_mem_bytes = d, m
+        self.scope.tuner.stats.decision(
+            {
+                "target": "shuffle_pipeline",
+                "key": self.sid,
+                "plan": self.scope.plan_fp,
+                "value": {"pair_depth": d, "mem_bytes": m},
+                "source": source,
+                "evidence": evidence,
+                "confidence": _confidence(int(self.entry.get("obs", 0) or 0)),
+            }
+        )
+        return d, m
+
+    def observe_pair_stream(self, run: Dict[str, Any]) -> None:
+        """The pair prefetcher's finished-run telemetry (the PR 2
+        ``PipelineStats`` run dict): producer/consumer waits name the
+        pipeline's bottleneck for the next generation."""
+        self.obs.update(
+            pipe_chunks=int(run.get("chunks_prefetched", 0) or 0),
+            pipe_producer_wait_s=float(run.get("producer_wait_s", 0.0) or 0.0),
+            pipe_consumer_wait_s=float(run.get("consumer_wait_s", 0.0) or 0.0),
+        )
+        self.scope.tuner.stats.inc("observations")
+
+    def observe_pipeline(self, info: Dict[str, Any]) -> None:
+        """Mem-tier pressure + grouping evidence from the finished
+        exchange (ledger peak/demotions, pairs per group)."""
+        self.obs.update(
+            pairs_per_group=int(info.get("pairs_per_group", 0) or 0),
+            mem_bytes_used=int(info.get("mem_bytes_used", 0) or 0),
+            mem_demotions=int(info.get("mem_demotions", 0) or 0),
+        )
 
     def observe_sides(
         self, left_bytes: int, right_bytes: int, left_rows: int, right_rows: int
@@ -656,6 +784,24 @@ class Tuner:
                         new["buckets"] = adj["buckets"]
                         new["converged"] = adj["converged"]
                         new["evidence"] = adj["evidence"]
+                if handle.obs.get("pipe_chunks"):
+                    padj = adjust_pipeline(
+                        handle.used_pair_depth, handle.used_mem_bytes, handle.obs
+                    )
+                    if padj is not None:
+                        if (
+                            cur.get("pair_depth") != padj["pair_depth"]
+                            or cur.get("mem_bytes") != padj["mem_bytes"]
+                            or bool(cur.get("pipe_converged"))
+                            != padj["converged"]
+                        ):
+                            material = True
+                        if padj["converged"] and not cur.get("pipe_converged"):
+                            converged_flips += 1
+                        new["pair_depth"] = padj["pair_depth"]
+                        new["mem_bytes"] = padj["mem_bytes"]
+                        new["pipe_converged"] = padj["converged"]
+                        new["pipe_evidence"] = padj["evidence"]
                 if new != cur:
                     joins[handle.sid] = new
             if not streams and not joins:
@@ -741,6 +887,10 @@ def describe_tuning(
         parts = []
         if j.get("buckets"):
             parts.append(f"buckets={j['buckets']}")
+        if j.get("pair_depth") is not None:
+            parts.append(f"pair_depth={j['pair_depth']}")
+        if j.get("mem_bytes") is not None:
+            parts.append(f"mem_bytes={j['mem_bytes']}")
         for k in ("left_bytes", "right_bytes", "right_rows"):
             if j.get(k) is not None:
                 parts.append(f"{k}~{j[k]}")

@@ -1,0 +1,434 @@
+"""Pipelined out-of-core exchange suite (ISSUE 15, docs/shuffle.md
+"Pipelined exchange"): write-behind spill, the memory-resident bucket
+tier, bucket-pair prefetch + budget-bounded grouping — each proven
+bit-identical against the ``fugue.tpu.shuffle.pipeline.enabled=false``
+phase-barrier kill-switch, with the PR 2 poison/no-deadlock contracts
+extended to the background writer."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_FAULT_PLAN,
+    FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES,
+    FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+    FUGUE_TPU_CONF_SHUFFLE_DIR,
+    FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES,
+    FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED,
+    FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH,
+)
+from fugue_tpu.dataframe import ArrowDataFrame, LocalDataFrameIterableDataFrame
+from fugue_tpu.exceptions import FugueTPUError
+from fugue_tpu.jax import JaxExecutionEngine
+
+HOWS = ["inner", "left_outer", "left_semi", "left_anti", "right_outer", "full_outer"]
+
+
+def _engine(tmp_path, budget=20_000, bucket=5_000, **conf):
+    return JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget,
+            FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: bucket,
+            FUGUE_TPU_CONF_SHUFFLE_DIR: str(tmp_path),
+            **conf,
+        }
+    )
+
+
+def _frames(n=4000, seed=0, nulls=True, right_keys=None, key_range=None):
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_range or (n // 8), n).astype(object)
+    rk = rng.integers(0, right_keys or key_range or (n // 8), n).astype(object)
+    if nulls:
+        lk[::97] = None
+        rk[::89] = None
+    left = pd.DataFrame({"k": pd.array(lk, dtype="Int64"), "a": rng.normal(size=n)})
+    right = pd.DataFrame({"k": pd.array(rk, dtype="Int64"), "b": rng.normal(size=n)})
+    return left, right
+
+
+def _norm(res):
+    tbl = res.as_arrow() if not isinstance(res, pa.Table) else res
+    pdf = tbl.replace_schema_metadata(None).to_pandas()
+    return pdf.sort_values(list(pdf.columns)).reset_index(drop=True)
+
+
+def _ab(tmp_path, how, seed=0, on_conf=None, **frames_kw):
+    """One join through the pipelined engine and the kill-switch engine;
+    returns (normalized frames, pipelined stats)."""
+    left, right = _frames(seed=seed, **frames_kw)
+    on_conf = on_conf or {}
+    eng = _engine(tmp_path, **on_conf)
+    got = _norm(eng.join(eng.to_df(left), eng.to_df(right), how=how, on=["k"]))
+    st = eng.stats()["shuffle"]
+    off = _engine(tmp_path, **{FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: False})
+    ref = _norm(off.join(off.to_df(left), off.to_df(right), how=how, on=["k"]))
+    st_off = off.stats()["shuffle"]
+    return got, ref[list(got.columns)], st, st_off
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_pipeline_parity_vs_kill_switch(tmp_path, how):
+    """Every hash-partitionable join type: the pipelined path (mem tier +
+    grouping + write-behind, default-on) is bit-identical to the
+    phase-barrier kill-switch; the kill-switch engine touches none of
+    the pipeline machinery."""
+    got, ref, st, st_off = _ab(tmp_path, how)
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["pipelined_joins"] == 1 and st["joins_spill"] == 1
+    assert st_off["pipelined_joins"] == 0
+    assert st_off["mem_buckets"] == 0 and st_off["group_joins"] == 0
+
+
+def test_kill_switch_span_multiset_is_serial(tmp_path):
+    """pipeline.enabled=false restores the PR 8 span shape exactly: one
+    shuffle.partition per side and one shuffle.bucket span per bucket id
+    0..P-1, in order."""
+    from fugue_tpu.obs import get_tracer
+
+    left, right = _frames(seed=3)
+    tr = get_tracer()
+    tr.clear()
+    tr.enable()
+    try:
+        off = _engine(tmp_path, **{FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: False})
+        off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"]).as_pandas()
+        recs = tr.records()
+        parts = [r for r in recs if r["name"] == "shuffle.partition"]
+        assert {r["args"]["side"] for r in parts} == {"left", "right"}
+        buckets = [r["args"]["bucket"] for r in recs if r["name"] == "shuffle.bucket"]
+        assert buckets == list(range(len(buckets))) and len(buckets) > 0
+        assert all("pairs" not in r["args"] for r in recs if r["name"] == "shuffle.bucket")
+    finally:
+        tr.disable()
+        tr.clear()
+
+
+def test_mem_tier_serves_buckets_without_disk(tmp_path):
+    """Under an ample ledger every bucket stays memory-resident: reads
+    are mem hits, nothing flows through the write-behind writer, and
+    bytes_spilled accounts the mem-resident payload."""
+    got, ref, st, _ = _ab(tmp_path, "inner", seed=4)
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["mem_buckets"] > 0
+    assert st["mem_bucket_hits"] > 0
+    assert st["mem_demotions"] == 0
+    assert st["writebehind_batches"] == 0
+    assert st["bytes_spilled"] == st["mem_bucket_bytes"] > 0
+
+
+def test_mem_ledger_pressure_demotes_largest_first(tmp_path):
+    """A deliberately tiny ledger forces demotions: demoted buckets take
+    the write-behind disk path with the full publish discipline, results
+    stay bit-identical, and the ledger bound holds (used <= cap)."""
+    got, ref, st, _ = _ab(
+        tmp_path,
+        "inner",
+        seed=5,
+        on_conf={FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES: 4096},
+    )
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["mem_demotions"] > 0
+    assert st["writebehind_batches"] > 0
+    assert not glob.glob(os.path.join(str(tmp_path), "shuffle-*")), "spill dir leaked"
+
+
+def test_mem_tier_disabled_by_negative_conf(tmp_path):
+    """mem_bucket_bytes < 0 turns the tier off: all buckets go through
+    the write-behind writer, still pipelined, still bit-identical."""
+    got, ref, st, _ = _ab(
+        tmp_path,
+        "left_outer",
+        seed=6,
+        on_conf={FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES: -1},
+    )
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["mem_buckets"] == 0 and st["mem_bucket_bytes"] == 0
+    assert st["writebehind_batches"] > 0 and st["pipelined_joins"] == 1
+
+
+def test_grouped_pairs_share_kernel_launches(tmp_path):
+    """With budget headroom, adjacent device-eligible pairs coalesce:
+    fewer kernel launches (group_joins) than bucket pairs (bucket_joins),
+    results bit-identical, and the measured peak stays under budget."""
+    got, ref, st, _ = _ab(
+        tmp_path,
+        "inner",
+        seed=7,
+        n=20000,
+        key_range=60000,  # mostly 1:1 matches: expansion stays near 1x
+        on_conf={
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: 400_000,
+            FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: 4096,
+        },
+    )
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["bucket_joins"] > st["group_joins"] > 0
+    assert 0 < st["peak_device_bytes"] < 400_000
+
+
+def test_dup_heavy_group_sizing_respects_budget(tmp_path):
+    """8x-duplicate keys: the expansion output dwarfs the ingest bytes,
+    so the measured per-pair peak must keep groups small — the budget
+    bound holds even though the static ingest estimate says ~10 pairs
+    would fit (regression for the guessed-margin sizing)."""
+    got, ref, st, _ = _ab(
+        tmp_path,
+        "inner",
+        seed=14,
+        n=20000,
+        on_conf={
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: 400_000,
+            FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: 4096,
+        },
+    )
+    pd.testing.assert_frame_equal(got, ref)
+    assert 0 < st["peak_device_bytes"] < 400_000, st["peak_device_bytes"]
+
+
+def test_empty_side_buckets_interleave_with_groups(tmp_path):
+    """Outer joins over skewed keys: many buckets exist only on the left
+    side (host-joined singletons) and interleave with device groups —
+    output order is bucket order either way and values match the
+    kill-switch exactly."""
+    got, ref, st, _ = _ab(tmp_path, "left_outer", seed=8, right_keys=40)
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["pipelined_joins"] == 1
+
+
+def test_pair_prefetch_depth_parity(tmp_path):
+    """An explicit pair-prefetch depth exercises the threaded producer
+    (read+decode+pad+ingest off-thread) — bit-identical, no deadlock,
+    spill dir cleaned."""
+    got, ref, st, _ = _ab(
+        tmp_path,
+        "inner",
+        seed=9,
+        on_conf={FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH: 2},
+    )
+    pd.testing.assert_frame_equal(got, ref)
+    assert st["pipelined_joins"] == 1
+    assert not glob.glob(os.path.join(str(tmp_path), "shuffle-*")), "spill dir leaked"
+
+
+def test_writebehind_poison_tears_and_recovers(tmp_path):
+    """shuffle.spill faults fired FROM THE BACKGROUND WRITER (mem tier
+    off, so every bucket publishes through it) tear individual buckets;
+    the reader recovers exactly those from the replayable source and the
+    join still matches the kill-switch."""
+    left, right = _frames(seed=10, nulls=False)
+    eng = _engine(
+        tmp_path,
+        **{
+            FUGUE_TPU_CONF_SHUFFLE_MEM_BUCKET_BYTES: -1,
+            FUGUE_TPU_CONF_FAULT_PLAN: "shuffle.spill=error@2",
+        },
+    )
+    got = _norm(eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"]))
+    off = _engine(tmp_path, **{FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: False})
+    ref = _norm(off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"]))
+    pd.testing.assert_frame_equal(got, ref[list(got.columns)])
+    st = eng.stats()["shuffle"]
+    assert st["spill_faults"] == 2
+    assert st["bucket_recoveries"] == 2
+    assert not glob.glob(os.path.join(str(tmp_path), "shuffle-*")), "spill dir leaked"
+
+
+def test_mem_tier_poison_drops_and_recovers(tmp_path):
+    """The mem tier's form of a torn publish: an injected fault at
+    retention DROPS the bucket and the reader repartitions it from the
+    source — same recovery ladder, zero disk involvement."""
+    left, right = _frames(seed=11, nulls=False)
+    eng = _engine(
+        tmp_path, **{FUGUE_TPU_CONF_FAULT_PLAN: "shuffle.spill=error@3"}
+    )
+    got = _norm(eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"]))
+    off = _engine(tmp_path, **{FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: False})
+    ref = _norm(off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"]))
+    pd.testing.assert_frame_equal(got, ref[list(got.columns)])
+    st = eng.stats()["shuffle"]
+    assert st["spill_faults"] == 3
+    assert st["bucket_recoveries"] == 3
+
+
+def test_writebehind_poison_surfaces_for_one_pass_stream(tmp_path):
+    """Mirror of the PR 2 poison-chunk no-deadlock proof for the
+    write-behind path: every publish torn (error@999), the source is a
+    one-pass stream (not replayable) — the poison SURFACES in the
+    consumer as the descriptive recovery error, nothing deadlocks, and
+    the failure path leaves no spill dir or orphaned tmp file."""
+    left, right = _frames(n=1000, seed=12, nulls=False)
+    ltbl = pa.Table.from_pandas(left, preserve_index=False)
+    eng = _engine(
+        tmp_path, **{FUGUE_TPU_CONF_FAULT_PLAN: "shuffle.spill=error@999"}
+    )
+    stream = LocalDataFrameIterableDataFrame(
+        (ArrowDataFrame(ltbl.slice(s, 200)) for s in range(0, 1000, 200)),
+        schema=ArrowDataFrame(ltbl).schema,
+    )
+    with pytest.raises(FugueTPUError, match="one-pass stream"):
+        res = eng.join(stream, eng.to_df(right), how="left_outer", on=["k"])
+        res.as_pandas()
+    assert not glob.glob(os.path.join(str(tmp_path), "shuffle-*")), "spill dir leaked"
+
+
+def test_writer_failure_propagates_with_original_traceback(tmp_path):
+    """A hard failure ON the writer thread (not an absorbed publish
+    fault) re-raises from submit/finalize with the writer-thread frames
+    intact, removes every tmp it created, and never deadlocks a blocked
+    submitter."""
+    import traceback
+
+    from fugue_tpu.shuffle.pipeline import SpillWriter
+
+    schema = pa.schema([("x", pa.int64())])
+    w = SpillWriter(str(tmp_path), "left", schema, depth=2)
+    w.submit(0, object())  # not a table: write_table raises on the thread
+    with pytest.raises(Exception) as ei:
+        for n in range(50):  # a dead writer must never block submitters
+            w.submit(1, pa.table({"x": [n]}))
+    frames = traceback.extract_tb(ei.value.__traceback__)
+    assert any("_run" in f.name for f in frames), "writer-thread frames lost"
+    assert not glob.glob(os.path.join(str(tmp_path), "*.tmp")), "tmp orphaned"
+    with pytest.raises(Exception):
+        w.finalize()  # the failure stays sticky
+
+
+def test_spill_dir_bytes_excludes_tmp(tmp_path):
+    """Regression (ISSUE 15 satellite): the sampler probe must not count
+    ``*.tmp`` — during the temp-write+rename window (and for the whole
+    write-behind pass) tmp and published bytes coexist and the probe
+    double-counted the bucket."""
+    from fugue_tpu.shuffle.partitioner import new_spill_dir, spill_dir_bytes
+
+    d = new_spill_dir(str(tmp_path))
+    with open(os.path.join(d, "left_00000.arrow"), "wb") as f:
+        f.write(b"x" * 100)
+    with open(os.path.join(d, "left_00001.arrow.tmp"), "wb") as f:
+        f.write(b"y" * 5000)
+    assert spill_dir_bytes([d]) == 100
+
+
+def test_repartition_pipelined_keeps_keys_whole(tmp_path):
+    """Pipelined spill repartition (read-ahead + mem tier) keeps the
+    one-bucket-per-chunk contract: every key lives in exactly ONE chunk
+    and the union round-trips."""
+    from fugue_tpu.collections import PartitionSpec
+
+    rng = np.random.default_rng(13)
+    n = 5000
+    pdf = pd.DataFrame({"k": rng.integers(0, 61, n), "v": rng.normal(size=n)})
+    eng = _engine(tmp_path, **{FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH: 2})
+    res = eng.repartition(eng.to_df(pdf), PartitionSpec(algo="hash", by=["k"]))
+    seen = set()
+    parts = []
+    for sub in res.native:
+        tbl = sub.as_arrow()
+        keys = set(tbl.column("k").to_pylist())
+        assert not (keys & seen), "key split across chunks"
+        seen |= keys
+        parts.append(tbl.to_pandas())
+    got = pd.concat(parts).sort_values(["k", "v"]).reset_index(drop=True)
+    exp = pdf.sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp.astype(got.dtypes.to_dict()))
+    st = eng.stats()["shuffle"]
+    assert st["mem_bucket_hits"] > 0
+    assert not glob.glob(os.path.join(str(tmp_path), "shuffle-*")), "spill dir leaked"
+
+
+# ---------------------------------------------------------------------------
+# adaptive tuning of the pipeline knobs (docs/tuning.md)
+# ---------------------------------------------------------------------------
+
+
+def test_adjust_pipeline_deepens_when_consumer_starved():
+    from fugue_tpu.tuning.tuner import adjust_pipeline
+
+    adj = adjust_pipeline(
+        1,
+        1 << 28,
+        {
+            "pipe_chunks": 40,
+            "wall_s": 2.0,
+            "pipe_producer_wait_s": 0.01,
+            "pipe_consumer_wait_s": 1.0,
+        },
+    )
+    assert adj["pair_depth"] == 2 and not adj["converged"]
+    # producer starved -> shallower, down to serial consumption
+    adj = adjust_pipeline(
+        2,
+        1 << 28,
+        {
+            "pipe_chunks": 40,
+            "wall_s": 2.0,
+            "pipe_producer_wait_s": 1.0,
+            "pipe_consumer_wait_s": 0.01,
+        },
+    )
+    assert adj["pair_depth"] == 1
+    # too fast to measure -> no adjustment
+    assert (
+        adjust_pipeline(1, 1 << 28, {"pipe_chunks": 40, "wall_s": 0.01}) is None
+    )
+
+
+def test_adjust_pipeline_mem_budget_tracks_pressure():
+    from fugue_tpu.tuning.tuner import MEM_BYTES_MAX, MEM_BYTES_MIN, adjust_pipeline
+
+    grown = adjust_pipeline(
+        0,
+        1 << 27,
+        {"pipe_chunks": 10, "wall_s": 1.0, "mem_demotions": 5, "mem_bytes_used": 1 << 27},
+    )
+    assert grown["mem_bytes"] == 1 << 28
+    shrunk = adjust_pipeline(
+        0,
+        1 << 29,
+        {"pipe_chunks": 10, "wall_s": 1.0, "mem_demotions": 0, "mem_bytes_used": 1 << 20},
+    )
+    assert MEM_BYTES_MIN <= shrunk["mem_bytes"] < 1 << 29
+    capped = adjust_pipeline(
+        0,
+        MEM_BYTES_MAX,
+        {"pipe_chunks": 10, "wall_s": 1.0, "mem_demotions": 3, "mem_bytes_used": MEM_BYTES_MAX},
+    )
+    assert capped["mem_bytes"] == MEM_BYTES_MAX and capped["converged"]
+
+
+def test_learned_pipeline_params_resolve_and_render(tmp_path):
+    """A seeded store entry supplies pair_depth/mem_bytes to the next
+    run of the same plan; describe_tuning renders them."""
+    from types import SimpleNamespace
+
+    from fugue_tpu.constants import FUGUE_TPU_CONF_TUNING_PATH
+    from fugue_tpu.tuning.tuner import Tuner, describe_tuning, run_scope
+
+    conf = {FUGUE_TPU_CONF_TUNING_PATH: os.path.join(str(tmp_path), "t.json")}
+    tuner = Tuner(conf)
+    tuner.store.publish(
+        "planfp0000000000",
+        lambda e: dict(
+            e,
+            joins={
+                "join": {
+                    "pair_depth": 3,
+                    "mem_bytes": 123456,
+                    "obs": 2,
+                    "pipe_evidence": "seeded",
+                }
+            },
+        ),
+    )
+    engine = SimpleNamespace(tuner=tuner, conf=conf)
+    with run_scope(engine, "planfp0000000000", conf):
+        handle = tuner.join_params(None, None, None)[3]
+        d, m = handle.pipeline_params(conf, 0, 999)
+    assert (d, m) == (3, 123456)
+    text = "\n".join(describe_tuning(conf, "planfp0000000000", engine))
+    assert "pair_depth=3" in text and "mem_bytes=123456" in text
