@@ -5,17 +5,25 @@
 install:
 	pip install -e . --no-deps --no-build-isolation
 
-# the four smoke gates below are non-blocking in `make test` (their
+# the three smoke gates below are non-blocking in `make test` (their
 # dedicated targets stay blocking) — but a failure must never be SILENT:
 # each emits a one-line WARNING so a regressed chaos/perf gate is visible
-# in CI logs instead of scrolling past as an ignored make error
+# in CI logs instead of scrolling past as an ignored make error.
+# dist-smoke is BLOCKING (ISSUE 16): workflow.run now routes through the
+# dist tier, so its chaos ladder is tier-1 behavior; set
+# DIST_SMOKE_NONBLOCKING=1 to demote it back to a report while iterating
+# on a known dist change
 test:
 	python -m pytest tests/ -q
 	python tools/lint_locks.py --strict         # concurrency audit; BLOCKING (ISSUE 12)
 	-@$(MAKE) --no-print-directory bench-smoke  || echo "WARNING: bench-smoke FAILED (non-blocking in 'make test'); run 'make bench-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory serve-smoke  || echo "WARNING: serve-smoke FAILED (non-blocking in 'make test'); run 'make serve-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory fleet-smoke  || echo "WARNING: fleet-smoke FAILED (non-blocking in 'make test'); run 'make fleet-smoke' to reproduce"
-	-@$(MAKE) --no-print-directory dist-smoke   || echo "WARNING: dist-smoke FAILED (non-blocking in 'make test'); run 'make dist-smoke' to reproduce"
+	@if [ "$$DIST_SMOKE_NONBLOCKING" = "1" ]; then \
+	  $(MAKE) --no-print-directory dist-smoke || echo "WARNING: dist-smoke FAILED (demoted by DIST_SMOKE_NONBLOCKING=1); run 'make dist-smoke' to reproduce"; \
+	else \
+	  $(MAKE) --no-print-directory dist-smoke; \
+	fi
 
 # downsized perf gate (≤~30s): device-aggregate worker only, fails when the
 # oracle-normalized groupby_aggregate vs_baseline drops >20% below the

@@ -2596,12 +2596,22 @@ _DIST_CONF = {
 }
 
 
-def _dist_worker_main(board: str, wid: str, stop_file: str) -> None:
+def _dist_worker_main(
+    board: str,
+    wid: str,
+    stop_file: str,
+    extra_conf: Optional[Dict[str, Any]] = None,
+) -> None:
     """One worker process of the tier: engine + heartbeat + HTTP fragment
-    server, pulling leased tasks off the shared board until stopped."""
+    server, pulling leased tasks off the shared board until stopped.
+    ``extra_conf`` lets a chaos case give ONE worker a fault plan (e.g. a
+    straggler delay that opens a SIGKILL window) without touching the
+    rest of the fleet."""
     from fugue_tpu.dist import DistWorker
 
-    w = DistWorker(board, wid, conf=dict(_DIST_CONF))
+    c = dict(_DIST_CONF)
+    c.update(extra_conf or {})
+    w = DistWorker(board, wid, conf=c)
     w.start()
     try:
         w.serve_forever(stop_file=stop_file)
@@ -2803,18 +2813,269 @@ def _bench_dist_chaos(workers: int = 3) -> Dict[str, Any]:
         _shutil.rmtree(root, ignore_errors=True)
 
 
+def _bench_dist_workflow_chaos(workers: int = 3) -> Dict[str, Any]:
+    """The ISSUE 16 chaos gate: arbitrary ``workflow.run`` graphs ride
+    the fault-tolerant dist tier. Two workflows — a functional
+    transform→shuffle-join→aggregate and the same pipeline as FugueSQL —
+    run through :meth:`DistSupervisor.run_workflow_job` (routed by the
+    planner in fugue_tpu/plan/distribute.py) against 3 worker processes,
+    one of which straggles on its first lease (injected ``dist.lease``
+    delay) and is SIGKILLed while provably mid-shuffle. Gates:
+
+    - both results bit-identical (canonicalized row order) to the
+      single-process cache-off oracle (`fugue.tpu.dist.enabled=false`);
+    - the board audit over every workflow job shows ZERO lost and ZERO
+      double-counted rows across the exchange;
+    - >= 1 WORKER_LOST re-dispatch (the recovery ladder actually fired);
+    - a warm rerun of the functional workflow delta-skips EVERY
+      content-addressed partition and dispatches nothing new.
+    """
+    import json as _json
+    import multiprocessing as _mp
+    import pandas as _pd
+    import shutil as _shutil
+    import signal as _signal
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from fugue_tpu import FugueWorkflow
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as fc
+    from fugue_tpu.dist import read_heartbeat
+    from fugue_tpu.execution import NativeExecutionEngine
+
+    root = _tempfile.mkdtemp(prefix="fugue_bench_wf_dist_")
+    board = os.path.join(root, "board")
+    ldir = os.path.join(root, "left")
+    rdir = os.path.join(root, "right")
+    stop_file = os.path.join(root, "stop")
+    os.makedirs(ldir)
+    os.makedirs(rdir)
+    for i in range(6):
+        _pd.DataFrame(
+            {
+                "k": [(j * 13 + i) % 97 for j in range(3000)],
+                "v": [float((j * 7 + i) % 1000) for j in range(3000)],
+            }
+        ).to_parquet(os.path.join(ldir, f"left_{i}.parquet"))
+    for i in range(3):
+        _pd.DataFrame(
+            {
+                "k": [(j + i * 33) % 97 for j in range(400)],
+                "w": [float((j * 3 + i) % 50) for j in range(400)],
+            }
+        ).to_parquet(os.path.join(rdir, f"right_{i}.parquet"))
+
+    def build_functional(dag: "FugueWorkflow") -> None:
+        a = dag.load(ldir, fmt="parquet").filter(col("v") > 10)
+        b = dag.load(rdir, fmt="parquet")
+        (
+            a.join(b, how="inner", on=["k"])
+            .partition_by("k")
+            .aggregate(fc.sum(col("v")).alias("s"), fc.count(col("w")).alias("n"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+
+    def build_sql(dag: "FugueWorkflow") -> None:
+        a = dag.load(ldir, fmt="parquet")
+        b = dag.load(rdir, fmt="parquet")
+        dag.select(
+            "SELECT a.k AS k, SUM(a.v * b.w) AS s, COUNT(*) AS n FROM ",
+            a,
+            " AS a INNER JOIN ",
+            b,
+            " AS b ON a.k = b.k WHERE a.v > 10 GROUP BY a.k",
+        ).yield_dataframe_as("r", as_local=True)
+
+    def canon(pdf: "_pd.DataFrame") -> "_pd.DataFrame":
+        return pdf.sort_values(list(pdf.columns)).reset_index(drop=True)
+
+    def run_wf(build, engine, conf) -> "_pd.DataFrame":
+        dag = FugueWorkflow()
+        build(dag)
+        dag.run(engine, conf=dict(conf))
+        return dag.yields["r"].result.as_pandas()
+
+    run_conf = {"fugue.tpu.dist.board": board, "fugue.tpu.dist.buckets": 8}
+    victim_wid = "w0"
+    killed: Dict[str, Any] = {"pid": None}
+    ctx = _mp.get_context("fork")
+    procs = []
+    t0 = time.perf_counter()
+
+    def kill_straggler() -> None:
+        # the victim worker's injected `dist.lease=delay:4@1` makes it
+        # sleep 4s holding its FIRST lease — poll the lease dir until a
+        # lease owned by the victim appears, then SIGKILL its process
+        # (pid from its heartbeat), i.e. provably mid-shuffle
+        lease_dir = os.path.join(board, "leases")
+        hb_dir = os.path.join(board, "hb")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                names = os.listdir(lease_dir)
+            except OSError:
+                names = []
+            for n in names:
+                try:
+                    with open(os.path.join(lease_dir, n)) as f:
+                        cur = _json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if cur.get("owner") == victim_wid:
+                    hb = read_heartbeat(hb_dir, victim_wid)
+                    if hb is None:
+                        continue
+                    killed["pid"] = int(hb["pid"])
+                    os.kill(killed["pid"], _signal.SIGKILL)
+                    return
+            time.sleep(0.01)
+
+    try:
+        for i in range(workers):
+            p = ctx.Process(
+                target=_dist_worker_main,
+                args=(board, f"w{i}", stop_file),
+                kwargs={
+                    "extra_conf": (
+                        {"fugue.tpu.fault.plan": "dist.lease=delay:4@1"}
+                        if i == 0
+                        else None
+                    )
+                },
+            )
+            p.start()
+            procs.append(p)
+        killer = _threading.Thread(target=kill_straggler, daemon=True)
+        killer.start()
+
+        def jids() -> set:
+            try:
+                return {
+                    n[: -len(".job.json")]
+                    for n in os.listdir(os.path.join(board, "jobs"))
+                    if n.endswith(".job.json")
+                }
+            except OSError:
+                return set()
+
+        eng = NativeExecutionEngine(dict(_DIST_CONF))
+        func_res = run_wf(build_functional, eng, run_conf)
+        func_jids = jids()
+        killer.join(15)
+        sql_res = run_wf(build_sql, eng, run_conf)
+        all_jids = jids()
+
+        stats = eng.stats()["dist"]
+        dispatched_before = int(stats.get("workflow_tasks_dispatched", 0))
+        skipped_before = int(stats.get("workflow_partitions_delta_skipped", 0))
+        warm_res = run_wf(build_functional, eng, run_conf)
+        stats = eng.stats()["dist"]
+        warm_dispatched = (
+            int(stats.get("workflow_tasks_dispatched", 0)) - dispatched_before
+        )
+        warm_skipped = (
+            int(stats.get("workflow_partitions_delta_skipped", 0)) - skipped_before
+        )
+
+        # board audit over every workflow job this run planned
+        sup = getattr(eng, "_wf_dist_supervisor", None)
+        rows_lost = rows_double = 0
+        audits: Dict[str, Any] = {}
+        for jid in sorted(all_jids):
+            a = sup.audit_job(jid)
+            audits[jid] = a
+            rows_lost += int(a["rows_lost"])
+            rows_double += int(a["rows_double_counted"])
+
+        # the single-process cache-off oracle: the kill-switch path
+        oracle_eng = NativeExecutionEngine(dict(_DIST_CONF))
+        oracle_conf = {
+            "fugue.tpu.dist.board": os.path.join(root, "oracle_board"),
+            "fugue.tpu.dist.enabled": False,
+            "fugue.tpu.dist.buckets": 8,
+        }
+        func_oracle = run_wf(build_functional, oracle_eng, oracle_conf)
+        sql_oracle = run_wf(build_sql, oracle_eng, oracle_conf)
+
+        func_identical = canon(func_res).equals(canon(func_oracle))
+        sql_identical = canon(sql_res).equals(canon(sql_oracle))
+        warm_identical = canon(warm_res).equals(canon(func_oracle))
+        # 6 left + 3 right maps + 8 reduces per functional job
+        n_tasks = 6 + 3 + 8
+        correct = (
+            killed["pid"] is not None
+            and func_identical
+            and sql_identical
+            and warm_identical
+            and rows_lost == 0
+            and rows_double == 0
+            and int(stats.get("redispatch_worker_lost", 0)) >= 1
+            and int(stats.get("workflow_jobs", 0)) >= 3
+            and warm_skipped == n_tasks
+            and warm_dispatched == 0
+        )
+        return {
+            "workers": workers,
+            "victim": victim_wid,
+            "victim_pid": killed["pid"],
+            "workflow_jobs": int(stats.get("workflow_jobs", 0)),
+            "workflow_tasks_dispatched": int(
+                stats.get("workflow_tasks_dispatched", 0)
+            ),
+            "workflow_tasks_re_dispatched": int(
+                stats.get("workflow_tasks_re_dispatched", 0)
+            ),
+            "workflow_tasks_stolen": int(stats.get("workflow_tasks_stolen", 0)),
+            "redispatch_worker_lost": int(stats.get("redispatch_worker_lost", 0)),
+            "warm_delta_skipped": warm_skipped,
+            "warm_dispatched": warm_dispatched,
+            "audits": audits,
+            "rows_lost": rows_lost,
+            "rows_double_counted": rows_double,
+            "functional_rows": int(len(func_res)),
+            "sql_rows": int(len(sql_res)),
+            "functional_bit_identical": func_identical,
+            "sql_bit_identical": sql_identical,
+            "warm_bit_identical": warm_identical,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "correct": correct,
+        }
+    finally:
+        try:
+            with open(stop_file, "w") as f:
+                f.write("stop")
+        except OSError:
+            pass
+        for p in procs:
+            p.join(5)
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+        _shutil.rmtree(root, ignore_errors=True)
+
+
 def _dist_smoke() -> None:
-    """``make dist-smoke``: the ISSUE 14 chaos gate — 3 workers +
-    supervisor run a distributed load→shuffle-join→aggregate, one worker
-    SIGKILLed mid-shuffle; all partitions complete via lease re-dispatch,
-    the artifact audit shows zero lost/double-counted bucket rows, and
-    the result is bit-identical to the single-process cache-off oracle
-    (the `fugue.tpu.dist.enabled=false` kill-switch path). Exit 16 on
-    any violation (the next code after the fleet gate's 15)."""
+    """``make dist-smoke``: the dist-tier chaos gates. First the ISSUE 14
+    join-job case — 3 workers + supervisor run a distributed
+    load→shuffle-join→aggregate, one worker SIGKILLed mid-shuffle; all
+    partitions complete via lease re-dispatch, the artifact audit shows
+    zero lost/double-counted bucket rows, and the result is bit-identical
+    to the single-process cache-off oracle (the
+    `fugue.tpu.dist.enabled=false` kill-switch path). Exit 16 on any
+    violation. Then the ISSUE 16 WORKFLOW case — the same ladder under
+    ``workflow.run`` routing (functional + FugueSQL graphs through
+    ``run_workflow_job``, one worker SIGKILLed mid-shuffle, warm rerun
+    delta-skips every partition). Exit 18 on any violation (17 is the
+    pipelined-shuffle gate's)."""
     case = _bench_dist_chaos()
     print(json.dumps({"metric": "dist_chaos", "chaos": case}))
     if not case["correct"]:
         raise SystemExit(16)
+    wf_case = _bench_dist_workflow_chaos()
+    print(json.dumps({"metric": "dist_workflow_chaos", "chaos": wf_case}))
+    if not wf_case["correct"]:
+        raise SystemExit(18)
 
 
 def _smoke() -> None:
@@ -3254,6 +3515,52 @@ def _telemetry_smoke(out_dir: str) -> None:
         assert len(dag.yields["r"].result.as_pandas()) == 128
         done.set()
         scraper.join(timeout=5)
+        # ISSUE 16: distributed-workflow counters ride the SAME registry
+        # (engine.stats()["dist"]) — run one tiny content-addressed
+        # workflow job on a throwaway board with a single in-thread
+        # worker so the gauges are live (non-zero) in the exposition
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from fugue_tpu.dist import DistSupervisor, DistWorker
+
+        dist_root = _tempfile.mkdtemp(prefix="fugue_telemetry_dist_")
+        dist_part = os.path.join(dist_root, "part.parquet")
+        pd.DataFrame({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]}).to_parquet(
+            dist_part
+        )
+        dist_stop = os.path.join(dist_root, "stop")
+        wkr = DistWorker(
+            os.path.join(dist_root, "board"),
+            "tw0",
+            conf={"fugue.tpu.cache.enabled": False},
+        )
+        wkr.start()
+        wthread = _threading.Thread(
+            target=wkr.serve_forever, kwargs={"stop_file": dist_stop}, daemon=True
+        )
+        wthread.start()
+        try:
+            sup = DistSupervisor(
+                os.path.join(dist_root, "board"),
+                engine=eng,
+                conf={"fugue.tpu.dist.poll_s": 0.01},
+            )
+
+            def _dist_reduce(pdf: "pd.DataFrame") -> "pd.DataFrame":
+                return pdf.groupby("k", as_index=False).agg(s=("v", "sum"))
+
+            out = sup.run_workflow_job(
+                [dist_part], None, ["k"], _dist_reduce, buckets=2, timeout=60
+            )
+            assert len(out) == 2, out
+            assert int(eng.stats()["dist"]["workflow_jobs"]) >= 1
+        finally:
+            with open(dist_stop, "w") as f:
+                f.write("stop")
+            wthread.join(timeout=10)
+            wkr.stop()
+            _shutil.rmtree(dist_root, ignore_errors=True)
         sampler.sample_once()  # deterministic: >=1 sample even on a fast box
         # final scrape (always succeeds: server still bound and running)
         import urllib.request as _ur
@@ -3285,6 +3592,21 @@ def _telemetry_smoke(out_dir: str) -> None:
             "fugue_tpu_analysis_udfs_refused",
         ):
             assert want in final, f"{want} missing from /metrics exposition"
+        # distributed-workflow job counters (ISSUE 16) flatten through
+        # engine.stats()["dist"] — the tiny board job above made them
+        # live, so the exposition must carry them with workflow_jobs >= 1
+        for want in (
+            "fugue_tpu_dist_workflow_jobs",
+            "fugue_tpu_dist_workflow_tasks_dispatched",
+            "fugue_tpu_dist_workflow_tasks_re_dispatched",
+            "fugue_tpu_dist_workflow_partitions_delta_skipped",
+        ):
+            assert want in final, f"{want} missing from /metrics exposition"
+        assert any(
+            ln.startswith("fugue_tpu_dist_workflow_jobs ")
+            and float(ln.split()[-1]) >= 1
+            for ln in final.splitlines()
+        ), "fugue_tpu_dist_workflow_jobs not live (expected >= 1)"
         with _ur.urlopen(
             f"http://{server.host}:{server.port}/healthz", timeout=5
         ) as resp:

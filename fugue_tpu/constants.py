@@ -353,6 +353,21 @@ FUGUE_TPU_CONF_DIST_POLL_S = "fugue.tpu.dist.poll_s"
 # shape); default 2 (network fetch releases the GIL, so the overlap is
 # real even on single-core hosts).
 FUGUE_TPU_CONF_DIST_FETCH_PREFETCH_DEPTH = "fugue.tpu.dist.fetch_prefetch_depth"
+# shared task-board root for DISTRIBUTED WORKFLOW execution: when set (and
+# dist.enabled is true), workflow.run hands distributable fragments of the
+# post-optimization DAG — Load roots + row-local chains into an equi-join,
+# keyed aggregate, or bucket-local SQL SELECT — to
+# DistSupervisor.run_workflow_job as leased board tasks
+# (fugue_tpu/plan/distribute.py, docs/distributed.md "Distributed
+# workflows"). Unset (default) = planner inert, fully local execution.
+FUGUE_TPU_CONF_DIST_BOARD = "fugue.tpu.dist.board"
+# wall-clock timeout (seconds) for one distributed workflow fragment's
+# board job; 0/unset = unbounded (recovery is driven by leases, not this)
+FUGUE_TPU_CONF_DIST_WORKFLOW_TIMEOUT_S = "fugue.tpu.dist.workflow_timeout_s"
+# wall-clock deadline (seconds) across ALL RetryPolicy-driven attempts of
+# one /dist/fetch fragment fetch (conf prefix fugue.tpu.retry.dist.*);
+# past it the fetch stops retrying and the orphaned-fragment ladder runs
+FUGUE_TPU_CONF_RETRY_DIST_DEADLINE_S = "fugue.tpu.retry.dist.deadline_s"
 
 # --- cost-based adaptive execution (fugue_tpu/tuning, docs/tuning.md) ---
 # Feedback layer that re-derives stream chunk size / prefetch depth and

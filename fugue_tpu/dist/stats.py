@@ -37,6 +37,18 @@ _COUNTERS = (
     "artifacts_published",
     "rows_in",
     "rows_out",
+    # distributed WORKFLOW jobs (run_workflow_job): one fragment of a
+    # workflow DAG routed through the board. Dispatch/steal/speculative/
+    # invalidation activity observed while a workflow job is in flight is
+    # attributed to that job (before/after deltas — approximate only if
+    # unrelated jobs run concurrently on the same supervisor).
+    "workflow_jobs",
+    "workflow_tasks_dispatched",
+    "workflow_tasks_re_dispatched",
+    "workflow_tasks_stolen",
+    "workflow_tasks_speculative",
+    "workflow_fragments_invalidated",
+    "workflow_partitions_delta_skipped",
 )
 
 

@@ -108,7 +108,11 @@ class DistSupervisor:
 
             engine = NativeExecutionEngine(dict(conf or {}))
         self.engine = engine
-        c = engine.conf
+        # explicit conf overlays the engine's: workflow.run passes its
+        # RUN-scoped merge here so workflow-level dist knobs apply without
+        # writing through to the engine
+        c = dict(engine.conf)
+        c.update(dict(conf or {}))
         self.board = TaskBoard(root)
         self.enabled = bool(c.get(FUGUE_TPU_CONF_DIST_ENABLED, True))
         self.default_buckets = int(c.get(FUGUE_TPU_CONF_DIST_BUCKETS, 8))
@@ -274,6 +278,258 @@ class DistSupervisor:
         self.stats.inc("map_tasks", len(map_tids))
         self.stats.inc("reduce_tasks", len(reduce_tids))
         return jid
+
+    # -- workflow jobs (fugue_tpu/plan/distribute.py routes through here) ----
+    def plan_workflow_job(
+        self,
+        left_paths: List[str],
+        right_paths: Optional[List[str]],
+        keys: List[str],
+        reduce_fn: Callable[..., pd.DataFrame],
+        combine_fn: Optional[Callable[[List[pd.DataFrame]], pd.DataFrame]] = None,
+        map_left: Optional[Callable[[pd.DataFrame], pd.DataFrame]] = None,
+        map_right: Optional[Callable[[pd.DataFrame], pd.DataFrame]] = None,
+        buckets: Optional[int] = None,
+        paths_per_task: int = 1,
+        tokens: Optional[Dict[str, str]] = None,
+    ) -> Tuple[str, List[str]]:
+        """Plan one WORKFLOW fragment as a board job. Identical spec and
+        manifest shapes to :meth:`plan_join_job` (so the whole recovery
+        ladder, ``wait_job`` and ``audit_job`` apply unchanged), but task
+        ids and artifact fps are CONTENT-ADDRESSED — a deterministic
+        fingerprint over the fragment's logic token (the planner's
+        description of map/reduce steps) and each partition range's file
+        tokens (path, size, mtime) instead of a fresh job uuid. A warm
+        rerun therefore finds done records already on the board for every
+        unchanged partition and delta-skips them: only map tasks over new
+        or changed files (and the reduces downstream of the changed map
+        set) execute. Returns ``(jid, all_tids)``; the count of reused
+        done records lands in ``workflow_partitions_delta_skipped``."""
+        toks = dict(tokens or {})
+        n_buckets = int(buckets or self.default_buckets)
+        sides: List[Dict[str, Any]] = [
+            {"name": "left", "paths": list(left_paths), "fn": dump_fn(map_left)}
+        ]
+        if right_paths is not None:
+            sides.append(
+                {
+                    "name": "right",
+                    "paths": list(right_paths),
+                    "fn": dump_fn(map_right),
+                }
+            )
+        schemas: List[pa.Schema] = []
+        for side in sides:
+            side["ranges"] = _chunk(side["paths"], paths_per_task)
+            side["columns"], schema = self._probe_side(side["paths"], side["fn"])
+            schemas.append(schema)
+        kinds = canonical_key_kinds(
+            _fields(schemas[0]), _fields(schemas[-1]), list(keys)
+        )
+        if kinds is None:
+            raise DistJobError(
+                f"shuffle keys {list(keys)} have no canonical hashable dtype "
+                "across the sides — the distributed exchange cannot "
+                "co-bucket them"
+            )
+        reduce_blob = dump_fn(reduce_fn)
+        combine_blob = dump_fn(combine_fn or _default_combine)
+        reduce_token = toks.get("reduce", "")
+        map_tids: List[str] = []
+        skipped = 0
+        for side in sides:
+            side_token = toks.get(side["name"], "")
+            tids = []
+            for rng in side["ranges"]:
+                tid = "wfm-" + spec_fingerprint(
+                    "map",
+                    side["name"],
+                    side_token,
+                    list(keys),
+                    kinds,
+                    n_buckets,
+                    [_file_token(p) for p in rng],
+                )[:20]
+                skipped += int(self.board.read_done(tid) is not None)
+                self.board.put_task(
+                    tid,
+                    {
+                        "kind": "map",
+                        # fragment rel paths embed the content-addressed
+                        # tid, so a constant job dir keeps reruns pointing
+                        # at the same (reusable) fragments
+                        "job": "wf",
+                        "paths": rng,
+                        "fn": side["fn"],
+                        "fp": spec_fingerprint("wf-map-art", tid),
+                        "shuffle": {
+                            "exchange": side["name"],
+                            "keys": list(keys),
+                            "kinds": kinds,
+                            "buckets": n_buckets,
+                        },
+                        "deps": [],
+                    },
+                )
+                tids.append(tid)
+            side["map_tids"] = tids
+            map_tids.extend(tids)
+        reduce_tids: List[str] = []
+        all_columns = {s["name"]: s["columns"] for s in sides}
+        for b in range(n_buckets):
+            tid = "wfr-" + spec_fingerprint(
+                "reduce", reduce_token, b, map_tids
+            )[:20]
+            skipped += int(self.board.read_done(tid) is not None)
+            self.board.put_task(
+                tid,
+                {
+                    "kind": "reduce",
+                    "job": "wf",
+                    "bucket": b,
+                    "fn": reduce_blob,
+                    "columns": all_columns,
+                    "exchanges": {
+                        s["name"]: {"producers": s["map_tids"]} for s in sides
+                    },
+                    "fp": spec_fingerprint("wf-reduce-art", tid),
+                    "deps": list(map_tids),
+                },
+            )
+            reduce_tids.append(tid)
+        jid = "wfj" + spec_fingerprint(
+            reduce_token,
+            [toks.get(s["name"], "") for s in sides],
+            map_tids,
+            reduce_tids,
+        )[:16]
+        self.board.put_job(
+            jid,
+            {
+                "buckets": n_buckets,
+                "keys": list(keys),
+                "kinds": kinds,
+                "sides": [
+                    {
+                        "name": s["name"],
+                        "ranges": s["ranges"],
+                        "fn": s["fn"],
+                        "map_tids": s["map_tids"],
+                        "columns": s["columns"],
+                    }
+                    for s in sides
+                ],
+                "reduce_tids": reduce_tids,
+                "reduce_fn": reduce_blob,
+                "combine": combine_blob,
+                "created": time.time(),
+            },
+        )
+        all_tids = map_tids + reduce_tids
+        self.stats.inc("jobs")
+        self.stats.inc("map_tasks", len(map_tids))
+        self.stats.inc("reduce_tasks", len(reduce_tids))
+        self.stats.inc("workflow_jobs")
+        self.stats.inc("workflow_tasks_dispatched", len(all_tids) - skipped)
+        self.stats.inc("workflow_partitions_delta_skipped", skipped)
+        return jid, all_tids
+
+    def run_workflow_job(
+        self,
+        left_paths: List[str],
+        right_paths: Optional[List[str]],
+        keys: List[str],
+        reduce_fn: Callable[..., pd.DataFrame],
+        *,
+        combine_fn: Optional[Callable[[List[pd.DataFrame]], pd.DataFrame]] = None,
+        map_left: Optional[Callable[[pd.DataFrame], pd.DataFrame]] = None,
+        map_right: Optional[Callable[[pd.DataFrame], pd.DataFrame]] = None,
+        buckets: Optional[int] = None,
+        paths_per_task: int = 1,
+        tokens: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
+    ) -> pd.DataFrame:
+        """One workflow fragment end to end: plan (content-addressed,
+        delta-skipping) + wait, with the job's recovery activity
+        attributed to the ``workflow_*`` counters. The kill-switch
+        (``fugue.tpu.dist.enabled=false``) runs the identical plan
+        serially in this process — bit-identical by construction."""
+        if not self.enabled:
+            return self._run_serial(
+                left_paths,
+                right_paths,
+                keys,
+                reduce_fn,
+                combine_fn=combine_fn,
+                map_left=map_left,
+                map_right=map_right,
+                buckets=buckets,
+                paths_per_task=paths_per_task,
+            )
+        before = self.stats.as_dict()
+        jid, all_tids = self.plan_workflow_job(
+            left_paths,
+            right_paths,
+            keys,
+            reduce_fn,
+            combine_fn=combine_fn,
+            map_left=map_left,
+            map_right=map_right,
+            buckets=buckets,
+            paths_per_task=paths_per_task,
+            tokens=tokens,
+        )
+        fails_before = sum(
+            1
+            for t in all_tids
+            for f in self.board.failures(t)
+            if f.get("category") != "poison"
+        )
+        try:
+            return self.wait_job(jid, timeout=timeout)
+        finally:
+            self._account_workflow(all_tids, before, fails_before)
+
+    def _account_workflow(
+        self,
+        tids: List[str],
+        before: Dict[str, Any],
+        fails_before: int,
+    ) -> None:
+        """Fold the recovery activity observed while a workflow job was
+        in flight into the workflow counters (before/after deltas over
+        the folded supervisor+worker totals — attributed to the observing
+        job, approximate only when unrelated jobs share the supervisor)."""
+        after = self.stats.as_dict()
+
+        def total(d: Dict[str, Any], name: str, fold_workers: bool) -> int:
+            t = int(d.get(name, 0) or 0)
+            if fold_workers:
+                for w in (d.get("workers") or {}).values():
+                    t += int(w.get(name, 0) or 0)
+            return t
+
+        for counter, name, fold in (
+            # steal classification is already folded into the redispatch
+            # totals by as_dict; orphan/speculative need the worker fold
+            ("workflow_tasks_stolen", "redispatch_worker_lost", False),
+            ("workflow_tasks_stolen", "redispatch_transient", False),
+            ("workflow_fragments_invalidated", "orphaned_outputs_recovered", True),
+            ("workflow_tasks_speculative", "speculative_marks", True),
+        ):
+            d = total(after, name, fold) - total(before, name, fold)
+            if d > 0:
+                self.stats.inc(counter, d)
+        fails_now = sum(
+            1
+            for t in tids
+            for f in self.board.failures(t)
+            if f.get("category") != "poison"
+        )
+        if fails_now > fails_before:
+            self.stats.inc(
+                "workflow_tasks_re_dispatched", fails_now - fails_before
+            )
 
     # -- monitoring / recovery ----------------------------------------------
     def _abort(self, jid: str, why: str, tids: List[str]) -> None:

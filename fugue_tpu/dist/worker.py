@@ -47,10 +47,13 @@ import pandas as pd
 import pyarrow as pa
 
 from ..resilience import (
+    SITE_DIST_BOARD,
     SITE_DIST_LEASE,
+    Deadline,
     FailureCategory,
     FaultInjector,
     RetryPolicy,
+    WorkerLostError,
     classify_failure,
 )
 from ..shuffle.partitioner import bucket_ids
@@ -194,6 +197,13 @@ class DistWorker:
         self._injector = FaultInjector.from_conf(c)
         self.retry_policy = RetryPolicy.from_conf(
             c, prefix="fugue.tpu.retry.dist", default_attempts=4
+        )
+        from ..constants import FUGUE_TPU_CONF_RETRY_DIST_DEADLINE_S
+
+        # wall-clock budget across ALL attempts of one fragment fetch;
+        # <=0/unset = unbounded (the attempt budget alone bounds it)
+        self.fetch_deadline_s = float(
+            c.get(FUGUE_TPU_CONF_RETRY_DIST_DEADLINE_S, 20.0)
         )
         self.leases = LeaseBoard(
             self.board.leases_dir,
@@ -358,6 +368,13 @@ class DistWorker:
                 # ingests these when it collects the done record
                 payload["spans"] = tracer.take_since(mark)
             payload["stats"] = self.stats.as_dict()
+            # the dist.board fault site sits in the torn-publish window:
+            # every output is already durable (fragments / artifact) but
+            # the done record is not yet on the board — `kill` here leaves
+            # orphaned outputs for the steal + invalidation ladder to
+            # cover, `error` unwinds to a TRANSIENT re-dispatch whose
+            # re-publishes dedup by content address
+            self._injector.fire(SITE_DIST_BOARD)
             won = self.board.publish_done(tid, payload)
             self.stats.inc("tasks_completed")
             if speculative:
@@ -544,19 +561,51 @@ class DistWorker:
                 return tbl, False
             if self.fetch_mode == "local" or own:
                 return self._orphan(ptid, rec, f"local fragment {rel} unreadable")
-        # remote: the producer serves its own dir over /dist/fetch
+        # remote: the producer serves its own dir over /dist/fetch. The
+        # retry loop is the shared RetryPolicy (conf fugue.tpu.retry.dist.*)
+        # under a wall-clock Deadline (fugue.tpu.retry.dist.deadline_s) —
+        # backoff/jitter/attempt budget come from conf, not ad-hoc sleeps.
         addr = rec.get("addr")
         if not addr:
             return self._orphan(ptid, rec, "producer has no fetch address")
-        for attempt in range(3):
-            blob = self._http_fetch(addr[0], int(addr[1]), rel)
-            if blob is not None:
+        deadline = Deadline.after(self.fetch_deadline_s)
+        failures = 0
+        last: Optional[BaseException] = None
+        while True:
+            try:
+                blob = self._http_fetch(addr[0], int(addr[1]), rel)
+            except ConnectionRefusedError:
+                # nothing is listening on the producer's advertised port:
+                # the process is gone, not slow — orphan immediately and
+                # classify the re-dispatch WORKER_LOST instead of burning
+                # the TRANSIENT backoff budget on a dead peer
+                return self._orphan(
+                    ptid,
+                    rec,
+                    f"connection refused fetching {rel} from {addr}",
+                    err_type=WorkerLostError,
+                )
+            except Exception as e:
+                last = e
+            else:
                 tbl = self._decode_fragment(blob, want_rows)
                 if tbl is not None:
                     return tbl, True
                 break  # complete transfer, bad content: torn at source
-            time.sleep(0.1 * (attempt + 1))
-        return self._orphan(ptid, rec, f"remote fetch of {rel} from {addr} failed")
+            failures += 1
+            if deadline.expired or not self.retry_policy.should_retry(
+                classify_failure(last), failures
+            ):
+                break
+            pause = self.retry_policy.delay(failures, seed=rel)
+            rem = deadline.remaining()
+            time.sleep(pause if rem is None else min(pause, rem))
+        return self._orphan(
+            ptid,
+            rec,
+            f"remote fetch of {rel} from {addr} failed after "
+            f"{failures} attempt(s) (last: {last})",
+        )
 
     @staticmethod
     def _read_fragment_file(path: str, want_rows: int) -> Optional[pa.Table]:
@@ -578,7 +627,11 @@ class DistWorker:
             return None
         return tbl if tbl.num_rows == want_rows else None
 
-    def _http_fetch(self, host: str, port: int, rel: str) -> Optional[bytes]:
+    def _http_fetch(self, host: str, port: int, rel: str) -> bytes:
+        """One GET against the producer's /dist/fetch route. Raises on
+        any transport failure — ConnectionRefusedError propagates intact
+        so the caller can prove the producer WORKER_LOST — and a non-200
+        status raises TRANSIENT (producer alive, fragment unservable)."""
         conn = http.client.HTTPConnection(host, port, timeout=2.0)
         try:
             conn.request(
@@ -587,25 +640,33 @@ class DistWorker:
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
-                return None
+                raise BucketUnavailableError(
+                    f"/dist/fetch {rel} from {host}:{port} -> "
+                    f"HTTP {resp.status}"
+                )
             return body
-        except Exception:
-            return None
         finally:
             conn.close()
 
-    def _orphan(self, ptid: str, rec: Dict[str, Any], why: str) -> Any:
+    def _orphan(
+        self,
+        ptid: str,
+        rec: Dict[str, Any],
+        why: str,
+        err_type: type = BucketUnavailableError,
+    ) -> Any:
         """The remote-fetch extension of PR 8's torn-bucket recovery: the
         consumer proves the output unreachable, deletes the producer's
         done record (any live worker re-executes it — deterministic, so
-        bit-identical fragments reappear) and retries as TRANSIENT."""
+        bit-identical fragments reappear) and re-raises — TRANSIENT by
+        default, WORKER_LOST when the evidence is a refused connection."""
         self.stats.inc("fetch_failures")
         alive = holder_alive(
             str(rec.get("worker") or ""), self.board.hb_dir, self.hb_stale_s
         )
         if self.board.invalidate_done(ptid):
             self.stats.inc("orphaned_outputs_recovered")
-        raise BucketUnavailableError(
+        raise err_type(
             f"{why}; producer {rec.get('worker')!r} "
             f"{'alive' if alive else 'dead/unknown'}; done record "
             f"invalidated for re-dispatch"

@@ -16,8 +16,18 @@ Runs at ``workflow.run()`` time over the task graph, before execution:
 Disable with ``fugue.tpu.plan.optimize=false`` (or per pass:
 ``.prune`` / ``.pushdown`` / ``.fuse`` / ``.lower_segments``). Every
 rewrite is result-identical to the unoptimized path.
+
+A separate post-optimization pass (``distribute.py``) partitions the
+task DAG into board jobs for the fault-tolerant dist tier when
+``fugue.tpu.dist.board`` is set — see docs/distributed.md.
 """
 
+from .distribute import (
+    DistributePlan,
+    describe_distribution,
+    execute_fragment,
+    plan_distribution,
+)
 from .fused import FusedVerbs, apply_steps_engine, compose_steps
 from .lowering import (
     LoweredSegment,
@@ -28,6 +38,7 @@ from .lowering import (
 from .optimizer import PlanReport, PlanStats, explain_tasks, optimize_tasks
 
 __all__ = [
+    "DistributePlan",
     "FusedVerbs",
     "LoweredSegment",
     "PlanReport",
@@ -35,7 +46,10 @@ __all__ = [
     "apply_steps_engine",
     "apply_terminal_engine",
     "compose_steps",
+    "describe_distribution",
+    "execute_fragment",
     "explain_tasks",
+    "plan_distribution",
     "lower_segments",
     "optimize_tasks",
     "segment_fingerprint",

@@ -9,6 +9,7 @@ from .counters import ResilienceStats
 from .fault import (
     NULL_INJECTOR,
     SITE_CHECKPOINT_SAVE,
+    SITE_DIST_BOARD,
     SITE_DIST_HEARTBEAT,
     SITE_DIST_LEASE,
     SITE_MAP_CHUNK,
@@ -47,6 +48,7 @@ __all__ = [
     "SITE_STREAM_CHUNK",
     "SITE_DIST_LEASE",
     "SITE_DIST_HEARTBEAT",
+    "SITE_DIST_BOARD",
     "RetryPolicy",
     "Deadline",
     "FailureCategory",

@@ -60,6 +60,7 @@ __all__ = [
     "SITE_SERVE_CLAIM",
     "SITE_DIST_LEASE",
     "SITE_DIST_HEARTBEAT",
+    "SITE_DIST_BOARD",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -99,6 +100,14 @@ SITE_DIST_LEASE = "dist.lease"
 # network partition: enough skipped beats and the worker reads as dead
 # to lease/claim stealers); `delay` widens the gap the same way
 SITE_DIST_HEARTBEAT = "dist.heartbeat"
+# inside DistWorker.run_task, between a done record's construction (all
+# task outputs durably written) and its exclusive-create publish to the
+# board (fugue_tpu/dist/worker.py) — `error` here records a TRANSIENT
+# failure with outputs orphaned on disk (the re-dispatch must republish or
+# dedup them); `kill` is the torn-publish crash window the lease-steal +
+# orphaned-fragment-invalidation ladder must cover without losing or
+# double-counting a row
+SITE_DIST_BOARD = "dist.board"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

@@ -35,11 +35,13 @@ class FugueWorkflowContext:
         # workflow-level fault plans / retry policies
         conf = conf if conf is not None else execution_engine.conf
         self._engine = execution_engine
+        self._conf = conf
         self._checkpoint_path = CheckpointPath(execution_engine, conf=conf)
         self._results: Dict[str, DataFrame] = {}
         self._aliases: Dict[int, FugueTask] = {}
         self._removed: Set[int] = set()
         self._cache_plan: Any = None
+        self._dist_plan: Any = None
         # fault budgets span the whole run (an injected `error@1` fails one
         # task once, not once per retry attempt)
         self._injector = FaultInjector.from_conf(conf)
@@ -83,6 +85,20 @@ class FugueWorkflowContext:
                 " keep it addressable, or disable the cache with "
                 "fugue.tpu.cache.enabled=false"
             )
+        dp = getattr(self, "_dist_plan", None)
+        if (
+            id(t) not in self._results
+            and dp is not None
+            and id(t) in dp.interior_ids
+        ):
+            raise FugueWorkflowError(
+                "this task executed REMOTELY as a leased board task inside a "
+                "distributed workflow fragment (fugue_tpu/plan/distribute.py,"
+                " docs/distributed.md); its intermediate frame never "
+                "materialized in this process. Pin it with persist()/"
+                "checkpoint()/yield_dataframe_as() to keep it local, or set "
+                "fugue.tpu.dist.enabled=false"
+            )
         return self._results[id(t)]
 
     def has_result(self, task: FugueTask) -> bool:
@@ -115,6 +131,25 @@ class FugueWorkflowContext:
 
             self._cache_plan = plan_cache(
                 tasks, self._engine, cache, self._checkpoint_path
+            )
+        # distributed-workflow pass (fugue_tpu/plan/distribute.py): with
+        # fugue.tpu.dist.board set, distributable fragments route through
+        # DistSupervisor.run_workflow_job and their interior tasks never
+        # run locally. Planner bugs must never fail a run: any planning
+        # error degrades to fully-local execution with a warning.
+        self._dist_plan = None
+        try:
+            from ..plan import plan_distribution
+
+            dp = plan_distribution(tasks, self._conf, self._cache_plan)
+            if dp.active and dp.fragments:
+                self._dist_plan = dp
+        except Exception as ex:  # pragma: no cover - defensive degrade
+            self._engine.log.warning(
+                "distributed-workflow planning failed (%s: %s); "
+                "running fully local",
+                type(ex).__name__,
+                ex,
             )
         # fan-out map: a ONE-PASS (local unbounded) result consumed by more
         # than one downstream task must be materialized once, or the second
@@ -152,7 +187,13 @@ class FugueWorkflowContext:
         the task's own inputs are checkpoint hits or absent)."""
         concurrency = self._engine.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
         plan = getattr(self, "_cache_plan", None)
-        cut = plan.skipped if plan is not None else set()
+        cut = set(plan.skipped) if plan is not None else set()
+        dp = getattr(self, "_dist_plan", None)
+        if dp is not None:
+            # fragment interiors execute remotely as leased board map/reduce
+            # tasks; locally they are part of the cut (their consumers — the
+            # fragment result tasks — are intercepted in _run_task_once)
+            cut |= dp.interior_ids
         if concurrency <= 1:
             for t in tasks:
                 if id(t) not in cut:
@@ -297,6 +338,30 @@ class FugueWorkflowContext:
             # (a later exact-match run takes the whole-task fast path) and
             # appends the fresh segment / partial to the manifest
             self._maybe_cache_publish(task, result, delta_hit=hit)
+            return
+        dp = getattr(self, "_dist_plan", None)
+        if dp is not None and id(task) in dp.results:
+            # fragment result: the whole covered subgraph (loads, row-local
+            # chains, shuffle, terminal, tail) ran as leased board tasks
+            # under the dist recovery ladder; only the combined frame lands
+            # here. The result still flows through set_result so the
+            # checkpoint/broadcast/yield contracts — and the cache publish
+            # below — behave exactly as a locally-computed frame would.
+            from ..plan import execute_fragment
+
+            frag = dp.results[id(task)]
+            with get_tracer().span(
+                "dist.workflow_fragment",
+                cat="dist",
+                task=task.name or type(task.extension).__name__,
+                keys=",".join(frag.keys),
+                buckets=frag.buckets,
+            ):
+                pdf = execute_fragment(frag, self._engine, self._conf)
+                df = self._engine.to_df(pdf)
+                result = task.set_result(self, df)
+                self._results[id(task)] = result
+            self._maybe_cache_publish(task, result)
             return
         inputs = [self._results[id(d)] for d in task.inputs]
         self._injector.fire(SITE_TASK_EXECUTE)

@@ -1041,6 +1041,11 @@ class FugueWorkflow:
                 engine_kind="any" if engine is None else type(engine).__name__,
             )
         )
+        # distributed workflows (docs/distributed.md): which fragments
+        # would route through the board tier and why the rest refuse
+        from ..plan import describe_distribution
+
+        lines.extend(describe_distribution(run_tasks, merged))
         # adaptive tuning (docs/tuning.md): what the tuner would use for
         # this plan right now — every learned knob with its evidence and
         # confidence, or why each stays static

@@ -446,8 +446,10 @@ def test_orphaned_fragment_recovery_dead_producer(tmp_path):
     fetched: the consumer proves the fragments unreachable, invalidates
     the producer's done records (orphan recovery — the remote-fetch
     extension of PR 8 torn-bucket recovery), re-runs the maps itself and
-    the job still completes bit-identically."""
-    from fugue_tpu.dist.worker import BucketUnavailableError
+    the job still completes bit-identically. A refused connection is
+    proof the producer process is GONE, so the recorded category is
+    WORKER_LOST (not TRANSIENT backoff against a dead peer)."""
+    from fugue_tpu.resilience import WorkerLostError
 
     left, right = _write_inputs(tmp_path, n_left=2, n_right=1)
     board = tmp_path / "board"
@@ -469,9 +471,9 @@ def test_orphaned_fragment_recovery_dead_producer(tmp_path):
     producer.heartbeat.stop(remove=False)
     time.sleep(0.7)
     rtid = f"{jid}-r-0000"
-    with pytest.raises(BucketUnavailableError) as ei:
+    with pytest.raises(WorkerLostError) as ei:
         consumer._execute_reduce(consumer.board.read_task(rtid))
-    assert classify_failure(ei.value) is FailureCategory.TRANSIENT
+    assert classify_failure(ei.value) is FailureCategory.WORKER_LOST
     assert consumer.stats.get("orphaned_outputs_recovered") >= 1
     # at least one producer done record was invalidated for re-dispatch
     assert any(sup.board.read_done(t) is None for t in map_tids)
@@ -652,3 +654,109 @@ def test_kill_switch_restores_single_process_bit_identically(tmp_path):
     )
     assert dist.equals(serial)
     assert off.board.list_tasks() == []  # nothing ever hit the board
+
+# ---------------------------------------------------------------------------
+# workflow jobs on the board (ISSUE 16, fugue_tpu/plan/distribute.py)
+# ---------------------------------------------------------------------------
+
+
+def _serial_workflow(board, left, right, **kw):
+    sup = DistSupervisor(
+        str(board), conf=dict(CONF, **{"fugue.tpu.dist.enabled": False})
+    )
+    return sup.run_workflow_job(
+        left, right, ["k"], _reduce, combine_fn=_combine, map_left=_map_left, **kw
+    )
+
+
+def test_workflow_job_bit_identical_and_warm_delta_skip(tmp_path):
+    """run_workflow_job executes on the worker tier bit-identically to the
+    kill-switch serial path, and a WARM rerun finds every content-addressed
+    task already done on the board — zero re-dispatch, all partitions
+    delta-skipped."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial_workflow(tmp_path / "oracle", left, right, buckets=4)
+    tokens = {"left": "assign v2", "reduce": "join+agg"}
+    pool = _WorkerPool(board, 2)
+    try:
+        sup = DistSupervisor(str(board), conf=dict(CONF))
+        got = sup.run_workflow_job(
+            left, right, ["k"], _reduce, combine_fn=_combine,
+            map_left=_map_left, buckets=4, tokens=tokens, timeout=60,
+        )
+        assert got.equals(serial)
+        d1 = sup.stats.as_dict()
+        assert d1["workflow_jobs"] == 1
+        assert d1["workflow_tasks_dispatched"] == 9  # 5 maps + 4 reduces
+        assert d1["workflow_partitions_delta_skipped"] == 0
+        # warm rerun: same fragment logic + same source files -> same
+        # content-addressed tids -> every done record reused
+        got2 = sup.run_workflow_job(
+            left, right, ["k"], _reduce, combine_fn=_combine,
+            map_left=_map_left, buckets=4, tokens=tokens, timeout=60,
+        )
+        assert got2.equals(serial)
+        d2 = sup.stats.as_dict()
+        assert d2["workflow_partitions_delta_skipped"] == 9
+        assert d2["workflow_tasks_dispatched"] == 9  # unchanged: 0 new
+    finally:
+        pool.close()
+
+
+def test_workflow_job_supervisor_restart_mid_reduce_with_waiter(tmp_path):
+    """Crash the supervisor AFTER the map wave completes (mid-REDUCE) and
+    attach a NEW supervisor as the waiter: the job completes from board
+    state alone, bit-identical, audit 0 lost / 0 double-counted."""
+    left, right = _write_inputs(tmp_path)
+    board = tmp_path / "board"
+    serial = _serial_workflow(tmp_path / "oracle", left, right, buckets=4)
+    sup1 = DistSupervisor(str(board), conf=dict(CONF))
+    jid, tids = sup1.plan_workflow_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=4,
+    )
+    map_tids = [t for t in tids if t.startswith("wfm-")]
+    pool = _WorkerPool(board, 2)
+    try:
+        # wait until every map is done (reduces now in flight), then crash
+        deadline = time.monotonic() + 30
+        while sup1.board.done_count(map_tids) < len(map_tids):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        del sup1
+        sup2 = DistSupervisor(str(board), conf=dict(CONF))
+        got = sup2.wait_job(jid, timeout=60)
+        assert got.equals(serial)
+        audit = sup2.audit_job(jid)
+        assert audit["rows_lost"] == 0 and audit["rows_double_counted"] == 0
+    finally:
+        pool.close()
+
+
+def test_dist_board_fault_site_transient_retry(tmp_path):
+    """dist.board fires between the done-record write window and publish:
+    the task's outputs are already durable, the failure is recorded
+    TRANSIENT, and the retry republishes — one done record, no data loss."""
+    left, right = _write_inputs(tmp_path, n_left=1, n_right=1)
+    board = tmp_path / "board"
+    conf = dict(CONF, **{"fugue.tpu.fault.plan": "dist.board=error@1"})
+    w = DistWorker(str(board), "w0", conf=conf, start_http=False)
+    sup = DistSupervisor(str(board), conf=dict(CONF))
+    jid, tids = sup.plan_workflow_job(
+        left, right, ["k"], _reduce, combine_fn=_combine,
+        map_left=_map_left, buckets=1,
+    )
+    tid = [t for t in tids if t.startswith("wfm-")][0]
+    # first attempt eats the injected fault AFTER executing (outputs
+    # durable) but BEFORE publish: no done record yet, failure TRANSIENT
+    assert not w.run_task(tid)
+    assert sup.board.read_done(tid) is None
+    fails = sup.board.failures(tid)
+    assert len(fails) == 1 and fails[0]["category"] == "transient"
+    assert sup.leases.read(tid) is None  # lease released on unwind
+    # budget spent: the next scan retries, re-publishes, ONE done record
+    assert w.poll_once()
+    assert sup.board.read_done(tid) is not None
+    done = [n for n in os.listdir(sup.board.done_dir) if n.startswith(tid)]
+    assert len(done) == 1

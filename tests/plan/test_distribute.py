@@ -1,0 +1,458 @@
+"""Distributed workflow execution (ISSUE 16, fugue_tpu/plan/distribute.py).
+
+The planner pass that routes workflow.run through the fault-tolerant dist
+tier: fragment discovery over the post-optimization DAG, the refusal
+ladder (everything the planner cannot prove bucket-local stays local with
+the reason in explain()), end-to-end execution over in-process workers
+bit-identical to the single-process oracle, the kill-switch contract
+(fugue.tpu.dist.enabled=false -> planner inert -> identical engine-verb
+span multisets), warm-rerun delta-skip, and the interior get_result error.
+"""
+
+import collections
+import os
+import threading
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col
+from fugue_tpu.column import functions as ff
+from fugue_tpu.dist import DistWorker
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.plan import plan_distribution
+from fugue_tpu.workflow._tasks import FugueTask  # noqa: F401 (API surface)
+
+BASE = {
+    "fugue.tpu.cache.enabled": False,
+    "fugue.tpu.tuning.enabled": False,
+    "fugue.tpu.dist.heartbeat.interval_s": 0.1,
+    "fugue.tpu.dist.heartbeat.stale_after_s": 0.6,
+    "fugue.tpu.dist.poll_s": 0.01,
+    "fugue.tpu.dist.buckets": 4,
+}
+
+
+def _sources(tmp_path, n_left=3, n_right=2):
+    ldir = tmp_path / "left"
+    rdir = tmp_path / "right"
+    ldir.mkdir(exist_ok=True)
+    rdir.mkdir(exist_ok=True)
+    for i in range(n_left):
+        pd.DataFrame(
+            {
+                "k": [(j * 3 + i) % 7 for j in range(40)],
+                "v": [float(j + i * 40) for j in range(40)],
+            }
+        ).to_parquet(str(ldir / f"l{i}.parquet"))
+    for i in range(n_right):
+        pd.DataFrame(
+            {"k": list(range(7)), "w": [float(i * 10 + j) for j in range(7)]}
+        ).to_parquet(str(rdir / f"r{i}.parquet"))
+    return str(ldir), str(rdir)
+
+
+class _Pool:
+    def __init__(self, board, n=2, conf=None):
+        os.makedirs(str(board), exist_ok=True)
+        self.stop_file = os.path.join(str(board), "_stop")
+        self.workers = [
+            DistWorker(str(board), f"w{i}", conf=dict(conf or BASE)).start()
+            for i in range(n)
+        ]
+        self.threads = [
+            threading.Thread(
+                target=w.serve_forever,
+                kwargs={"stop_file": self.stop_file},
+                daemon=True,
+            )
+            for w in self.workers
+        ]
+        for t in self.threads:
+            t.start()
+
+    def close(self):
+        with open(self.stop_file, "w") as f:
+            f.write("stop")
+        for t in self.threads:
+            t.join(timeout=10)
+        for w in self.workers:
+            w.stop()
+
+
+def _join_agg(dag, ldir, rdir):
+    a = dag.load(ldir, fmt="parquet").filter(col("v") > 10)
+    b = dag.load(rdir, fmt="parquet")
+    (
+        a.join(b, how="inner", on=["k"])
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("w")).alias("n"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+
+
+def _sql_wf(dag, ldir, rdir):
+    a = dag.load(ldir, fmt="parquet")
+    b = dag.load(rdir, fmt="parquet")
+    dag.select(
+        "SELECT a.k AS k, SUM(a.v * b.w) AS s, COUNT(*) AS n FROM ",
+        a,
+        " AS a INNER JOIN ",
+        b,
+        " AS b ON a.k = b.k WHERE a.v > 10 GROUP BY a.k",
+    ).yield_dataframe_as("r", as_local=True)
+
+
+def _canon(pdf):
+    return (
+        pdf.sort_values(list(pdf.columns))
+        .reset_index(drop=True)
+        .reindex(sorted(pdf.columns), axis=1)
+    )
+
+
+def _run(build, ldir, rdir, conf, engine=None):
+    eng = engine if engine is not None else NativeExecutionEngine(dict(BASE))
+    dag = FugueWorkflow()
+    build(dag, ldir, rdir)
+    dag.run(eng, conf=conf)
+    return dag.yields["r"].result.as_pandas(), eng
+
+
+# ---------------------------------------------------------------------------
+# planner units (dry: plan_distribution / explain, no workers)
+# ---------------------------------------------------------------------------
+
+
+def _plan_of(build, ldir, rdir, board, extra=None):
+    from fugue_tpu._utils.params import ParamDict
+    from fugue_tpu.plan import optimize_tasks
+
+    dag = FugueWorkflow()
+    build(dag, ldir, rdir)
+    conf = ParamDict(dict(BASE, **{"fugue.tpu.dist.board": board}))
+    conf.update(extra or {})
+    tasks, _, _, _ = optimize_tasks(dag._tasks, conf)
+    return plan_distribution(tasks, conf)
+
+
+def test_planner_inert_without_board_or_disabled(tmp_path):
+    ldir, rdir = _sources(tmp_path)
+    plan = _plan_of(_join_agg, ldir, rdir, "")
+    assert not plan.active and not plan.fragments
+    plan = _plan_of(
+        _join_agg,
+        ldir,
+        rdir,
+        str(tmp_path / "board"),
+        {"fugue.tpu.dist.enabled": False},
+    )
+    assert not plan.active and not plan.fragments
+
+
+def test_planner_finds_join_agg_fragment(tmp_path):
+    """The canonical workflow lowers to one segment; the planner claims
+    the whole subgraph (both loads, the segment, the tail aggregate)."""
+    ldir, rdir = _sources(tmp_path)
+    plan = _plan_of(_join_agg, ldir, rdir, str(tmp_path / "board"))
+    assert plan.active and len(plan.fragments) == 1 and not plan.refusals
+    frag = plan.fragments[0]
+    assert frag.keys == ["k"]
+    assert frag.terminal[0] == "join"
+    assert len(frag.covered_ids) == 4
+    assert [len(s["paths"]) for s in frag.sides] == [3, 2]
+    # the filter rides the left map body
+    assert any(st[0] == "filter" for st in frag.sides[0]["steps"])
+    # the keyed tail aggregate rides the reduce
+    assert frag.tail_ops and frag.tail_ops[-1][0] == "aggregate"
+
+
+def test_planner_finds_sql_fragment(tmp_path):
+    ldir, rdir = _sources(tmp_path)
+    plan = _plan_of(_sql_wf, ldir, rdir, str(tmp_path / "board"))
+    assert len(plan.fragments) == 1 and not plan.refusals
+    frag = plan.fragments[0]
+    assert frag.terminal[0] == "sql" and frag.keys == ["k"]
+    assert frag.terminal[2] == ["_0", "_1"]
+
+
+def test_refusal_non_parquet_source(tmp_path):
+    ldir, rdir = _sources(tmp_path)
+    csv = tmp_path / "csv_src"
+    csv.mkdir()
+    pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]}).to_csv(
+        str(csv / "a.csv"), index=False
+    )
+
+    def build(dag, l, r):
+        a = dag.load(str(csv), fmt="csv", columns="k:long,v:double")
+        b = dag.load(r, fmt="parquet")
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+
+    plan = _plan_of(build, ldir, rdir, str(tmp_path / "board"))
+    assert not plan.fragments and plan.refusals
+    assert any("csv" in why for _, why in plan.refusals)
+
+
+def test_refusal_non_row_local_interior(tmp_path):
+    """A distinct() between load and join has no row-local step form —
+    the fragment refuses and the subgraph stays local."""
+    ldir, rdir = _sources(tmp_path)
+
+    def build(dag, l, r):
+        a = dag.load(l, fmt="parquet").distinct()
+        b = dag.load(r, fmt="parquet")
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+
+    plan = _plan_of(build, ldir, rdir, str(tmp_path / "board"))
+    assert not plan.fragments
+    assert plan.refusals
+
+
+def test_refusal_pinned_and_multi_consumer_interiors(tmp_path):
+    """A yielded (pinned) side frame, or one consumed by two terminals,
+    must materialize locally — both rungs show up as refusals."""
+    ldir, rdir = _sources(tmp_path)
+
+    def pinned(dag, l, r):
+        a = dag.load(l, fmt="parquet")
+        b = dag.load(r, fmt="parquet")
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+        a.yield_dataframe_as("a_too", as_local=True)
+
+    plan = _plan_of(pinned, ldir, rdir, str(tmp_path / "board"))
+    assert not plan.fragments
+    assert any("pinned" in why for _, why in plan.refusals)
+
+    def fan_out(dag, l, r):
+        a = dag.load(l, fmt="parquet")
+        b = dag.load(r, fmt="parquet")
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+        a.join(b, how="left_outer", on=["k"]).yield_dataframe_as(
+            "r2", as_local=True
+        )
+
+    plan = _plan_of(fan_out, ldir, rdir, str(tmp_path / "board"))
+    assert not plan.fragments
+    assert any("consumer" in why for _, why in plan.refusals)
+
+
+def test_refusal_sql_shapes(tmp_path):
+    """ORDER BY / DISTINCT / global aggregates are not bucket-local."""
+    ldir, rdir = _sources(tmp_path)
+    shapes = {
+        "order": (
+            "SELECT a.k, a.v FROM ",
+            " AS a INNER JOIN ",
+            " AS b ON a.k = b.k ORDER BY a.v",
+        ),
+        "distinct": (
+            "SELECT DISTINCT a.k FROM ",
+            " AS a INNER JOIN ",
+            " AS b ON a.k = b.k",
+        ),
+        "global_agg": (
+            "SELECT SUM(a.v) AS s FROM ",
+            " AS a INNER JOIN ",
+            " AS b ON a.k = b.k",
+        ),
+    }
+    for name, (head, mid, tail) in shapes.items():
+
+        def build(dag, l, r, head=head, mid=mid, tail=tail):
+            a = dag.load(l, fmt="parquet")
+            b = dag.load(r, fmt="parquet")
+            dag.select(head, a, mid, b, tail).yield_dataframe_as(
+                "r", as_local=True
+            )
+
+        plan = _plan_of(build, ldir, rdir, str(tmp_path / "board"))
+        assert not plan.fragments, name
+        assert plan.refusals, name
+
+
+def test_explain_renders_board_plan(tmp_path):
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    dag = FugueWorkflow()
+    _join_agg(dag, ldir, rdir)
+    out = dag.explain(conf=dict(BASE, **{"fugue.tpu.dist.board": board}))
+    assert "== distributed workflows (board=" in out
+    assert "1 fragment(s), 0 refused" in out
+    assert "map[left]: 3 file(s)" in out
+    # off / disabled renderings
+    out_off = dag.explain(conf=dict(BASE))
+    assert "distributed workflows: off" in out_off
+    out_dis = dag.explain(
+        conf=dict(
+            BASE,
+            **{
+                "fugue.tpu.dist.board": board,
+                "fugue.tpu.dist.enabled": False,
+            },
+        )
+    )
+    assert "distributed workflows: disabled" in out_dis
+
+
+# ---------------------------------------------------------------------------
+# end to end over in-process workers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("build", [_join_agg, _sql_wf], ids=["functional", "sql"])
+def test_workflow_run_distributed_bit_identical(tmp_path, build):
+    """workflow.run with a board routes the fragment through the dist
+    tier; the (canonicalized) result is identical to the dist-disabled
+    single-process run and the workflow counters land in engine stats."""
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    oracle, _ = _run(
+        build,
+        ldir,
+        rdir,
+        {"fugue.tpu.dist.board": board, "fugue.tpu.dist.enabled": False},
+    )
+    pool = _Pool(board)
+    try:
+        got, eng = _run(build, ldir, rdir, {"fugue.tpu.dist.board": board})
+        pd.testing.assert_frame_equal(_canon(oracle), _canon(got))
+        d = eng.stats()["dist"]
+        assert d["workflow_jobs"] == 1
+        assert d["workflow_tasks_dispatched"] > 0
+    finally:
+        pool.close()
+
+
+def test_workflow_warm_rerun_delta_skips_unchanged_partitions(tmp_path):
+    """Warm distributed rerun over the SAME sources reuses every
+    content-addressed done record; over an APPENDED source only the new
+    partition's map (and downstream reduces) re-dispatch."""
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    pool = _Pool(board)
+    try:
+        eng = NativeExecutionEngine(dict(BASE))
+        conf = {"fugue.tpu.dist.board": board}
+        got1, _ = _run(_join_agg, ldir, rdir, conf, engine=eng)
+        d1 = dict(eng.stats()["dist"])
+        # warm: identical sources -> all 9 tasks (5 maps + 4 reduces) reused
+        got2, _ = _run(_join_agg, ldir, rdir, conf, engine=eng)
+        d2 = dict(eng.stats()["dist"])
+        assert got2.equals(got1)
+        assert (
+            d2["workflow_partitions_delta_skipped"]
+            - d1.get("workflow_partitions_delta_skipped", 0)
+            == 9
+        )
+        assert d2["workflow_tasks_dispatched"] == d1["workflow_tasks_dispatched"]
+        # append one file to the left source: its map is NEW, the other 5
+        # maps are reused (reduces depend on the map set, so they rerun)
+        pd.DataFrame(
+            {"k": [1, 2, 3], "v": [500.0, 600.0, 700.0]}
+        ).to_parquet(os.path.join(ldir, "l9.parquet"))
+        got3, _ = _run(_join_agg, ldir, rdir, conf, engine=eng)
+        d3 = dict(eng.stats()["dist"])
+        skipped = (
+            d3["workflow_partitions_delta_skipped"]
+            - d2["workflow_partitions_delta_skipped"]
+        )
+        dispatched = (
+            d3["workflow_tasks_dispatched"] - d2["workflow_tasks_dispatched"]
+        )
+        assert skipped == 5  # 3 old left maps + 2 right maps reused
+        assert dispatched == 5  # 1 new map + a fresh wave of 4 reduces
+        # the appended rows are in the result
+        oracle, _ = _run(
+            _join_agg,
+            ldir,
+            rdir,
+            {"fugue.tpu.dist.board": board, "fugue.tpu.dist.enabled": False},
+        )
+        pd.testing.assert_frame_equal(_canon(oracle), _canon(got3))
+    finally:
+        pool.close()
+
+
+def test_kill_switch_identical_span_multisets(tmp_path):
+    """fugue.tpu.dist.enabled=false with a board set must be bit-identical
+    to no board at all — including the MULTISET of engine-verb spans (the
+    planner is inert, so the local path is byte-for-byte the same code)."""
+    from fugue_tpu.obs import get_tracer
+
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    tracer = get_tracer()
+    tracer.enable()
+    try:
+
+        def spans(conf):
+            tracer.clear()
+            got, _ = _run(_join_agg, ldir, rdir, conf)
+            multiset = collections.Counter(
+                r["name"]
+                for r in tracer.records()
+                if r.get("cat") in ("engine", "workflow")
+            )
+            return got, multiset
+
+        got_off, spans_off = spans(
+            {"fugue.tpu.dist.board": board, "fugue.tpu.dist.enabled": False}
+        )
+        got_none, spans_none = spans({})
+        assert got_off.equals(got_none)
+        assert spans_off == spans_none
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+def test_interior_result_raises_descriptive_error(tmp_path):
+    """Asking for a frame that executed remotely inside a fragment names
+    the dist tier and the pin/kill-switch escape hatches."""
+    from fugue_tpu.exceptions import FugueWorkflowError
+
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    pool = _Pool(board)
+    try:
+        eng = NativeExecutionEngine(dict(BASE))
+        dag = FugueWorkflow()
+        a = dag.load(ldir, fmt="parquet")
+        b = dag.load(rdir, fmt="parquet")
+        a.join(b, how="inner", on=["k"]).yield_dataframe_as("r", as_local=True)
+        dag.run(eng, conf={"fugue.tpu.dist.board": board})
+        with pytest.raises(FugueWorkflowError, match="REMOTELY|dist"):
+            _ = a.result
+    finally:
+        pool.close()
+
+
+def test_cache_hit_blocks_fragment_warm_local_wins(tmp_path):
+    """With the result cache on, a warm run serves the terminal from the
+    local cache and the planner must NOT claim the fragment (no board
+    traffic at all on the second run)."""
+    ldir, rdir = _sources(tmp_path)
+    board = str(tmp_path / "board")
+    cache_dir = str(tmp_path / "cache")
+    conf = dict(
+        BASE,
+        **{
+            "fugue.tpu.cache.enabled": True,
+            "fugue.tpu.cache.dir": cache_dir,
+            "fugue.tpu.dist.board": board,
+        },
+    )
+    pool = _Pool(board)
+    try:
+        eng = NativeExecutionEngine(dict(conf))
+        got1, _ = _run(_join_agg, ldir, rdir, {}, engine=eng)
+        d1 = dict(eng.stats().get("dist", {}))
+        got2, _ = _run(_join_agg, ldir, rdir, {}, engine=eng)
+        d2 = dict(eng.stats().get("dist", {}))
+        assert got2.equals(got1)
+        # the warm run planned NO new workflow job: the cache cut won
+        assert d2.get("workflow_jobs", 0) == d1.get("workflow_jobs", 0)
+    finally:
+        pool.close()
