@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-exchange test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -79,6 +79,15 @@ test-lowering:
 # hash-repartition round trip, torn-spill recovery, conf gates
 test-shuffle:
 	JAX_PLATFORMS=cpu python -m pytest tests/jax_engine/test_shuffle.py -q -m "not slow"
+
+# device-resident staged exchange suite (docs/shuffle.md
+# "device_exchange"): rung parity vs spill and the legacy ladder across
+# dup/NULL/-0.0/tz-aware keys, kill-switch bit-identity with identical
+# engine-verb span multisets, over-budget forced spill fallback, the
+# staged-schedule peak-stage-bytes bound from the high-water gauge, and
+# the mem-bucket decoded-form ingest cache
+test-exchange:
+	JAX_PLATFORMS=cpu python -m pytest tests/jax_engine/test_device_exchange.py -q -m "not slow"
 
 # result-cache suite (docs/cache.md): cached-hit parity, invalidation
 # (mutated files / edited UDFs / partition specs), poisoned-subtree

@@ -693,6 +693,16 @@ def _worker_hpo() -> None:
     _timed_burst(run_once, "resid", HPO_CONFIGS * HPO_ROWS_PER, verify)
 
 
+def _worker_device_exchange() -> None:
+    """``--worker=xchg``: the device_exchange case needs a REAL multi-
+    device mesh, and the virtual cpu mesh can only be forced before jax
+    initializes — so the smoke gate runs it through the worker-subprocess
+    protocol (``_force_cpu_mesh`` fires in the dispatch, pre-import)
+    instead of in-process like the other cases. Last stdout line is the
+    case's result dict."""
+    print(json.dumps(_bench_device_exchange()))
+
+
 def _run_worker_best(
     name: str, fallback_cpu: bool, runs: int = 2, extra_env: Optional[dict] = None
 ) -> dict:
@@ -1509,16 +1519,22 @@ def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> d
     to the host oracle, and exactly ZERO broadcast-strategy joins in the
     ``engine.join`` span attrs (the whole point is that nothing was ever
     resident at once)."""
+    import gc
+
     import numpy as _np
     import pandas as _pd
 
     from fugue_tpu.constants import (
         FUGUE_TPU_CONF_CACHE_ENABLED,
         FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
     )
     from fugue_tpu.jax import JaxExecutionEngine
     from fugue_tpu.obs import get_tracer
 
+    # the peak gate sums EVERY live device array — collect cyclic garbage
+    # a previous in-process case left behind so it can't decide this gate
+    gc.collect()
     rng = _np.random.default_rng(8)
     kmax = rows * 3  # mostly 1:1 matches with some dups — realistic equi-join
     left = _pd.DataFrame(
@@ -1532,6 +1548,10 @@ def _bench_shuffle_join(budget_bytes: int = 8 << 20, rows: int = 6_000_000) -> d
         {
             FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget_bytes,
             FUGUE_TPU_CONF_CACHE_ENABLED: False,
+            # this case measures the SPILL rung — keep the device_exchange
+            # rung out regardless of mesh size (extra.device_exchange
+            # covers that rung)
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: False,
         }
     )
     tracer = get_tracer()
@@ -1605,17 +1625,23 @@ def _bench_shuffle_pipeline(
       (one engine.join, one shuffle.partition per side, one
       shuffle.bucket per bucket) — the "restores PR 8" proof.
     """
+    import gc
+
     import numpy as _np
     import pandas as _pd
 
     from fugue_tpu.constants import (
         FUGUE_TPU_CONF_CACHE_ENABLED,
         FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
         FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED,
     )
     from fugue_tpu.jax import JaxExecutionEngine
     from fugue_tpu.obs import get_tracer
 
+    # the peak gate sums EVERY live device array — collect cyclic garbage
+    # a previous in-process case left behind so it can't decide this gate
+    gc.collect()
     rng = _np.random.default_rng(8)
     kmax = rows * 3
     left = _pd.DataFrame(
@@ -1632,6 +1658,9 @@ def _bench_shuffle_pipeline(
                 FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget_bytes,
                 FUGUE_TPU_CONF_CACHE_ENABLED: False,
                 FUGUE_TPU_CONF_SHUFFLE_PIPELINE_ENABLED: pipe,
+                # A/B measures pipelined vs barrier SPILL — pin the
+                # device_exchange rung off so mesh size can't reroute it
+                FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: False,
             }
         )
         l, r = eng.to_df(left), eng.to_df(right)
@@ -1674,7 +1703,8 @@ def _bench_shuffle_pipeline(
             "frame": got,
             "spans": spans,
             "bucket_span_ids": bucket_span_ids,
-            "stats": {k: int(v) for k, v in st.items()},
+            # device_budget_source is a string leaf — keep the numeric view
+            "stats": {k: int(v) for k, v in st.items() if not isinstance(v, str)},
         }
 
     pipe = _run(True, trace=False)
@@ -1749,6 +1779,166 @@ def _bench_shuffle_pipeline(
     }
 
 
+def _bench_device_exchange(
+    budget_bytes: int = 8 << 20, rows: int = 700_000, runs: int = 2
+) -> dict:
+    """Device-resident staged exchange case (ISSUE 17, docs/shuffle.md
+    "Device exchange"): a hash join whose sides exceed the per-device
+    budget but fit AGGREGATE mesh memory (budget × shards), run A/B —
+    the staged one-hop-at-a-time exchange rung against the
+    ``fugue.tpu.shuffle.device_exchange.enabled=false`` kill-switch,
+    which forces the SAME join through the spill rung. Gates (exit 18):
+
+    - every traced join ran strategy=device_exchange with the switch on
+      and shuffle_spill with it off (the ladder routed the band);
+    - exchange >= 1.3x the spill wall (best of ``runs`` each, so one-off
+      hop-kernel compiles don't decide the ratio);
+    - results bit-identical across the switch AND to the pandas oracle;
+    - ZERO spill machinery on the exchange run — no shuffle.partition /
+      shuffle.bucket spans, ``joins_spill == 0`` — the "zero host round
+      trips" proof: rows never left the device tier;
+    - the staged schedule held its memory bound:
+      0 < ``device_exchange_peak_stage_bytes`` <= the conf'd per-stage
+      payload cap (``exchange_stage_bytes``).
+    """
+    import numpy as _np
+    import pandas as _pd
+
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_CACHE_ENABLED,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
+    )
+    from fugue_tpu.jax import JaxExecutionEngine
+    from fugue_tpu.obs import get_tracer
+    from fugue_tpu.shuffle.strategy import default_mesh_shards, exchange_stage_bytes
+
+    rng = _np.random.default_rng(17)
+    kmax = rows * 3
+    left = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "a": rng.normal(size=rows)}
+    )
+    right = _pd.DataFrame(
+        {"k": rng.integers(0, kmax, rows), "b": rng.normal(size=rows)}
+    )
+    side_bytes = int(left.memory_usage(index=False).sum())
+    conf = {
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget_bytes,
+        FUGUE_TPU_CONF_CACHE_ENABLED: False,
+    }
+    stage_cap = exchange_stage_bytes(conf)
+    shards = default_mesh_shards()
+
+    def _run(exchange: bool, trace: bool) -> dict:
+        eng = JaxExecutionEngine(
+            dict(conf, **{FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: exchange})
+        )
+        l, r = eng.to_df(left), eng.to_df(right)
+        tracer = get_tracer()
+        was_enabled = tracer.enabled
+        mark = tracer.mark()
+        if trace:
+            tracer.enable()
+        spans: dict = {}
+        strategies: list = []
+        walls = []
+        got = None
+        try:
+            for n in range(runs):
+                t0 = time.perf_counter()
+                res = eng.join(l, r, how="inner", on=["k"])
+                tbl = res.as_arrow()
+                walls.append(time.perf_counter() - t0)
+                if got is None:
+                    got = (
+                        tbl.replace_schema_metadata(None)
+                        .to_pandas()
+                        .sort_values(["k", "a", "b"])
+                        .reset_index(drop=True)
+                    )
+                if trace and n == 0:
+                    for rec in tracer.take_since(mark):
+                        spans[rec["name"]] = spans.get(rec["name"], 0) + 1
+                        if rec["name"] == "engine.join":
+                            strategies.append(rec["args"].get("strategy"))
+                    if not was_enabled:
+                        tracer.disable()  # only the first run is traced
+        finally:
+            if not was_enabled:
+                tracer.disable()
+        st = eng.stats()["shuffle"]
+        return {
+            "wall_s": round(min(walls), 3),
+            "walls": [round(w, 3) for w in walls],
+            "frame": got,
+            "spans": spans,
+            "strategies": strategies,
+            "budget_source": str(st["device_budget_source"]),
+            "stats": {k: int(v) for k, v in st.items() if not isinstance(v, str)},
+        }
+
+    xchg = _run(True, trace=True)
+    spill = _run(False, trace=False)
+    oracle = (
+        left.merge(right, on="k")[list(xchg["frame"].columns)]
+        .sort_values(["k", "a", "b"])
+        .reset_index(drop=True)
+    )
+    parity_switch = bool(xchg["frame"].equals(spill["frame"]))
+    parity_oracle = bool(
+        xchg["frame"].equals(oracle.astype(xchg["frame"].dtypes.to_dict()))
+    )
+    speedup = round(spill["wall_s"] / max(xchg["wall_s"], 1e-9), 2)
+    routed = bool(
+        xchg["strategies"]
+        and all(s == "device_exchange" for s in xchg["strategies"])
+        and spill["stats"]["joins_spill"] >= 1
+        and spill["stats"]["device_exchange_joins"] == 0
+    )
+    # the "zero host round trips" proof: no spill machinery ran at all on
+    # the exchange side — not one partition pass, not one bucket file
+    no_spill_machinery = bool(
+        xchg["spans"].get("shuffle.partition", 0) == 0
+        and xchg["spans"].get("shuffle.bucket", 0) == 0
+        and xchg["spans"].get("shuffle.exchange", 0) >= 1
+        and xchg["stats"]["joins_spill"] == 0
+        and xchg["stats"]["device_exchange_joins"] >= 1
+    )
+    peak_stage = xchg["stats"]["device_exchange_peak_stage_bytes"]
+    return {
+        "rows_per_side": rows,
+        "side_bytes": side_bytes,
+        "device_budget_bytes": budget_bytes,
+        "aggregate_budget_bytes": budget_bytes * shards,
+        "mesh_shards": shards,
+        "budget_source": xchg["budget_source"],
+        "exchange_wall_s": xchg["wall_s"],
+        "spill_wall_s": spill["wall_s"],
+        "speedup": speedup,
+        "exchange_stages": xchg["stats"]["device_exchange_stages"],
+        "exchange_rows": xchg["stats"]["device_exchange_rows"],
+        "exchange_bytes": xchg["stats"]["device_exchange_bytes"],
+        "peak_stage_bytes": peak_stage,
+        "stage_cap_bytes": stage_cap,
+        "peak_stage_over_cap": round(peak_stage / max(stage_cap, 1), 3),
+        "peak_device_bytes": xchg["stats"]["peak_device_bytes"],
+        "exchange_spans": xchg["spans"],
+        "join_strategies": xchg["strategies"],
+        "parity_switch": parity_switch,
+        "parity_oracle": parity_oracle,
+        "routed": routed,
+        "no_spill_machinery": no_spill_machinery,
+        "correct": bool(
+            routed
+            and no_spill_machinery
+            and speedup >= 1.3
+            and parity_switch
+            and parity_oracle
+            and 0 < peak_stage <= stage_cap
+        ),
+    }
+
+
 def _bench_adaptive_tuning(
     rows: int = 400_000,
     misconf_chunk: int = 2048,
@@ -1782,6 +1972,7 @@ def _bench_adaptive_tuning(
         FUGUE_TPU_CONF_CACHE_ENABLED,
         FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES,
         FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
         FUGUE_TPU_CONF_STREAM_CHUNK_ROWS,
         FUGUE_TPU_CONF_TUNING_ENABLED,
     )
@@ -1895,6 +2086,9 @@ def _bench_adaptive_tuning(
                 FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: join_budget,
                 FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: join_bucket_bytes,
                 FUGUE_TPU_CONF_STREAM_CHUNK_ROWS: 1 << 20,
+                # this phase calibrates SPILL bucket sizing — on an 8-way
+                # mesh the exchange rung would swallow the join entirely
+                FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: False,
             },
         )
         jleft = _pd.DataFrame(
@@ -3187,6 +3381,15 @@ def _smoke() -> None:
     shuffle_pipeline_case = _bench_shuffle_pipeline(
         budget_bytes=1 << 20, rows=700_000
     )
+    # device-resident staged exchange (ISSUE 17): sides past the 8MiB
+    # per-device budget but inside aggregate mesh memory, A/B'd against
+    # the fugue.tpu.shuffle.device_exchange.enabled=false spill fallback;
+    # must be >=1.3x, bit-identical both ways, zero spill machinery on
+    # the exchange run, staged peak under the per-stage payload cap.
+    # Runs as a worker SUBPROCESS: the rung needs a multi-device mesh,
+    # and the virtual 8-way cpu mesh can only be forced before jax
+    # initializes — which already happened in this process
+    device_exchange_case = _run_worker("xchg", fallback_cpu=True)
     # UDF auto-trace (ISSUE 11): an untouched plain-pandas UDF must reach
     # >=5x over the interpreted path via analyzer translation — one
     # fused/lowered jit entry, zero per-verb launches, bit-identical
@@ -3214,6 +3417,7 @@ def _smoke() -> None:
         "segment_lowering": segment_case,
         "shuffle_join": shuffle_case,
         "shuffle_pipeline": shuffle_pipeline_case,
+        "device_exchange": device_exchange_case,
         "udf_trace": udf_case,
         "adaptive_tuning": tuning_case,
         "wall_s": round(time.perf_counter() - t0, 1),
@@ -3242,6 +3446,8 @@ def _smoke() -> None:
         raise SystemExit(14)
     if not shuffle_pipeline_case["correct"]:
         raise SystemExit(17)  # 15/16 are the fleet/dist chaos gates
+    if not device_exchange_case["correct"]:
+        raise SystemExit(18)
 
 
 def _trace_smoke(trace_dir: str) -> None:
@@ -3592,6 +3798,24 @@ def _telemetry_smoke(out_dir: str) -> None:
             "fugue_tpu_analysis_udfs_refused",
         ):
             assert want in final, f"{want} missing from /metrics exposition"
+        # device-exchange shuffle counters (ISSUE 17) flatten through
+        # engine.stats()["shuffle"]; the string device_budget_source leaf
+        # is skipped by the numeric flattener, so the exposition must
+        # stay valid (proven by validate_prometheus_text above) while
+        # still carrying every exchange counter + the staged-peak gauge
+        for want in (
+            "fugue_tpu_shuffle_device_exchange_joins",
+            "fugue_tpu_shuffle_device_exchange_fallbacks",
+            "fugue_tpu_shuffle_device_exchange_stages",
+            "fugue_tpu_shuffle_device_exchange_rows",
+            "fugue_tpu_shuffle_device_exchange_bytes",
+            "fugue_tpu_shuffle_device_exchange_peak_stage_bytes",
+            "fugue_tpu_shuffle_device_budget_bytes",
+        ):
+            assert want in final, f"{want} missing from /metrics exposition"
+        assert "device_budget_source" not in final, (
+            "string stats leaf leaked into the /metrics exposition"
+        )
         # distributed-workflow job counters (ISSUE 16) flatten through
         # engine.stats()["dist"] — the tiny board job above made them
         # live, so the exposition must carry them with workflow_jobs >= 1
@@ -3916,6 +4140,12 @@ def _main_impl(strict_tpu: bool = False) -> None:
                     # kill-switch — write-behind spill + mem-resident
                     # bucket tier + bucket-pair prefetch/grouping
                     "shuffle_pipeline": _bench_shuffle_pipeline(),
+                    # device-resident staged exchange (ISSUE 17): the
+                    # exchange-band join A/B'd against the kill-switched
+                    # spill fallback — rows move on-device with the
+                    # one-hop-at-a-time ppermute schedule, zero host
+                    # round trips
+                    "device_exchange": _bench_device_exchange(),
                     # multi-tenant serving (ISSUE 10): 8 clients × 4
                     # tenants × mixed workloads through one EngineServer
                     # with in-flight dedup, per-tenant p50/p99 + rows/s
@@ -4001,6 +4231,7 @@ if __name__ == "__main__":
             "compiled": _worker_compiled,
             "infer": _worker_infer,
             "hpo": _worker_hpo,
+            "xchg": _worker_device_exchange,
         }[name]()
     elif len(sys.argv) > 1 and sys.argv[1] == "--capture":
         main(strict_tpu=True)

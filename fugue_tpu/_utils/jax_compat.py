@@ -30,3 +30,16 @@ def axis_size(axis: str) -> Any:
         return lax.axis_size(axis)
     except AttributeError:
         return lax.psum(1, axis)
+
+
+def lax_ppermute(x: Any, axis: str, perm: Any) -> Any:
+    """Point-to-point ring permutation — the staged-exchange collective.
+
+    ``lax.ppermute`` has carried this signature since the pmap era, but
+    route it through the compat layer like ``shard_map``/``axis_size`` so
+    a future rename (``jax.lax.shift``-style proposals) lands in ONE
+    place instead of in every kernel.
+    """
+    from jax import lax
+
+    return lax.ppermute(x, axis, perm)

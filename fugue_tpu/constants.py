@@ -239,6 +239,22 @@ FUGUE_TPU_CONF_SHUFFLE_PREFETCH_DEPTH = "fugue.tpu.shuffle.prefetch_depth"
 # bounded write-behind queue depth (bucket batches in flight to the
 # background spill writer thread before the partitioner blocks)
 FUGUE_TPU_CONF_SHUFFLE_WRITEBEHIND_DEPTH = "fugue.tpu.shuffle.writebehind_depth"
+# --- device-resident exchange (docs/shuffle.md "Device exchange") ---
+# kill-switch for the device_exchange strategy rung: joins whose sides
+# exceed the per-device budget but fit aggregate mesh memory exchange
+# rows on-device with a staged one-hop-at-a-time collective schedule
+# instead of spilling. =false restores the three-rung ladder (such
+# joins spill, bit-identically to the pre-exchange behavior).
+FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED = (
+    "fugue.tpu.shuffle.device_exchange.enabled"
+)
+# per-stage collective payload cap for the staged exchange schedule, in
+# bytes per device. 0/unset = auto (1/8 of device_budget_bytes — small
+# enough that a stage buffer never threatens the budget, large enough
+# that per-stage fixed costs amortize across the schedule).
+FUGUE_TPU_CONF_SHUFFLE_EXCHANGE_STAGE_BYTES = (
+    "fugue.tpu.shuffle.device_exchange.stage_bytes"
+)
 
 # --- multi-tenant serving layer (fugue_tpu/serve, docs/serving.md) ---
 # concurrent workflow executions one EngineServer runs at a time (its

@@ -654,6 +654,16 @@ class JaxExecutionEngine(ExecutionEngine):
         self.metrics.register("pipeline", lambda: self._pipeline_stats)
         self.metrics.register("jit_cache", lambda: self._jit_cache)
         self.metrics.register("shuffle", lambda: self._shuffle_stats)
+        # record the resolved device budget + which detection source won
+        # (conf / device_memory_stats / host_meminfo / fallback) so a
+        # mis-detected budget is visible in engine.stats()["shuffle"]
+        from ..shuffle.strategy import device_budget_info
+
+        try:
+            _budget, _budget_src = device_budget_info(self.conf)
+            self._shuffle_stats.set_budget(_budget, _budget_src)
+        except Exception:
+            pass
 
     def _resource_probe_fns(self) -> Dict[str, Any]:
         # jax-engine occupancy for the continuous resource sampler
@@ -1551,8 +1561,11 @@ class JaxExecutionEngine(ExecutionEngine):
         decided from size estimates + conf by ``shuffle.strategy``:
         **broadcast** for small right sides, **copartition** (in-device
         all-to-all + shard-local probe) when both sides fit the device
-        budget at once, **shuffle_spill** (on-disk hash buckets joined
-        one pair at a time, ``fugue_tpu/shuffle/``) past it — the chosen
+        budget at once, **device_exchange** (staged one-hop-at-a-time
+        on-device exchange, ``fugue_tpu/shuffle/exchange.py``) when the
+        sides exceed the per-device budget but fit aggregate mesh
+        memory, **shuffle_spill** (on-disk hash buckets joined one pair
+        at a time, ``fugue_tpu/shuffle/``) past it — the chosen
         strategy is an attr on the ``engine.join`` span. right_outer
         mirrors left_outer; full_outer composes left_outer ∪ NULL-extended
         anti; cross runs through the expansion kernel on a constant key.
@@ -1617,8 +1630,38 @@ class JaxExecutionEngine(ExecutionEngine):
                 est_l, est_r, est_rr, tune = tuner.join_params(
                     est_l, est_r, est_rr
                 )
-            dec = choose_join_strategy(self.conf, est_l, est_r, est_rr)
-            if dec.strategy == "shuffle_spill" and shuffle_enabled(self.conf):
+            dec = choose_join_strategy(
+                self.conf,
+                est_l,
+                est_r,
+                est_rr,
+                n_shards=num_row_shards(self._mesh),
+            )
+            if dec.strategy == "device_exchange":
+                # sides past the per-device budget but within aggregate
+                # mesh memory: rows stay device-resident and move with
+                # the staged one-hop schedule (shuffle/exchange.py) —
+                # zero host round trips between partition and kernel
+                res = self._try_device_exchange(df1, df2, how, on, tune)
+                if res is not None:
+                    sp.set(strategy="device_exchange", reason=dec.reason)
+                    self._shuffle_stats.inc("device_exchange_joins")
+                    return res
+                # ineligible frames (host-resident columns, keys the
+                # preparers can't align, cross joins): spill remains the
+                # bit-identical fallback — same discipline as over-budget
+                self._shuffle_stats.inc("device_exchange_fallbacks")
+                if shuffle_enabled(self.conf):
+                    from ..shuffle.join import shuffle_spill_join
+
+                    res = shuffle_spill_join(self, df1, df2, how, on, tune=tune)
+                    if res is not None:
+                        sp.set(
+                            strategy="shuffle_spill",
+                            reason=f"device_exchange ineligible; {dec.reason}",
+                        )
+                        return res
+            elif dec.strategy == "shuffle_spill" and shuffle_enabled(self.conf):
                 from ..shuffle.join import shuffle_spill_join
 
                 res = shuffle_spill_join(self, df1, df2, how, on, tune=tune)
@@ -1663,7 +1706,47 @@ class JaxExecutionEngine(ExecutionEngine):
         sp.set(strategy="host")
         return self._back(self._host_engine.join(self._host(df1), self._host(df2), how=how, on=on))
 
-    def _full_outer_device(self, df1, df2, on) -> Optional[DataFrame]:
+    def _try_device_exchange(self, df1, df2, how: str, on, tune) -> Optional[DataFrame]:
+        """Run the join through the device_exchange rung: the same device
+        join-type dispatch as the generic ladder, but the co-partition
+        step uses the STAGED exchange (and broadcast is skipped — the
+        right side already failed the per-device budget). None → caller
+        falls back to spill, bit-identically."""
+        from ..dataframe.utils import parse_join_type
+
+        jt = parse_join_type(how)
+        if jt in ("inner", "left_outer", "left_semi", "left_anti"):
+            kernel_how = {
+                "inner": "inner",
+                "left_outer": "left_outer",
+                "left_semi": "semi",
+                "left_anti": "anti",
+            }[jt]
+            return self._join_device(
+                df1, df2, kernel_how, on, exchange_staged=True, tune=tune
+            )
+        if jt == "right_outer":
+            res = self._join_device(
+                df2, df1, "left_outer", on, exchange_staged=True, tune=tune
+            )
+            if res is not None:
+                from ..dataframe.utils import get_join_schemas
+
+                _, out_schema = get_join_schemas(
+                    self.to_df(df1), self.to_df(df2), how="right_outer", on=on
+                )
+                if list(res.schema.names) != out_schema.names:
+                    res = res[out_schema.names]  # type: ignore[index]
+            return res
+        if jt == "full_outer":
+            return self._full_outer_device(
+                df1, df2, on, exchange_staged=True, tune=tune
+            )
+        return None  # cross: replication-shaped, nothing to exchange
+
+    def _full_outer_device(
+        self, df1, df2, on, exchange_staged: bool = False, tune=None
+    ) -> Optional[DataFrame]:
         """full_outer = left_outer(L,R) ∪ (anti(R,L) with NULL left
         values) — composed from device verbs, so it inherits all their
         representations (dictionaries, epochs, masks)."""
@@ -1675,10 +1758,14 @@ class JaxExecutionEngine(ExecutionEngine):
             )
         except Exception:
             return None
-        left_part = self._join_device(df1, df2, "left_outer", on)
+        left_part = self._join_device(
+            df1, df2, "left_outer", on, exchange_staged=exchange_staged, tune=tune
+        )
         if left_part is None:
             return None
-        right_only = self._join_device(df2, df1, "anti", on)
+        right_only = self._join_device(
+            df2, df1, "anti", on, exchange_staged=exchange_staged, tune=tune
+        )
         if right_only is None:
             return None
         ext = self._null_extend(right_only, out_schema, self.to_df(df1))
@@ -1975,8 +2062,21 @@ class JaxExecutionEngine(ExecutionEngine):
             )
         return self._jit_cache[cache_key](right_codes, table)
 
-    def _join_device(self, df1, df2, kernel_how: str, on) -> Optional[DataFrame]:
-        """Try the device hash join; None → host fallback."""
+    def _join_device(
+        self,
+        df1,
+        df2,
+        kernel_how: str,
+        on,
+        exchange_staged: bool = False,
+        tune=None,
+    ) -> Optional[DataFrame]:
+        """Try the device hash join; None → host fallback.
+
+        ``exchange_staged=True`` is the device_exchange rung: broadcast
+        is skipped (the right side already failed the per-device budget)
+        and the co-partition step runs the staged one-hop exchange
+        instead of the single-shot all-to-all."""
         from ..dataframe.utils import get_join_schemas
         from ..ops.join import device_hash_join
 
@@ -2058,7 +2158,7 @@ class JaxExecutionEngine(ExecutionEngine):
         n_right = next(iter(j2.device_cols.values())).shape[0]
         encodings: Dict[str, Any] = {}
         null_masks: Dict[str, Any] = {}
-        if n_right <= broadcast_max_rows(self.conf):
+        if not exchange_staged and n_right <= broadcast_max_rows(self.conf):
             strategy = "broadcast"
             self._last_join_strategy = "broadcast"
             rep = replicated_sharding(self._mesh)
@@ -2078,7 +2178,9 @@ class JaxExecutionEngine(ExecutionEngine):
             null_masks = dict(j1.null_masks)
         else:
             strategy = "shuffle"
-            self._last_join_strategy = "copartition"
+            self._last_join_strategy = (
+                "device_exchange" if exchange_staged else "copartition"
+            )
             if j1.host_table is not None:
                 return None  # rows move; host columns can't follow
             left_cols = dict(j1.device_cols)
@@ -2094,23 +2196,65 @@ class JaxExecutionEngine(ExecutionEngine):
         if strategy == "shuffle":
             # ONE exchange, shared by the unique probe and any dup-key
             # expansion retry (the retry must not repeat the all-to-all)
-            from ..ops.join import copartition_by_keys
+            if exchange_staged:
+                from ..obs import get_tracer
+                from ..shuffle.exchange import staged_copartition_by_keys
+                from ..shuffle.strategy import exchange_stage_bytes
 
-            (
-                left_cols,
-                left_valid,
-                right_key_arrs,
-                right_entries,
-                right_valid,
-            ) = copartition_by_keys(
-                self._mesh,
-                left_cols,
-                left_valid,
-                list(left_key_arrs.keys()),
-                right_key_arrs,
-                right_entries,
-                right_valid,
-            )
+                stage_bytes = exchange_stage_bytes(self.conf)
+                stages_before = self._shuffle_stats.get("device_exchange_stages")
+                with get_tracer().span(
+                    "shuffle.exchange", cat="shuffle", annotate=True
+                ) as xsp:
+                    (
+                        left_cols,
+                        left_valid,
+                        right_key_arrs,
+                        right_entries,
+                        right_valid,
+                    ) = staged_copartition_by_keys(
+                        self._mesh,
+                        left_cols,
+                        left_valid,
+                        list(left_key_arrs.keys()),
+                        right_key_arrs,
+                        right_entries,
+                        right_valid,
+                        stage_bytes,
+                        stats=self._shuffle_stats,
+                    )
+                    xsp.set(
+                        stage_bytes=stage_bytes,
+                        peak_stage_bytes=self._shuffle_stats.get(
+                            "device_exchange_peak_stage_bytes"
+                        ),
+                    )
+                if tune is not None:
+                    tune.observe_exchange(
+                        stages=self._shuffle_stats.get("device_exchange_stages")
+                        - stages_before,
+                        peak_stage_bytes=self._shuffle_stats.get(
+                            "device_exchange_peak_stage_bytes"
+                        ),
+                    )
+            else:
+                from ..ops.join import copartition_by_keys
+
+                (
+                    left_cols,
+                    left_valid,
+                    right_key_arrs,
+                    right_entries,
+                    right_valid,
+                ) = copartition_by_keys(
+                    self._mesh,
+                    left_cols,
+                    left_valid,
+                    list(left_key_arrs.keys()),
+                    right_key_arrs,
+                    right_entries,
+                    right_valid,
+                )
             strategy = "local"
         res = device_hash_join(
             self._mesh,

@@ -28,9 +28,9 @@ collectives ARE the transport, so platform quirks surface in-tree.
 import os
 from typing import Any
 
-from .._utils.jax_compat import axis_size
+from .._utils.jax_compat import axis_size, lax_ppermute
 
-__all__ = ["psum", "pmin", "pmax", "all_gather", "all_to_all"]
+__all__ = ["psum", "pmin", "pmax", "all_gather", "all_to_all", "ppermute"]
 
 
 def _sum_only() -> bool:
@@ -88,6 +88,29 @@ def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
         g = _gather_via_psum(x, axis)
         return jnp.concatenate(list(g), axis=0) if tiled else g
     return lax.all_gather(x, axis, tiled=tiled)
+
+
+def ppermute(x: Any, axis: str, shift: int) -> Any:
+    """Ring shift: shard i's block lands on shard ``(i + shift) % n`` —
+    ONE point-to-point hop per shard, the staged exchange's primitive.
+    Peak in-flight payload is a single block (vs ``all_to_all``'s n
+    blocks), which is what lets the staged schedule bound per-stage
+    bytes. ``shift % n == 0`` is the local hop: no comm at all."""
+    from jax import lax
+
+    n = axis_size(axis)
+    if n == 1 or shift % n == 0:
+        # identity hop — keep shard_map's replication typing intact the
+        # same way the size-1 reduces do (psum of the zero delta would be
+        # wasteful; the value's VMA is already "varying" here, so a plain
+        # pass-through is sound: out_specs stay row-sharded)
+        return x
+    if _sum_only():
+        # my source shard under the ring shift is (i - shift) mod n
+        src = (lax.axis_index(axis) - shift) % n
+        return _gather_via_psum(x, axis)[src]
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax_ppermute(x, axis, perm)
 
 
 def all_to_all(x: Any, axis: str, split_axis: int, concat_axis: int) -> Any:

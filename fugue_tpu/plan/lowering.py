@@ -31,6 +31,13 @@ Everything is gated by ``fugue.tpu.plan.lower_segments`` (default ON).
 A lowered segment executes under ONE ``plan.segment`` span (replacing the
 per-verb ``engine.<verb>`` spans) and compiles to ONE engine jit-cache
 entry labeled ``segment:<fingerprint>``.
+
+Join segments past the broadcast probe bound route through
+``engine.join``, where the strategy ladder (annotated on the plan by
+``annotate_join_strategies``, docs/shuffle.md) picks copartition,
+device_exchange (the staged on-device exchange — chain steps still fuse
+into one program via ``fused_apply`` and the exchanged shards feed the
+join kernel with zero host round trips), or the spill shuffle.
 """
 
 from typing import Any, Dict, List, Optional, Set, Tuple

@@ -294,10 +294,14 @@ def annotate_join_strategies(
     """Annotate every join node with the strategy the engine's ladder
     (``fugue_tpu/shuffle/strategy.py`` — the SAME decision function) will
     pick for the plan-time size estimates, and note it in the report so
-    ``workflow.explain()`` shows broadcast / copartition / shuffle_spill
-    before anything runs. Annotation only — no rewrite, no task cloning;
-    the runtime decision over live frame sizes stays authoritative."""
-    from ..shuffle.strategy import choose_join_strategy
+    ``workflow.explain()`` shows broadcast / copartition / device_exchange
+    / shuffle_spill before anything runs. Annotation only — no rewrite,
+    no task cloning; the runtime decision over live frame sizes stays
+    authoritative (it uses the engine's REAL mesh shard count; plan time
+    assumes the default every-device mesh)."""
+    from ..shuffle.strategy import choose_join_strategy, default_mesh_shards
+
+    n_shards = default_mesh_shards()
 
     memo: Dict[int, Tuple[Optional[int], Optional[int], bool]] = {}
     idx = {id(n): i for i, n in enumerate(nodes)}
@@ -315,7 +319,7 @@ def annotate_join_strategies(
                 "one-pass side: streaming join plan, spill shuffle if ineligible",
             )
         else:
-            dec = choose_join_strategy(conf, lb, rb, rr)
+            dec = choose_join_strategy(conf, lb, rb, rr, n_shards=n_shards)
             strategy, reason = dec.strategy, dec.reason
         n.annotations.append(f"strategy={strategy}")
         report.join_strategies.append(

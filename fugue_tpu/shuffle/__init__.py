@@ -11,9 +11,14 @@ repartition and joins past device memory.
   ledger, and the per-exchange pipeline context; kill-switch
   ``fugue.tpu.shuffle.pipeline.enabled=false`` restores the strict
   phase-barrier path bit-identically.
-- :mod:`.strategy` — the ONE broadcast/copartition/shuffle_spill decision
-  rule, shared by plan time (``workflow.explain()``) and run time
-  (``engine.join``).
+- :mod:`.exchange` — the device-resident staged exchange (ISSUE 17):
+  rows past the per-device budget but within aggregate mesh memory move
+  with a one-hop-at-a-time ``ppermute`` schedule whose per-stage payload
+  stays under the budget (arXiv:2112.01075) — zero host round trips;
+  kill-switch ``fugue.tpu.shuffle.device_exchange.enabled``.
+- :mod:`.strategy` — the ONE broadcast/copartition/device_exchange/
+  shuffle_spill decision rule, shared by plan time
+  (``workflow.explain()``) and run time (``engine.join``).
 - :mod:`.stats` — ``engine.stats()["shuffle"]`` counters.
 """
 
@@ -26,6 +31,7 @@ from .partitioner import (
     spill_dir_bytes,
     spill_partition,
 )
+from .exchange import staged_copartition_by_keys, staged_exchange_rows
 from .join import shuffle_spill_join, spill_repartition
 from .pipeline import MemBucketLedger, SpillPipeline, SpillWriter
 from .stats import ShuffleStats
@@ -35,8 +41,11 @@ from .strategy import (
     bucket_count,
     choose_join_strategy,
     device_budget_bytes,
+    device_budget_info,
+    device_exchange_enabled,
     estimate_frame_bytes,
     estimate_frame_rows,
+    exchange_stage_bytes,
     mem_bucket_cap_bytes,
     pair_prefetch_depth,
     pipeline_enabled,
@@ -62,8 +71,13 @@ __all__ = [
     "bucket_count",
     "choose_join_strategy",
     "device_budget_bytes",
+    "device_budget_info",
+    "device_exchange_enabled",
     "estimate_frame_bytes",
     "estimate_frame_rows",
+    "exchange_stage_bytes",
+    "staged_copartition_by_keys",
+    "staged_exchange_rows",
     "shuffle_enabled",
     "spill_dir_root",
     "target_bucket_bytes",

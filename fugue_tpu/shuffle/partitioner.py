@@ -196,9 +196,12 @@ class SpilledSide:
     **memory-resident tier** instead of on disk: ``mem_tables`` maps
     bucket id → accumulated arrow slices whose bytes fit the exchange's
     ``MemBucketLedger``. ``read_bucket`` serves them without any disk or
-    IPC round-trip; torn/absent-file detection and recovery are
-    unchanged for everything else (a demoted bucket is
-    indistinguishable from a serial one)."""
+    IPC round-trip, combining the slices into ONE contiguous table the
+    first time and caching that decoded form (keyed by bucket id,
+    budget-accounted — see :meth:`_retain_combined`) so later reads of
+    the same bucket never re-concat or re-decode the per-chunk slices.
+    Torn/absent-file detection and recovery are unchanged for everything
+    else (a demoted bucket is indistinguishable from a serial one)."""
 
     def __init__(
         self,
@@ -214,6 +217,7 @@ class SpilledSide:
         mem_tables: Optional[Dict[int, List[pa.Table]]] = None,
         ledger: Any = None,
         mem_bytes: int = 0,
+        mem_bucket_bytes: Optional[Dict[int, int]] = None,
     ):
         self.spill_dir = spill_dir
         self.side = side
@@ -226,7 +230,9 @@ class SpilledSide:
         self.replay = replay
         self.mem_tables = mem_tables or {}
         self.mem_bytes = mem_bytes
+        self.mem_bucket_bytes = mem_bucket_bytes or {}
         self._ledger = ledger
+        self._combined: set = set()
 
     def path(self, i: int) -> str:
         return os.path.join(self.spill_dir, f"{self.side}_{i:05d}.arrow")
@@ -238,6 +244,8 @@ class SpilledSide:
             self._ledger.release(self.mem_bytes)
             self.mem_bytes = 0
         self.mem_tables = {}
+        self.mem_bucket_bytes = {}
+        self._combined = set()
 
     @property
     def rows(self) -> int:
@@ -258,11 +266,20 @@ class SpilledSide:
             return None
         parts = self.mem_tables.get(i)
         if parts is not None:
+            if i in self._combined:
+                # decoded-form cache hit: this bucket was already combined
+                # into one contiguous table by an earlier read — serve it
+                # straight, no re-concat and no per-slice re-decode for
+                # the consumer's ingest
+                if stats is not None:
+                    stats.inc("mem_bucket_hits")
+                    stats.inc("mem_bucket_ingest_hits")
+                return parts[0]
             tbl = parts[0] if len(parts) == 1 else pa.concat_tables(parts)
             if tbl.num_rows == expected:
                 if stats is not None:
                     stats.inc("mem_bucket_hits")
-                return tbl
+                return self._retain_combined(i, tbl)
             # a mem bucket that disagrees with its own ledger can only be
             # a bug — but recovery is cheap and already exists: fall
             # through to the disk/replay path below
@@ -283,6 +300,29 @@ class SpilledSide:
             if stats is not None:
                 stats.inc("bucket_recoveries")
         return tbl
+
+    def _retain_combined(self, i: int, tbl: pa.Table) -> pa.Table:
+        """Replace bucket ``i``'s accumulated per-chunk slices with ONE
+        contiguous combined table and cache it for later reads. Budget-
+        accounted: the combined copy's byte delta vs the slices is
+        admitted to (or released from) the exchange ledger, so the cache
+        can never exceed the mem-tier budget — a refused admit serves the
+        chunked concat view uncached (correctness never depends on the
+        cache)."""
+        combined = tbl.combine_chunks()
+        new_nb = int(combined.nbytes)
+        old_nb = int(self.mem_bucket_bytes.get(i, new_nb))
+        delta = new_nb - old_nb
+        if self._ledger is not None:
+            if delta > 0 and not self._ledger.admit(delta):
+                return tbl
+            if delta < 0:
+                self._ledger.release(-delta)
+        self.mem_tables[i] = [combined]
+        self.mem_bucket_bytes[i] = new_nb
+        self.mem_bytes += delta
+        self._combined.add(i)
+        return combined
 
     def _recover_bucket(self, i: int) -> pa.Table:
         if self.replay is None:
@@ -583,4 +623,5 @@ def _spill_partition_pipelined(
         mem_tables=mem,
         ledger=ledger,
         mem_bytes=mem_total,
+        mem_bucket_bytes=mem_bytes,
     )

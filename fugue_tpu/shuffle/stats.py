@@ -33,6 +33,13 @@ _COUNTERS = (
     "mem_bucket_hits",  # bucket reads served from the mem tier
     "mem_demotions",  # mem buckets demoted to disk under ledger pressure
     "writebehind_batches",  # batches routed through the background writer
+    # --- device-resident exchange (docs/shuffle.md "Device exchange") ---
+    "device_exchange_joins",  # joins executed with the device_exchange strategy
+    "device_exchange_fallbacks",  # exchange-band joins forced back to spill
+    "device_exchange_stages",  # staged-schedule collective launches (hops × rounds)
+    "device_exchange_rows",  # rows moved through the staged exchange
+    "device_exchange_bytes",  # payload bytes moved (rows × row width)
+    "mem_bucket_ingest_hits",  # pair reads served from the decoded-form cache
 )
 
 
@@ -50,19 +57,46 @@ class ShuffleStats:
             if nbytes > self._peak:
                 self._peak = int(nbytes)
 
+    def peak_exchange(self, nbytes: int) -> None:
+        """High-water per-stage collective payload of the staged device
+        exchange — the proof artifact that the one-hop-at-a-time schedule
+        really bounds peak per-device exchange bytes."""
+        with self._lock:
+            if nbytes > self._peak_exchange:
+                self._peak_exchange = int(nbytes)
+
+    def set_budget(self, nbytes: int, source: str) -> None:
+        """Record the resolved device budget and which detection source
+        won (``conf`` / ``device_memory_stats`` / ``host_meminfo`` /
+        ``fallback``). Survives ``reset()`` — it is configuration, not a
+        counter."""
+        with self._lock:
+            self._budget_bytes = int(nbytes)
+            self._budget_source = str(source)
+
     def get(self, name: str) -> int:
         with self._lock:
             if name == "peak_device_bytes":
                 return self._peak
+            if name == "device_exchange_peak_stage_bytes":
+                return self._peak_exchange
             return self._c.get(name, 0)
 
     def as_dict(self) -> Dict[str, int]:
         with self._lock:
             out = {k: self._c.get(k, 0) for k in _COUNTERS}
             out["peak_device_bytes"] = self._peak
+            out["device_exchange_peak_stage_bytes"] = self._peak_exchange
+            out["device_budget_bytes"] = self._budget_bytes
+            # string leaf: /metrics flattening skips non-numerics, so the
+            # source shows in engine.stats() without breaking exposition
+            out["device_budget_source"] = self._budget_source  # type: ignore[assignment]
             return out
 
     def reset(self) -> None:
         with self._lock:
             self._c: Dict[str, int] = {}
             self._peak = 0
+            self._peak_exchange = 0
+            self._budget_bytes = getattr(self, "_budget_bytes", 0)
+            self._budget_source = getattr(self, "_budget_source", "unset")

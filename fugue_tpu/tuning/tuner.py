@@ -552,6 +552,20 @@ class ExchangeHandle:
             peak_device_bytes=int(peak_device_bytes), wall_s=float(wall_s)
         )
 
+    def observe_exchange(self, stages: int, peak_stage_bytes: int) -> None:
+        """Staged device-exchange telemetry (ISSUE 17): how many
+        collective stages this join's schedule ran and the high-water
+        per-stage payload. Persisted as exchange-size calibration
+        evidence — next runs of this plan see the measured schedule in
+        ``workflow.explain()``, and the recorded side cardinalities (the
+        ``observe_sides`` funnel) are what steer ``choose_join_strategy``
+        onto the device_exchange rung without re-estimating."""
+        self.obs.update(
+            exch_stages=int(stages),
+            exch_peak_stage_bytes=int(peak_stage_bytes),
+        )
+        self.scope.tuner.stats.inc("observations")
+
 
 # -- the tuner ---------------------------------------------------------------
 class Tuner:
@@ -772,6 +786,19 @@ class Tuner:
                     ):
                         new[k] = int(v)
                         material = True
+                # staged device-exchange calibration (ISSUE 17): persist
+                # the measured schedule (stage count + peak per-stage
+                # payload) under the same drift margin as cardinalities
+                for k in ("exch_stages", "exch_peak_stage_bytes"):
+                    v = handle.obs.get(k)
+                    if v is None:
+                        continue
+                    old = cur.get(k)
+                    if old is None or abs(v - old) > CARDINALITY_MARGIN * max(
+                        old, 1
+                    ):
+                        new[k] = int(v)
+                        material = True
                 if handle.used_buckets and handle.obs.get("peak_device_bytes"):
                     adj = adjust_buckets(handle.used_buckets, handle.obs, budget)
                     if adj is not None:
@@ -894,6 +921,11 @@ def describe_tuning(
         for k in ("left_bytes", "right_bytes", "right_rows"):
             if j.get(k) is not None:
                 parts.append(f"{k}~{j[k]}")
+        if j.get("exch_stages") is not None:
+            parts.append(
+                f"exchange: {j['exch_stages']} stages @ "
+                f"<={j.get('exch_peak_stage_bytes', 0)}B/stage"
+            )
         lines.append(
             "  %s: %s [%s, obs=%s, confidence=%s] -- %s"
             % (

@@ -1,0 +1,365 @@
+"""Device-resident staged exchange rung (docs/shuffle.md
+"device_exchange"): joins past the per-device budget but within
+aggregate mesh memory move rows with the staged one-hop-at-a-time
+``ppermute`` schedule — zero host round trips between partition and the
+join kernel. Parity is judged against BOTH the spill path (the
+bit-identical over-budget fallback) and the legacy ladder."""
+
+import jax
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET,
+    FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
+    FUGUE_TPU_CONF_SHUFFLE_DIR,
+    FUGUE_TPU_CONF_SHUFFLE_ENABLED,
+    FUGUE_TPU_CONF_SHUFFLE_EXCHANGE_STAGE_BYTES,
+)
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.shuffle.strategy import choose_join_strategy, estimate_frame_bytes
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="staged exchange needs a multi-device mesh"
+)
+
+EX_HOWS = [
+    "inner",
+    "left_outer",
+    "left_semi",
+    "left_anti",
+    "right_outer",
+    "full_outer",
+]
+
+
+def _join_frames(n=3000, seed=0, nulls=True):
+    """Dup keys (N:M expansion) and NULL keys in one pair of frames.
+    Int32 so the NULL-masked keys stay device-kernel-eligible (the
+    float64 null-view; 64-bit ints with NULLs are a standing device
+    refusal and would fall back to spill on every rung)."""
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, n // 8, n).astype(object)
+    rk = rng.integers(0, n // 8, n).astype(object)
+    if nulls:
+        lk[::97] = None
+        rk[::89] = None
+    left = pd.DataFrame({"k": pd.array(lk, dtype="Int32"), "a": rng.normal(size=n)})
+    right = pd.DataFrame({"k": pd.array(rk, dtype="Int32"), "b": rng.normal(size=n)})
+    return left, right
+
+
+def _norm(res):
+    tbl = res.as_arrow() if not isinstance(res, pa.Table) else res
+    pdf = tbl.replace_schema_metadata(None).to_pandas()
+    return pdf.sort_values(list(pdf.columns)).reset_index(drop=True)
+
+
+def _band_budget(left, right):
+    """A budget that lands BOTH sides in the exchange band: past the
+    per-device budget, within budget x shards (the estimate uses the real
+    device representation, measured on a throwaway engine)."""
+    probe = JaxExecutionEngine()
+    both = estimate_frame_bytes(probe.to_df(left)) + estimate_frame_bytes(
+        probe.to_df(right)
+    )
+    return max(1, both // 4)
+
+
+def _engine(tmp_path, budget, enabled=True, **conf):
+    return JaxExecutionEngine(
+        {
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget,
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: enabled,
+            FUGUE_TPU_CONF_SHUFFLE_DIR: str(tmp_path),
+            **conf,
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def frames():
+    return _join_frames()
+
+
+@pytest.fixture(scope="module")
+def budget(frames):
+    return _band_budget(*frames)
+
+
+@pytest.fixture(scope="module")
+def eng_x(frames, budget, tmp_path_factory):
+    e = _engine(tmp_path_factory.mktemp("xchg"), budget)
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def eng_spill(frames, budget, tmp_path_factory):
+    e = _engine(tmp_path_factory.mktemp("spill"), budget, enabled=False)
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def eng_legacy(tmp_path_factory):
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_SHUFFLE_ENABLED: False})
+    yield e
+    e.stop()
+
+
+@pytest.mark.parametrize("how", EX_HOWS)
+def test_exchange_parity_vs_spill_and_legacy(
+    frames, eng_x, eng_spill, eng_legacy, how
+):
+    """Every hash-partitionable join type, dup + NULL keys: the exchange
+    rung routes (no spill) and its output is bit-identical to both the
+    spill path at the same budget and the legacy ladder."""
+    left, right = frames
+    x_before = eng_x.stats()["shuffle"]["device_exchange_joins"]
+    res = eng_x.join(eng_x.to_df(left), eng_x.to_df(right), how=how, on=["k"])
+    got = _norm(res)
+    st = eng_x.stats()["shuffle"]
+    assert st["device_exchange_joins"] == x_before + 1, "exchange rung not used"
+    assert st["joins_spill"] == 0
+    sp = eng_spill.join(
+        eng_spill.to_df(left), eng_spill.to_df(right), how=how, on=["k"]
+    )
+    spn = _norm(sp)[list(got.columns)]
+    assert eng_spill.stats()["shuffle"]["joins_spill"] >= 1
+    assert eng_spill.stats()["shuffle"]["device_exchange_joins"] == 0
+    pd.testing.assert_frame_equal(got, spn)
+    ref = eng_legacy.join(
+        eng_legacy.to_df(left), eng_legacy.to_df(right), how=how, on=["k"]
+    )
+    pd.testing.assert_frame_equal(got, _norm(ref)[list(got.columns)])
+
+
+def test_exchange_negative_zero_keys(tmp_path):
+    """-0.0 and +0.0 keys match by value across the exchange, exactly as
+    the join kernels and the spill partitioner treat them."""
+    rng = np.random.default_rng(5)
+    n = 2000
+    lk = rng.integers(0, n // 8, n).astype(np.float64)
+    rk = rng.integers(0, n // 8, n).astype(np.float64)
+    lk[::7] = 0.0
+    rk[::11] = -0.0  # must co-locate and match lk's +0.0 rows
+    left = pd.DataFrame({"k": lk, "a": rng.normal(size=n)})
+    right = pd.DataFrame({"k": rk, "b": rng.normal(size=n)})
+    eng = _engine(tmp_path, _band_budget(left, right))
+    res = eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"])
+    got = _norm(res)
+    assert eng.stats()["shuffle"]["device_exchange_joins"] == 1
+    off = JaxExecutionEngine({FUGUE_TPU_CONF_SHUFFLE_ENABLED: False})
+    ref = off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"])
+    pd.testing.assert_frame_equal(got, _norm(ref)[list(got.columns)])
+
+
+def test_exchange_tz_aware_keys(tmp_path):
+    """tz-aware timestamp keys keep value semantics through the banded
+    rung (whether the exchange takes them or refuses into the spill
+    fallback, the result must match the legacy ladder exactly)."""
+    rng = np.random.default_rng(6)
+    n = 2000
+    base = pd.date_range("2024-01-01", periods=n // 8, freq="h", tz="US/Eastern")
+    left = pd.DataFrame(
+        {"k": base[rng.integers(0, len(base), n)], "a": rng.normal(size=n)}
+    )
+    right = pd.DataFrame(
+        {"k": base[rng.integers(0, len(base), n)], "b": rng.normal(size=n)}
+    )
+    eng = _engine(tmp_path, _band_budget(left, right))
+    res = eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"])
+    got = _norm(res)
+    off = JaxExecutionEngine({FUGUE_TPU_CONF_SHUFFLE_ENABLED: False})
+    ref = off.join(off.to_df(left), off.to_df(right), how="inner", on=["k"])
+    pd.testing.assert_frame_equal(got, _norm(ref)[list(got.columns)])
+
+
+def test_kill_switch_bit_identity_and_span_multiset(frames, budget, tmp_path):
+    """device_exchange.enabled=false restores the three-rung ladder
+    bit-identically: same declared arrow schema + values, and the SAME
+    engine-verb span multiset (the switch changes the shuffle transport,
+    never the verb shape). The exchange run proves zero host round
+    trips: shuffle.exchange spans present, zero shuffle.partition /
+    shuffle.bucket spans."""
+    from collections import Counter
+
+    from fugue_tpu.obs import get_tracer
+
+    left, right = frames
+    tr = get_tracer()
+
+    def run(enabled, sub):
+        eng = _engine(tmp_path / sub, budget, enabled=enabled)
+        tr.clear()
+        tr.enable()
+        try:
+            res = eng.join(
+                eng.to_df(left), eng.to_df(right), how="inner", on=["k"]
+            )
+            tbl = res.as_arrow().replace_schema_metadata(None)
+            recs = tr.records()
+        finally:
+            tr.disable()
+            tr.clear()
+        return tbl, recs
+
+    t_on, recs_on = run(True, "on")
+    t_off, recs_off = run(False, "off")
+    assert t_on.schema == t_off.schema
+    a = _norm(t_on)
+    b = _norm(t_off)
+    pd.testing.assert_frame_equal(a, b)
+    # engine-VERB multiset: identical across the switch. engine.to_df is
+    # excluded — it is the ingest utility, and the spill transport calls
+    # it internally per bucket (that per-bucket host round trip is
+    # exactly what the exchange rung removes)
+    verbs_on = Counter(
+        r["name"]
+        for r in recs_on
+        if r["name"].startswith("engine.") and r["name"] != "engine.to_df"
+    )
+    verbs_off = Counter(
+        r["name"]
+        for r in recs_off
+        if r["name"].startswith("engine.") and r["name"] != "engine.to_df"
+    )
+    assert verbs_on == verbs_off
+    names_on = Counter(r["name"] for r in recs_on)
+    names_off = Counter(r["name"] for r in recs_off)
+    assert names_on["shuffle.exchange"] >= 1
+    assert names_on["shuffle.partition"] == 0 and names_on["shuffle.bucket"] == 0
+    assert names_off["shuffle.partition"] == 2 and names_off["shuffle.bucket"] > 0
+    strat_on = [
+        r["args"].get("strategy") for r in recs_on if r["name"] == "engine.join"
+    ]
+    strat_off = [
+        r["args"].get("strategy") for r in recs_off if r["name"] == "engine.join"
+    ]
+    assert strat_on == ["device_exchange"]
+    assert strat_off == ["shuffle_spill"]
+    reasons = [
+        r["args"].get("reason") for r in recs_on if r["name"] == "engine.join"
+    ]
+    assert "aggregate mesh memory" in (reasons[0] or "")
+
+
+def test_over_budget_forces_spill_fallback(frames, tmp_path):
+    """Past budget x shards the rung refuses even when enabled: the join
+    spills, exactly as the three-rung ladder would."""
+    left, right = frames
+    budget = max(1, _band_budget(left, right) // 100)
+    eng = _engine(tmp_path, budget, enabled=True)
+    res = eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"])
+    assert len(_norm(res)) > 0
+    st = eng.stats()["shuffle"]
+    assert st["joins_spill"] == 1
+    assert st["device_exchange_joins"] == 0
+
+
+def test_staged_schedule_peak_bytes_bound(frames, tmp_path):
+    """The high-water gauge proves the staged schedule's memory model:
+    per-stage collective payload never exceeds the configured stage cap,
+    and a small cap means many stages (rounds x hops), not a bigger
+    buffer."""
+    left, right = frames
+    stage = 4096
+    eng = _engine(
+        tmp_path,
+        _band_budget(left, right),
+        **{FUGUE_TPU_CONF_SHUFFLE_EXCHANGE_STAGE_BYTES: stage},
+    )
+    res = eng.join(eng.to_df(left), eng.to_df(right), how="inner", on=["k"])
+    assert res.count() > 0
+    st = eng.stats()["shuffle"]
+    assert st["device_exchange_joins"] == 1
+    peak = st["device_exchange_peak_stage_bytes"]
+    assert 0 < peak <= stage, peak
+    shards = len(jax.devices())
+    assert st["device_exchange_stages"] > shards  # multiple rounds per hop
+    assert st["device_budget_source"] == "conf"
+
+
+def test_choose_join_strategy_band_edges():
+    """The one strategy rule, at the rung's exact boundaries."""
+    conf = {FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: 1000}
+    rows = 10**9  # far past broadcast_max_rows: broadcast never wins
+    # inside the per-device budget: copartition, shards irrelevant
+    assert (
+        choose_join_strategy(conf, 400, 400, rows, n_shards=8).strategy
+        == "copartition"
+    )
+    # the band: past budget, within budget x shards
+    assert (
+        choose_join_strategy(conf, 2000, 2000, rows, n_shards=8).strategy
+        == "device_exchange"
+    )
+    # at the aggregate boundary (inclusive)
+    assert (
+        choose_join_strategy(conf, 4000, 4000, rows, n_shards=8).strategy
+        == "device_exchange"
+    )
+    # past the aggregate: spill
+    assert (
+        choose_join_strategy(conf, 5000, 5000, rows, n_shards=8).strategy
+        == "shuffle_spill"
+    )
+    # single device: the aggregate IS the budget — the historical ladder
+    assert (
+        choose_join_strategy(conf, 2000, 2000, rows, n_shards=1).strategy
+        == "shuffle_spill"
+    )
+    # kill-switch off: the band spills
+    off = dict(conf, **{FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: False})
+    assert (
+        choose_join_strategy(off, 2000, 2000, rows, n_shards=8).strategy
+        == "shuffle_spill"
+    )
+
+
+def test_mem_bucket_ingest_cache(tmp_path):
+    """Satellite: a memory-resident bucket's decoded form is combined
+    once and cached across reads (keyed by bucket id, ledger-accounted)
+    — the second read is an ingest-cache hit serving ONE contiguous
+    chunk, and release returns every byte."""
+    from fugue_tpu.shuffle.partitioner import spill_partition
+    from fugue_tpu.shuffle.pipeline import MemBucketLedger, SpillPipeline
+    from fugue_tpu.shuffle.stats import ShuffleStats
+
+    stats = ShuffleStats()
+    rng = np.random.default_rng(0)
+    n = 4000
+    tbl = pa.Table.from_pandas(
+        pd.DataFrame(
+            {"k": rng.integers(0, 500, n), "v": rng.normal(size=n)}
+        ),
+        preserve_index=False,
+    )
+    chunks = [tbl.slice(s, 500) for s in range(0, n, 500)]
+    pipe = SpillPipeline(MemBucketLedger(1 << 26), 4, stats)
+    side = spill_partition(
+        iter(chunks),
+        tbl.schema,
+        ["k"],
+        ["i"],
+        8,
+        str(tmp_path),
+        "left",
+        stats=stats,
+        replay=lambda: iter(chunks),
+        pipeline=pipe,
+    )
+    assert len(side.mem_tables) == 8  # ample ledger: all buckets resident
+    first = side.read_bucket(0, stats)
+    again = side.read_bucket(0, stats)
+    assert first is again  # the CACHED combined table, not a rebuild
+    assert first.column(0).num_chunks == 1  # one contiguous chunk
+    assert stats.get("mem_bucket_ingest_hits") == 1
+    assert stats.get("mem_bucket_hits") == 2
+    # budget accounting: the ledger tracked the combined form's delta and
+    # release_mem returns every live byte
+    side.release_mem()
+    assert pipe.ledger.used_bytes == 0

@@ -30,11 +30,19 @@ HOWS = ["inner", "left_outer", "left_semi", "left_anti", "right_outer", "full_ou
 
 
 def _engine(tmp_path, budget=20_000, bucket=5_000, **conf):
+    # pipelined-SPILL suite: pin the device_exchange rung off so small
+    # budgets keep routing these joins through the spill path under test
+    # (the exchange rung has its own suite, test_device_exchange.py)
+    from fugue_tpu.constants import (
+        FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED,
+    )
+
     return JaxExecutionEngine(
         {
             FUGUE_TPU_CONF_SHUFFLE_DEVICE_BUDGET: budget,
             FUGUE_TPU_CONF_SHUFFLE_BUCKET_BYTES: bucket,
             FUGUE_TPU_CONF_SHUFFLE_DIR: str(tmp_path),
+            FUGUE_TPU_CONF_SHUFFLE_DEVICE_EXCHANGE_ENABLED: False,
             **conf,
         }
     )
