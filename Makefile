@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-exchange test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-exchange test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke timeline-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -19,6 +19,7 @@ test:
 	-@$(MAKE) --no-print-directory bench-smoke  || echo "WARNING: bench-smoke FAILED (non-blocking in 'make test'); run 'make bench-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory serve-smoke  || echo "WARNING: serve-smoke FAILED (non-blocking in 'make test'); run 'make serve-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory fleet-smoke  || echo "WARNING: fleet-smoke FAILED (non-blocking in 'make test'); run 'make fleet-smoke' to reproduce"
+	-@$(MAKE) --no-print-directory timeline-smoke || echo "WARNING: timeline-smoke FAILED (non-blocking in 'make test'); run 'make timeline-smoke' to reproduce"
 	@if [ "$$DIST_SMOKE_NONBLOCKING" = "1" ]; then \
 	  $(MAKE) --no-print-directory dist-smoke || echo "WARNING: dist-smoke FAILED (demoted by DIST_SMOKE_NONBLOCKING=1); run 'make dist-smoke' to reproduce"; \
 	else \
@@ -195,6 +196,16 @@ trace-smoke:
 # Perfetto counter tracks
 telemetry-smoke:
 	JAX_PLATFORMS=cpu python bench.py --telemetry-smoke /tmp/fugue_telemetry_smoke
+
+# cluster-tracing chaos gate (ISSUE 18 acceptance, exit 19): the dist
+# chaos shape (3 workers, straggler's holder SIGKILLed mid-shuffle) with
+# tracing + span spools + the flight recorder ON — the spools assemble
+# into ONE validated Perfetto trace with >= 4 named process tracks whose
+# worker spans share the run's trace id, and the kill is reconstructed
+# FROM THE EVENT LOG ALONE (chaos.inject → hb.expired → lease.steal →
+# task.redispatch, in order) by tools/fugue_timeline.py
+timeline-smoke:
+	JAX_PLATFORMS=cpu python bench.py --timeline-smoke /tmp/fugue_timeline_smoke
 
 bench:
 	python bench.py

@@ -3272,6 +3272,308 @@ def _dist_smoke() -> None:
         raise SystemExit(18)
 
 
+# ---------------------------------------------------------------------------
+# extra.timeline_chaos — the ISSUE 18 observability gate (make timeline-smoke,
+# exit 19)
+# ---------------------------------------------------------------------------
+
+
+def _bench_timeline_chaos(out_dir: str, workers: int = 3) -> Dict[str, Any]:
+    """Cluster-tracing chaos proof (docs/observability.md): the ISSUE 14
+    dist chaos shape — 3 worker processes + supervisor, one SIGKILLed
+    mid-shuffle — run with tracing, the span spool and the flight
+    recorder all ON. Gates:
+
+    - the per-process spools + driver buffer assemble into ONE validated
+      Perfetto trace (``validate_chrome_trace``) with >= 4 named process
+      tracks, and the surviving workers' ``dist.task`` spans carry the
+      run's trace id (cross-process propagation actually worked);
+    - the injected kill is fully reconstructable FROM THE EVENT LOG
+      ALONE: ``chaos.inject`` → ``hb.expired`` (the victim's heartbeat
+      proven stale) → ``lease.steal`` of the straggler task from the
+      victim (reason ``worker_lost``) → ``task.redispatch`` on the new
+      holder, in timestamp order, all naming the same task;
+    - ``tools/fugue_timeline.py`` renders that log (exit 0);
+    - the job itself still meets the ISSUE 14 bar (all partitions
+      complete, zero lost/double-counted rows, >= 1 WORKER_LOST
+      re-dispatch).
+
+    A no-chaos warm-up job runs first so every worker has published at
+    least one spool before the victim dies — a worker whose FIRST lease
+    is the straggler would otherwise never reach its publish point, and
+    the >= 4 track assertion would race the scheduler."""
+    import multiprocessing as _mp
+    import pandas as _pd
+    import shutil as _shutil
+    import signal as _signal
+    import subprocess as _subprocess
+    import tempfile as _tempfile
+
+    from fugue_tpu.dist import DistSupervisor, read_heartbeat
+    from fugue_tpu.obs import (
+        assemble_trace,
+        get_event_log,
+        mint_trace_id,
+        publish_spool,
+        read_events,
+        read_spools,
+        trace_scope,
+    )
+
+    os.makedirs(out_dir, exist_ok=True)
+    spool = os.path.join(out_dir, "spool")
+    events = os.path.join(out_dir, "events")
+    for d in (spool, events):  # stale artifacts would satisfy the gates
+        _shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+    conf = dict(
+        _DIST_CONF,
+        **{
+            "fugue.tpu.trace.enabled": True,
+            "fugue.tpu.trace.spool_dir": spool,
+            "fugue.tpu.events.enabled": True,
+            "fugue.tpu.events.dir": events,
+        },
+    )
+    root = _tempfile.mkdtemp(prefix="fugue_bench_timeline_")
+    board = os.path.join(root, "board")
+    data = os.path.join(root, "data")
+    marker = os.path.join(root, "marker")
+    stop_file = os.path.join(root, "stop")
+    os.makedirs(data)
+    left, right = [], []
+    for i in range(6):
+        p = os.path.join(data, f"left_{i}.parquet")
+        _pd.DataFrame(
+            {
+                "part": i,
+                "k": [(j * 13 + i) % 97 for j in range(2000)],
+                "v": [float((j * 7 + i) % 1000) for j in range(2000)],
+            }
+        ).to_parquet(p)
+        left.append(p)
+    for i in range(3):
+        p = os.path.join(data, f"right_{i}.parquet")
+        _pd.DataFrame(
+            {
+                "k": [(j + i * 33) % 97 for j in range(400)],
+                "w": [float((j * 3 + i) % 50) for j in range(400)],
+            }
+        ).to_parquet(p)
+        right.append(p)
+    map_left, reduce_fn, combine = _dist_job_fns(marker)
+
+    def map_warm(pdf: "_pd.DataFrame") -> "_pd.DataFrame":
+        return pdf.drop(columns=["part"]).assign(v2=pdf["v"] * 2.0)
+
+    ctx = _mp.get_context("fork")
+    procs = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(workers):
+            p = ctx.Process(
+                target=_dist_worker_main,
+                args=(board, f"w{i}", stop_file),
+                kwargs={"extra_conf": dict(conf)},
+            )
+            p.start()
+            procs.append(p)
+        sup = DistSupervisor(board, conf=dict(conf))
+
+        # --- warm-up: every worker completes (and spools) something
+        sup.run_join_job(
+            left, right, ["k"], reduce_fn, combine_fn=combine,
+            map_left=map_warm, buckets=4, timeout=120,
+        )
+        deadline = time.monotonic() + 30
+        while len(read_spools(spool)) < workers:
+            if time.monotonic() > deadline:
+                break  # counted below; the gate reports what it saw
+            time.sleep(0.05)
+
+        # --- the chaos run, under ONE cluster trace id
+        trace_id = mint_trace_id()
+        with trace_scope(trace_id):
+            jid = sup.plan_join_job(
+                left, right, ["k"], reduce_fn,
+                combine_fn=combine, map_left=map_left, buckets=8,
+            )
+            straggler_tid = f"{jid}-m-left-0000"
+            deadline = time.monotonic() + 60
+            while not os.path.exists(marker):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("no worker ever started the straggler map")
+                time.sleep(0.02)
+            lease = sup.leases.read(straggler_tid)
+            victim_wid = lease["owner"] if lease else None
+            hb = read_heartbeat(sup.board.hb_dir, victim_wid) if victim_wid else None
+            if hb is None:
+                raise RuntimeError(f"no heartbeat for lease owner {victim_wid!r}")
+            victim_pid = int(hb["pid"])
+            get_event_log().emit(
+                "chaos.inject",
+                fault="SIGKILL",
+                target=victim_wid,
+                victim_pid=victim_pid,
+                task=straggler_tid,
+            )
+            t_kill = time.time()
+            os.kill(victim_pid, _signal.SIGKILL)
+            for p in procs:
+                if p.pid == victim_pid:
+                    p.join(10)
+            result = sup.wait_job(jid, timeout=180)
+            audit = sup.audit_job(jid)
+        dist_stats = sup.engine.stats()["dist"]
+
+        # --- assemble the cluster trace (driver buffer + every spool)
+        publish_spool(spool, label="driver")
+        trace_path = os.path.join(out_dir, "trace.json")
+        summary = assemble_trace(spool, trace_path)
+        traced_worker_procs = sorted(
+            {
+                str(rec.get("proc"))
+                for doc in read_spools(spool)
+                if doc.get("label") != "driver"
+                for rec in doc.get("spans", [])
+                if isinstance(rec, dict)
+                and rec.get("trace") == trace_id
+                and rec.get("name") == "dist.task"
+            }
+        )
+
+        # --- reconstruct the kill from the event log ALONE
+        evs = read_events(events)
+
+        def _first(pred) -> Optional[Dict[str, Any]]:
+            for e in evs:
+                if pred(e):
+                    return e
+            return None
+
+        inject = _first(
+            lambda e: e["type"] == "chaos.inject" and e.get("task") == straggler_tid
+        )
+        expiry = _first(
+            lambda e: e["type"] == "hb.expired"
+            and e.get("holder") == victim_wid
+            and e.get("task") == straggler_tid
+        )
+        steal = _first(
+            lambda e: e["type"] == "lease.steal"
+            and e.get("task") == straggler_tid
+            and e.get("prev_owner") == victim_wid
+            and e.get("reason") == "worker_lost"
+        )
+        redispatch = _first(
+            lambda e: e["type"] == "task.redispatch"
+            and e.get("task") == straggler_tid
+            and e.get("reason") == "stolen"
+        )
+        chain = [inject, expiry, steal, redispatch]
+        chain_found = all(e is not None for e in chain)
+        chain_ordered = chain_found and all(
+            chain[i]["ts"] <= chain[i + 1]["ts"] for i in range(len(chain) - 1)
+        )
+        same_new_holder = (
+            chain_found and steal.get("owner") == redispatch.get("owner")
+        )
+
+        # --- the CLI renders the same log without touching the board
+        cli = _subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "fugue_timeline.py"),
+             events, "--trace", trace_id],
+            capture_output=True, text=True, timeout=60,
+        )
+        cli_ok = cli.returncode == 0 and "stolen" in cli.stdout
+
+        n_tasks = len(left) + len(right) + 8
+        completed = audit["map_done"] + audit["reduce_done"]
+        correct = (
+            completed == n_tasks
+            and audit["rows_lost"] == 0
+            and audit["rows_double_counted"] == 0
+            and int(dist_stats.get("redispatch_worker_lost", 0)) >= 1
+            and summary["processes"] >= workers + 1
+            and trace_id in summary["traces"]
+            and len(traced_worker_procs) >= 1
+            and chain_found
+            and chain_ordered
+            and same_new_holder
+            and cli_ok
+        )
+        return {
+            "workers": workers,
+            "victim": victim_wid,
+            "trace_id": trace_id,
+            "trace_path": trace_path,
+            "events_dir": events,
+            "completed": completed,
+            "result_rows": int(len(result)),
+            "redispatch_worker_lost": int(
+                dist_stats.get("redispatch_worker_lost", 0)
+            ),
+            "trace_processes": summary["processes"],
+            "trace_process_names": summary["process_names"],
+            "trace_spans": summary["spans"],
+            "trace_ids_seen": summary["traces"],
+            "traced_worker_procs": traced_worker_procs,
+            "events_total": len(evs),
+            "chain": [
+                None
+                if e is None
+                else {
+                    "type": e["type"],
+                    "t_rel_s": round(e["ts"] - t_kill, 3),
+                    "proc": e.get("proc"),
+                }
+                for e in chain
+            ],
+            "chain_found": chain_found,
+            "chain_ordered": chain_ordered,
+            "chain_same_new_holder": same_new_holder,
+            "timeline_cli_ok": cli_ok,
+            "audit": audit,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "correct": correct,
+        }
+    finally:
+        try:
+            with open(stop_file, "w") as f:
+                f.write("stop")
+        except OSError:
+            pass
+        for p in procs:
+            p.join(5)
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+        _shutil.rmtree(root, ignore_errors=True)
+
+
+def _timeline_smoke(out_dir: str) -> None:
+    """``make timeline-smoke``: the ISSUE 18 cluster-tracing chaos gate.
+    Exit 19 on any violation (16/18 are the dist gates'), with a labeled
+    JSON verdict instead of a stack trace — the Make target is
+    non-blocking inside ``make test`` and must stay grep-able."""
+    try:
+        case = _bench_timeline_chaos(out_dir)
+    except Exception as ex:
+        print(
+            json.dumps(
+                {
+                    "metric": "timeline_chaos",
+                    "error": f"{type(ex).__name__}: {ex}",
+                    "correct": False,
+                }
+            )
+        )
+        raise SystemExit(19) from None
+    print(json.dumps({"metric": "timeline_chaos", "chaos": case}))
+    if not case["correct"]:
+        raise SystemExit(19)
+
+
 def _smoke() -> None:
     """``make bench-smoke``: a downsized regression gate on the headline
     metric (≤~30s). Runs ONLY the device-aggregate worker (same rows/burst
@@ -4260,6 +4562,10 @@ if __name__ == "__main__":
         out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
         with _bench_lock():
             _telemetry_smoke(out)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--timeline-smoke":
+        out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_timeline_smoke"
+        with _bench_lock():
+            _timeline_smoke(out)
     elif len(sys.argv) > 1 and sys.argv[1] == "--north-star":
         with _bench_lock():
             _north_star()

@@ -87,6 +87,26 @@ FUGUE_TPU_CONF_TRACE_XLA = "fugue.tpu.trace.xla"
 FUGUE_TPU_CONF_TRACE_DIR = "fugue.tpu.trace.dir"
 # span buffer cap; past it new spans are dropped (and counted as dropped)
 FUGUE_TPU_CONF_TRACE_MAX_SPANS = "fugue.tpu.trace.max_spans"
+# shared spool directory for cluster tracing (ISSUE 18): remote processes
+# (dist workers, serve replicas) atomically publish their span buffer +
+# resource-sampler ring there as <host>-<pid>.spool.json, and
+# obs.assemble_trace merges the spools into ONE Perfetto trace with one
+# named track per process. Empty/unset = no spooling (the default; spool
+# writes only happen while tracing is also enabled)
+FUGUE_TPU_CONF_TRACE_SPOOL_DIR = "fugue.tpu.trace.spool_dir"
+
+# --- cluster flight recorder (fugue_tpu/obs/events.py; ISSUE 18) ---
+# master switch for the append-only recovery-event log: lease
+# acquire/renew/steal, heartbeat expiry, re-dispatch, orphan
+# invalidation, speculative twins, fleet failovers, journal replays —
+# typed JSON records carrying the cluster trace id, rendered by
+# workflow.timeline() / tools/fugue_timeline.py. Default OFF; the
+# FUGUE_TPU_EVENTS env var overrides in both directions. Disabled cost
+# is one attribute check per recovery event (which are rare by nature).
+FUGUE_TPU_CONF_EVENTS_ENABLED = "fugue.tpu.events.enabled"
+# shared directory the per-process event files append into
+# (<host>-<pid>.events.jsonl); FUGUE_TPU_EVENTS_DIR env overrides
+FUGUE_TPU_CONF_EVENTS_DIR = "fugue.tpu.events.dir"
 
 # --- live telemetry (fugue_tpu/obs/sampler.py + /metrics; ISSUE 6) ---
 # master switch for the continuous resource sampler: a daemon thread
@@ -403,6 +423,13 @@ FUGUE_TPU_CONF_TUNING_PATH = "fugue.tpu.tuning.path"
 # plan-fingerprint entries kept in the store; least-recently-used past it
 # are evicted at publish time (stale-plan hygiene for long-lived servers)
 FUGUE_TPU_CONF_TUNING_MAX_ENTRIES = "fugue.tpu.tuning.max_entries"
+# per-verb roofline recording (ISSUE 18, ROADMAP item 5 groundwork):
+# while tracing is enabled the jax engine folds each verb's achieved
+# bytes/s and rows/s into the TunedStore's "rooflines" key (same atomic
+# publish + LRU bounds), rendered by engine.report(). Record-only — no
+# placement decision reads it yet. Default ON (cost: one in-memory fold
+# per traced verb close; nothing at all while tracing is off).
+FUGUE_TPU_CONF_TUNING_ROOFLINES = "fugue.tpu.tuning.rooflines"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_AUTO_PERSIST,

@@ -129,7 +129,17 @@ class TaskBoard:
 
     # -- tasks ---------------------------------------------------------------
     def put_task(self, tid: str, spec: Dict[str, Any]) -> None:
-        self._write_json(self._task(tid), dict(spec, id=tid))
+        doc = dict(spec, id=tid)
+        if "trace" not in doc:
+            # cluster tracing (ISSUE 18): a spec written inside a traced
+            # run carries the run's {"trace", "parent"} so the executing
+            # worker's spans attach under the submitting run
+            from ..obs.tracer import trace_carrier
+
+            carrier = trace_carrier()
+            if carrier:
+                doc["trace"] = carrier
+        self._write_json(self._task(tid), doc)
 
     def read_task(self, tid: str) -> Optional[Dict[str, Any]]:
         return self._read_json(self._task(tid))

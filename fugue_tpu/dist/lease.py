@@ -35,6 +35,7 @@ from ..cache.store import (
     release_claim_file,
     try_claim_file,
 )
+from ..obs.events import get_event_log
 from .heartbeat import DEFAULT_STALE_AFTER_S, holder_alive
 
 __all__ = ["LeaseBoard"]
@@ -131,6 +132,27 @@ class LeaseBoard:
                     if reason == "worker_lost"
                     else "leases_stolen_expired"
                 )
+                log = get_event_log()
+                if log.enabled:
+                    if reason == "worker_lost":
+                        # the heartbeat (or dead-pid probe) proved the
+                        # holder gone — record the expiry as its own event
+                        # so the timeline shows expiry BEFORE the steal
+                        log.emit(
+                            "hb.expired",
+                            holder=holder.get("owner"),
+                            task=task_id,
+                            age_s=round(now - float(holder.get("ts", now)), 3),
+                        )
+                    log.emit(
+                        "lease.steal",
+                        task=task_id,
+                        owner=owner,
+                        prev_owner=holder.get("owner"),
+                        reason=reason,
+                    )
+            else:
+                get_event_log().emit("lease.acquire", task=task_id, owner=owner)
         return owned, cur
 
     def renew(self, task_id: str, owner: str, lease_s: float) -> bool:
@@ -159,6 +181,7 @@ class LeaseBoard:
         renewed = after is not None and after.get("owner") == owner
         if renewed:
             self._inc("leases_renewed")
+            get_event_log().emit("lease.renew", task=task_id, owner=owner)
         return renewed
 
     def release(self, task_id: str, owner: str) -> bool:

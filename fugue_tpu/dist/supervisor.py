@@ -40,6 +40,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import pandas as pd
 import pyarrow as pa
 
+from ..obs.events import get_event_log
+from ..obs.tracer import proc_ident
 from ..resilience import RetryPolicy
 from ..shuffle.partitioner import bucket_ids, canonical_key_kinds
 from .board import TaskBoard, dump_fn, load_fn, spec_fingerprint
@@ -576,6 +578,12 @@ class DistSupervisor:
                 if now - acquired > self.speculative_after_s:
                     if self.board.mark_speculative(tid):
                         self.stats.inc("speculative_marks")
+                        get_event_log().emit(
+                            "task.speculative",
+                            task=tid,
+                            holder=lease.get("owner"),
+                            held_s=round(now - acquired, 3),
+                        )
 
     def wait_job(self, jid: str, timeout: Optional[float] = None) -> pd.DataFrame:
         """Block until every reduce task is done, then combine their
@@ -618,6 +626,12 @@ class DistSupervisor:
                         # invalidate and let a live worker re-produce it
                         self.board.invalidate_done(tid)
                         self.stats.inc("orphaned_outputs_recovered")
+                        get_event_log().emit(
+                            "task.orphan",
+                            task=tid,
+                            why="torn/evicted reduce artifact",
+                            producer=rec.get("worker"),
+                        )
                         missing = tid
                         break
                     partials.append(loaded[0].as_pandas())
@@ -648,9 +662,28 @@ class DistSupervisor:
         if spans and tracer.enabled:
             # an IN-process worker (thread-pool tests, single-host runs)
             # shares this tracer and already emitted its spans — only
-            # foreign pids' records are new information
-            spans = [s for s in spans if s.get("pid") != os.getpid()]
+            # foreign PROCESSES' records are new information. Identity is
+            # host+pid (proc_ident): a bare pid match would wrongly drop a
+            # remote host's spans that happen to share this pid
+            me = proc_ident()
+            spans = [
+                s for s in spans if (s.get("proc") or s.get("pid")) not in (me, os.getpid())
+            ]
             tracer.ingest(spans)
+        m = rec.get("metrics")
+        if (
+            isinstance(m, dict)
+            and m.get("delta")
+            and m.get("proc") not in (proc_ident(), None)
+        ):
+            # metrics federation (ISSUE 18): a remote worker's span-
+            # histogram delta merges into the driver's families with the
+            # associative encoding — driver /metrics covers the fleet.
+            # An in-process worker shares these families (its proc is
+            # ours) and is skipped: its observations already landed.
+            from ..obs import get_span_metrics
+
+            get_span_metrics().merge(m["delta"])
         if isinstance(rec.get("stats"), dict) and rec.get("worker"):
             self.stats.note_worker(str(rec["worker"]), rec["stats"])
 

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import pandas as pd
 import pyarrow as pa
 
+from ..obs.events import get_event_log
 from ..resilience import (
     SITE_DIST_BOARD,
     SITE_DIST_LEASE,
@@ -171,6 +172,7 @@ class DistWorker:
             FUGUE_TPU_CONF_DIST_HB_STALE_S,
             FUGUE_TPU_CONF_DIST_LEASE_S,
             FUGUE_TPU_CONF_DIST_POLL_S,
+            FUGUE_TPU_CONF_TRACE_SPOOL_DIR,
         )
         from ..execution import NativeExecutionEngine
 
@@ -178,6 +180,10 @@ class DistWorker:
         self.board = TaskBoard(root)
         self.engine = NativeExecutionEngine(dict(conf or {}))
         c = self.engine.conf
+        # cluster tracing (ISSUE 18): with a spool dir configured, every
+        # task attempt ends with an atomic publish of this worker's whole
+        # span buffer + sampler ring to <spool>/<host>-<pid>.spool.json
+        self.spool_dir = str(c.get(FUGUE_TPU_CONF_TRACE_SPOOL_DIR, ""))
         self.lease_s = float(c.get(FUGUE_TPU_CONF_DIST_LEASE_S, 15.0))
         self.poll_s = max(0.005, float(c.get(FUGUE_TPU_CONF_DIST_POLL_S, 0.05)))
         self.fetch_mode = str(c.get(FUGUE_TPU_CONF_DIST_FETCH, "auto"))
@@ -323,21 +329,49 @@ class DistWorker:
         """Lease → execute → first-wins publish. False when the lease was
         not acquired or the attempt failed (failure recorded; a live
         worker — possibly this one — retries on a later scan)."""
-        from ..obs import get_tracer
+        from contextlib import nullcontext
+
+        from ..obs import get_tracer, trace_scope
 
         spec = self.board.read_task(tid)
         if spec is None:
             return False
         lease_id = f"{tid}.spec" if speculative else tid
+        prev_holder = self.leases.read(lease_id)
         owned, _holder = self.leases.try_acquire(
             lease_id, self.worker_id, self.lease_s
         )
         if not owned:
             return False
+        # categorized re-dispatch record (flight recorder): this attempt
+        # follows a steal (previous holder displaced) or a recorded failure
+        stolen = prev_holder is not None and prev_holder.get("owner") not in (
+            None,
+            self.worker_id,
+        )
+        n_fails = len(self.board.failures(tid))
+        if stolen or n_fails > 0:
+            get_event_log().emit(
+                "task.redispatch",
+                task=tid,
+                owner=self.worker_id,
+                reason="stolen" if stolen else "failed_retry",
+                attempts=n_fails,
+                trace=(spec.get("trace") or {}).get("trace"),
+            )
         keeper = _LeaseKeeper(
             self.leases, lease_id, self.worker_id, self.lease_s
         ).start()
         tracer = get_tracer()
+        # adopt the submitting run's trace context carried on the spec:
+        # this task's spans land under the run's trace id, parented on the
+        # supervisor-side dist.job span instead of floating as local roots
+        carrier = spec.get("trace") or {}
+        tctx = (
+            trace_scope(carrier.get("trace"), carrier.get("parent"))
+            if (tracer.enabled and carrier)
+            else nullcontext()
+        )
         try:
             # the dist.lease fault site sits between lease acquisition
             # and the task body: an `error` rule unwinds through the
@@ -345,8 +379,13 @@ class DistWorker:
             # orphaned lease for a live worker to steal
             self._injector.fire(SITE_DIST_LEASE)
             mark = tracer.mark() if tracer.enabled else 0
+            msnap = None
+            if tracer.enabled:
+                from ..obs import get_span_metrics
+
+                msnap = get_span_metrics().snapshot()
             t0 = time.time()
-            with tracer.span(
+            with tctx, tracer.span(
                 "dist.task",
                 cat="dist",
                 task=tid,
@@ -363,10 +402,21 @@ class DistWorker:
                 ts0=t0,
                 ts1=time.time(),
             )
+            if carrier.get("trace"):
+                payload["trace"] = carrier["trace"]
             if tracer.enabled:
                 # ship spans home like fork workers do: the supervisor
                 # ingests these when it collects the done record
                 payload["spans"] = tracer.take_since(mark)
+                # … and the span-HISTOGRAM delta (metrics federation,
+                # ISSUE 18): the driver's /metrics then covers remote
+                # task latencies too. Keyed by proc identity so an
+                # in-process worker's delta is never merged twice.
+                from ..obs import get_span_metrics, proc_ident
+
+                delta = get_span_metrics().delta_since(msnap or {})
+                if delta:
+                    payload["metrics"] = {"proc": proc_ident(), "delta": delta}
             payload["stats"] = self.stats.as_dict()
             # the dist.board fault site sits in the torn-publish window:
             # every output is already durable (fragments / artifact) but
@@ -389,6 +439,14 @@ class DistWorker:
             self.board.record_failure(
                 tid, self.worker_id, cat.value, f"{type(e).__name__}: {e}"
             )
+            get_event_log().emit(
+                "task.failed",
+                task=tid,
+                worker=self.worker_id,
+                category=cat.value,
+                error=f"{type(e).__name__}: {e}"[:200],
+                trace=carrier.get("trace"),
+            )
             self.stats.inc("tasks_failed")
             if cat is FailureCategory.FATAL:
                 raise
@@ -396,6 +454,24 @@ class DistWorker:
         finally:
             keeper.stop()
             self.leases.release(lease_id, self.worker_id)
+            self._maybe_publish_spool(tracer)
+
+    def _maybe_publish_spool(self, tracer: Any) -> None:
+        """Atomic publish of this worker's span buffer + sampler ring +
+        stats to the shared spool (cluster tracing); best-effort — a full
+        disk must not fail the task that already published its result."""
+        if not self.spool_dir or not tracer.enabled:
+            return
+        try:
+            from ..obs import publish_spool
+
+            publish_spool(
+                self.spool_dir,
+                stats=self.stats.as_dict(),
+                label=f"worker {self.worker_id}",
+            )
+        except Exception as ex:
+            self.engine.log.warning("span spool publish failed: %s", ex)
 
     def _execute(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         kind = spec.get("kind")
@@ -632,10 +708,14 @@ class DistWorker:
         any transport failure — ConnectionRefusedError propagates intact
         so the caller can prove the producer WORKER_LOST — and a non-200
         status raises TRANSIENT (producer alive, fragment unservable)."""
+        from ..rpc.http import trace_headers
+
         conn = http.client.HTTPConnection(host, port, timeout=2.0)
         try:
             conn.request(
-                "GET", "/dist/fetch?path=" + urllib.parse.quote(rel, safe="")
+                "GET",
+                "/dist/fetch?path=" + urllib.parse.quote(rel, safe=""),
+                headers=trace_headers(),
             )
             resp = conn.getresponse()
             body = resp.read()
@@ -666,6 +746,9 @@ class DistWorker:
         )
         if self.board.invalidate_done(ptid):
             self.stats.inc("orphaned_outputs_recovered")
+            get_event_log().emit(
+                "task.orphan", task=ptid, why=why[:200], producer=rec.get("worker")
+            )
         raise err_type(
             f"{why}; producer {rec.get('worker')!r} "
             f"{'alive' if alive else 'dead/unknown'}; done record "

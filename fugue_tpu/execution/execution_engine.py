@@ -200,12 +200,18 @@ class ExecutionEngine(FugueEngineBase):
         self._active_runs = 0
         # apply trace switches (fugue.tpu.trace.* / FUGUE_TPU_TRACE) so
         # constructing an engine with tracing conf turns the tracer on
-        from ..obs import configure_from_conf, configure_sampler_from_conf
+        from ..obs import (
+            configure_events_from_conf,
+            configure_from_conf,
+            configure_sampler_from_conf,
+        )
 
         configure_from_conf(self._conf)
         # ditto for the continuous resource sampler (fugue.tpu.telemetry.*
         # / FUGUE_TPU_TELEMETRY), plus this engine's occupancy probes
         configure_sampler_from_conf(self._conf)
+        # and the cluster flight recorder (fugue.tpu.events.*)
+        configure_events_from_conf(self._conf)
         self._register_resource_probes()
 
     def __repr__(self) -> str:
@@ -501,11 +507,19 @@ class ExecutionEngine(FugueEngineBase):
         histograms — plus this engine's metrics."""
         from ..obs import get_span_metrics, get_tracer, render_report
 
+        rooflines = None
+        tuner = getattr(self, "_tuner", None)  # never force lazy creation
+        if tuner is not None:
+            try:
+                rooflines = tuner.roofline.snapshot() or None
+            except Exception:
+                rooflines = None
         return render_report(
             get_tracer().records(),
             self.stats(),
             top_n=top_n,
             span_metrics=get_span_metrics(),
+            rooflines=rooflines,
         )
 
     @property

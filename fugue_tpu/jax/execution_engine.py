@@ -664,6 +664,13 @@ class JaxExecutionEngine(ExecutionEngine):
             self._shuffle_stats.set_budget(_budget, _budget_src)
         except Exception:
             pass
+        # per-verb roofline recording (ISSUE 18, record-only): while
+        # tracing is enabled, every traced verb's close folds achieved
+        # bytes/s + rows/s into this engine's tuner (TunedStore
+        # "rooflines" key); fugue.tpu.tuning.rooflines=false opts out
+        from ..tuning import install_verb_observer
+
+        install_verb_observer(self)
 
     def _resource_probe_fns(self) -> Dict[str, Any]:
         # jax-engine occupancy for the continuous resource sampler

@@ -16,6 +16,15 @@ See ``docs/observability.md``. Quick start::
     engine.reset_stats()                    # consistent reset across all
 """
 
+from .assemble import assemble_trace
+from .events import (
+    EVENT_TYPES,
+    EventLog,
+    configure_events_from_conf,
+    get_event_log,
+    read_events,
+    render_timeline,
+)
 from .export import (
     render_report,
     to_chrome_trace,
@@ -38,15 +47,24 @@ from .sampler import (
     configure_sampler_from_conf,
     get_sampler,
 )
+from .spool import publish_spool, read_spools
 from .tracer import (
     NULL_SPAN,
     Tracer,
     configure_from_conf,
+    current_trace_id,
     get_tracer,
+    mint_trace_id,
+    proc_ident,
+    set_verb_observer,
+    trace_carrier,
+    trace_scope,
     traced_verb,
 )
 
 __all__ = [
+    "EVENT_TYPES",
+    "EventLog",
     "Histogram",
     "HistogramFamily",
     "MetricsRegistry",
@@ -55,16 +73,29 @@ __all__ = [
     "SpanMetrics",
     "Tracer",
     "active_run_labels",
+    "assemble_trace",
+    "configure_events_from_conf",
     "configure_from_conf",
     "configure_sampler_from_conf",
     "current_run_labels",
+    "current_trace_id",
+    "get_event_log",
     "get_sampler",
     "get_span_metrics",
     "get_tracer",
+    "mint_trace_id",
+    "proc_ident",
+    "publish_spool",
+    "read_events",
+    "read_spools",
     "render_report",
+    "render_timeline",
     "run_labels",
+    "set_verb_observer",
     "to_chrome_trace",
     "to_prometheus_text",
+    "trace_carrier",
+    "trace_scope",
     "traced_verb",
     "validate_chrome_trace",
     "validate_prometheus_text",

@@ -30,6 +30,8 @@ __all__ = [
 def to_chrome_trace(
     records: Iterable[Dict[str, Any]],
     counters: Optional[Iterable[Any]] = None,
+    counter_tracks: Optional[Dict[int, Iterable[Any]]] = None,
+    process_names: Optional[Dict[int, str]] = None,
 ) -> Dict[str, Any]:
     """Convert tracer records (ns timestamps) to a Chrome trace-event dict.
 
@@ -37,26 +39,36 @@ def to_chrome_trace(
     ``(ts_ns, {name: value})`` samples (``ResourceSampler.series()``);
     each name becomes one Perfetto counter track (``ph: "C"``) on the
     driver process, sharing the spans' clock so resource curves render
-    directly under the span bars."""
+    directly under the span bars. ``counter_tracks`` pins additional
+    series to explicit track pids (the cluster assembler ships each remote
+    process's sampler ring home and renders it on that process's track).
+    ``process_names`` overrides the default driver/worker track naming.
+    Each span event carries its tracer span id as a top-level ``"id"`` so
+    ``validate_chrome_trace`` can prove cluster-wide id uniqueness."""
     events: List[Dict[str, Any]] = []
     pids = set()
     for r in records:
         pids.add(r["pid"])
-        events.append(
-            {
-                "name": r["name"],
-                "cat": r.get("cat", "host"),
-                "ph": "X",
-                "ts": r["ts"] / 1000.0,  # ns → µs
-                "dur": max(r["dur"], 0) / 1000.0,
-                "pid": r["pid"],
-                "tid": r.get("tid", 1),
-                "args": _jsonable(r.get("args", {})),
-            }
-        )
+        ev = {
+            "name": r["name"],
+            "cat": r.get("cat", "host"),
+            "ph": "X",
+            "ts": r["ts"] / 1000.0,  # ns → µs
+            "dur": max(r["dur"], 0) / 1000.0,
+            "pid": r["pid"],
+            "tid": r.get("tid", 1),
+            "args": _jsonable(r.get("args", {})),
+        }
+        if r.get("id") is not None:
+            ev["id"] = r["id"]
+        if r.get("trace"):
+            ev["args"]["trace"] = r["trace"]
+        events.append(ev)
+    tracks: Dict[int, Any] = dict(counter_tracks or {})
     if counters:
-        cpid = os.getpid()
-        for ts, vals in counters:
+        tracks.setdefault(os.getpid(), counters)
+    for cpid, series in tracks.items():
+        for ts, vals in series:
             for cname, v in vals.items():
                 events.append(
                     {
@@ -72,6 +84,7 @@ def to_chrome_trace(
         pids.add(cpid)
     # metadata events name the process tracks (driver vs forked workers)
     first = min(pids) if pids else None
+    names = process_names or {}
     for pid in sorted(pids):
         events.append(
             {
@@ -80,7 +93,10 @@ def to_chrome_trace(
                 "pid": pid,
                 "tid": 0,
                 "args": {
-                    "name": "fugue-tpu driver" if pid == first else f"fugue-tpu worker {pid}"
+                    "name": names.get(
+                        pid,
+                        "fugue-tpu driver" if pid == first else f"fugue-tpu worker {pid}",
+                    )
                 },
             }
         )
@@ -124,9 +140,11 @@ def write_chrome_trace(
 def validate_chrome_trace(path: str) -> Dict[str, Any]:
     """Assert ``path`` is valid trace-event JSON; returns summary counts.
 
-    Checks the envelope, the per-event required keys, and that durations/
+    Checks the envelope, the per-event required keys, that durations/
     timestamps are non-negative numbers — the properties Perfetto needs to
-    render the file at all.
+    render the file at all — and (ISSUE 18) that no two span events share
+    one ``(pid, span id)`` pair, the regression the host+pid id prefix
+    exists to prevent when multiple hosts' spans merge into one trace.
     """
     with open(path) as f:
         doc = json.load(f)
@@ -139,6 +157,7 @@ def validate_chrome_trace(path: str) -> Dict[str, Any]:
     n_counters = 0
     names = set()
     counter_names = set()
+    seen_ids = set()
     for ev in events:
         assert isinstance(ev, dict) and "ph" in ev and "name" in ev, ev
         assert "pid" in ev, ev
@@ -148,6 +167,13 @@ def validate_chrome_trace(path: str) -> Dict[str, Any]:
             assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
             assert "tid" in ev, ev
+            if ev.get("id") is not None:
+                key = (ev["pid"], ev["id"])
+                assert key not in seen_ids, (
+                    f"{path}: duplicate (pid, span id) pair {key} — "
+                    "colliding span ids corrupt parent links in merged traces"
+                )
+                seen_ids.add(key)
         elif ev["ph"] == "C":
             n_counters += 1
             counter_names.add(ev["name"])
@@ -170,12 +196,16 @@ def render_report(
     stats: Optional[Dict[str, Any]] = None,
     top_n: int = 15,
     span_metrics: Any = None,
+    rooflines: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> str:
     """Plain-text top-N report: spans grouped by name with count / total /
     self / mean / p50 / p95 / p99 / max wall, plus the metrics registry
     dump. Quantiles come from the span-latency histograms (the global
     :class:`~fugue_tpu.obs.metrics.SpanMetrics` store unless one is
-    passed); a span name with no histogram series prints ``-``."""
+    passed); a span name with no histogram series prints ``-``.
+    ``rooflines`` (``<verb>|<dtype-class>|w<width>`` → throughput fold,
+    the ISSUE 18 record-only table) renders as its own section when
+    non-empty."""
     if span_metrics is None:
         from .metrics import get_span_metrics
 
@@ -221,6 +251,33 @@ def render_report(
                 f"{a['total'] / a['count'] / 1e6:>10.3f}"
                 f"{q(name, 'p50_ms')}{q(name, 'p95_ms')}{q(name, 'p99_ms')}"
                 f"{a['max'] / 1e6:>10.3f}"
+            )
+    if rooflines:
+        lines.append("")
+        lines.append("== verb rooflines (record-only; best achieved) ==")
+        lines.append(
+            f"{'verb|dtype|width':<36}{'obs':>6}{'best_MB/s':>12}"
+            f"{'best_Mrow/s':>13}{'last_MB/s':>12}{'last_Mrow/s':>13}"
+        )
+
+        def mb(v: Any) -> str:
+            return (
+                f"{float(v) / 1e6:>12.2f}"
+                if isinstance(v, (int, float))
+                else f"{'-':>12}"
+            )
+
+        ranked_rl = sorted(
+            rooflines.items(),
+            key=lambda kv: -float(kv[1].get("best_bytes_s", 0) or 0),
+        )
+        for key, e in ranked_rl:
+            lines.append(
+                f"{key:<36}{int(e.get('obs', 0) or 0):>6}"
+                f"{mb(e.get('best_bytes_s'))}"
+                f"{mb(e.get('best_rows_s')):>13}"
+                f"{mb(e.get('last_bytes_s'))}"
+                f"{mb(e.get('last_rows_s')):>13}"
             )
     if stats:
         lines.append("")

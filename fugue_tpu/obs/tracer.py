@@ -25,7 +25,12 @@ Design constraints, in priority order:
   buffer, slices off what it produced (:meth:`Tracer.mark` /
   :meth:`Tracer.take_since`) and ships the records back with its chunk
   result; the driver :meth:`Tracer.ingest`\\ s them. Span ids are
-  ``"<pid>:<seq>"`` strings so ids never collide across the fork.
+  ``"<host>-<pid>:<seq>"`` strings so ids never collide across the fork
+  nor across hosts sharing a store (:func:`proc_ident`).
+- **Cluster trace context** (ISSUE 18): :func:`trace_scope` binds a
+  Dapper-style ``{"trace", "parent"}`` context; :func:`trace_carrier`
+  is the wire form every cross-process hop ships, so remote spans attach
+  under the submitting run instead of floating as local roots.
 
 Enablement: conf ``fugue.tpu.trace.enabled`` (checked at engine
 construction via :func:`configure_from_conf`) or the ``FUGUE_TPU_TRACE``
@@ -33,10 +38,14 @@ env var (which overrides the conf either way). ``fugue.tpu.trace.xla``
 (default true) gates the TraceAnnotation mirroring.
 """
 
+import contextlib
 import os
+import socket
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+import uuid
+from contextvars import ContextVar
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from .metrics import get_span_metrics
 
@@ -45,12 +54,83 @@ __all__ = [
     "get_tracer",
     "configure_from_conf",
     "traced_verb",
+    "set_verb_observer",
     "NULL_SPAN",
+    "proc_ident",
+    "mint_trace_id",
+    "trace_scope",
+    "current_trace_id",
+    "trace_carrier",
 ]
 
 ENV_TRACE = "FUGUE_TPU_TRACE"
 
 _DEFAULT_MAX_SPANS = 200_000
+
+# short hostname, resolved once per process image (fork children inherit it,
+# which is correct — they share the host)
+_HOST = socket.gethostname().split(".")[0] or "localhost"
+
+
+def proc_ident() -> str:
+    """Cluster-unique process identity: ``"<host>-<pid>"``. Span ids and
+    spool filenames are prefixed with this so nothing collides when two
+    hosts hand out the same pid (the ISSUE 18 cross-host collision fix)."""
+    return f"{_HOST}-{os.getpid()}"
+
+
+# -- cluster trace context --------------------------------------------------
+#
+# A Dapper-style trace context rides a ContextVar (same shape as the
+# run-label machinery in metrics.py): ``{"trace": <id>, "parent": <span id>}``.
+# ``workflow.run`` / ``serve.submit`` mint a trace id; every outbound hop
+# (HTTP request, board task spec, fleet claim) ships ``trace_carrier()``;
+# the receiving process re-enters the context with ``trace_scope(...)`` so
+# its spans (a) carry the trace id and (b) root under the carried parent
+# span instead of floating as process-local roots.
+
+_TRACE_CTX: ContextVar[Dict[str, str]] = ContextVar("fugue_tpu_trace_ctx", default={})
+
+
+def mint_trace_id() -> str:
+    """A cluster-unique trace id for one ``workflow.run``/``serve.submit``."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> Optional[str]:
+    return _TRACE_CTX.get().get("trace")
+
+
+@contextlib.contextmanager
+def trace_scope(
+    trace: Optional[str] = None, parent: Optional[str] = None
+) -> Iterator[str]:
+    """Bind a trace context for the duration (minting an id when ``trace``
+    is None). Spans opened inside carry the trace id, and a span opened
+    with no local parent attaches under ``parent`` — the remote submitting
+    span. Nesting re-binds; the outer context is restored on exit."""
+    ctx: Dict[str, str] = {"trace": trace or mint_trace_id()}
+    if parent:
+        ctx["parent"] = parent
+    token = _TRACE_CTX.set(ctx)
+    try:
+        yield ctx["trace"]
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+def trace_carrier() -> Dict[str, str]:
+    """The wire fields for one outbound hop: the bound trace id plus the
+    innermost open span id as the causal parent. Empty when no trace
+    context is bound (propagation stays opt-in and zero-cost)."""
+    ctx = _TRACE_CTX.get()
+    if not ctx:
+        return {}
+    out = {"trace": ctx["trace"]}
+    sid = _TRACER.current_span_id() or ctx.get("parent")
+    if sid:
+        out["parent"] = sid
+    return out
 
 
 class _NullSpan:
@@ -98,6 +178,10 @@ class _SpanCtx:
         stack = tr._stack()
         if self._parent is None and stack:
             self._parent = stack[-1]
+        elif self._parent is None:
+            # no local ancestor: attach under the carried remote parent (the
+            # submitting run's span) when a trace context is bound
+            self._parent = _TRACE_CTX.get().get("parent")
         self._sid = tr._new_id()
         stack.append(self._sid)
         if self._annotate and tr.xla_annotate:
@@ -129,19 +213,22 @@ class _SpanCtx:
             stack.remove(self._sid)
         if et is not None:
             self._args.setdefault("error", getattr(et, "__name__", str(et)))
-        tr._emit(
-            {
-                "name": self._name,
-                "cat": self._cat,
-                "ts": self._t0,
-                "dur": t1 - self._t0,
-                "pid": os.getpid(),
-                "tid": tr._tid(),
-                "id": self._sid,
-                "parent": self._parent,
-                "args": self._args,
-            }
-        )
+        rec = {
+            "name": self._name,
+            "cat": self._cat,
+            "ts": self._t0,
+            "dur": t1 - self._t0,
+            "pid": os.getpid(),
+            "proc": proc_ident(),
+            "tid": tr._tid(),
+            "id": self._sid,
+            "parent": self._parent,
+            "args": self._args,
+        }
+        trace = _TRACE_CTX.get().get("trace")
+        if trace:
+            rec["trace"] = trace
+        tr._emit(rec)
         return False
 
 
@@ -195,9 +282,11 @@ class Tracer:
         return st
 
     def _new_id(self) -> str:
+        # host+pid-prefixed: unique across forks AND across hosts sharing a
+        # store (two hosts can hand out the same pid)
         with self._lock:
             self._seq += 1
-            return f"{os.getpid()}:{self._seq}"
+            return f"{proc_ident()}:{self._seq}"
 
     def _tid(self) -> int:
         ident = threading.get_ident()
@@ -340,9 +429,25 @@ def configure_from_conf(conf: Any) -> None:
         tr.max_spans = int(cap)
 
 
+# process-wide traced-verb close hook (ISSUE 18 roofline recording):
+# called as (verb_name, wall_seconds, result) after a SUCCESSFUL traced
+# verb while tracing is enabled. None = no observer = zero extra work.
+_VERB_OBSERVER: Optional[Callable[[str, float, Any], None]] = None
+
+
+def set_verb_observer(fn: Optional[Callable[[str, float, Any], None]]) -> None:
+    """Install (or clear, with None) the traced-verb close observer. One
+    slot per process — a newer install replaces the previous one."""
+    global _VERB_OBSERVER
+    _VERB_OBSERVER = fn
+
+
 def traced_verb(name: str, cat: str = "engine", annotate: bool = True) -> Callable:
     """Decorator instrumenting an engine verb as one span. The disabled
-    path is a single attribute check before delegating."""
+    path is a single attribute check before delegating. While tracing is
+    on, a successful close additionally feeds the registered verb
+    observer (roofline recording) with the verb's wall time and result —
+    failures are never folded into throughput ceilings."""
     import functools
 
     def deco(fn: Callable) -> Callable:
@@ -351,8 +456,18 @@ def traced_verb(name: str, cat: str = "engine", annotate: bool = True) -> Callab
             tr = _TRACER
             if not tr.enabled:
                 return fn(*a, **k)
+            obs = _VERB_OBSERVER
+            if obs is None:
+                with tr.span(name, cat=cat, annotate=annotate):
+                    return fn(*a, **k)
+            t0 = time.perf_counter()
             with tr.span(name, cat=cat, annotate=annotate):
-                return fn(*a, **k)
+                out = fn(*a, **k)
+            try:
+                obs(name, time.perf_counter() - t0, out)
+            except Exception:  # recording must never fail the verb
+                pass
+            return out
 
         return wrapper
 

@@ -45,6 +45,39 @@ from ..resilience import (
 )  # classify_failure also stamps /serve/poll's error_code (ISSUE 14)
 from .base import RPCClient, RPCServer
 
+# cluster trace propagation (ISSUE 18): every hop ships the submitting
+# run's trace id + the caller's innermost span id; the receiving process
+# re-enters the context so its spans attach under the submitting run
+TRACE_HEADER = "X-Fugue-Trace"
+PARENT_HEADER = "X-Fugue-Parent"
+
+
+def trace_headers() -> dict:
+    """The outbound trace-context headers for the current caller (empty
+    when no trace context is bound)."""
+    from ..obs.tracer import trace_carrier
+
+    c = trace_carrier()
+    if not c:
+        return {}
+    out = {TRACE_HEADER: c["trace"]}
+    if "parent" in c:
+        out[PARENT_HEADER] = c["parent"]
+    return out
+
+
+def _scope_from_headers(headers: Any) -> Any:
+    """A ``trace_scope`` bound from inbound request headers, or a no-op
+    context when the request carries none."""
+    trace = headers.get(TRACE_HEADER) if headers is not None else None
+    if not trace:
+        import contextlib
+
+        return contextlib.nullcontext()
+    from ..obs.tracer import trace_scope
+
+    return trace_scope(str(trace), headers.get(PARENT_HEADER))
+
 
 class HttpRPCClient(RPCClient):
     """Picklable client stub carrying only (host, port, key) + timeouts.
@@ -101,11 +134,13 @@ class HttpRPCClient(RPCClient):
             if conn.sock is not None:
                 conn.sock.settimeout(self._timeout)
             sent = True
+            headers = {"Content-Length": str(len(payload))}
+            headers.update(trace_headers())
             conn.request(
                 "POST",
                 "/invoke",
                 body=payload,
-                headers={"Content-Length": str(len(payload))},
+                headers=headers,
             )
             resp = conn.getresponse()
             body = resp.read()
@@ -254,6 +289,21 @@ class HttpRPCServer(RPCServer):
 
             text = to_prometheus_text(engine=self._metrics_engine())
             return 200, "text/plain; version=0.0.4; charset=utf-8", text.encode()
+        if path == "/metrics/snapshot":
+            # metrics federation (ISSUE 18): the machine-readable form —
+            # this replica's span-histogram families in the mergeable
+            # encoding. A FleetClient merges N of these associatively and
+            # renders ONE fleet-level exposition (federated_metrics())
+            from ..obs import get_span_metrics
+            from ..obs.tracer import proc_ident
+
+            srv = self._serve_server()
+            payload = {
+                "replica": getattr(srv, "replica_id", None),
+                "proc": proc_ident(),
+                "spans": get_span_metrics().snapshot(),
+            }
+            return 200, "application/json", json.dumps(payload).encode()
         if path == "/stats":
             from ..obs import active_run_labels, get_sampler, get_span_metrics
 
@@ -288,12 +338,15 @@ class HttpRPCServer(RPCServer):
         consumer's orphan-recovery ladder takes it from there."""
         from urllib.parse import parse_qs
 
+        from ..obs import get_tracer
+
         worker = self._dist_ref() if self._dist_ref is not None else None
         if worker is None:
             return 404, "application/json", b'{"error": "no dist worker bound"}'
         vals = parse_qs(query).get("path")
         rel = vals[0] if vals else ""
-        blob = worker.read_blob(rel) if rel else None
+        with get_tracer().span("rpc.dist_fetch", cat="rpc", path=rel):
+            blob = worker.read_blob(rel) if rel else None
         if blob is None:
             return (
                 404,
@@ -491,21 +544,27 @@ class HttpRPCServer(RPCServer):
                     path = self.path.split("?", 1)[0]
                     from ..obs import get_tracer
 
-                    if path == "/serve/submit":
-                        with get_tracer().span("rpc.serve_submit", cat="rpc"):
-                            self._reply(*server._serve_submit(raw))
-                        return
-                    if path == "/serve/cancel":
-                        self._reply(*server._serve_cancel(raw))
-                        return
-                    key, args, kwargs = cloudpickle.loads(base64.b64decode(raw))
-                    try:
-                        with get_tracer().span("rpc.serve", cat="rpc", key=key):
-                            result = (True, server.invoke(key, *args, **kwargs))
-                    except Exception as e:  # result is the exception itself
-                        result = (False, e)
-                    body = base64.b64encode(cloudpickle.dumps(result))
-                    self._reply(200, "application/octet-stream", body)
+                    # adopt the caller's trace context (X-Fugue-Trace /
+                    # X-Fugue-Parent): spans below land under the
+                    # submitting run instead of floating as local roots
+                    with _scope_from_headers(self.headers):
+                        if path == "/serve/submit":
+                            with get_tracer().span("rpc.serve_submit", cat="rpc"):
+                                self._reply(*server._serve_submit(raw))
+                            return
+                        if path == "/serve/cancel":
+                            self._reply(*server._serve_cancel(raw))
+                            return
+                        key, args, kwargs = cloudpickle.loads(
+                            base64.b64decode(raw)
+                        )
+                        try:
+                            with get_tracer().span("rpc.serve", cat="rpc", key=key):
+                                result = (True, server.invoke(key, *args, **kwargs))
+                        except Exception as e:  # result is the exception itself
+                            result = (False, e)
+                        body = base64.b64encode(cloudpickle.dumps(result))
+                        self._reply(200, "application/octet-stream", body)
                 except Exception:  # pragma: no cover - transport error
                     self.send_response(500)
                     self.end_headers()
@@ -513,12 +572,13 @@ class HttpRPCServer(RPCServer):
             def do_GET(self) -> None:  # noqa: N802 — telemetry/serve routes
                 try:
                     path, _, query = self.path.partition("?")
-                    made = server._get_body(path, query)
-                    if made is None:
-                        self.send_response(404)
-                        self.end_headers()
-                        return
-                    self._reply(*made)
+                    with _scope_from_headers(self.headers):
+                        made = server._get_body(path, query)
+                        if made is None:
+                            self.send_response(404)
+                            self.end_headers()
+                            return
+                        self._reply(*made)
                 except Exception:  # telemetry must never crash the server
                     try:
                         self.send_response(500)

@@ -75,6 +75,9 @@ class ServeHttpClient:
                 conn.sock.settimeout(self._read_timeout)
             sent = True
             headers = {"Content-Length": str(len(body))} if body is not None else {}
+            from ..rpc.http import trace_headers
+
+            headers.update(trace_headers())
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
@@ -210,4 +213,15 @@ class ServeHttpClient:
 
     def readyz(self) -> Dict[str, Any]:
         status, ctype, data = self._request("GET", "/readyz", idempotent=True)
+        return self._json(status, ctype, data)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """This replica's span-histogram families in the mergeable
+        encoding (``GET /metrics/snapshot``) — what
+        :meth:`FleetClient.federated_metrics` merges fleet-wide."""
+        status, ctype, data = self._request(
+            "GET", "/metrics/snapshot", idempotent=True
+        )
+        if status != 200:
+            raise ConnectionError(f"/metrics/snapshot returned HTTP {status}")
         return self._json(status, ctype, data)

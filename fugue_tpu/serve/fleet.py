@@ -199,6 +199,17 @@ class FleetCoordinator:
                 self._inc("fleet_claims")
                 if stole:
                     self._inc("fleet_claim_steals")
+                    # flight recorder (ISSUE 18): a stolen claim is a
+                    # recovery-ladder event — the prior owner died (or
+                    # outran its lease) mid-execution
+                    from ..obs.events import get_event_log
+
+                    get_event_log().emit(
+                        "fleet.claim_steal",
+                        key=key[:12],
+                        owner=self.replica_id,
+                        prev_owner=(holder or {}).get("owner"),
+                    )
                 # the serve.claim fault site fires in the CALLER, after it
                 # has recorded ownership — a fault between claim write and
                 # execution start must still release the claim on unwind
@@ -284,6 +295,39 @@ class FleetClient:
     @property
     def replicas(self) -> int:
         return len(self._clients)
+
+    # -- federated metrics (ISSUE 18 tentpole, piece 3) -----------------------
+    def federated_span_metrics(self) -> Tuple[Any, List[Optional[str]]]:
+        """Merge every reachable replica's ``/metrics/snapshot`` into one
+        fresh :class:`~fugue_tpu.obs.metrics.SpanMetrics`. The encoding is
+        associative and commutative, so the merged histogram's per-series
+        count equals the SUM of the per-replica counts exactly — nothing
+        is estimated. Returns ``(merged, replica_ids)`` (a None replica id
+        means the process served metrics without a serve front end)."""
+        from ..obs.metrics import SpanMetrics
+
+        merged = SpanMetrics()
+        replicas: List[Optional[str]] = []
+        for cl in self._clients:
+            try:
+                snap = cl.metrics_snapshot()
+            except Exception:
+                self._inc("metrics_unreachable")
+                continue
+            merged.merge(snap.get("spans") or {})
+            replicas.append(snap.get("replica"))
+        self._inc("metrics_federations")
+        return merged, replicas
+
+    def federated_metrics(self) -> str:
+        """ONE fleet-level Prometheus text exposition: per-replica span
+        histograms merged via :meth:`federated_span_metrics` and rendered
+        through the same ``to_prometheus_text`` the per-replica
+        ``/metrics`` route uses — scrape one page for the whole fleet."""
+        from ..obs.prom import to_prometheus_text
+
+        merged, _replicas = self.federated_span_metrics()
+        return to_prometheus_text(span_metrics=merged)
 
     # -- placement -----------------------------------------------------------
     def readyz_all(self) -> List[Optional[Dict[str, Any]]]:
@@ -398,6 +442,14 @@ class FleetClient:
                     sub.sid = re["id"]
                     sub.failovers += 1
                     self._inc("failovers")
+                    from ..obs.events import get_event_log
+
+                    get_event_log().emit(
+                        "fleet.failover",
+                        key=sub.idempotency_key[:24],
+                        from_replica=failed,
+                        to_replica=idx,
+                    )
                     return
                 except (ServeRejected, *self._FAILOVER_ERRORS):
                     continue

@@ -56,6 +56,7 @@ from ..constants import (
     FUGUE_TPU_CONF_SERVE_REPLICA_ID,
     FUGUE_TPU_CONF_SERVE_RESERVE_BYTES,
     FUGUE_TPU_CONF_SERVE_RETAIN,
+    FUGUE_TPU_CONF_TRACE_SPOOL_DIR,
 )
 from ..resilience import SITE_SERVE_CLAIM, SITE_SERVE_JOURNAL, FaultInjector
 from .dedup import submission_key
@@ -90,12 +91,13 @@ class _Execution:
     __slots__ = (
         "key", "dag", "tenant", "priority", "seq", "submitted_at",
         "started_at", "finished_at", "started", "state", "result",
-        "error", "waiters", "done",
+        "error", "waiters", "done", "trace",
     )
 
     def __init__(self, key: Optional[str], dag: Any, tenant: str,
                  priority: int, seq: int):
         self.key = key
+        self.trace: Dict[str, str] = {}
         self.dag = dag
         self.tenant = tenant
         self.priority = int(priority)
@@ -266,6 +268,10 @@ class EngineServer:
                     c.get(FUGUE_TPU_CONF_SERVE_JOURNAL_MAX_BYTES, 64 * 1024 * 1024)
                 ),
             )
+        # cluster tracing (ISSUE 18): with a spool dir configured this
+        # replica exports its span buffer after every execution so a
+        # driver-side assembler merges it into ONE fleet trace
+        self._spool_dir = str(c.get(FUGUE_TPU_CONF_TRACE_SPOOL_DIR, ""))
         # cross-host liveness (ISSUE 14): with a heartbeat dir configured
         # this replica beats under its replica_id, and the shared store's
         # claim stealing (cache/store.py) judges it by that beat instead
@@ -287,6 +293,12 @@ class EngineServer:
         # serving counters ride the engine's unified registry (ISSUE 3
         # contract: engine.stats()["serve"], reset under keep-entries)
         engine.metrics.register("serve", self._stats)
+        if self._fleet is not None:
+            # fleet rollup (ISSUE 18, metrics federation): the cross-
+            # replica coordination counters as their own stats group —
+            # engine.stats()["fleet"] answers "is the fleet dedup/failover
+            # machinery actually firing" without digging through serve.*
+            engine.metrics.register("fleet", _FleetRollup(self))
         self._register_probes()
 
     # -- lifecycle -----------------------------------------------------------
@@ -315,6 +327,7 @@ class EngineServer:
         published into a fleet result hit, not a re-run."""
         if self._journal is None:
             return
+        replayed = 0
         for rec in self._journal.unfinished():
             dag = self._journal.decode_dag(rec)
             if dag is None:
@@ -330,12 +343,19 @@ class EngineServer:
                     reserve_bytes=rec.get("reserve"),
                 )
                 self._stats.inc("journal_replays")
+                replayed += 1
             except ServeRejected:
                 pass  # shed on replay too: rejection is never silent
             finally:
                 # the replayed submission journals its own fresh admit
                 # record; retire the pre-crash one either way
                 self._journal.done(rec.get("sid", ""), "replayed")
+        if replayed:
+            from ..obs.events import get_event_log
+
+            get_event_log().emit(
+                "serve.journal_replay", replica=self.replica_id, entries=replayed
+            )
 
     def stop(self, timeout: Optional[float] = 30.0) -> None:
         """Stop admitting and drain: in-flight executions finish, still-
@@ -447,8 +467,19 @@ class EngineServer:
         session and a counter to the operator, never silent."""
         from ..obs import get_tracer
 
+        tracer = get_tracer()
         tenant = str(tenant)
-        with get_tracer().span("serve.submit", cat="serve", tenant=tenant) as sp:
+        tctx: Any = nullcontext()
+        if tracer.enabled:
+            from ..obs import current_trace_id, trace_scope
+
+            if current_trace_id() is None:
+                # cluster tracing (ISSUE 18): an in-process submission
+                # mints its own trace root; an HTTP submission arrives
+                # with the client's trace already bound by the handler
+                # (rpc/http.py reads X-Fugue-Trace) and keeps it
+                tctx = trace_scope()
+        with tctx, tracer.span("serve.submit", cat="serve", tenant=tenant) as sp:
             if not self._running:
                 raise ServeRejected("server_stopped")
             # the journal records what was SUBMITTED: a factory pickles
@@ -534,6 +565,12 @@ class EngineServer:
                     )
                 self._seq += 1
                 ex = _Execution(key, dag, tenant, prio, self._seq)
+                if tracer.enabled:
+                    # the worker thread re-enters this scope so serve.run
+                    # (and the dag's spans) land under the submit's trace
+                    from ..obs import trace_carrier
+
+                    ex.trace = trace_carrier()
                 ex.waiters.append(sub)
                 sub._execution = ex
                 # WAL before the queue: an admission the client can see
@@ -746,66 +783,75 @@ class EngineServer:
         # workflow.run's own run_labels nests inside and overlays its
         # workflow/run ids, keeping this tenant label
         labels: Any = nullcontext()
+        tctx: Any = nullcontext()
         if tracer.enabled:
             from ..obs import run_labels
 
             labels = run_labels(tenant=ex.tenant)
+            if ex.trace:
+                # re-enter the submission's trace on this worker thread:
+                # serve.run (and everything the dag forks) attaches under
+                # the submitting client's trace id, not a fresh root
+                from ..obs import trace_scope
+
+                tctx = trace_scope(ex.trace.get("trace"), ex.trace.get("parent"))
         fleet_owner = False
-        try:
-            # cross-replica single-flight (docs/serving.md "Fleet"): claim
-            # the key in the shared store, or serve the owner's published
-            # result instead of re-executing. acquire() is bounded by the
-            # holder's lease — a dead owner's claim is stolen, never waited
-            # on forever.
-            if self._fleet is not None and ex.key is not None:
-                role, payload = self._fleet.acquire(ex.key)
-                if role == "result":
-                    ex.result = self._rehydrate(payload)
+        with tctx:  # fleet claims/events below carry the submit's trace too
+            try:
+                # cross-replica single-flight (docs/serving.md "Fleet"): claim
+                # the key in the shared store, or serve the owner's published
+                # result instead of re-executing. acquire() is bounded by the
+                # holder's lease — a dead owner's claim is stolen, never waited
+                # on forever.
+                if self._fleet is not None and ex.key is not None:
+                    role, payload = self._fleet.acquire(ex.key)
+                    if role == "result":
+                        ex.result = self._rehydrate(payload)
+                        ex.finished_at = time.monotonic()
+                        ex.state = "done"
+                    else:
+                        fleet_owner = True
+                        # between claim write and execution start — the chaos
+                        # tests' deterministic crash point; an injected error
+                        # here unwinds through the release below
+                        self._injector.fire(SITE_SERVE_CLAIM)
+                if ex.state != "done":
+                    if self._journal is not None:
+                        # the no-double-execution audit reads these: one exec
+                        # record per dag actually run on this replica
+                        self._journal.exec_start(
+                            ex.waiters[0].id if ex.waiters else "", ex.key
+                        )
+                        self._stats.inc("journal_appends")
+                    with labels, tracer.span(
+                        "serve.run",
+                        cat="serve",
+                        tenant=ex.tenant,
+                        priority=ex.priority,
+                        waiters=len(ex.waiters),
+                        queue_wait_s=round(wait_s, 6),
+                    ):
+                        result = ex.dag.run(self._engine)
+                    ex.result = result
                     ex.finished_at = time.monotonic()
                     ex.state = "done"
-                else:
-                    fleet_owner = True
-                    # between claim write and execution start — the chaos
-                    # tests' deterministic crash point; an injected error
-                    # here unwinds through the release below
-                    self._injector.fire(SITE_SERVE_CLAIM)
-            if ex.state != "done":
-                if self._journal is not None:
-                    # the no-double-execution audit reads these: one exec
-                    # record per dag actually run on this replica
-                    self._journal.exec_start(
-                        ex.waiters[0].id if ex.waiters else "", ex.key
-                    )
-                    self._stats.inc("journal_appends")
-                with labels, tracer.span(
-                    "serve.run",
-                    cat="serve",
-                    tenant=ex.tenant,
-                    priority=ex.priority,
-                    waiters=len(ex.waiters),
-                    queue_wait_s=round(wait_s, 6),
-                ):
-                    result = ex.dag.run(self._engine)
-                ex.result = result
+                    if fleet_owner:
+                        frames = self._extract_frames(result)
+                        if frames is not None:
+                            # publish releases the claim; waiters fleet-wide
+                            # load this artifact instead of executing
+                            self._fleet.publish_result(ex.key, frames)
+                        else:
+                            self._fleet.release(ex.key)
+            except BaseException as e:  # the waiter gets the error, not the worker
+                ex.error = e
                 ex.finished_at = time.monotonic()
-                ex.state = "done"
+                ex.state = "failed"
                 if fleet_owner:
-                    frames = self._extract_frames(result)
-                    if frames is not None:
-                        # publish releases the claim; waiters fleet-wide
-                        # load this artifact instead of executing
-                        self._fleet.publish_result(ex.key, frames)
-                    else:
-                        self._fleet.release(ex.key)
-        except BaseException as e:  # the waiter gets the error, not the worker
-            ex.error = e
-            ex.finished_at = time.monotonic()
-            ex.state = "failed"
-            if fleet_owner:
-                # no error tombstones: a failed owner releases the claim
-                # so a cross-replica waiter re-decides (executes) rather
-                # than caching a failure fleet-wide
-                self._fleet.release(ex.key)
+                    # no error tombstones: a failed owner releases the claim
+                    # so a cross-replica waiter re-decides (executes) rather
+                    # than caching a failure fleet-wide
+                    self._fleet.release(ex.key)
         if ex.state == "done":
             self._stats.inc("completed")
         else:
@@ -832,6 +878,23 @@ class EngineServer:
                 self._stats.inc("journal_appends")
         self._finish_waiters(ex)
         self._retire(waiters)
+        self._maybe_publish_spool()
+
+    def _maybe_publish_spool(self) -> None:
+        """Cumulative, idempotent span export (obs/spool.py): last write
+        wins, so publishing after every execution is safe and cheap."""
+        if not self._spool_dir:
+            return
+        from ..obs import get_tracer
+
+        if not get_tracer().enabled:
+            return
+        from ..obs.spool import publish_spool
+
+        try:
+            publish_spool(self._spool_dir, label=f"replica {self.replica_id}")
+        except Exception as ex:
+            self._engine.log.warning("trace spool publish failed: %s", ex)
 
     def _finish_waiters(self, ex: _Execution) -> None:
         ex.done.set()
@@ -943,6 +1006,44 @@ class EngineServer:
         except Exception:
             pass
         return out
+
+
+class _FleetRollup:
+    """``engine.stats()["fleet"]`` — the cross-replica view: the
+    ``fleet_*`` counters sliced out of :class:`~fugue_tpu.serve.stats.ServeStats`
+    (renamed without the prefix) plus live store gauges. Weakly bound so
+    a collected server unregisters itself in effect; ``reset()`` is a
+    no-op because the underlying counters already reset with the
+    ``serve`` source (one reset, not two)."""
+
+    def __init__(self, server: "EngineServer"):
+        import weakref
+
+        self._ref = weakref.ref(server)
+
+    def as_dict(self) -> Dict[str, Any]:
+        srv = self._ref()
+        if srv is None or srv._fleet is None:
+            return {}
+        st = srv._stats.as_dict()
+        out: Dict[str, Any] = {
+            k[len("fleet_"):]: v
+            for k, v in st.items()
+            if k.startswith("fleet_") and isinstance(v, (int, float))
+        }
+        out["replica_id"] = srv.replica_id
+        try:
+            out["results_cached"] = sum(
+                1
+                for n in os.listdir(srv._fleet.results_dir)
+                if n.endswith(".result.pkl")
+            )
+        except OSError:
+            out["results_cached"] = 0
+        return out
+
+    def reset(self) -> None:
+        pass
 
 
 def _result_bytes(result: Any) -> int:

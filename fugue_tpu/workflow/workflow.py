@@ -926,12 +926,14 @@ class FugueWorkflow:
         # same label across runs) unless conf names one explicitly.
         run_attrs: Dict[str, Any] = {}
         run_ctx: Any = nullcontext()
+        trace_ctx: Any = nullcontext()
         if tracer.enabled:
             import hashlib
             import uuid as _uuid
 
             from ..constants import FUGUE_TPU_CONF_TELEMETRY_WORKFLOW
             from ..obs import run_labels as _run_labels
+            from ..obs import trace_scope as _trace_scope
 
             wf_label = str(
                 plan_conf.get(FUGUE_TPU_CONF_TELEMETRY_WORKFLOW, "")
@@ -940,6 +942,17 @@ class FugueWorkflow:
             ).hexdigest()[:8]
             run_attrs = {"workflow": wf_label, "run": _uuid.uuid4().hex[:8]}
             run_ctx = _run_labels(**run_attrs)
+            # cluster trace context (ISSUE 18): mint ONE trace id for this
+            # run — every hop below (fork workers, board tasks, HTTP, fleet
+            # claims) carries it, so remote spans attach under this run.
+            # Inside an already-traced scope (a serve replica running a
+            # submitted dag) ADOPT that trace instead of minting: the
+            # whole execution stays one trace end to end.
+            from ..obs import current_trace_id as _current_trace_id
+
+            self._last_trace_id = _current_trace_id() or _uuid.uuid4().hex[:16]
+            run_attrs["trace"] = self._last_trace_id
+            trace_ctx = _trace_scope(self._last_trace_id)
         # adaptive execution (docs/tuning.md): key this run's telemetry by
         # the POST-optimization plan fingerprint so the tuner's learned
         # settings apply to — and learn from — exactly this plan; the
@@ -950,7 +963,7 @@ class FugueWorkflow:
         self._last_plan_fingerprint = _plan_fp(run_tasks)
         try:
             with e.run_conf_scope(self._conf), e._as_borrowed_context():
-                with run_ctx, tracer.span(
+                with trace_ctx, run_ctx, tracer.span(
                     "workflow.run", cat="workflow", tasks=len(run_tasks), **run_attrs
                 ), _tuning_scope(e, self._last_plan_fingerprint, plan_conf):
                     ctx.run(
@@ -995,6 +1008,33 @@ class FugueWorkflow:
             engine.log.info("workflow trace exported to %s", path)
         except Exception as ex:  # export must never fail the run
             engine.log.warning("trace export failed: %s", ex)
+
+    def timeline(
+        self, events_dir: Optional[str] = None, conf: Any = None
+    ) -> str:
+        """Human-readable post-mortem of the cluster recovery events the
+        last :meth:`run` produced (ISSUE 18 flight recorder): lease
+        steals, heartbeat expiries, re-dispatches, orphan invalidations,
+        speculative twins — merged from every process's event file and
+        filtered to this run's trace id. ``events_dir`` defaults to the
+        run conf's ``fugue.tpu.events.dir`` (env
+        ``FUGUE_TPU_EVENTS_DIR`` overrides)."""
+        import os as _os
+
+        from ..constants import FUGUE_TPU_CONF_EVENTS_DIR
+        from ..obs import read_events, render_timeline
+
+        if events_dir is None:
+            events_dir = _os.environ.get("FUGUE_TPU_EVENTS_DIR", "")
+            if not events_dir:
+                merged = self._merged_plan_conf(conf, engine=self._last_engine)
+                events_dir = str(merged.get(FUGUE_TPU_CONF_EVENTS_DIR, ""))
+        if not events_dir:
+            return "(no events dir configured — set fugue.tpu.events.dir)"
+        return render_timeline(
+            read_events(events_dir),
+            trace=getattr(self, "_last_trace_id", None),
+        )
 
     def _merged_plan_conf(self, conf: Any = None, engine: Any = None) -> ParamDict:
         from ..constants import _FUGUE_GLOBAL_CONF

@@ -1,0 +1,518 @@
+"""Cluster-wide tracing (ISSUE 18, docs/observability.md): cross-process
+trace propagation, the per-process span spool + trace assembler, the
+flight recorder, and metrics federation.
+
+Covers the satellite test checklist:
+
+- a forked map worker's spans land under the submitting run's trace id,
+  with the run's results bit-identical to an untraced run;
+- a REAL HTTP hop (``/serve/submit``) lands the server-side execution's
+  spans under the submitting client's trace id, results bit-identical;
+- flight-recorder completeness: every counted lease steal has exactly one
+  ``lease.steal`` journal record (and every dead-holder steal exactly one
+  ``hb.expired``);
+- federated metrics: the merged histogram's per-series count equals the
+  SUM of the per-replica counts, and the fleet exposition passes
+  ``validate_prometheus_text``;
+- the host+pid span-id collision fix: ``validate_chrome_trace`` rejects a
+  duplicate (pid, span id) pair.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.collections import PartitionSpec
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_EVENTS_DIR,
+    FUGUE_TPU_CONF_EVENTS_ENABLED,
+    FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS,
+    FUGUE_TPU_CONF_MAP_PARALLELISM,
+    FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT,
+)
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.obs import (
+    EVENT_TYPES,
+    assemble_trace,
+    current_trace_id,
+    get_event_log,
+    get_span_metrics,
+    get_tracer,
+    mint_trace_id,
+    proc_ident,
+    publish_spool,
+    read_events,
+    read_spools,
+    render_timeline,
+    to_chrome_trace,
+    to_prometheus_text,
+    trace_carrier,
+    trace_scope,
+    validate_chrome_trace,
+    validate_prometheus_text,
+)
+from fugue_tpu.obs.metrics import SpanMetrics
+from fugue_tpu.serve import EngineServer, FleetClient, ServeHttpClient
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    tr.clear()
+    tr.enable()
+    yield tr
+    tr.disable()
+    tr.clear()
+
+
+@pytest.fixture
+def events(tmp_path):
+    """Flight recorder pointed at a fresh dir; disabled + closed after."""
+    log = get_event_log()
+    d = str(tmp_path / "events")
+    log.configure(d, True)
+    yield d
+    log.configure(d, False)
+    log.close()
+
+
+def _frame(n=8000, groups=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"k": rng.integers(0, groups, n), "v": rng.random(n)})
+
+
+# ---------------------------------------------------------------------------
+# trace context: mint / scope / carrier
+# ---------------------------------------------------------------------------
+
+
+def test_trace_scope_sets_and_restores():
+    assert current_trace_id() is None
+    tid = mint_trace_id()
+    assert len(tid) == 16 and int(tid, 16) >= 0
+    with trace_scope(tid):
+        assert current_trace_id() == tid
+        assert trace_carrier()["trace"] == tid
+        # a nested scope with no args mints a FRESH trace (a new run)
+        with trace_scope():
+            inner = current_trace_id()
+            assert inner is not None and inner != tid
+        assert current_trace_id() == tid
+    assert current_trace_id() is None
+
+
+def test_remote_hop_reparents_under_carrier(tracer):
+    """The propagation contract: a span opened in a scope restored from a
+    carrier (the HTTP-header / task-spec hop) records the submitting
+    run's trace id and parents onto the submitting span."""
+    tid = mint_trace_id()
+    with trace_scope(tid):
+        with tracer.span("serve.submit") as sp:  # noqa: F841
+            carrier = trace_carrier()
+    assert carrier["trace"] == tid and carrier["parent"]
+    # "the other process": only the carrier crosses the wire
+    with trace_scope(carrier["trace"], carrier["parent"]):
+        with tracer.span("dist.task"):
+            pass
+    recs = {r["name"]: r for r in tracer.records()}
+    assert recs["dist.task"]["trace"] == tid
+    assert recs["dist.task"]["parent"] == recs["serve.submit"]["id"]
+    assert recs["serve.submit"]["trace"] == tid
+
+
+def test_span_ids_are_host_pid_prefixed(tracer):
+    with tracer.span("x"):
+        pass
+    (rec,) = tracer.records()
+    assert rec["id"].startswith(proc_ident() + ":")
+
+
+def test_validate_rejects_duplicate_pid_span_id(tmp_path, tracer):
+    with tracer.span("a"):
+        pass
+    (rec,) = tracer.records()
+    clone = dict(rec)  # same pid, same span id — the cross-host collision
+    doc = to_chrome_trace([rec, clone])
+    p = tmp_path / "dup.json"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(AssertionError, match="duplicate"):
+        validate_chrome_trace(str(p))
+
+
+# ---------------------------------------------------------------------------
+# span spool + assembler
+# ---------------------------------------------------------------------------
+
+
+def test_spool_publish_idempotent_and_torn_skipped(tmp_path, tracer):
+    with tracer.span("engine.aggregate", rows=10):
+        pass
+    d = str(tmp_path / "spool")
+    p1 = publish_spool(d, stats={"n": 1}, label="worker w0")
+    p2 = publish_spool(d, stats={"n": 2}, label="worker w0")
+    assert p1 == p2  # one file per process; last write wins
+    (tmp_path / "spool" / "ghost.spool.json").write_text('{"spans": [')  # torn
+    docs = read_spools(d)
+    assert len(docs) == 1
+    assert docs[0]["proc"] == proc_ident() and docs[0]["stats"] == {"n": 2}
+    assert [r["name"] for r in docs[0]["spans"]] == ["engine.aggregate"]
+
+
+def test_spool_carries_sampler_ring(tmp_path, tracer):
+    """Satellite fix: the remote sampler ring ships through the spool and
+    renders as a counter track on that process's assembled track."""
+    with tracer.span("w"):
+        pass
+    d = str(tmp_path / "spool")
+    publish_spool(d, counters=[(time.perf_counter_ns(), {"host_rss_bytes": 1.0})])
+    (doc,) = read_spools(d)
+    assert doc["counters"] and doc["counters"][0][1] == {"host_rss_bytes": 1.0}
+
+
+def _fake_spool(spool_dir, proc, label, spans):
+    doc = {
+        "version": 1,
+        "proc": proc,
+        "pid": 123,
+        "label": label,
+        "spans": spans,
+        "counters": [],
+        "stats": {},
+    }
+    os.makedirs(spool_dir, exist_ok=True)
+    with open(os.path.join(spool_dir, proc + ".spool.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def _span(proc, seq, name, trace=None, parent=None):
+    return {
+        "name": name,
+        "cat": "dist",
+        "ts": time.perf_counter_ns(),
+        "dur": 1000,
+        "pid": 123,  # raw OS pid — identical across "hosts" on purpose
+        "tid": 1,
+        "id": f"{proc}:{seq}",
+        "parent": parent,
+        "proc": proc,
+        "trace": trace,
+        "args": {},
+    }
+
+
+def test_assemble_dedups_remaps_and_names_tracks(tmp_path, tracer):
+    tid = mint_trace_id()
+    with trace_scope(tid):
+        with tracer.span("workflow.run"):
+            pass
+    d = str(tmp_path / "spool")
+    # two "hosts" whose raw pids collide; w0's first span ALSO appears in
+    # the driver-ingested copy (same proc + span id → deduplicated)
+    s0 = _span("hostA-123", 1, "dist.task", trace=tid)
+    _fake_spool(d, "hostA-123", "worker w0", [s0, _span("hostA-123", 2, "dist.fetch")])
+    _fake_spool(d, "hostB-123", "worker w1", [_span("hostB-123", 1, "dist.task", trace=tid)])
+    out = str(tmp_path / "trace.json")
+    summary = assemble_trace(d, out, local_records=tracer.records() + [s0])
+    assert summary["processes"] == 3
+    assert summary["spans"] == 4  # 1 driver + 2 w0 (deduped) + 1 w1
+    assert summary["process_spans"]["hostA-123"] == 2
+    assert summary["process_names"][proc_ident()] == "fugue-tpu driver"
+    assert summary["process_names"]["hostB-123"] == "fugue-tpu worker w1 hostB-123"
+    assert summary["traces"] == [tid]
+    with open(out) as f:
+        doc = json.load(f)
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert pids == {1, 2, 3}  # dense synthetic pids, driver first
+    # trace filter: only the run's spans survive
+    summary = assemble_trace(
+        d, out, local_records=tracer.records(), trace_id=tid
+    )
+    assert summary["spans"] == 3 and summary["traces"] == [tid]
+
+
+# ---------------------------------------------------------------------------
+# forked map workers inherit the run's trace id (bit-identical results)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(os.name != "posix", reason="fork pool requires posix fork")
+def test_fork_map_worker_spans_under_run_trace(tracer):
+    from fugue_tpu.execution.parallel_map import fork_available
+
+    if not fork_available():
+        pytest.skip("no fork start method")
+    import fugue_tpu.api as fa
+
+    pdf = _frame(6000, 8, seed=2)
+
+    def demean(df: pd.DataFrame) -> pd.DataFrame:
+        df["v"] = df["v"] - df["v"].mean()
+        return df
+
+    def run():
+        e = JaxExecutionEngine(
+            {
+                FUGUE_TPU_CONF_MAP_PARALLELISM: 2,
+                FUGUE_TPU_CONF_MAP_PARALLEL_MIN_ROWS: 0,
+            }
+        )
+        try:
+            return fa.transform(
+                pdf, demean, schema="*", partition=PartitionSpec(by=["k"]), engine=e
+            )
+        finally:
+            e.stop_engine()
+
+    tid = mint_trace_id()
+    with trace_scope(tid):
+        traced = run()
+    worker = [r for r in tracer.records() if r["name"] == "map.worker_chunk"]
+    assert worker, "no worker spans shipped home"
+    assert all(r.get("trace") == tid for r in worker)
+    assert all(r["pid"] != os.getpid() for r in worker)
+    # the instrumentation changed nothing: untraced run is bit-identical
+    tracer.disable()
+    tracer.clear()
+    untraced = run()
+    pd.testing.assert_frame_equal(traced, untraced)
+
+
+# ---------------------------------------------------------------------------
+# a REAL HTTP hop: /serve/submit propagates the client's trace id
+# ---------------------------------------------------------------------------
+
+
+def _agg_dag(seed: int = 0, rows: int = 64) -> FugueWorkflow:
+    dag = FugueWorkflow()
+    (
+        dag.df(
+            pd.DataFrame(
+                {
+                    "k": [i % 4 for i in range(rows)],
+                    "v": [float(i + seed) for i in range(rows)],
+                }
+            )
+        )
+        .partition_by("k")
+        .aggregate(ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("n"))
+        .yield_dataframe_as("r", as_local=True)
+    )
+    return dag
+
+
+@pytest.fixture
+def http_serve():
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            FUGUE_TPU_CONF_SERVE_MAX_CONCURRENT: 1,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    srv = EngineServer(eng).start()
+    rpc.bind_serve(srv)
+    try:
+        yield eng, rpc, srv
+    finally:
+        srv.stop()
+        rpc.stop()
+
+
+def test_http_submit_lands_spans_under_client_trace(http_serve, tracer):
+    eng, rpc, srv = http_serve
+    cl = ServeHttpClient(rpc.host, rpc.port)
+    tid = mint_trace_id()
+    with trace_scope(tid):
+        sub = cl.submit(lambda: _agg_dag(seed=5), tenant="acme")
+        frames = cl.result(sub["id"], timeout=60)
+    served = frames["r"].sort_values("k").reset_index(drop=True)
+    # the server-side execution ran in ANOTHER thread with no inherited
+    # context — only the X-Fugue-Trace header links it to this run
+    runs = [r for r in tracer.records() if r["name"] == "workflow.run"]
+    assert runs and any(r.get("trace") == tid for r in runs)
+    # bit-identical to running the same dag directly
+    local = (
+        _agg_dag(seed=5)
+        .run(NativeExecutionEngine({}))
+        .yields["r"]
+        .result.as_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(served, local)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_emit_read_render(events):
+    log = get_event_log()
+    tid = mint_trace_id()
+    with trace_scope(tid):
+        log.emit("lease.steal", task="t1", owner="w1", prev_owner="w0",
+                 reason="worker_lost")
+    log.emit("chaos.inject", fault="SIGKILL", target="w0")
+    assert os.path.exists(log.path())
+    evs = read_events(events)
+    assert {e["type"] for e in evs} == {"lease.steal", "chaos.inject"}
+    (steal,) = [e for e in evs if e["type"] == "lease.steal"]
+    assert steal["trace"] == tid and steal["proc"] == proc_ident()
+    assert set(e["type"] for e in evs) <= EVENT_TYPES
+    txt = render_timeline(evs, trace=tid)
+    # trace filter keeps trace-LESS records (the injection) alongside
+    assert "stolen by w1 from w0 (worker_lost)" in txt
+    assert "SIGKILL injected into w0" in txt
+
+
+def test_event_log_disabled_is_silent(tmp_path):
+    log = get_event_log()
+    before = log.as_dict()["emitted"]
+    log.emit("lease.acquire", task="t", owner="w")  # disabled: no-op
+    assert log.as_dict()["emitted"] == before
+
+
+def test_events_conf_enables_and_env_overrides(tmp_path, monkeypatch):
+    d = str(tmp_path / "ev")
+    log = get_event_log()
+    try:
+        e = NativeExecutionEngine(
+            {FUGUE_TPU_CONF_EVENTS_ENABLED: True, FUGUE_TPU_CONF_EVENTS_DIR: d}
+        )
+        assert log.enabled
+        log.emit("serve.journal_replay", replica="r0", entries=2)
+        assert read_events(d)[0]["type"] == "serve.journal_replay"
+        # env kill-switch wins over conf (the tracer's contract)
+        monkeypatch.setenv("FUGUE_TPU_EVENTS", "0")
+        e2 = NativeExecutionEngine(
+            {FUGUE_TPU_CONF_EVENTS_ENABLED: True, FUGUE_TPU_CONF_EVENTS_DIR: d}
+        )
+        assert not log.enabled
+        del e, e2
+    finally:
+        log.configure(d, False)
+        log.close()
+
+
+def test_lease_steal_journal_completeness(tmp_path, events):
+    """Every COUNTED recovery event has exactly one journal record: run
+    the lease matrix (clean grant, expiry steal, dead-holder steal) and
+    reconcile the stats counters against the event log."""
+    from fugue_tpu.dist import HeartbeatWriter, LeaseBoard
+
+    class Stats:
+        def __init__(self):
+            self.d = {}
+
+        def inc(self, k, n=1):
+            self.d[k] = self.d.get(k, 0) + n
+
+    st = Stats()
+    hb_dir = str(tmp_path / "hb")
+    lb = LeaseBoard(
+        str(tmp_path / "leases"), hb_dir=hb_dir, hb_stale_s=0.3, stats=st
+    )
+    # clean grant → lease.acquire
+    assert lb.try_acquire("t1", "w0", lease_s=0.05)[0]
+    # expiry steal (no heartbeat evidence) → lease.steal(reason=expired)
+    time.sleep(0.1)
+    assert lb.try_acquire("t1", "w1", lease_s=30.0)[0]
+    # dead-holder steal: fresh-then-stale heartbeat → hb.expired + steal
+    HeartbeatWriter(hb_dir, "w2", interval_s=0.05).beat()
+    assert lb.try_acquire("t2", "w2", lease_s=30.0)[0]
+    time.sleep(0.4)  # the heartbeat goes provably stale
+    assert lb.try_acquire("t2", "w3", lease_s=30.0)[0]
+
+    evs = read_events(events)
+    by_type = {}
+    for e in evs:
+        by_type.setdefault(e["type"], []).append(e)
+    assert len(by_type.get("lease.steal", [])) == st.d["leases_stolen"] == 2
+    assert (
+        len(by_type.get("hb.expired", []))
+        == st.d["leases_stolen_dead"]
+        == 1
+    )
+    assert st.d["leases_stolen_expired"] == 1
+    steal_dead = [
+        e for e in by_type["lease.steal"] if e["reason"] == "worker_lost"
+    ]
+    assert len(steal_dead) == 1 and steal_dead[0]["prev_owner"] == "w2"
+    # the expiry record precedes its steal and names the same task
+    exp = by_type["hb.expired"][0]
+    assert exp["holder"] == "w2" and exp["task"] == "t2"
+    assert exp["ts"] <= steal_dead[0]["ts"]
+    # clean grants: one lease.acquire per non-steal grant
+    n_clean = st.d["leases_acquired"] - st.d["leases_stolen"]
+    assert len(by_type.get("lease.acquire", [])) == n_clean == 2
+
+
+# ---------------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------------
+
+
+def _latency_count(sm: SpanMetrics, span: str) -> int:
+    return sum(
+        h.count
+        for labels, h in sm.latency.series()
+        if labels.get("span") == span
+    )
+
+
+def _obs(sm: SpanMetrics, span: str, n: int, dur_ns: int = 2_000_000) -> None:
+    for _ in range(n):
+        sm.observe_record({"name": span, "dur": dur_ns, "args": {"rows": 10}})
+
+
+def test_federated_merge_counts_are_exact_sums():
+    a, b = SpanMetrics(), SpanMetrics()
+    _obs(a, "engine.aggregate", 3)
+    _obs(b, "engine.aggregate", 5, dur_ns=8_000_000)
+    _obs(b, "engine.join", 2)
+    merged = SpanMetrics()
+    merged.merge(a.snapshot())
+    merged.merge(b.snapshot())
+    assert _latency_count(merged, "engine.aggregate") == 8  # 3 + 5, exactly
+    assert _latency_count(merged, "engine.join") == 2
+    # merge is order-independent (associative + commutative encoding)
+    merged2 = SpanMetrics()
+    merged2.merge(b.snapshot())
+    merged2.merge(a.snapshot())
+    assert merged2.snapshot() == merged.snapshot()
+    text = to_prometheus_text(span_metrics=merged)
+    summary = validate_prometheus_text(text)
+    assert any(
+        n.startswith("fugue_tpu_span_latency_seconds") for n in summary["names"]
+    )
+    # the merged count is in the exposition itself, not just the object
+    assert 'span="engine.aggregate"' in text and " 8" in text
+
+
+def test_fleet_client_federates_over_http(http_serve, tracer):
+    eng, rpc, srv = http_serve
+    cl = ServeHttpClient(rpc.host, rpc.port)
+    sub = cl.submit(lambda: _agg_dag(seed=7))
+    cl.result(sub["id"], timeout=60)
+    # the replica now has live span histograms; federate through the wire
+    fc = FleetClient([(rpc.host, rpc.port)])
+    merged, replicas = fc.federated_span_metrics()
+    assert len(replicas) == 1
+    want = _latency_count(get_span_metrics(), "workflow.run")
+    assert want >= 1
+    assert _latency_count(merged, "workflow.run") == want
+    text = fc.federated_metrics()
+    summary = validate_prometheus_text(text)
+    assert any(
+        n.startswith("fugue_tpu_span_latency_seconds") for n in summary["names"]
+    )
+    assert fc.stats()["metrics_federations"] == 2

@@ -219,7 +219,11 @@ def test_chrome_trace_export_golden(tracer, tmp_path):
         "workflow.task",
     ]  # completion order
     for e in evs:
-        assert set(e) == {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"}
+        # "id" rode in with ISSUE 18: the cluster-unique span id survives
+        # export so cross-process assembly can dedup re-published spools
+        assert set(e) == {
+            "name", "cat", "ph", "ts", "dur", "pid", "tid", "args", "id",
+        }
         assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
     chunk, agg, task = evs
     # nesting is encoded by time containment on one (pid, tid) track

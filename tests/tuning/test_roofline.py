@@ -1,0 +1,170 @@
+"""Per-verb roofline recording (ISSUE 18 satellite; docs/tuning.md) —
+record-only groundwork for ROADMAP 5's cost-model replacement.
+
+Covers the fold math (associative delta publishes), the TunedStore
+"rooflines" document key (atomic publish, foreign-key preservation, the
+shared LRU bound), the verb-observer gate (conf off → no observer
+installed; tracing off → zero folds), and the ``engine.report()``
+rendering.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import FUGUE_TPU_CONF_TUNING_ROOFLINES
+from fugue_tpu.jax import JaxExecutionEngine
+from fugue_tpu.obs import get_tracer, set_verb_observer
+from fugue_tpu.tuning import RooflineRecorder, rooflines_enabled
+from fugue_tpu.tuning.store import TunedStore
+
+
+class Stats:
+    def __init__(self):
+        self.d = {}
+
+    def inc(self, k, n=1):
+        self.d[k] = self.d.get(k, 0) + n
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    tr.clear()
+    tr.enable()
+    yield tr
+    tr.disable()
+    tr.clear()
+
+
+def test_fold_math_best_and_totals(tmp_path):
+    store = TunedStore(str(tmp_path / "_tuned.json"))
+    rec = RooflineRecorder(store)
+    rec.observe("engine.filter", "float", 2, wall_s=0.25, rows=1_000_000,
+                nbytes=8_000_000)
+    rec.observe("engine.filter", "float", 2, wall_s=0.50, rows=1_000_000,
+                nbytes=16_000_000)
+    assert rec.pending_count() == 1
+    (entry,) = rec.snapshot().values()
+    assert entry["obs"] == 2
+    assert entry["rows"] == 2_000_000 and entry["bytes"] == 24_000_000
+    # best_* is the max ACHIEVED rate across observations, not an average
+    assert entry["best_bytes_s"] == pytest.approx(16_000_000 / 0.5)
+    assert entry["best_rows_s"] == pytest.approx(1_000_000 / 0.25)
+    # last_* is the most recent observation's rate
+    assert entry["last_bytes_s"] == pytest.approx(16_000_000 / 0.5)
+    assert entry["last_rows_s"] == pytest.approx(1_000_000 / 0.5)
+
+
+def test_flush_publishes_delta_and_preserves_foreign_keys(tmp_path):
+    path = str(tmp_path / "_tuned.json")
+    with open(path, "w") as f:
+        json.dump({"tuning": {"version": 1, "plans": {"fp": {"x": 1}}}}, f)
+    st = Stats()
+    store = TunedStore(path, stats=st)
+    rec = RooflineRecorder(store, stats=st)
+    rec.observe("engine.take", "int", 4, wall_s=0.1, rows=1000, nbytes=32_000)
+    assert rec.flush() and rec.pending_count() == 0
+    with open(path) as f:
+        doc = json.load(f)
+    # the tuning document is intact next to the new rooflines key
+    assert doc["tuning"]["plans"] == {"fp": {"x": 1}}
+    assert doc["rooflines"]["entries"]["engine.take|int|w4"]["obs"] == 1
+    assert st.d["roofline_publishes"] == 1
+    # a SECOND process's delta folds in (associative read-merge-write)
+    other = RooflineRecorder(TunedStore(path))
+    other.observe("engine.take", "int", 4, wall_s=0.1, rows=1000, nbytes=32_000)
+    assert other.flush()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["rooflines"]["entries"]["engine.take|int|w4"]["obs"] == 2
+    # both stores converge on re-read
+    assert store.rooflines()["engine.take|int|w4"]["obs"] == 2
+
+
+def test_rooflines_share_the_lru_bound(tmp_path):
+    st = Stats()
+    store = TunedStore(str(tmp_path / "_tuned.json"), max_entries=3, stats=st)
+    rec = RooflineRecorder(store)
+    for i in range(5):
+        rec.observe(f"engine.v{i}", "float", 1, wall_s=0.1, rows=10, nbytes=80)
+        assert rec.flush()
+    assert len(store.rooflines()) == 3
+    assert st.d["evictions"] >= 2
+
+
+def test_tiny_verbs_and_nonframes_are_skipped(tmp_path):
+    from fugue_tpu.tuning.roofline import MIN_VERB_WALL_S
+
+    rec = RooflineRecorder(TunedStore(str(tmp_path / "t.json")))
+    rec.record("engine.take", MIN_VERB_WALL_S / 2, object())  # too fast
+    rec.record("engine.take", 1.0, object())  # not a frame
+    rec.record("engine.take", 1.0, None)
+    assert rec.pending_count() == 0
+
+
+def test_conf_gate_and_engine_end_to_end(tmp_path, tracer):
+    assert rooflines_enabled({}) is True  # default ON (record-only, cheap)
+    import fugue_tpu.obs.tracer as tmod
+
+    set_verb_observer(None)  # shed any observer a prior test's engine left
+    e = JaxExecutionEngine({FUGUE_TPU_CONF_TUNING_ROOFLINES: False})
+    try:
+        assert tmod._VERB_OBSERVER is None  # opted out: no hook at all
+    finally:
+        e.stop_engine()
+        set_verb_observer(None)
+    pdf = pd.DataFrame(
+        {
+            "k": np.arange(50_000) % 64,
+            "v": np.random.default_rng(0).random(50_000),
+        }
+    )
+    e = JaxExecutionEngine({"fugue.tpu.tuning.path": str(tmp_path / "t.json")})
+    try:
+        df = e.to_df(pdf)
+        e.distinct(df).as_pandas()
+        roof = e.tuner.roofline.snapshot()
+        assert any(k.startswith("engine.distinct|") for k in roof), roof
+        for entry in roof.values():
+            assert entry["obs"] >= 1 and entry["best_bytes_s"] > 0
+        rpt = e.report()
+        assert "verb rooflines" in rpt and "engine.distinct" in rpt
+    finally:
+        e.stop_engine()
+        set_verb_observer(None)
+
+
+def test_observer_never_fires_with_tracing_disabled(tmp_path):
+    tr = get_tracer()
+    tr.disable()
+    calls = []
+    set_verb_observer(lambda name, wall, out: calls.append(name))
+    try:
+        e = JaxExecutionEngine({})
+        try:
+            e.to_df(pd.DataFrame({"a": [1, 2, 3]})).as_pandas()
+        finally:
+            e.stop_engine()
+        assert calls == []  # disabled tracing: the hook is never consulted
+    finally:
+        set_verb_observer(None)
+
+
+def test_concurrent_observe_is_consistent(tmp_path):
+    rec = RooflineRecorder(TunedStore(str(tmp_path / "t.json")))
+
+    def work():
+        for _ in range(200):
+            rec.observe("engine.take", "int", 1, wall_s=0.01, rows=10, nbytes=80)
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    (entry,) = rec.snapshot().values()
+    assert entry["obs"] == 800 and entry["rows"] == 8000

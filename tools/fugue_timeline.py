@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Render a cluster post-mortem timeline from a flight-recorder dir.
+
+ISSUE 18 tentpole, piece 2: every recovery-ladder event the dist/serve
+tiers take (lease steal, heartbeat expiry, re-dispatch, orphan
+invalidation, speculative twin, fleet failover, journal replay) lands as
+one typed JSON line in ``<events_dir>/<host>-<pid>.events.jsonl``
+(:mod:`fugue_tpu.obs.events`). This CLI merges every process's file and
+prints the human-readable timeline — the "what actually happened"
+reconstruction after a chaos run or a production incident::
+
+    python tools/fugue_timeline.py /tmp/events
+    python tools/fugue_timeline.py /tmp/events --trace 3f2a9c...   # one run
+    python tools/fugue_timeline.py /tmp/events --json              # raw records
+
+Exit codes: 0 = rendered, 2 = no events found (wrong dir, or
+``fugue.tpu.events.enabled`` was never on).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events_dir", help="the fugue.tpu.events.dir to read")
+    ap.add_argument(
+        "--trace",
+        default=None,
+        help="keep only one run's events (a 16-hex trace id; "
+        "trace-less records like chaos injections are kept)",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="print the merged raw records as JSON lines instead",
+    )
+    args = ap.parse_args(argv)
+
+    from fugue_tpu.obs.events import read_events, render_timeline
+
+    events = read_events(args.events_dir)
+    if args.trace is not None:
+        events = [e for e in events if e.get("trace") in (args.trace, None)]
+    if not events:
+        print(f"no events found under {args.events_dir}", file=sys.stderr)
+        return 2
+    if args.json:
+        for e in events:
+            print(json.dumps(e, sort_keys=True))
+        return 0
+    print(render_timeline(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
