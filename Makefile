@@ -1,4 +1,4 @@
-.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-exchange test-serve test-dist test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke timeline-smoke serve-smoke fleet-smoke dist-smoke bench bench-smoke dryrun native
+.PHONY: install test test-multihost test-resilience test-obs test-plan test-lowering test-cache test-delta test-shuffle test-exchange test-serve test-dist test-views test-analysis test-tuning lint-locks cache-clean trace-smoke telemetry-smoke timeline-smoke serve-smoke fleet-smoke dist-smoke view-smoke bench bench-smoke dryrun native
 
 # editable install so examples/notebooks import fugue_tpu without PYTHONPATH
 # (--no-build-isolation: the env is offline; the baked-in setuptools builds it)
@@ -19,6 +19,7 @@ test:
 	-@$(MAKE) --no-print-directory bench-smoke  || echo "WARNING: bench-smoke FAILED (non-blocking in 'make test'); run 'make bench-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory serve-smoke  || echo "WARNING: serve-smoke FAILED (non-blocking in 'make test'); run 'make serve-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory fleet-smoke  || echo "WARNING: fleet-smoke FAILED (non-blocking in 'make test'); run 'make fleet-smoke' to reproduce"
+	-@$(MAKE) --no-print-directory view-smoke   || echo "WARNING: view-smoke FAILED (non-blocking in 'make test'); run 'make view-smoke' to reproduce"
 	-@$(MAKE) --no-print-directory timeline-smoke || echo "WARNING: timeline-smoke FAILED (non-blocking in 'make test'); run 'make timeline-smoke' to reproduce"
 	@if [ "$$DIST_SMOKE_NONBLOCKING" = "1" ]; then \
 	  $(MAKE) --no-print-directory dist-smoke || echo "WARNING: dist-smoke FAILED (demoted by DIST_SMOKE_NONBLOCKING=1); run 'make dist-smoke' to reproduce"; \
@@ -174,6 +175,24 @@ test-dist:
 # fugue.tpu.dist.enabled=false kill-switch path)
 dist-smoke:
 	JAX_PLATFORMS=cpu python bench.py --dist-smoke
+
+# continuous-view suite (docs/views.md): registration WAL replay after a
+# SIGKILLed registrar, per-generation bit-identity, delta refusal
+# degrading to full recompute, watch-lease steal to a survivor replica,
+# unregister tombstones, freshness-SLO admission boost, typed-event
+# counter parity, and the fleet LRU pinning each view's latest generation
+test-views:
+	JAX_PLATFORMS=cpu python -m pytest tests/views -q -m "not slow"
+
+# continuous-view chaos gate (ISSUE 20 acceptance, exit 20): 2 replicas
+# share a store + journal; a view over a source grown one partition per
+# round for 5 rounds is maintained while the lease-holding replica is
+# SIGKILLed mid-refresh — the survivor steals the watch lease, every
+# generation publishes exactly once with correct as_of, the final result
+# is bit-identical to a cold cache-off oracle, and the delta path keeps
+# steady-state skip_fraction >= 0.9 (no silent full recomputes)
+view-smoke:
+	JAX_PLATFORMS=cpu python bench.py --view-smoke
 
 # wipe a result-cache directory's artifacts: make cache-clean CACHE_DIR=...
 # (defaults to $FUGUE_TPU_CACHE_DIR)

@@ -2776,6 +2776,295 @@ def _fleet_smoke() -> None:
 
 
 # ---------------------------------------------------------------------------
+# extra.views — the ISSUE 20 chaos gate (make view-smoke, exit 20)
+# ---------------------------------------------------------------------------
+
+
+def _view_factory_for(src: str, marker: str, sleep_s: float):
+    """The standing view's factory: load the watched parquet dir, signal
+    execution start (marker file), hold the run open long enough to
+    SIGKILL the maintaining replica mid-refresh, aggregate."""
+
+    def build():
+        import pandas as _pd
+
+        from fugue_tpu import FugueWorkflow
+        from fugue_tpu.column import col, functions as ff
+
+        def crawl(df: _pd.DataFrame) -> _pd.DataFrame:
+            with open(marker, "w") as f:
+                f.write("running")
+            time.sleep(sleep_s)
+            return df
+
+        dag = FugueWorkflow()
+        (
+            dag.load(src, fmt="parquet")
+            .transform(crawl, schema="*")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def _view_replica_main(root: str, idx: int, port_file: str) -> None:
+    """One views-enabled serve replica over the shared store: engine +
+    EngineServer + HTTP surface + heartbeat; parks until SIGKILLed or
+    terminated by the parent."""
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve import EngineServer
+
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            "fugue.tpu.cache.dir": os.path.join(root, "store"),
+            "fugue.tpu.serve.journal.dir": os.path.join(root, "journal"),
+            "fugue.tpu.serve.replica_id": f"r{idx}",
+            "fugue.tpu.serve.max_concurrent": 2,
+            # a dead replica's in-flight plan claim must be stealable well
+            # inside the smoke budget
+            "fugue.tpu.serve.fleet.lease_s": 2.0,
+            "fugue.tpu.views.enabled": True,
+            "fugue.tpu.views.poll_s": 0.2,
+            "fugue.tpu.views.lease_s": 2.0,
+            "fugue.tpu.dist.heartbeat.dir": os.path.join(root, "hb"),
+            "fugue.tpu.dist.heartbeat.interval_s": 0.2,
+            "fugue.tpu.dist.heartbeat.stale_after_s": 1.0,
+            "fugue.tpu.events.enabled": True,
+            "fugue.tpu.events.dir": os.path.join(root, "events"),
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    srv = EngineServer(eng).start()
+    rpc.bind_serve(srv)
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{rpc.host} {rpc.port}")
+    os.replace(tmp, port_file)
+    while True:  # the parent owns this process's lifetime
+        time.sleep(0.5)
+
+
+def _bench_views(rounds: int = 5, base_partitions: int = 16) -> Dict[str, Any]:
+    """Chaos proof for the continuous-view subsystem (docs/views.md):
+    2 views-enabled replicas over one store; a registered view's source
+    dir grows one partition per round for ``rounds`` rounds; the replica
+    holding the watch lease is SIGKILLed mid-refresh. Gates:
+
+    - the survivor steals the lease and keeps publishing (zero lost AND
+      zero duplicate generations: the event log's view.publish set is
+      exactly 1..N);
+    - every generation served with correct ``as_of`` (monotone across
+      generations, echoed on the wire);
+    - the final generation is bit-identical to a cold cache-off run over
+      the final source;
+    - steady-state delta skip_fraction >= 0.9 (appends never trigger a
+      full recompute).
+    """
+    import multiprocessing as _mp
+    import shutil as _shutil
+    import signal as _signal
+    import tempfile as _tempfile
+    import urllib.request as _urlreq
+
+    import pandas as _pd
+
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.serve import ServeHttpClient
+
+    root = _tempfile.mkdtemp(prefix="fugue_bench_views_")
+    src = os.path.join(root, "src")
+    marker = os.path.join(root, "refresh_marker")
+    os.makedirs(src)
+
+    def write_part(i: int) -> None:
+        _pd.DataFrame(
+            {
+                "k": [i % 8] * 32,
+                "v": [float((i * 31 + j) % 997) for j in range(32)],
+            }
+        ).to_parquet(os.path.join(src, f"part-{i:05d}.parquet"))
+
+    for i in range(base_partitions):
+        write_part(i)
+    factory = _view_factory_for(src, marker, 0.15)
+
+    ctx = _mp.get_context("fork")
+    procs = []
+    t0 = time.perf_counter()
+    try:
+        port_files = [os.path.join(root, f"port_{i}") for i in range(2)]
+        for i in range(2):
+            p = ctx.Process(target=_view_replica_main, args=(root, i, port_files[i]))
+            p.start()
+            procs.append(p)
+        clients = []
+        for pf in port_files:
+            deadline = time.monotonic() + 60
+            while not os.path.exists(pf):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("view replica never came up")
+                time.sleep(0.05)
+            host, port = open(pf).read().split()
+            clients.append(ServeHttpClient(host, int(port)))
+
+        clients[0].register_view("growing", factory, src, fmt="parquet")
+        res = clients[0].view("growing", timeout=60)
+        assert res["generation"] == 1, res
+        served = [(1, res["as_of"])]
+
+        killed_at_round = rounds // 2 + 1
+        victim = None
+        total = base_partitions
+        for rnd in range(1, rounds + 1):
+            if os.path.exists(marker):
+                os.remove(marker)
+            write_part(total)
+            total += 1
+            if rnd == killed_at_round:
+                # SIGKILL the maintaining replica once this round's
+                # refresh is provably in flight (the factory's marker)
+                holder = None
+                deadline = time.monotonic() + 30
+                while holder is None and time.monotonic() < deadline:
+                    holder = clients[0].views()["views"][0]["maintainer"]
+                    if holder is None:
+                        time.sleep(0.05)
+                assert holder is not None, "no lease holder to kill"
+                victim = int(holder[1:])  # "r0" -> 0
+                deadline = time.monotonic() + 60
+                while not os.path.exists(marker):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("refresh never started")
+                    time.sleep(0.02)
+                os.kill(procs[victim].pid, _signal.SIGKILL)
+                procs[victim].join(10)
+            # any live replica serves the view; wait out this generation
+            cli = clients[victim ^ 1] if victim is not None else clients[rnd % 2]
+            deadline = time.monotonic() + 120
+            while True:
+                res = cli.view("growing", timeout=120)
+                if res["generation"] >= rnd + 1:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"round {rnd}: stuck at generation {res['generation']}"
+                    )
+                time.sleep(0.1)
+            served.append((res["generation"], res["as_of"]))
+
+        survivor = clients[victim ^ 1]
+        final = survivor.view("growing", timeout=60)
+
+        # --- survivor health + stats over the wire
+        rz = survivor.readyz()
+        host, port = (
+            survivor._host,
+            survivor._port,
+        )
+        with _urlreq.urlopen(f"http://{host}:{port}/stats", timeout=10) as r:
+            views_stats = json.loads(r.read().decode())["engine"]["views"]
+
+        # --- the event-log audit: generations exactly once, the steal
+        # observed, steady-state refreshes delta-sized
+        from fugue_tpu.obs.events import read_events
+
+        events = read_events(os.path.join(root, "events"))
+        pubs = [e for e in events if e["type"] == "view.publish"]
+        gens = sorted(e["gen"] for e in pubs)
+        expected = list(range(1, rounds + 2))
+        zero_lost_or_dup = gens == expected
+        steals = [e for e in events if e["type"] == "view.lease.steal"]
+        stole = any(e.get("prev_owner") == f"r{victim}" for e in steals)
+        # last refresh per published generation: the one that landed
+        refresh_by_gen: Dict[int, Dict[str, Any]] = {}
+        for e in events:
+            if e["type"] == "view.refresh":
+                refresh_by_gen[e["gen"]] = e
+        steady = [refresh_by_gen[g] for g in expected if g > 1]
+        fresh = sum(e["fresh"] for e in steady)
+        tot = sum(e["total"] for e in steady)
+        skip_fraction = 1.0 - (fresh / tot) if tot else 0.0
+        all_delta = all(e["mode"] == "delta" for e in steady)
+
+        # --- as_of correctness: monotone nondecreasing as served, and
+        # the final served as_of is the last publish's observation time
+        as_of_monotone = all(
+            served[i][1] <= served[i + 1][1] for i in range(len(served) - 1)
+        )
+        as_of_correct = as_of_monotone and abs(
+            final["as_of"] - max(e["as_of"] for e in pubs)
+        ) < 1e-6
+
+        # --- bit-identity: the final generation vs a cold cache-off run
+        odag = factory()
+        odag.run(NativeExecutionEngine({"fugue.tpu.cache.enabled": False}))
+        want = (
+            odag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+        )
+        got = final["frames"]["r"].sort_values("k").reset_index(drop=True)
+        identical = got.equals(want)
+
+        correct = (
+            zero_lost_or_dup
+            and stole
+            and identical
+            and as_of_correct
+            and all_delta
+            and skip_fraction >= 0.9
+            and rz.get("views", {}).get("loop_alive") is True
+        )
+        return {
+            "rounds": rounds,
+            "victim": f"r{victim}",
+            "generations": gens,
+            "zero_lost_or_duplicate": zero_lost_or_dup,
+            "lease_stolen": stole,
+            "skip_fraction": round(skip_fraction, 4),
+            "all_steady_delta": all_delta,
+            "as_of_correct": as_of_correct,
+            "bit_identical": identical,
+            "survivor_views_stats": {
+                k: views_stats.get(k)
+                for k in (
+                    "generations_published",
+                    "lease_steals",
+                    "delta_refusals",
+                    "views_active",
+                )
+            },
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "correct": correct,
+        }
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(5)
+        _shutil.rmtree(root, ignore_errors=True)
+
+
+def _view_smoke() -> None:
+    """``make view-smoke``: the ISSUE 20 chaos gate — 2 views-enabled
+    replicas over one store, a source dir grown one partition per round,
+    the maintaining replica SIGKILLed mid-refresh. The survivor must
+    steal the watch lease and publish every generation exactly once
+    (event-log audit), every generation serves with correct ``as_of``,
+    the final result is bit-identical to a cold cache-off run, and the
+    steady-state delta skip_fraction stays >= 0.9. Exit 20 on any
+    violation (the next code after the 15/16/18/19 chaos gates)."""
+    case = _bench_views()
+    print(json.dumps({"metric": "views", "chaos": case}))
+    if not case["correct"]:
+        raise SystemExit(20)
+
+
+# ---------------------------------------------------------------------------
 # extra.dist_chaos — the ISSUE 14 chaos gate (make dist-smoke, exit 16)
 # ---------------------------------------------------------------------------
 
@@ -3926,6 +4215,102 @@ def _compare(baseline_path: str, current_path: Optional[str] = None) -> None:
         raise SystemExit(8)
 
 
+def _views_telemetry_leg() -> Dict[str, Any]:
+    """Views observability (ISSUE 20): a standing view registered on a
+    views-enabled replica must surface its ``fugue_tpu_views_*``
+    counters, a per-view ``fugue_tpu_resource_view_lag_s_*`` gauge, and
+    the ``/readyz`` watcher-loop health section — with the Prometheus
+    exposition staying valid throughout."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import urllib.request as _ur
+
+    import pandas as _pd
+
+    from fugue_tpu.execution import NativeExecutionEngine
+    from fugue_tpu.obs import get_sampler, validate_prometheus_text
+    from fugue_tpu.serve import EngineServer
+
+    root = _tempfile.mkdtemp(prefix="fugue_telemetry_views_")
+    src = os.path.join(root, "src")
+    os.makedirs(src)
+    _pd.DataFrame({"k": [0, 1, 0, 1], "v": [1.0, 2.0, 3.0, 4.0]}).to_parquet(
+        os.path.join(src, "part-00000.parquet")
+    )
+
+    def view_factory():
+        from fugue_tpu import FugueWorkflow
+        from fugue_tpu.column import col, functions as ff
+
+        dag = FugueWorkflow()
+        (
+            dag.load(src, fmt="parquet")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    eng = NativeExecutionEngine(
+        {
+            "fugue.rpc.server": "fugue_tpu.rpc.http.HttpRPCServer",
+            "fugue.tpu.cache.dir": os.path.join(root, "store"),
+            "fugue.tpu.serve.journal.dir": os.path.join(root, "journal"),
+            "fugue.tpu.serve.replica_id": "tv0",
+            "fugue.tpu.views.enabled": True,
+            "fugue.tpu.views.poll_s": 0.05,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    rpc = eng.rpc_server
+    rpc.start()
+    srv = EngineServer(eng).start()
+    rpc.bind_serve(srv)
+    try:
+        srv.views.register("lagview", view_factory, src, fmt="parquet")
+        deadline = time.monotonic() + 60
+        while srv.views.result("lagview") is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("view never published its first generation")
+            time.sleep(0.05)
+        get_sampler().sample_once()  # the per-view lag probe fires
+        with _ur.urlopen(
+            f"http://{rpc.host}:{rpc.port}/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        validate_prometheus_text(text)
+        for want in (
+            "fugue_tpu_views_views_active",
+            "fugue_tpu_views_refreshes",
+            "fugue_tpu_views_generations_published",
+            "fugue_tpu_views_partitions_fresh",
+            "fugue_tpu_views_delta_refusals",
+            "fugue_tpu_views_full_recomputes",
+            "fugue_tpu_views_max_staleness_s",
+            "fugue_tpu_resource_view_lag_s_lagview",
+        ):
+            assert want in text, f"{want} missing from /metrics exposition"
+        assert any(
+            ln.startswith("fugue_tpu_views_generations_published ")
+            and float(ln.split()[-1]) >= 1
+            for ln in text.splitlines()
+        ), "fugue_tpu_views_generations_published not live (expected >= 1)"
+        with _ur.urlopen(
+            f"http://{rpc.host}:{rpc.port}/readyz", timeout=5
+        ) as resp:
+            rz = json.loads(resp.read())
+        assert rz["views"]["loop_alive"] is True, rz
+        assert rz["views"]["maintaining"] == ["lagview"], rz
+        return {
+            "lag_gauge": "fugue_tpu_resource_view_lag_s_lagview",
+            "generation": srv.views.result("lagview")["generation"],
+        }
+    finally:
+        srv.stop()
+        rpc.stop()
+        _shutil.rmtree(root, ignore_errors=True)
+
+
 def _telemetry_smoke(out_dir: str) -> None:
     """``make telemetry-smoke``: the live-telemetry round-trip proof.
 
@@ -4150,10 +4535,16 @@ def _telemetry_smoke(out_dir: str) -> None:
         assert tsum["counters"] > 0, "no counter-track events in trace"
         for want in ("device_bytes", "overlap_fraction"):
             assert want in tsum["counter_names"], (want, tsum["counter_names"])
+        # continuous-view telemetry (ISSUE 20): its own views-enabled
+        # replica so the fugue_tpu_views_* family, the per-view lag
+        # gauge, and the /readyz watcher section are all proven live
+        views_leg = _views_telemetry_leg()
         print(
             json.dumps(
                 {
                     "metric": "telemetry_smoke",
+                    "views_lag_gauge": views_leg["lag_gauge"],
+                    "views_generation": views_leg["generation"],
                     "trace": path,
                     "inflight_scrapes": inflight["scrapes"],
                     "prom_samples": prom["samples"],
@@ -4558,6 +4949,9 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--dist-smoke":
         with _bench_lock():
             _dist_smoke()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--view-smoke":
+        with _bench_lock():
+            _view_smoke()
     elif len(sys.argv) > 1 and sys.argv[1] == "--telemetry-smoke":
         out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/fugue_telemetry_smoke"
         with _bench_lock():

@@ -41,6 +41,7 @@ MODULES = [
     ("fugue_tpu.parallel", "Mesh, distributed init, profiler"),
     ("fugue_tpu.rpc", "Worker-to-driver callbacks"),
     ("fugue_tpu.serve", "Multi-tenant engine server (admission, dedup, budgets)"),
+    ("fugue_tpu.views", "Continuous views (registry, watch leases, maintainer)"),
     ("fugue_tpu.dist", "Multi-host worker tier (leases, heartbeats, supervisor)"),
     ("fugue_tpu.obs", "Observability (tracer, cluster traces, flight recorder, metrics)"),
     ("fugue_tpu.tuning", "Adaptive tuning (learned settings, verb rooflines)"),

@@ -348,6 +348,36 @@ FUGUE_TPU_CONF_SERVE_JOURNAL_DIR = "fugue.tpu.serve.journal.dir"
 # semantics are provably unchanged (unfinished() parity). 0 disables.
 FUGUE_TPU_CONF_SERVE_JOURNAL_MAX_BYTES = "fugue.tpu.serve.journal.max_bytes"
 
+# --- continuous views (fugue_tpu/views, docs/views.md) ---
+# master kill-switch, default OFF: =false means no registration
+# endpoints (they 404), no watcher threads, and a serve wire contract /
+# span multiset bit-identical to the pre-views tiers. Turning it on
+# requires a shared store (fugue.tpu.cache.dir) — the registry, heads,
+# leases and generation payloads all live there so any replica can serve
+# while exactly one maintains.
+FUGUE_TPU_CONF_VIEWS_ENABLED = "fugue.tpu.views.enabled"
+# watcher loop interval in seconds: how often the maintainer re-observes
+# every watched source (and renews its watch leases)
+FUGUE_TPU_CONF_VIEWS_POLL_S = "fugue.tpu.views.poll_s"
+# per-view watch lease duration: a lease this old whose holder cannot be
+# proven alive (dist heartbeat / same-host pid probe) is stealable — the
+# exactly-one-maintainer guarantee under replica death
+FUGUE_TPU_CONF_VIEWS_LEASE_S = "fugue.tpu.views.lease_s"
+# published generations retained per view beyond the pinned latest one
+# (older generation payloads are deleted by the maintainer on publish)
+FUGUE_TPU_CONF_VIEWS_KEEP_GENERATIONS = "fugue.tpu.views.keep_generations"
+# how many priority points an SLO-at-risk refresh gains (priority is
+# min-wins, so the boost SUBTRACTS; floor 0)
+FUGUE_TPU_CONF_VIEWS_SLO_BOOST = "fugue.tpu.views.slo_boost"
+# fraction of a tenant's freshness_s after which a pending refresh counts
+# as at-risk and takes the boost (breach itself is at 1.0)
+FUGUE_TPU_CONF_VIEWS_SLO_RISK_FRACTION = "fugue.tpu.views.slo_risk_fraction"
+# registered views cap (bounds /metrics cardinality and registry scans)
+FUGUE_TPU_CONF_VIEWS_MAX = "fugue.tpu.views.max"
+# how long a maintainer waits for one refresh submission to finish before
+# counting it failed and retrying next tick
+FUGUE_TPU_CONF_VIEWS_REFRESH_TIMEOUT_S = "fugue.tpu.views.refresh_timeout_s"
+
 # --- multi-host worker tier (fugue_tpu/dist, docs/distributed.md) ---
 # master kill-switch: =false makes DistSupervisor.run_* execute the whole
 # job serially in THIS process (same functions, same bucket order) —

@@ -64,6 +64,13 @@ EVENT_TYPES = frozenset(
         "fleet.failover",  # FleetClient re-placed a submission elsewhere
         "serve.journal_replay",  # replica resubmitted journaled work on restart
         "chaos.inject",  # fault injected by a smoke/chaos harness
+        "view.register",  # standing view registered (continuous pipelines)
+        "view.unregister",  # standing view retired; lease released
+        "view.lease.acquire",  # replica became a view's maintainer
+        "view.lease.steal",  # maintenance moved off a dead/expired replica
+        "view.refresh",  # maintainer pushed fresh partitions through the queue
+        "view.publish",  # a new view generation reached the fleet store
+        "view.slo_breach",  # view staleness exceeded its tenant freshness SLO
     }
 )
 
@@ -262,6 +269,30 @@ _RENDER = {
     ),
     "chaos.inject": lambda r: (
         f"{r.get('fault', 'fault')} injected into {r.get('target')}"
+    ),
+    "view.register": lambda r: (
+        f"view {r.get('view')} registered by tenant {r.get('tenant')} "
+        f"on {r.get('source')}"
+    ),
+    "view.unregister": lambda r: f"view {r.get('view')} unregistered",
+    "view.lease.acquire": lambda r: (
+        f"view {r.get('view')} watch lease acquired by {r.get('owner')}"
+    ),
+    "view.lease.steal": lambda r: (
+        f"view {r.get('view')} watch lease stolen by {r.get('owner')} "
+        f"from {r.get('prev_owner')} ({r.get('reason')})"
+    ),
+    "view.refresh": lambda r: (
+        f"view {r.get('view')} refresh -> gen {r.get('gen')} "
+        f"({r.get('mode')}: {r.get('fresh')}/{r.get('total')} partition(s) fresh)"
+    ),
+    "view.publish": lambda r: (
+        f"view {r.get('view')} generation {r.get('gen')} published "
+        f"(as_of {r.get('as_of')})"
+    ),
+    "view.slo_breach": lambda r: (
+        f"view {r.get('view')} freshness SLO breached "
+        f"(lag {r.get('lag_s')}s > {r.get('slo_s')}s)"
     ),
 }
 

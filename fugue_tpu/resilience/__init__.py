@@ -20,6 +20,7 @@ from .fault import (
     SITE_SHUFFLE_SPILL,
     SITE_STREAM_CHUNK,
     SITE_TASK_EXECUTE,
+    SITE_VIEW_REGISTER,
     FaultInjector,
 )
 from .policy import (
@@ -49,6 +50,7 @@ __all__ = [
     "SITE_DIST_LEASE",
     "SITE_DIST_HEARTBEAT",
     "SITE_DIST_BOARD",
+    "SITE_VIEW_REGISTER",
     "RetryPolicy",
     "Deadline",
     "FailureCategory",

@@ -61,6 +61,7 @@ __all__ = [
     "SITE_DIST_LEASE",
     "SITE_DIST_HEARTBEAT",
     "SITE_DIST_BOARD",
+    "SITE_VIEW_REGISTER",
 ]
 
 SITE_MAP_DISPATCH = "map.dispatch"
@@ -108,6 +109,12 @@ SITE_DIST_HEARTBEAT = "dist.heartbeat"
 # orphaned-fragment-invalidation ladder must cover without losing or
 # double-counting a row
 SITE_DIST_BOARD = "dist.board"
+# inside ViewRegistry.register, between the WAL append and the spec's
+# atomic publish to the shared registry (fugue_tpu/views/registry.py) —
+# `error`/`kill` here leave a journaled-but-invisible registration: the
+# crash window a restarted replica's view replay must close by
+# re-publishing the spec from its own WAL
+SITE_VIEW_REGISTER = "view.register"
 
 FUGUE_TPU_FAULT_PLAN_ENV = "FUGUE_TPU_FAULT_PLAN"
 

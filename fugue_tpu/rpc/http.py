@@ -207,6 +207,11 @@ class HttpRPCServer(RPCServer):
       ``POST /serve/cancel`` — the remote session surface over a bound
       EngineServer (see docs/serving.md; idempotency keys make submit
       safe under the retry policy);
+    - ``POST /serve/register`` / ``POST /serve/unregister``,
+      ``GET /serve/views``, ``GET /serve/view?id=`` (plus ``DELETE``) —
+      the continuous-view surface (ISSUE 20, docs/views.md); all answer
+      a bare 404 when ``fugue.tpu.views.enabled`` is off, keeping the
+      disabled-mode wire contract identical;
     - ``GET /dist/fetch?path=<rel>`` — the worker tier's shuffle-fragment
       channel (ISSUE 14, docs/distributed.md): a bound
       :class:`~fugue_tpu.dist.DistWorker` serves files from its OWN data
@@ -326,6 +331,10 @@ class HttpRPCServer(RPCServer):
             return self._serve_poll(query)
         if path == "/serve/result":
             return self._serve_result(query)
+        if path == "/serve/views":
+            return self._serve_views()
+        if path == "/serve/view":
+            return self._serve_view(query)
         if path == "/dist/fetch":
             return self._dist_fetch(query)
         return None
@@ -387,6 +396,13 @@ class HttpRPCServer(RPCServer):
             "replica_id": st.get("replica_id"),
             "store": health,
         }
+        views = getattr(srv, "views", None)
+        if views is not None:
+            # watcher-loop health (ISSUE 20): a dead maintainer loop is a
+            # readiness fact — views it holds leases on go stale until
+            # another replica steals them. Only present when views are on,
+            # so the disabled-mode /readyz payload is unchanged.
+            payload["views"] = views.health()
         # 503 on full/unwritable: the shape a load balancer sheds on —
         # BEFORE the admission queue starts rejecting sessions outright
         code = 503 if (full or unwritable) else 200
@@ -459,11 +475,112 @@ class HttpRPCServer(RPCServer):
             body = (True, frames)
         except Exception as e:
             body = (False, e)
-        return (
+        made = (
             200,
             "application/octet-stream",
             base64.b64encode(cloudpickle.dumps(body)),
         )
+        # staleness metadata (ISSUE 20): only when the views subsystem is
+        # on — with it off the reply stays byte- and header-identical to
+        # the PR 13/16 wire contract
+        if self._views_service() is not None:
+            ex = sub._execution
+            if ex is not None and ex.finished_at is not None:
+                # finished_at is monotonic; rebase onto the wall clock
+                as_of = time.time() - (time.monotonic() - ex.finished_at)
+                made = made + (
+                    {
+                        "X-Fugue-As-Of": repr(round(as_of, 6)),
+                        "X-Fugue-Staleness-S": repr(
+                            round(max(0.0, time.time() - as_of), 6)
+                        ),
+                    },
+                )
+        return made
+
+    # -- continuous-view routes (ISSUE 20; see docs/views.md) ----------------
+    # Kill-switch contract: when ``fugue.tpu.views.enabled`` is off the
+    # server has no ViewService, every handler below returns None, and the
+    # caller answers a BARE 404 — byte-identical to an unknown route, so
+    # the PR 13/16 serve wire contract is unchanged with views disabled.
+    def _views_service(self) -> Any:
+        srv = self._serve_server()
+        return getattr(srv, "views", None) if srv is not None else None
+
+    def _serve_views(self) -> Any:
+        vs = self._views_service()
+        if vs is None:
+            return None
+        return 200, "application/json", json.dumps({"views": vs.list()}).encode()
+
+    def _serve_view(self, query: str) -> Any:
+        """One view's latest published generation: 202 + describe JSON
+        before the first publish, else the frames as b64 cloudpickle with
+        ``X-Fugue-As-Of`` / ``X-Fugue-Staleness-S`` / ``X-Fugue-Generation``
+        response headers carrying the staleness metadata."""
+        vs = self._views_service()
+        if vs is None:
+            return None
+        vid = self._query_id(query)
+        desc = vs.describe(vid) if vid else None
+        if desc is None:
+            return (
+                404,
+                "application/json",
+                json.dumps({"error": f"unknown view {vid!r}"}).encode(),
+            )
+        res = vs.result(vid)
+        if res is None:
+            # registered but nothing published yet — poll like /serve/result
+            return 202, "application/json", json.dumps(desc).encode()
+        headers = {
+            "X-Fugue-As-Of": repr(res["as_of"]),
+            "X-Fugue-Staleness-S": repr(res["staleness_s"]),
+            "X-Fugue-Generation": str(res["generation"]),
+        }
+        body = base64.b64encode(cloudpickle.dumps(res))
+        return 200, "application/octet-stream", body, headers
+
+    def _serve_register(self, raw: bytes) -> Any:
+        vs = self._views_service()
+        if vs is None:
+            return None
+        req = cloudpickle.loads(base64.b64decode(raw))
+        try:
+            desc = vs.register(
+                str(req["id"]),
+                req["factory"],
+                str(req["source"]),
+                fmt=str(req.get("format", "") or ""),
+                tenant=str(req.get("tenant", "default")),
+            )
+        except ValueError as e:
+            return 400, "application/json", json.dumps({"error": str(e)}).encode()
+        return 200, "application/json", json.dumps(desc).encode()
+
+    def _serve_unregister(self, raw: bytes) -> Any:
+        vs = self._views_service()
+        if vs is None:
+            return None
+        req = json.loads(raw.decode() or "{}")
+        return self._unregister_reply(vs, str(req.get("id", "")))
+
+    def _serve_view_delete(self, query: str) -> Any:
+        # DELETE /serve/view?id=<id> — same semantics as /serve/unregister
+        vs = self._views_service()
+        if vs is None:
+            return None
+        return self._unregister_reply(vs, self._query_id(query) or "")
+
+    @staticmethod
+    def _unregister_reply(vs: Any, vid: str) -> Any:
+        if not vid or not vs.unregister(vid):
+            return (
+                404,
+                "application/json",
+                json.dumps({"error": f"unknown view {vid!r}"}).encode(),
+            )
+        return 200, "application/json", json.dumps({"unregistered": vid}).encode()
 
     def _serve_submit(self, raw: bytes) -> Any:
         srv = self._serve_server()
@@ -530,10 +647,21 @@ class HttpRPCServer(RPCServer):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _reply(self, status: int, ctype: str, body: bytes) -> None:
+            def _reply(
+                self,
+                status: int,
+                ctype: str,
+                body: bytes,
+                headers: Any = None,
+            ) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # optional 4th tuple element from a route: extra response
+                # headers (views staleness metadata); routes that return
+                # 3-tuples are wire-identical to before the field existed
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -555,6 +683,18 @@ class HttpRPCServer(RPCServer):
                         if path == "/serve/cancel":
                             self._reply(*server._serve_cancel(raw))
                             return
+                        if path in ("/serve/register", "/serve/unregister"):
+                            made = (
+                                server._serve_register(raw)
+                                if path == "/serve/register"
+                                else server._serve_unregister(raw)
+                            )
+                            if made is None:  # views disabled: bare 404
+                                self.send_response(404)
+                                self.end_headers()
+                                return
+                            self._reply(*made)
+                            return
                         key, args, kwargs = cloudpickle.loads(
                             base64.b64decode(raw)
                         )
@@ -568,6 +708,26 @@ class HttpRPCServer(RPCServer):
                 except Exception:  # pragma: no cover - transport error
                     self.send_response(500)
                     self.end_headers()
+
+            def do_DELETE(self) -> None:  # noqa: N802 — view retirement
+                try:
+                    path, _, query = self.path.partition("?")
+                    made = (
+                        server._serve_view_delete(query)
+                        if path == "/serve/view"
+                        else None
+                    )
+                    if made is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self._reply(*made)
+                except Exception:
+                    try:
+                        self.send_response(500)
+                        self.end_headers()
+                    except Exception:
+                        pass
 
             def do_GET(self) -> None:  # noqa: N802 — telemetry/serve routes
                 try:

@@ -21,7 +21,14 @@ Over HTTP (the ``rpc/http.py`` surface)::
 
 from .client import ServeHttpClient, ServeWorkerLost
 from .dedup import submission_key
-from .fleet import FleetClient, FleetCoordinator, FleetResult, FleetSubmission
+from .fleet import (
+    FleetClient,
+    FleetCoordinator,
+    FleetResult,
+    FleetSubmission,
+    parse_view_result_name,
+    view_result_key,
+)
 from .journal import SubmissionJournal
 from .server import EngineServer, ServeRejected, Submission, SubmissionCanceled
 from .stats import ServeStats
@@ -42,6 +49,8 @@ __all__ = [
     "SubmissionJournal",
     "TenantAccounts",
     "TenantPolicy",
+    "parse_view_result_name",
     "submission_key",
     "tenant_policy",
+    "view_result_key",
 ]

@@ -211,6 +211,96 @@ class ServeHttpClient:
         )
         return self._json(status, ctype, data)
 
+    # -- continuous views (ISSUE 20; see docs/views.md) ----------------------
+    def register_view(
+        self,
+        view_id: str,
+        factory: Any,
+        source: str,
+        fmt: str = "",
+        tenant: str = "default",
+    ) -> Dict[str, Any]:
+        """Register a continuous view: ``factory`` is a zero-arg workflow
+        factory (same wire rule as :meth:`submit` — a BUILT dag may close
+        over local frames and is rejected server-side), ``source`` the
+        watched path. Raises ``ValueError`` on a 400 (bad id/factory),
+        ``KeyError`` on a 404 (views disabled on the replica).
+        Registration is idempotent server-side, so retries are safe."""
+        body = base64.b64encode(
+            cloudpickle.dumps(
+                {
+                    "id": view_id,
+                    "factory": factory,
+                    "source": source,
+                    "format": fmt,
+                    "tenant": tenant,
+                }
+            )
+        )
+        status, ctype, data = self._request(
+            "POST", "/serve/register", body, idempotent=True
+        )
+        if status == 404:
+            raise KeyError(
+                f"/serve/register answered 404 — views disabled on "
+                f"{self._host}:{self._port} (fugue.tpu.views.enabled)"
+            )
+        payload = self._json(status, ctype, data)
+        if status == 400:
+            raise ValueError(payload.get("error", "invalid view registration"))
+        if status != 200:
+            raise ConnectionError(f"/serve/register returned HTTP {status}")
+        return payload
+
+    def unregister_view(self, view_id: str) -> Dict[str, Any]:
+        status, ctype, data = self._request(
+            "POST", "/serve/unregister",
+            json.dumps({"id": view_id}).encode(),
+            idempotent=True,  # unregister is naturally idempotent
+        )
+        if status == 404 and not data:
+            raise KeyError(
+                f"/serve/unregister answered 404 — views disabled on "
+                f"{self._host}:{self._port}"
+            )
+        return self._json(status, ctype, data)
+
+    def views(self) -> Dict[str, Any]:
+        """``GET /serve/views`` — every registered view's describe dict."""
+        status, ctype, data = self._request("GET", "/serve/views", idempotent=True)
+        if status != 200:
+            raise ConnectionError(f"/serve/views returned HTTP {status}")
+        return self._json(status, ctype, data)
+
+    def view(
+        self,
+        view_id: str,
+        timeout: Optional[float] = None,
+        poll_interval: float = 0.05,
+    ) -> Dict[str, Any]:
+        """The view's latest published generation: ``{view, generation,
+        as_of, staleness_s, mode, frames, schemas}`` with ``frames`` as
+        ``{yield_name: pandas}``. 202 (registered, nothing published yet)
+        polls like :meth:`result` when ``timeout`` is set, else raises
+        ``TimeoutError`` immediately; 404 raises ``KeyError``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            status, ctype, data = self._request(
+                "GET", f"/serve/view?id={view_id}", idempotent=True
+            )
+            if status == 200 and ctype.startswith("application/octet-stream"):
+                return cloudpickle.loads(base64.b64decode(data))
+            if status == 404:
+                raise KeyError(f"unknown view {view_id!r} (or views disabled)")
+            if status != 202:
+                raise ConnectionError(f"/serve/view returned HTTP {status}")
+            if deadline is None or time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"view {view_id!r} has no published generation"
+                    + (f" after {timeout}s" if timeout is not None else "")
+                )
+            time.sleep(poll_interval)
+
     def readyz(self) -> Dict[str, Any]:
         status, ctype, data = self._request("GET", "/readyz", idempotent=True)
         return self._json(status, ctype, data)

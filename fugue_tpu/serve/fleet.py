@@ -44,7 +44,38 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..workflow._checkpoint import _atomic_publish, _best_effort_remove
 
-__all__ = ["FleetCoordinator", "FleetClient", "FleetSubmission", "FleetResult"]
+__all__ = [
+    "FleetCoordinator",
+    "FleetClient",
+    "FleetSubmission",
+    "FleetResult",
+    "view_result_key",
+    "parse_view_result_name",
+]
+
+# continuous views (docs/views.md) publish each generation under this
+# grammar; "--" is banned in view ids so the name parses unambiguously
+_VIEW_RESULT_PREFIX = "view--"
+_RESULT_SUFFIX = ".result.pkl"
+
+
+def view_result_key(view_id: str, generation: int) -> str:
+    """Fleet result-store key of one view generation."""
+    return f"{_VIEW_RESULT_PREFIX}{view_id}--g{int(generation):08d}"
+
+
+def parse_view_result_name(name: str) -> Optional[Tuple[str, int]]:
+    """``(view_id, generation)`` from a results-dir filename, or None
+    for an ordinary request-scoped result."""
+    if not name.startswith(_VIEW_RESULT_PREFIX) or not name.endswith(
+        _RESULT_SUFFIX
+    ):
+        return None
+    stem = name[len(_VIEW_RESULT_PREFIX): -len(_RESULT_SUFFIX)]
+    vid, sep, g = stem.rpartition("--g")
+    if not sep or not vid or not g.isdigit():
+        return None
+    return vid, int(g)
 
 
 class FleetResult:
@@ -145,20 +176,50 @@ class FleetCoordinator:
     def release(self, key: str) -> None:
         self.store.release_claim(key, self.replica_id)
 
+    def remove_result(self, key: str) -> bool:
+        """Delete one published payload (the views maintainer retires
+        superseded generations through this). True if a file went away."""
+        path = self._result_path(key)
+        existed = os.path.exists(path)
+        _best_effort_remove(path)
+        return existed and not os.path.exists(path)
+
     def _evict_results(self) -> None:
-        """mtime-LRU count cap, the ArtifactStore eviction discipline."""
+        """mtime-LRU count cap, the ArtifactStore eviction discipline.
+
+        Standing views are NOT request-scoped (ISSUE 20 small fix): the
+        latest generation per view is pinned — it must stay servable for
+        ``GET /serve/view/<id>`` until a newer generation supersedes it,
+        however much interactive traffic churns the LRU. Pinned files
+        are excluded from both the count and the eviction; superseded
+        generations age out like any request result (and the maintainer
+        retires them proactively)."""
         if self.max_results <= 0:
             return
         try:
             names = [
-                n for n in os.listdir(self.results_dir) if n.endswith(".result.pkl")
+                n for n in os.listdir(self.results_dir)
+                if n.endswith(_RESULT_SUFFIX)
             ]
         except OSError:
             return
-        if len(names) <= self.max_results:
+        latest_gen: Dict[str, int] = {}
+        for n in names:
+            parsed = parse_view_result_name(n)
+            if parsed is not None:
+                vid, gen = parsed
+                latest_gen[vid] = max(gen, latest_gen.get(vid, 0))
+        evictable = [
+            n for n in names
+            if (
+                (p := parse_view_result_name(n)) is None
+                or p[1] < latest_gen.get(p[0], 0)
+            )
+        ]
+        if len(evictable) <= self.max_results:
             return
         entries = []
-        for n in names:
+        for n in evictable:
             p = os.path.join(self.results_dir, n)
             try:
                 entries.append((os.stat(p).st_mtime, p))

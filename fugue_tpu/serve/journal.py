@@ -135,6 +135,48 @@ class SubmissionJournal:
     def done(self, sid: str, state: str) -> None:
         self._append({"op": "done", "sid": sid, "state": state})
 
+    # -- standing views (ISSUE 20) -------------------------------------------
+    # A view registration is durable state, not a one-shot submission: it
+    # journals BEFORE the spec becomes visible on the shared store, and
+    # unregistration writes the terminal ``done``. The sid carries the
+    # registration epoch (``view:<id>@<created_ts>``) so a
+    # register→unregister→re-register cycle never aliases: compaction is
+    # sid-based, and an aliased sid would let the old registration's
+    # ``done`` swallow the new registration's record. The submission
+    # replay path never sees these (``unfinished()`` filters on op ==
+    # "admit"); :meth:`view_unfinished` is the views-side replay reader.
+
+    @staticmethod
+    def view_sid(view_id: str, created_ts: float) -> str:
+        return f"view:{view_id}@{created_ts!r}"
+
+    def view_register(self, sid: str, payload: Dict[str, Any]) -> None:
+        """WAL a view registration (``payload`` is the wire-safe spec
+        dict, factory already base64 cloudpickle)."""
+        self._append(
+            {"op": "view_register", "sid": sid, "view": payload,
+             "ts": time.time()}
+        )
+
+    def view_unregister(self, sid: str) -> None:
+        self.done(sid, "unregistered")
+
+    def view_unfinished(self) -> List[Dict[str, Any]]:
+        """Registration records with no terminal ``done`` — what a
+        restarted replica re-publishes to the shared registry. Last
+        record per view id wins (a re-register after unregister)."""
+        done = set()
+        regs: Dict[str, Dict[str, Any]] = {}
+        for rec in self.read_records(self.path):
+            op = rec.get("op")
+            if op == "done":
+                done.add(rec.get("sid"))
+            elif op == "view_register" and rec.get("sid"):
+                vid = (rec.get("view") or {}).get("id")
+                if vid:
+                    regs[vid] = rec
+        return [r for r in regs.values() if r.get("sid") not in done]
+
     @property
     def appends(self) -> int:
         with self._lock:

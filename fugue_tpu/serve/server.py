@@ -57,8 +57,10 @@ from ..constants import (
     FUGUE_TPU_CONF_SERVE_RESERVE_BYTES,
     FUGUE_TPU_CONF_SERVE_RETAIN,
     FUGUE_TPU_CONF_TRACE_SPOOL_DIR,
+    FUGUE_TPU_CONF_VIEWS_ENABLED,
 )
 from ..resilience import SITE_SERVE_CLAIM, SITE_SERVE_JOURNAL, FaultInjector
+from ..workflow.factory import build_workflow, is_workflow_factory
 from .dedup import submission_key
 from .fleet import FleetCoordinator, FleetResult
 from .journal import SubmissionJournal
@@ -290,9 +292,26 @@ class EngineServer:
                 injector=self._injector,
                 log=engine.log,
             )
+        # continuous views (ISSUE 20, docs/views.md): default OFF, and
+        # even when on, inert without the shared store every piece of the
+        # subsystem (registry, leases, generation payloads) lives on
+        self._views: Optional[Any] = None
+        if bool(c.get(FUGUE_TPU_CONF_VIEWS_ENABLED, False)):
+            if self._fleet is None:
+                engine.log.warning(
+                    "views: fugue.tpu.views.enabled is on but no shared "
+                    "store is mounted (fugue.tpu.cache.dir, with the fleet "
+                    "enabled); continuous views stay off"
+                )
+            else:
+                from ..views import ViewService
+
+                self._views = ViewService(self)
         # serving counters ride the engine's unified registry (ISSUE 3
         # contract: engine.stats()["serve"], reset under keep-entries)
         engine.metrics.register("serve", self._stats)
+        if self._views is not None:
+            engine.metrics.register("views", self._views)
         if self._fleet is not None:
             # fleet rollup (ISSUE 18, metrics federation): the cross-
             # replica coordination counters as their own stats group —
@@ -318,6 +337,10 @@ class EngineServer:
         if self._heartbeat is not None:
             self._heartbeat.start()
         self._replay_journal()
+        if self._views is not None:
+            # after the submission replay: view registrations replay from
+            # the same WAL, then the watch loop starts ticking
+            self._views.start()
         return self
 
     def _replay_journal(self) -> None:
@@ -360,6 +383,10 @@ class EngineServer:
     def stop(self, timeout: Optional[float] = 30.0) -> None:
         """Stop admitting and drain: in-flight executions finish, still-
         queued ones fail their waiters with ``ServeRejected``."""
+        if self._views is not None:
+            # stop the watch loop first (it submits into the queue being
+            # drained below) and release its leases so a peer takes over
+            self._views.stop()
         with self._cv:
             if not self._running:
                 return
@@ -399,6 +426,13 @@ class EngineServer:
     @property
     def engine(self) -> Any:
         return self._engine
+
+    @property
+    def views(self) -> Optional[Any]:
+        """The continuous-view service, or None when
+        ``fugue.tpu.views.enabled`` is off (the kill-switch contract:
+        registration endpoints 404, no watcher threads)."""
+        return self._views
 
     @property
     def running(self) -> bool:
@@ -485,8 +519,8 @@ class EngineServer:
             # the journal records what was SUBMITTED: a factory pickles
             # (and replays fresh); a built dag is journaled best-effort
             raw_dag = dag
-            if callable(dag) and not hasattr(dag, "_tasks"):
-                dag = dag()
+            if is_workflow_factory(dag):
+                dag = build_workflow(dag)
             self._stats.inc("submitted")
             self._stats.inc_tenant(tenant, "submitted")
             if idempotency_key is not None:

@@ -8,6 +8,11 @@ Policy comes from the engine conf under ``fugue.tpu.serve.tenant.<id>.*``
   (reserves of in-flight submissions plus the measured result bytes of
   completed-but-unclaimed ones) plus the new submission's reserve must
   stay under it. 0 = unlimited.
+- ``freshness_s`` — the tenant's view-staleness SLO in seconds
+  (continuous views, ``docs/views.md``): a standing view whose pending
+  refresh has waited past the at-risk fraction of this budget gets a
+  priority boost in the admission queue; past the full budget a
+  ``view.slo_breach`` event is recorded. Unset / <= 0 = no SLO.
 - ``conf.<key>`` — a per-run conf overlay merged into every submitted
   workflow's compile conf. Any ``fugue.tpu.*`` key is accepted:
   ``workflow.run`` scopes workflow conf per run (the engine's
@@ -46,12 +51,14 @@ class TenantPolicy:
         budget_bytes: int = 0,
         conf_overlay: Optional[Dict[str, Any]] = None,
         dropped_keys: Tuple[str, ...] = (),
+        freshness_s: Optional[float] = None,
     ):
         self.tenant = tenant
         self.priority = priority
         self.budget_bytes = int(budget_bytes)
         self.conf_overlay = dict(conf_overlay or {})
         self.dropped_keys = tuple(dropped_keys)
+        self.freshness_s = None if freshness_s is None else float(freshness_s)
 
 
 def tenant_policy(conf: Any, tenant: str) -> TenantPolicy:
@@ -59,6 +66,7 @@ def tenant_policy(conf: Any, tenant: str) -> TenantPolicy:
     prefix = f"{FUGUE_TPU_CONF_SERVE_TENANT_PREFIX}{tenant}."
     priority: Optional[int] = None
     budget = 0
+    freshness: Optional[float] = None
     overlay: Dict[str, Any] = {}
     dropped = []
     try:
@@ -74,6 +82,8 @@ def tenant_policy(conf: Any, tenant: str) -> TenantPolicy:
             priority = int(v)
         elif sub == "budget_bytes":
             budget = int(v)
+        elif sub == "freshness_s":
+            freshness = float(v)
         elif sub.startswith("conf."):
             key = sub[len("conf."):]
             # any fugue.tpu.* key is safely per-run now that workflow.run
@@ -90,6 +100,7 @@ def tenant_policy(conf: Any, tenant: str) -> TenantPolicy:
         budget_bytes=budget,
         conf_overlay=overlay,
         dropped_keys=tuple(dropped),
+        freshness_s=freshness,
     )
 
 

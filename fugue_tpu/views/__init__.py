@@ -1,0 +1,39 @@
+"""Continuous views (ISSUE 20, docs/views.md): standing workflows with
+incremental view maintenance, served by the fleet.
+
+A tenant registers a workflow factory plus a watched source; the fleet
+journals the registration through the serve WAL, exactly one replica
+advances the view under a per-view watch lease (PR 14 claim + heartbeat
+primitive), fresh partitions ride the PR 9 delta path through the normal
+admission queue, and every replica serves the latest published
+generation with ``as_of``/staleness metadata. Default OFF
+(``fugue.tpu.views.enabled``).
+"""
+
+from .maintainer import ViewMaintainer, probe_name
+from .registry import ViewRegistry, ViewSpec
+from .service import ViewService
+from .stats import ViewStats
+from .watcher import (
+    FileSourceWatcher,
+    Observation,
+    SourceWatcher,
+    WatchError,
+    classify_tokens,
+    make_watcher,
+)
+
+__all__ = [
+    "ViewService",
+    "ViewRegistry",
+    "ViewSpec",
+    "ViewMaintainer",
+    "ViewStats",
+    "SourceWatcher",
+    "FileSourceWatcher",
+    "Observation",
+    "WatchError",
+    "classify_tokens",
+    "make_watcher",
+    "probe_name",
+]

@@ -1,6 +1,7 @@
 from .workflow import FugueWorkflow, FugueWorkflowResult, WorkflowDataFrame
 from .api import out_transform, raw_sql, transform
 from ._checkpoint import Checkpoint, StrongCheckpoint, WeakCheckpoint
+from .factory import build_workflow, is_workflow_factory, validate_view_factory
 from .module import module
 
 __all__ = [
@@ -14,4 +15,7 @@ __all__ = [
     "StrongCheckpoint",
     "WeakCheckpoint",
     "module",
+    "is_workflow_factory",
+    "build_workflow",
+    "validate_view_factory",
 ]

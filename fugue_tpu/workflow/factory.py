@@ -1,0 +1,79 @@
+"""Registration-safe workflow factories.
+
+A *workflow factory* is a zero-arg callable that builds and returns a
+fresh :class:`~fugue_tpu.workflow.workflow.FugueWorkflow` each call. It
+is the form that crosses process boundaries cleanly — a BUILT dag may
+close over live in-process frames, but a factory cloudpickles as code
+and rebuilds against whatever engine runs it. The serving tier has
+always accepted both forms on submit; continuous views (ISSUE 20,
+``docs/views.md``) make the factory form load-bearing: a registered
+view's factory is journaled in the WAL, re-hydrated after replica
+death, and re-invoked once per generation for the lifetime of the view,
+so it must be durable, rebuildable, and must actually yield something
+to publish. :func:`validate_view_factory` checks exactly that at
+registration time, turning "my view silently never refreshes" into an
+immediate 400.
+"""
+
+from typing import Any, Callable
+
+__all__ = [
+    "is_workflow_factory",
+    "build_workflow",
+    "validate_view_factory",
+]
+
+
+def is_workflow_factory(obj: Any) -> bool:
+    """True when ``obj`` is the factory form: callable and not a built
+    dag (a built :class:`FugueWorkflow` carries ``_tasks``)."""
+    return callable(obj) and not hasattr(obj, "_tasks")
+
+
+def build_workflow(obj: Any) -> Any:
+    """Return a runnable dag: invoke the factory form, pass a built dag
+    through unchanged."""
+    return obj() if is_workflow_factory(obj) else obj
+
+
+def validate_view_factory(factory: Callable[[], Any]) -> None:
+    """Registration gate for a standing view's factory: it must be a
+    zero-arg factory (not a built dag), must cloudpickle (it outlives
+    this process via the WAL), must build without error, and the built
+    workflow must yield at least one dataframe (a view with nothing to
+    publish is a misregistration, not a quiet no-op). Raises
+    ``ValueError`` with the specific reason."""
+    if not callable(factory):
+        raise ValueError("view factory is not callable")
+    if not is_workflow_factory(factory):
+        raise ValueError(
+            "view factory is a built workflow; register the zero-arg "
+            "factory so each generation rebuilds against the live source"
+        )
+    try:
+        import cloudpickle
+
+        cloudpickle.loads(cloudpickle.dumps(factory))
+    except Exception as ex:
+        raise ValueError(
+            f"view factory does not survive cloudpickle "
+            f"({type(ex).__name__}: {ex}); a standing view's factory is "
+            f"journaled and replayed across replica restarts"
+        ) from ex
+    try:
+        dag = factory()
+    except Exception as ex:
+        raise ValueError(
+            f"view factory raised while building its workflow "
+            f"({type(ex).__name__}: {ex})"
+        ) from ex
+    if not hasattr(dag, "_tasks"):
+        raise ValueError(
+            f"view factory returned {type(dag).__name__}, not a "
+            f"FugueWorkflow"
+        )
+    if not getattr(dag, "yields", None):
+        raise ValueError(
+            "view factory's workflow yields nothing — a view must "
+            "yield_dataframe_as(...) the frames it publishes"
+        )

@@ -1,0 +1,586 @@
+"""Continuous views (ISSUE 20, docs/views.md).
+
+Covers the full lifecycle on one and two replicas: multi-generation
+append loops bit-identical to a cold full run at EVERY generation, the
+delta-refusal degradation ladder (a mutated historical partition forces
+a full recompute — correct result, reason recorded — never silent
+staleness), WAL-journaled registration replay across a crash, the
+per-view watch-lease steal, unregister semantics, the freshness-SLO
+priority boost observable in the admission order, typed-event/counter
+parity (the timeline CLI reconstructs a view's history from the log
+alone), the fleet LRU pinning of each view's latest generation, and the
+``fugue.tpu.views.enabled`` kill-switch (default OFF: no service, no
+maintainer thread, no ``views`` stats group, no view.* events).
+
+Determinism idiom: ``fugue.tpu.views.poll_s`` is set huge so the
+maintainer thread parks after its initial (no-op) tick, and tests drive
+``maintainer.tick_once()`` synchronously.
+"""
+
+import os
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from fugue_tpu import FugueWorkflow
+from fugue_tpu.column import col, functions as ff
+from fugue_tpu.constants import (
+    FUGUE_TPU_CONF_CACHE_DIR,
+    FUGUE_TPU_CONF_EVENTS_DIR,
+    FUGUE_TPU_CONF_EVENTS_ENABLED,
+    FUGUE_TPU_CONF_FAULT_PLAN,
+    FUGUE_TPU_CONF_SERVE_JOURNAL_DIR,
+    FUGUE_TPU_CONF_SERVE_REPLICA_ID,
+    FUGUE_TPU_CONF_VIEWS_ENABLED,
+    FUGUE_TPU_CONF_VIEWS_LEASE_S,
+    FUGUE_TPU_CONF_VIEWS_POLL_S,
+)
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.resilience import InjectedFaultError
+from fugue_tpu.serve import EngineServer, parse_view_result_name, view_result_key
+
+
+def _write_part(src: str, i: int, rows: int = 8, scale: float = 1.0) -> None:
+    pd.DataFrame(
+        {
+            "k": [i % 4] * rows,
+            "v": [float(i * 10 + j) * scale for j in range(rows)],
+        }
+    ).to_parquet(os.path.join(src, f"part-{i:05d}.parquet"))
+
+
+def _factory(src: str):
+    def build() -> FugueWorkflow:
+        dag = FugueWorkflow()
+        (
+            dag.load(src, fmt="parquet")
+            .partition_by("k")
+            .aggregate(ff.sum(col("v")).alias("s"))
+            .yield_dataframe_as("r", as_local=True)
+        )
+        return dag
+
+    return build
+
+
+def _oracle(src: str) -> pd.DataFrame:
+    """Cold, cache-off full run over the source as it is RIGHT NOW."""
+    dag = _factory(src)()
+    dag.run(NativeExecutionEngine({"fugue.tpu.cache.enabled": False}))
+    return (
+        dag.yields["r"].result.as_pandas().sort_values("k").reset_index(drop=True)
+    )
+
+
+def _frames_of(res: dict) -> pd.DataFrame:
+    return res["frames"]["r"].sort_values("k").reset_index(drop=True)
+
+
+def _conf(store, jdir, rid, **extra):
+    conf = {
+        FUGUE_TPU_CONF_CACHE_DIR: str(store),
+        FUGUE_TPU_CONF_SERVE_JOURNAL_DIR: str(jdir),
+        FUGUE_TPU_CONF_SERVE_REPLICA_ID: rid,
+        FUGUE_TPU_CONF_VIEWS_ENABLED: True,
+        # park the loop after its initial (spec-less) tick; tests drive
+        # tick_once() synchronously for determinism
+        FUGUE_TPU_CONF_VIEWS_POLL_S: 3600.0,
+        "fugue.tpu.tuning.enabled": False,
+    }
+    conf.update(extra)
+    return conf
+
+
+@pytest.fixture()
+def src(tmp_path):
+    d = str(tmp_path / "src")
+    os.makedirs(d)
+    for i in range(2):
+        _write_part(d, i)
+    return d
+
+
+def _server(tmp_path, rid="A", **extra):
+    eng = NativeExecutionEngine(
+        _conf(tmp_path / "store", tmp_path / "journal", rid, **extra)
+    )
+    return EngineServer(eng).start()
+
+
+def test_multi_generation_append_bit_identical(tmp_path, src):
+    srv = _server(tmp_path)
+    try:
+        vs = srv.views
+        m = vs.maintainer
+        vs.register("agg", _factory(src), src, fmt="parquet", tenant="t1")
+        m.tick_once()
+        res = vs.result("agg")
+        assert res is not None and res["generation"] == 1
+        assert res["mode"] == "full"
+        assert _frames_of(res).equals(_oracle(src))
+        for i in range(2, 5):
+            _write_part(src, i)
+            m.tick_once()
+            res = vs.result("agg")
+            assert res["generation"] == i, res
+            assert res["mode"] == "delta"
+            assert res["staleness_s"] >= 0.0
+            # bit-identical to a cold cache-off run at EVERY generation
+            assert _frames_of(res).equals(_oracle(src))
+        st = srv.engine.stats()["views"]
+        assert st["generations_published"] == 4
+        assert st["delta_refusals"] == 0
+        # steady-state delta skipped everything but the appended file
+        assert st["steady_partitions_fresh"] == 3  # one per append tick
+        assert st["steady_partitions_total"] == 3 + 4 + 5
+        # describe carries the staleness metadata any replica can serve
+        d = vs.describe("agg")
+        assert d["generation"] == 4 and d["partitions"] == 5
+        assert d["staleness_s"] >= 0.0 and d["maintainer"] == "A"
+    finally:
+        srv.stop()
+
+
+def test_unchanged_source_publishes_nothing(tmp_path, src):
+    srv = _server(tmp_path)
+    try:
+        vs = srv.views
+        vs.register("agg", _factory(src), src, fmt="parquet")
+        vs.maintainer.tick_once()
+        vs.maintainer.tick_once()
+        vs.maintainer.tick_once()
+        st = vs.stats.as_dict()
+        assert st["refreshes"] == 1 and st["generations_published"] == 1
+    finally:
+        srv.stop()
+
+
+def test_delta_refusal_degrades_to_full_recompute(tmp_path, src):
+    """A mutated HISTORICAL partition is a delta refusal at steady state:
+    the generation is rebuilt from scratch (correct result, reason
+    recorded in the head and counted in stats) — never served stale."""
+    srv = _server(tmp_path)
+    try:
+        vs = srv.views
+        m = vs.maintainer
+        vs.register("agg", _factory(src), src, fmt="parquet")
+        m.tick_once()
+        _write_part(src, 2)
+        m.tick_once()
+        assert vs.result("agg")["mode"] == "delta"
+        # rewrite partition 0 in place with DIFFERENT content
+        _write_part(src, 0, rows=16, scale=3.0)
+        m.tick_once()
+        res = vs.result("agg")
+        assert res["generation"] == 3 and res["mode"] == "full"
+        assert _frames_of(res).equals(_oracle(src))
+        head = vs.registry.head("agg")
+        assert "rewrite" in (head.get("reason") or "")
+        st = srv.engine.stats()["views"]
+        assert st["delta_refusals"] == 1 and st["full_recomputes"] == 1
+    finally:
+        srv.stop()
+
+
+def test_registration_replays_from_wal_after_crash(tmp_path, src):
+    """The register crash window: the WAL record lands, then the replica
+    dies before the spec publishes. A restarted replica's replay closes
+    the window — the view exists and is maintained as if the crash never
+    happened."""
+    srv = _server(
+        tmp_path, **{FUGUE_TPU_CONF_FAULT_PLAN: "view.register=error@1"}
+    )
+    try:
+        with pytest.raises(InjectedFaultError):
+            srv.views.register("agg", _factory(src), src, fmt="parquet")
+        assert srv.views.registry.get("agg") is None  # spec never published
+    finally:
+        srv.stop()
+    # same journal dir + replica id, no fault plan: the restart
+    srv2 = _server(tmp_path)
+    try:
+        vs = srv2.views
+        spec = vs.registry.get("agg")
+        assert spec is not None and spec.tenant == "default"
+        vs.maintainer.tick_once()
+        res = vs.result("agg")
+        assert res["generation"] == 1
+        assert _frames_of(res).equals(_oracle(src))
+    finally:
+        srv2.stop()
+
+
+def test_lease_steal_moves_maintenance_to_survivor(tmp_path, src):
+    """Two replicas over one store: A maintains, wedges holding the
+    lease; B cannot advance the view until the lease expires, then
+    steals it and publishes the next generation."""
+    lease = {FUGUE_TPU_CONF_VIEWS_LEASE_S: 0.5}
+    a = _server(tmp_path, rid="A", **lease)
+    b = _server(tmp_path, rid="B", **lease)
+    try:
+        a.views.register("agg", _factory(src), src, fmt="parquet")
+        a.views.maintainer.tick_once()
+        assert a.views.result("agg")["generation"] == 1
+        assert a.views.maintainer.holder("agg") == "A"
+        # A wedges WITHOUT releasing (a SIGKILL's in-process analogue)
+        a.views.maintainer.halt_for_test()
+        _write_part(src, 2)
+        # B serves the view it does not maintain, but cannot advance it
+        # while A's lease is live
+        assert b.views.result("agg")["generation"] == 1
+        b.views.maintainer.tick_once()
+        assert b.views.result("agg")["generation"] == 1
+        time.sleep(0.7)  # A's lease expires
+        b.views.maintainer.tick_once()
+        res = b.views.result("agg")
+        assert res["generation"] == 2 and _frames_of(res).equals(_oracle(src))
+        assert b.views.maintainer.holder("agg") == "B"
+        st = b.engine.stats()["views"]
+        assert st["lease_steals"] == 1 and st["lease_acquires"] == 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_unregister_stops_maintenance_and_releases_everything(tmp_path, src):
+    srv = _server(tmp_path)
+    try:
+        vs = srv.views
+        m = vs.maintainer
+        vs.register("agg", _factory(src), src, fmt="parquet")
+        m.tick_once()
+        key = view_result_key("agg", 1)
+        assert vs._fleet.load_result(key) is not None
+        assert vs.unregister("agg") is True
+        assert vs.registry.get("agg") is None
+        assert vs.list() == [] and vs.result("agg") is None
+        # published generations are retired with the view
+        assert vs._fleet.load_result(key) is None
+        # the next tick drops the lease; the loop has nothing to maintain
+        m.tick_once()
+        assert m.holder("agg") is None
+        assert m.health()["maintaining"] == []
+        st = vs.stats.as_dict()
+        assert st["unregistered"] == 1
+        assert vs.unregister("agg") is False  # idempotent
+    finally:
+        srv.stop()
+    # the tombstone outlives the restart: A's own WAL record must not
+    # resurrect the view on replay
+    srv2 = _server(tmp_path)
+    try:
+        assert srv2.views.registry.get("agg") is None
+    finally:
+        srv2.stop()
+
+
+def test_reregister_after_unregister_is_a_fresh_view(tmp_path, src):
+    srv = _server(tmp_path)
+    try:
+        vs = srv.views
+        vs.register("agg", _factory(src), src, fmt="parquet")
+        vs.maintainer.tick_once()
+        assert vs.unregister("agg") is True
+        vs.register("agg", _factory(src), src, fmt="parquet")
+        assert vs.registry.get("agg") is not None
+        vs.maintainer.tick_once()
+        assert vs.result("agg")["generation"] == 1  # generations restart
+    finally:
+        srv.stop()
+    # replay keeps exactly the second registration
+    srv2 = _server(tmp_path)
+    try:
+        assert srv2.views.registry.get("agg") is not None
+    finally:
+        srv2.stop()
+
+
+def test_register_validation_and_caps(tmp_path, src):
+    srv = _server(tmp_path, **{"fugue.tpu.views.max": 1})
+    try:
+        vs = srv.views
+        with pytest.raises(ValueError, match="view id"):
+            vs.register("bad--id", _factory(src), src)
+        with pytest.raises(ValueError, match="factory"):
+            vs.register("built", _factory(src)(), src)  # a BUILT dag
+        with pytest.raises(ValueError, match="yield"):
+            vs.register("noyield", FugueWorkflow, src)
+        vs.register("agg", _factory(src), src, fmt="parquet", tenant="t1")
+        # idempotent re-register of the identical view is a no-op
+        vs.register("agg", _factory(src), src, fmt="parquet", tenant="t1")
+        assert len(vs.list()) == 1
+        # same id, conflicting source: rejected
+        with pytest.raises(ValueError, match="already registered"):
+            vs.register("agg", _factory(src), src + "x", fmt="parquet", tenant="t1")
+        with pytest.raises(ValueError, match="max"):
+            vs.register("two", _factory(src), src)
+    finally:
+        srv.stop()
+
+
+def test_slo_boost_observable_in_admission_order(tmp_path, src):
+    """A refresh whose lag puts the tenant's freshness SLO at risk is
+    boosted: with one worker busy, the boosted refresh and a plain
+    submission queue together and the refresh is PICKED first."""
+    srv = _server(
+        tmp_path,
+        **{
+            "fugue.tpu.serve.max_concurrent": 1,
+            "fugue.tpu.serve.aging_s": 1000.0,
+            "fugue.tpu.serve.tenant.slo.freshness_s": 1.0,
+            "fugue.tpu.views.refresh_timeout_s": 60.0,
+        },
+    )
+    try:
+        vs = srv.views
+        m = vs.maintainer
+        vs.register("agg", _factory(src), src, fmt="parquet", tenant="slo")
+        m.tick_once()
+        assert vs.result("agg")["generation"] == 1
+        _write_part(src, 2)
+        # a change observed long ago: the SLO is already breached
+        with m._lock:
+            m._pending_since["agg"] = time.time() - 100.0
+
+        marker = str(tmp_path / "blocker.marker")
+
+        def blocker_factory():
+            def crawl(df: pd.DataFrame) -> pd.DataFrame:
+                with open(marker, "w") as f:
+                    f.write("running")
+                time.sleep(0.8)
+                return df
+
+            dag = FugueWorkflow()
+            (
+                dag.df(pd.DataFrame({"k": [1], "v": [1.0]}))
+                .transform(crawl, schema="*")
+                .yield_dataframe_as("r", as_local=True)
+            )
+            return dag
+
+        blocker = srv.submit(blocker_factory, tenant="other")
+        deadline = time.monotonic() + 30
+        while not os.path.exists(marker) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert os.path.exists(marker)  # the single worker is now busy
+        t = threading.Thread(target=m.tick_once)  # blocks on the refresh
+        t.start()
+        deadline = time.monotonic() + 30
+        refresh_ex = None
+        while refresh_ex is None and time.monotonic() < deadline:
+            with srv._lock:
+                for ex in srv._queue:
+                    if ex.tenant == "slo":
+                        refresh_ex = ex
+            time.sleep(0.01)
+        assert refresh_ex is not None
+        # the boost is visible before anything runs: default priority 5
+        # minus fugue.tpu.views.slo_boost (2)
+        assert refresh_ex.priority == srv.default_priority - 2
+
+        def competitor_factory():  # a DIFFERENT plan: no single-flight dedup
+            dag = FugueWorkflow()
+            (
+                dag.df(pd.DataFrame({"k": [2], "v": [4.0]}))
+                .partition_by("k")
+                .aggregate(ff.sum(col("v")).alias("s"))
+                .yield_dataframe_as("r", as_local=True)
+            )
+            return dag
+
+        comp = srv.submit(competitor_factory, tenant="other")  # priority 5
+        t.join(60)
+        comp.result(timeout=60)
+        assert refresh_ex.started_at < comp._execution.started_at
+        res = vs.result("agg")
+        assert res["generation"] == 2 and _frames_of(res).equals(_oracle(src))
+        head = vs.registry.head("agg")
+        assert head["slo_boosted"] is True
+        st = srv.engine.stats()["views"]
+        assert st["slo_boosts"] >= 1 and st["slo_breaches"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_events_counter_parity_and_timeline(tmp_path, src, capsys):
+    """Counter-exact parity between the typed view.* events and the
+    stats counters, and the timeline CLI reconstructing one view's
+    history from the log alone."""
+    from fugue_tpu.obs.events import get_event_log, read_events
+
+    d = str(tmp_path / "events")
+    log = get_event_log()
+    lease = {
+        FUGUE_TPU_CONF_EVENTS_ENABLED: True,
+        FUGUE_TPU_CONF_EVENTS_DIR: d,
+        FUGUE_TPU_CONF_VIEWS_LEASE_S: 0.5,
+    }
+    try:
+        a = _server(tmp_path, rid="A", **lease)
+        b = _server(tmp_path, rid="B", **lease)
+        try:
+            a.views.register("agg", _factory(src), src, fmt="parquet")
+            a.views.maintainer.tick_once()
+            _write_part(src, 2)
+            a.views.maintainer.tick_once()
+            a.views.maintainer.halt_for_test()
+            _write_part(src, 3)
+            time.sleep(0.7)
+            b.views.maintainer.tick_once()  # the steal + generation 3
+            assert b.views.result("agg")["generation"] == 3
+            b.views.unregister("agg")
+            sa = a.views.stats.as_dict()
+            sb = b.views.stats.as_dict()
+        finally:
+            a.stop()
+            b.stop()
+        events = read_events(d)
+        by_type: dict = {}
+        for e in events:
+            if e["type"].startswith("view."):
+                by_type.setdefault(e["type"], []).append(e)
+        # counter-exact parity, fleet-wide (counters are per-replica)
+        assert len(by_type["view.register"]) == sa["registered"] + sb.get(
+            "registered", 0
+        )
+        assert len(by_type["view.lease.acquire"]) == sa["lease_acquires"]
+        assert len(by_type["view.lease.steal"]) == sb["lease_steals"]
+        assert len(by_type["view.refresh"]) == sa["refreshes"] + sb["refreshes"]
+        assert (
+            len(by_type["view.publish"])
+            == sa["generations_published"] + sb["generations_published"]
+        )
+        assert len(by_type["view.unregister"]) == sb["unregistered"]
+        # zero lost or duplicate generations, from the log alone
+        gens = sorted(e["gen"] for e in by_type["view.publish"])
+        assert gens == [1, 2, 3]
+        steal = by_type["view.lease.steal"][0]
+        assert steal["owner"] == "B" and steal["prev_owner"] == "A"
+        # the CLI reconstructs the view's history from the log alone
+        from tools.fugue_timeline import main as timeline_main
+
+        assert timeline_main([d, "--view", "agg"]) == 0
+        out = capsys.readouterr().out
+        for needle in (
+            "view agg registered",
+            "lease",
+            "refresh",
+            "publish",
+            "unregistered",
+        ):
+            assert needle.split()[0] in out or needle in out
+        assert timeline_main([d, "--view", "nosuch"]) == 2
+    finally:
+        log.configure(d, False)
+        log.close()
+
+
+def test_kill_switch_default_off(tmp_path, src):
+    """Views default OFF: no service object, no maintainer thread, no
+    ``views`` stats group, no view.* events — the serve surface is
+    exactly the pre-views one."""
+    from fugue_tpu.obs.events import get_event_log
+
+    eng = NativeExecutionEngine(
+        {
+            FUGUE_TPU_CONF_CACHE_DIR: str(tmp_path / "store"),
+            FUGUE_TPU_CONF_SERVE_JOURNAL_DIR: str(tmp_path / "journal"),
+            FUGUE_TPU_CONF_SERVE_REPLICA_ID: "A",
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    srv = EngineServer(eng).start()
+    try:
+        assert srv.views is None
+        assert "views" not in eng.stats()
+        assert "views" not in srv.stats()
+        assert not any(
+            t.name == "fugue-view-maintainer" for t in threading.enumerate()
+        )
+        emitted_before = get_event_log().as_dict()["emitted"]
+        srv.submit(_factory(src)).result(timeout=60)
+        assert get_event_log().as_dict()["emitted"] == emitted_before
+    finally:
+        srv.stop()
+
+
+def test_views_disabled_without_shared_store(tmp_path, src):
+    """views.enabled without a shared store (fleet) degrades to OFF with
+    a warning — there is nowhere to publish generations."""
+    eng = NativeExecutionEngine(
+        {
+            FUGUE_TPU_CONF_VIEWS_ENABLED: True,
+            "fugue.tpu.cache.enabled": False,
+            "fugue.tpu.tuning.enabled": False,
+        }
+    )
+    srv = EngineServer(eng).start()
+    try:
+        assert srv.views is None
+    finally:
+        srv.stop()
+
+
+def test_fleet_lru_pins_latest_generation_per_view(tmp_path):
+    """The ISSUE 20 small fix: request-scoped results age out of the
+    fleet's mtime-LRU, but each view's LATEST generation is pinned —
+    excluded from both the count and the eviction — while superseded
+    generations age out like any request result."""
+    from fugue_tpu.cache.store import ArtifactStore
+    from fugue_tpu.serve.fleet import FleetCoordinator
+
+    store = ArtifactStore(str(tmp_path / "store"), 0)
+    fleet = FleetCoordinator(store, "A", max_results=2)
+    frames = {"r": (pd.DataFrame({"x": [1]}), "x:long")}
+    old = time.time() - 1000
+    fleet.publish_result(view_result_key("agg", 1), frames)
+    fleet.publish_result(view_result_key("agg", 2), frames)
+    for p in (
+        fleet._result_path(view_result_key("agg", 1)),
+        fleet._result_path(view_result_key("agg", 2)),
+    ):
+        os.utime(p, (old, old))  # older than every request result
+    for i in range(4):
+        fleet.publish_result(f"req-{i}", frames)
+    # the latest generation survived arbitrarily many request publishes;
+    # the superseded one aged out of the LRU first (oldest mtime)
+    assert fleet.load_result(view_result_key("agg", 2)) is not None
+    assert fleet.load_result(view_result_key("agg", 1)) is None
+    names = os.listdir(fleet.results_dir)
+    assert sum(1 for n in names if parse_view_result_name(n) is None) == 2
+
+
+def test_view_result_key_roundtrip():
+    assert parse_view_result_name(
+        view_result_key("hourly_agg.v2", 7) + ".result.pkl"
+    ) == ("hourly_agg.v2", 7)
+    assert parse_view_result_name("abcdef.result.pkl") is None
+    assert parse_view_result_name("view--x--g0001.weird") is None
+
+
+def test_watcher_classification(tmp_path):
+    """classify_tokens: append vs rewrite vs unchanged, including the
+    appendable-format grown-tail rule (csv/json boundary file may grow
+    in place and still count as an append)."""
+    from fugue_tpu.views.watcher import classify_tokens
+
+    def tok(path, size, mtime):
+        return {"path": path, "size": size, "mtime_ns": mtime}
+
+    base = [tok("a", 10, 1), tok("b", 20, 2)]
+    assert classify_tokens(base, list(base), "parquet") == ("unchanged", 0)
+    grown = base + [tok("c", 5, 3)]
+    assert classify_tokens(base, grown, "parquet") == ("append", 1)
+    # historical partition mutated: rewrite, full recompute
+    mut = [tok("a", 11, 9), tok("b", 20, 2)]
+    assert classify_tokens(base, mut, "parquet")[0] == "rewrite"
+    # shrunk source: rewrite
+    assert classify_tokens(base, base[:1], "parquet")[0] == "rewrite"
+    # csv boundary file grown in place: still an append (tail re-read)
+    grown_tail = [tok("a", 10, 1), tok("b", 25, 9)]
+    assert classify_tokens(base, grown_tail, "csv") == ("append", 1)
+    # ...but for parquet that is a mutation: rewrite
+    assert classify_tokens(base, grown_tail, "parquet")[0] == "rewrite"

@@ -11,7 +11,14 @@ reconstruction after a chaos run or a production incident::
 
     python tools/fugue_timeline.py /tmp/events
     python tools/fugue_timeline.py /tmp/events --trace 3f2a9c...   # one run
+    python tools/fugue_timeline.py /tmp/events --view hourly_agg   # one view
     python tools/fugue_timeline.py /tmp/events --json              # raw records
+
+``--view`` reconstructs one continuous view's full history (ISSUE 20,
+docs/views.md) from the log alone: registration, every lease
+acquire/steal, every refresh with its delta/full mode and partition
+counts, every published generation with its priority, SLO breaches, and
+unregistration — the ``view.*`` event types.
 
 Exit codes: 0 = rendered, 2 = no events found (wrong dir, or
 ``fugue.tpu.events.enabled`` was never on).
@@ -35,6 +42,12 @@ def main(argv=None) -> int:
         "trace-less records like chaos injections are kept)",
     )
     ap.add_argument(
+        "--view",
+        default=None,
+        help="keep only one continuous view's history (the view id): "
+        "its view.* lifecycle events, reconstructed from the log alone",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="print the merged raw records as JSON lines instead",
@@ -46,6 +59,13 @@ def main(argv=None) -> int:
     events = read_events(args.events_dir)
     if args.trace is not None:
         events = [e for e in events if e.get("trace") in (args.trace, None)]
+    if args.view is not None:
+        events = [
+            e
+            for e in events
+            if e.get("type", "").startswith("view.")
+            and e.get("view") == args.view
+        ]
     if not events:
         print(f"no events found under {args.events_dir}", file=sys.stderr)
         return 2
